@@ -1,0 +1,37 @@
+"""ray_tpu: a TPU-native distributed AI runtime.
+
+A ground-up framework with the capability surface of the reference system
+(tasks, actors, objects, placement groups, Train/Tune/Data/Serve/RL
+libraries) redesigned for TPU clusters: JAX/XLA/Pallas on the compute path,
+ICI/DCN collectives instead of NCCL, and slice-aware gang scheduling.
+"""
+
+from ._version import __version__  # noqa: F401
+from . import exceptions  # noqa: F401
+from .api import (  # noqa: F401
+    available_resources,
+    cancel,
+    cluster_resources,
+    free,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    timeline,
+    wait,
+)
+from .actor import ActorClass, ActorHandle  # noqa: F401
+from .remote_function import RemoteFunction  # noqa: F401
+from .runtime.core import ObjectRef  # noqa: F401
+
+__all__ = [
+    "__version__", "init", "shutdown", "is_initialized", "remote", "get",
+    "put", "wait", "kill", "cancel", "free", "get_actor", "ObjectRef",
+    "ActorClass", "ActorHandle", "RemoteFunction", "cluster_resources",
+    "available_resources", "nodes", "timeline", "exceptions",
+]

@@ -1,0 +1,151 @@
+"""Actor API: ActorClass / ActorHandle / ActorMethod.
+
+Parity with the reference's actor layer (ref: python/ray/actor.py —
+ActorClass :745, ActorClass._remote :1035, ActorMethod._remote :416,
+ActorHandle :1417). Creation is scheduled by the controller (GCS-style,
+ref: gcs_actor_scheduler.cc:65); method calls go peer-to-peer to the actor's
+worker, never through the control plane.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .runtime import serialization
+from .runtime.core import get_core
+from .util.scheduling_strategies import resolve_strategy
+
+
+def _build_actor_resources(opts: Dict[str, Any]) -> Dict[str, float]:
+    resources = dict(opts.get("resources") or {})
+    num_cpus = opts.get("num_cpus")
+    num_tpus = opts.get("num_tpus", opts.get("num_gpus"))
+    # Like the reference, an actor holds no CPU while alive unless asked
+    # (actors default to num_cpus=0 for their lifetime).
+    if num_cpus:
+        resources["CPU"] = float(num_cpus)
+    if num_tpus:
+        resources["TPU"] = float(num_tpus)
+    if opts.get("memory"):
+        resources["memory"] = float(opts["memory"])
+    return resources
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, **opts) -> "ActorMethod":
+        return ActorMethod(self._handle, self._method_name,
+                           num_returns=opts.get("num_returns", self._num_returns))
+
+    def remote(self, *args, **kwargs):
+        core = get_core()
+        refs = core.submit_actor_task(
+            self._handle._actor_id, self._method_name, args, kwargs,
+            {"num_returns": self._num_returns})
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor method {self._method_name} cannot be called directly; "
+            f"use .{self._method_name}.remote()")
+
+
+def _rebuild_handle(actor_id: str):
+    return ActorHandle(actor_id)
+
+
+class ActorHandle:
+    def __init__(self, actor_id: str):
+        self._actor_id = actor_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    @property
+    def actor_id(self) -> str:
+        return self._actor_id
+
+    def _actor_method(self, name):
+        return ActorMethod(self, name)
+
+    def __reduce__(self):
+        return (_rebuild_handle, (self._actor_id,))
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id[:16]})"
+
+    def __hash__(self):
+        return hash(self._actor_id)
+
+    def __eq__(self, other):
+        return isinstance(other, ActorHandle) and other._actor_id == self._actor_id
+
+
+class ActorClass:
+    def __init__(self, cls, **options):
+        self._cls = cls
+        self._options = options
+        self._cls_key_cache: Dict[int, str] = {}
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor class {self._cls.__name__} cannot be instantiated "
+            f"directly; use {self._cls.__name__}.remote()")
+
+    def options(self, **new_options) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(new_options)
+        return ActorClass(self._cls, **merged)
+
+    def _export(self) -> str:
+        core = get_core()
+        key = self._cls_key_cache.get(id(core))
+        if key is None:
+            blob = serialization.dumps_inline(self._cls)
+            key = core.export_function(blob)
+            self._cls_key_cache = {id(core): key}
+        return key
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        core = get_core()
+        opts = dict(self._options)
+        namespace = opts.get("namespace")
+        if namespace is None:
+            namespace = getattr(core, "namespace", "")
+        spec_opts = {
+            "name": opts.get("name"),
+            "namespace": namespace,
+            "get_if_exists": opts.get("get_if_exists", False),
+            "resources": _build_actor_resources(opts),
+            "max_restarts": opts.get("max_restarts", 0),
+            "max_concurrency": opts.get("max_concurrency", 1),
+        }
+        spec_opts.update(resolve_strategy(opts.get("scheduling_strategy")))
+        actor_id = core.create_actor(
+            self._export(), self._cls.__name__, args, kwargs, spec_opts)
+        return ActorHandle(actor_id)
+
+    @property
+    def underlying_class(self):
+        return self._cls
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    """Look up a named actor (ref: python/ray/_private/worker.py get_actor)."""
+    core = get_core()
+    if namespace is None:
+        namespace = getattr(core, "namespace", "")
+    info = core.controller.call("get_actor", name=name, namespace=namespace)
+    if info is None or info["state"] == "DEAD":
+        raise ValueError(f"no live actor named {name!r} in namespace "
+                         f"{namespace!r}")
+    return ActorHandle(info["actor_id"])

@@ -1,0 +1,106 @@
+"""Top-level public API.
+
+Parity with the reference's python/ray/_private/worker.py public surface:
+init :1333, shutdown :1973, get :2740, put :2894, wait :2959, remote :3347,
+kill, cancel, get_actor.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+from . import exceptions
+from .actor import ActorHandle, get_actor  # noqa: F401  (re-exported)
+from .remote_function import remote_decorator
+from .runtime import node as _node
+from .runtime.core import ObjectRef, get_core
+
+
+def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
+         num_tpus: Optional[int] = None, resources: Optional[dict] = None,
+         labels: Optional[dict] = None, namespace: str = "",
+         ignore_reinit_error: bool = False, **kwargs) -> "_node.Session":
+    """Start (or connect to) a cluster session."""
+    if _node.current_session() is not None:
+        if ignore_reinit_error:
+            return _node.current_session()
+        raise RuntimeError("ray_tpu.init() called twice; "
+                           "pass ignore_reinit_error=True to allow")
+    session = _node.Session(address=address, num_cpus=num_cpus,
+                            num_tpus=num_tpus, resources=resources,
+                            labels=labels, namespace=namespace)
+    _node.set_session(session)
+    return session
+
+
+def shutdown() -> None:
+    session = _node.current_session()
+    if session is not None:
+        _node.set_session(None)
+        session.shutdown()
+
+
+def is_initialized() -> bool:
+    return _node.current_session() is not None
+
+
+remote = remote_decorator
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None) -> Any:
+    return get_core().get(refs, timeout=timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    return get_core().put(value)
+
+
+def wait(refs: List[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    return get_core().wait(refs, num_returns=num_returns, timeout=timeout,
+                           fetch_local=fetch_local)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    get_core().kill_actor(actor.actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    get_core().cancel(ref, force=force)
+
+
+def free(refs: Union[ObjectRef, List[ObjectRef]]) -> None:
+    if isinstance(refs, ObjectRef):
+        refs = [refs]
+    get_core().free(refs)
+
+
+def cluster_resources() -> dict:
+    nodes = get_core().controller.call("list_nodes")
+    total: dict = {}
+    for info in nodes.values():
+        for k, v in info["resources"].items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def available_resources() -> dict:
+    nodes = get_core().controller.call("list_nodes")
+    total: dict = {}
+    for info in nodes.values():
+        for k, v in info["available_resources"].items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def nodes() -> list:
+    return list(get_core().controller.call("list_nodes").values())
+
+
+def timeline() -> list:
+    """Task state events for chrome-tracing-style dumps (ref:
+    python/ray/_private/state.py:438 chrome_tracing_dump)."""
+    core = get_core()
+    core.flush_events()
+    return core.controller.call("list_task_events")

@@ -1,0 +1,93 @@
+"""User-facing exception types.
+
+Parity with the reference's python/ray/exceptions.py (RayError hierarchy:
+RayTaskError, RayActorError, GetTimeoutError, ObjectLostError, ...).
+"""
+
+from __future__ import annotations
+
+
+class RtpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RtpuError):
+    """A task raised an exception during execution.
+
+    Wraps the remote traceback; re-raised at `get()` like the reference's
+    RayTaskError (ref: python/ray/exceptions.py).
+    """
+
+    def __init__(self, cause_cls_name: str, cause_repr: str, traceback_str: str,
+                 task_desc: str = ""):
+        self.cause_cls_name = cause_cls_name
+        self.cause_repr = cause_repr
+        self.traceback_str = traceback_str
+        self.task_desc = task_desc
+        super().__init__(
+            f"{task_desc or 'task'} failed with {cause_cls_name}: {cause_repr}\n"
+            f"--- remote traceback ---\n{traceback_str}"
+        )
+
+    def __reduce__(self):
+        return (TaskError, (self.cause_cls_name, self.cause_repr,
+                            self.traceback_str, self.task_desc))
+
+
+class ActorError(RtpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    def __init__(self, actor_id_hex: str, reason: str = "actor died"):
+        self.actor_id_hex = actor_id_hex
+        self.reason = reason
+        super().__init__(f"Actor {actor_id_hex} is dead: {reason}")
+
+    def __reduce__(self):
+        return (ActorDiedError, (self.actor_id_hex, self.reason))
+
+
+class ActorUnavailableError(ActorError):
+    pass
+
+
+class GetTimeoutError(RtpuError, TimeoutError):
+    pass
+
+
+class ObjectLostError(RtpuError):
+    def __init__(self, object_id_hex: str, reason: str = "object lost"):
+        self.object_id_hex = object_id_hex
+        self.reason = reason
+        super().__init__(f"Object {object_id_hex} unavailable: {reason}")
+
+    def __reduce__(self):
+        return (ObjectLostError, (self.object_id_hex, self.reason))
+
+
+class ObjectStoreFullError(RtpuError):
+    pass
+
+
+class WorkerCrashedError(RtpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RtpuError):
+    pass
+
+
+class TaskCancelledError(RtpuError):
+    pass
+
+
+class PlacementGroupSchedulingError(RtpuError):
+    pass
+
+
+# Aliases matching the reference's public names so migrating users can catch
+# familiar exception types.
+RayError = RtpuError
+RayTaskError = TaskError
+RayActorError = ActorDiedError

@@ -1,0 +1,88 @@
+"""@remote functions.
+
+Parity with the reference's RemoteFunction (ref: python/ray/
+remote_function.py:41; submission path `_remote` :308, core submit :484).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from .runtime import serialization
+from .runtime.core import get_core
+from .util.scheduling_strategies import resolve_strategy
+
+
+def _build_resources(opts: Dict[str, Any]) -> Dict[str, float]:
+    resources = dict(opts.get("resources") or {})
+    num_cpus = opts.get("num_cpus")
+    num_tpus = opts.get("num_tpus", opts.get("num_gpus"))
+    resources["CPU"] = float(1 if num_cpus is None else num_cpus)
+    if num_tpus:
+        resources["TPU"] = float(num_tpus)
+    if opts.get("memory"):
+        resources["memory"] = float(opts["memory"])
+    return resources
+
+
+class RemoteFunction:
+    def __init__(self, fn, **options):
+        self._fn = fn
+        self._options = options
+        self._fn_key_cache: Dict[int, str] = {}  # id(core) -> exported key
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._fn.__name__} cannot be called directly; "
+            f"use {self._fn.__name__}.remote()")
+
+    def options(self, **new_options) -> "RemoteFunction":
+        merged = dict(self._options)
+        merged.update(new_options)
+        return RemoteFunction(self._fn, **merged)
+
+    def _export(self) -> str:
+        core = get_core()
+        key = self._fn_key_cache.get(id(core))
+        if key is None:
+            blob = serialization.dumps_inline(self._fn)
+            key = core.export_function(blob)
+            self._fn_key_cache = {id(core): key}
+        return key
+
+    def remote(self, *args, **kwargs):
+        core = get_core()
+        opts = dict(self._options)
+        spec_opts = {
+            "num_returns": opts.get("num_returns", 1),
+            "resources": _build_resources(opts),
+            "max_retries": opts.get("max_retries", 3),
+            "retry_exceptions": opts.get("retry_exceptions", False),
+            "name": opts.get("name") or self._fn.__name__,
+        }
+        spec_opts.update(resolve_strategy(opts.get("scheduling_strategy")))
+        refs = core.submit_task(self._export(), args, kwargs, spec_opts)
+        if spec_opts["num_returns"] == 1:
+            return refs[0]
+        return refs
+
+    @property
+    def underlying_function(self):
+        return self._fn
+
+
+def remote_decorator(*args, **options):
+    """Implements @remote / @remote(**options) for functions and classes."""
+    from .actor import ActorClass
+    import inspect
+
+    def wrap(target):
+        if inspect.isclass(target):
+            return ActorClass(target, **options)
+        return RemoteFunction(target, **options)
+
+    if len(args) == 1 and callable(args[0]) and not options:
+        return wrap(args[0])
+    return wrap

@@ -1,0 +1,108 @@
+"""Runtime configuration flags.
+
+Equivalent of the reference's RAY_CONFIG X-macro flag system
+(ref: src/ray/common/ray_config_def.h, 226 flags; env override parsing at
+src/ray/common/ray_config.h:104). Every field can be overridden per-process
+with an ``RTPU_<name>`` environment variable; `from_env()` performs the same
+getenv sweep the reference does at static-init time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+
+
+def _coerce(value: str, typ):
+    if typ is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(value)
+    if typ is float:
+        return float(value)
+    if typ is dict or typ is list:
+        return json.loads(value)
+    return value
+
+
+@dataclass
+class RuntimeConfig:
+    # --- RPC / control plane ---
+    rpc_connect_timeout_s: float = 10.0
+    rpc_call_timeout_s: float = 0.0  # 0 = no timeout
+    # Probabilistic RPC fault injection, modeled on the reference's chaos hook
+    # "RAY_testing_rpc_failure" (ref: src/ray/rpc/rpc_chaos.cc:30-49,
+    # ray_config_def.h:873). Format: "Method=max_failures:req_prob:resp_prob".
+    testing_rpc_failure: str = ""
+
+    # --- health / liveness (ref: gcs_health_check_manager.cc cadence flags
+    # ray_config_def.h:879-885) ---
+    heartbeat_interval_s: float = 1.0
+    node_death_timeout_s: float = 10.0
+
+    # --- workers / scheduling ---
+    worker_idle_timeout_s: float = 60.0
+    worker_start_timeout_s: float = 60.0
+    prestart_workers: int = 0
+    max_tasks_in_flight_per_worker: int = 1
+    # Lease/dispatch pipelining cap, modeled on
+    # ClusterSizeBasedLeaseRequestRateLimiter (ref: core_worker.h:1962).
+    max_pending_lease_requests: int = 10
+
+    # --- objects ---
+    # Results smaller than this are returned inline to the owner's in-process
+    # memory store instead of the shared-memory store (the reference inlines
+    # small returns the same way; ref: core_worker.cc ExecuteTask return path).
+    max_direct_call_object_size: int = 100 * 1024
+    object_store_memory: int = 0  # 0 = auto (fraction of shm)
+    object_store_fraction: float = 0.3
+    object_spill_dir: str = ""  # "" = <session>/spill
+
+    # --- task execution ---
+    task_retry_delay_s: float = 0.1
+    default_max_retries: int = 3
+
+    # --- observability ---
+    enable_timeline: bool = True
+    event_buffer_size: int = 10000
+    metrics_report_interval_s: float = 5.0
+
+    # --- logging ---
+    log_to_driver: bool = True
+
+    @classmethod
+    def from_env(cls) -> "RuntimeConfig":
+        cfg = cls()
+        for f in dataclasses.fields(cls):
+            env_key = f"RTPU_{f.name}"
+            if env_key in os.environ:
+                setattr(cfg, f.name, _coerce(os.environ[env_key], f.type if isinstance(f.type, type) else type(getattr(cfg, f.name))))
+        return cfg
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d):
+        cfg = cls()
+        for k, v in d.items():
+            if hasattr(cfg, k):
+                setattr(cfg, k, v)
+        return cfg
+
+
+_global_config: RuntimeConfig | None = None
+
+
+def get_config() -> RuntimeConfig:
+    global _global_config
+    if _global_config is None:
+        _global_config = RuntimeConfig.from_env()
+    return _global_config
+
+
+def set_config(cfg: RuntimeConfig) -> None:
+    global _global_config
+    _global_config = cfg

@@ -1,0 +1,532 @@
+"""Cluster controller — the control plane.
+
+Equivalent of the reference's GCS server (ref: src/ray/gcs/gcs_server/
+gcs_server.h:90) collapsed into one asyncio component: node table + health
+(GcsNodeManager / GcsHealthCheckManager), actor table + scheduling
+(GcsActorManager gcs_actor_manager.cc:396,:508; GcsActorScheduler
+gcs_actor_scheduler.cc:54 ScheduleByGcs), internal KV + function store
+(gcs_kv_manager.cc, GcsFunctionManager), pubsub (src/ray/pubsub/
+publisher.h:300 — but push over persistent sockets instead of long-poll),
+placement groups with two-phase reserve/commit (gcs_placement_group_mgr.cc,
+gcs_placement_group_scheduler.cc), job table (GcsJobManager), and a task
+event sink (GcsTaskManager, gcs_task_manager.cc) backing the state API.
+
+Unlike the reference it can run *in-process* with the driver for single-host
+sessions (zero extra processes on the control path) or standalone via
+``python -m ray_tpu.runtime.controller`` for multi-node clusters.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import time
+from typing import Any, Dict, List, Optional
+
+from . import scheduling
+from .ids import ActorID, NodeID, PlacementGroupID
+from .rpc import RpcClient, RpcServer, ServerConn
+
+
+class NodeInfo:
+    def __init__(self, node_id: str, address: str, resources: Dict[str, float],
+                 labels: Dict[str, str]):
+        self.node_id = node_id
+        self.address = address
+        self.total_resources = dict(resources)
+        self.available_resources = dict(resources)
+        self.labels = dict(labels)
+        self.alive = True
+        self.last_heartbeat = time.monotonic()
+        self.client: Optional[RpcClient] = None
+
+    def snapshot(self):
+        return {
+            "node_id": self.node_id,
+            "address": self.address,
+            "resources": self.total_resources,
+            "available_resources": self.available_resources,
+            "labels": self.labels,
+            "alive": self.alive,
+        }
+
+
+ACTOR_PENDING = "PENDING_CREATION"
+ACTOR_ALIVE = "ALIVE"
+ACTOR_RESTARTING = "RESTARTING"
+ACTOR_DEAD = "DEAD"
+
+
+class ActorInfo:
+    def __init__(self, actor_id: str, spec: Dict[str, Any]):
+        self.actor_id = actor_id
+        self.spec = spec
+        self.state = ACTOR_PENDING
+        self.address: Optional[str] = None
+        self.node_id: Optional[str] = None
+        self.worker_id: Optional[str] = None
+        self.num_restarts = 0
+        self.death_cause: Optional[str] = None
+
+    def snapshot(self):
+        return {
+            "actor_id": self.actor_id,
+            "name": self.spec.get("name"),
+            "namespace": self.spec.get("namespace", ""),
+            "class_name": self.spec.get("class_name", ""),
+            "state": self.state,
+            "address": self.address,
+            "node_id": self.node_id,
+            "num_restarts": self.num_restarts,
+            "death_cause": self.death_cause,
+            "resources": self.spec.get("resources", {}),
+        }
+
+
+class Controller:
+    def __init__(self, session_name: str, address: str):
+        self.session_name = session_name
+        self.address = address
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.actors: Dict[str, ActorInfo] = {}
+        self.named_actors: Dict[tuple, str] = {}  # (namespace, name) -> actor_id
+        self.kv: Dict[str, Dict[str, bytes]] = collections.defaultdict(dict)
+        self.subscribers: Dict[str, List[ServerConn]] = collections.defaultdict(list)
+        self.placement_groups: Dict[str, Dict[str, Any]] = {}
+        self.jobs: Dict[str, Dict[str, Any]] = {}
+        self.task_events: collections.deque = collections.deque(maxlen=100000)
+        self.metrics: Dict[str, Any] = {}
+        self._server = RpcServer(address, self._handlers(), on_disconnect=self._on_disconnect)
+        self._health_task: Optional[asyncio.Task] = None
+        self.start_time = time.time()
+
+    def _handlers(self):
+        return {
+            # nodes
+            "register_node": self.register_node,
+            "heartbeat": self.heartbeat,
+            "list_nodes": self.list_nodes,
+            "drain_node": self.drain_node,
+            # kv
+            "kv_put": self.kv_put,
+            "kv_get": self.kv_get,
+            "kv_del": self.kv_del,
+            "kv_keys": self.kv_keys,
+            "kv_exists": self.kv_exists,
+            # actors
+            "register_actor": self.register_actor,
+            "actor_ready": self.actor_ready,
+            "actor_died": self.actor_died,
+            "get_actor": self.get_actor,
+            "list_actors": self.list_actors,
+            "kill_actor": self.kill_actor,
+            # scheduling
+            "pick_node": self.pick_node,
+            "report_backlog": self.report_backlog,
+            # placement groups
+            "create_placement_group": self.create_placement_group,
+            "remove_placement_group": self.remove_placement_group,
+            "get_placement_group": self.get_placement_group,
+            "list_placement_groups": self.list_placement_groups,
+            # pubsub
+            "subscribe": self.subscribe,
+            "publish": self.publish,
+            # jobs
+            "register_job": self.register_job,
+            "mark_job_finished": self.mark_job_finished,
+            "list_jobs": self.list_jobs,
+            # observability
+            "add_task_events": self.add_task_events,
+            "list_task_events": self.list_task_events,
+            "report_metrics": self.report_metrics,
+            "get_metrics": self.get_metrics,
+            "cluster_status": self.cluster_status,
+            "ping": self.ping,
+        }
+
+    async def start(self):
+        await self._server.start()
+        self._health_task = asyncio.ensure_future(self._health_loop())
+
+    async def stop(self):
+        if self._health_task:
+            self._health_task.cancel()
+        for node in self.nodes.values():
+            if node.client is not None:
+                try:
+                    await node.client.notify_async("shutdown")
+                except Exception:
+                    pass
+        await self._server.stop()
+
+    # ------------------------------------------------------------------ nodes
+    async def register_node(self, node_id: str, address: str,
+                            resources: Dict[str, float],
+                            labels: Dict[str, str] = None):
+        info = NodeInfo(node_id, address, resources, labels or {})
+        info.client = RpcClient(address)
+        self.nodes[node_id] = info
+        await self._publish("node", {"event": "node_added", "node": info.snapshot()})
+        return {"session_name": self.session_name}
+
+    async def heartbeat(self, node_id: str, available_resources: Dict[str, float],
+                        load: Dict[str, Any] = None):
+        node = self.nodes.get(node_id)
+        if node is None:
+            return {"registered": False}
+        node.last_heartbeat = time.monotonic()
+        node.available_resources = available_resources
+        if not node.alive:
+            node.alive = True
+        return {"registered": True}
+
+    async def list_nodes(self):
+        return {nid: n.snapshot() for nid, n in self.nodes.items()}
+
+    async def drain_node(self, node_id: str):
+        node = self.nodes.get(node_id)
+        if node is not None and node.client is not None:
+            await node.client.notify_async("shutdown")
+        return True
+
+    async def _health_loop(self):
+        """Liveness sweep (ref: gcs_health_check_manager.cc — gRPC health
+        checks; here heartbeat staleness over the persistent socket)."""
+        from .config import get_config
+
+        cfg = get_config()
+        while True:
+            await asyncio.sleep(cfg.heartbeat_interval_s)
+            now = time.monotonic()
+            for node in self.nodes.values():
+                if node.alive and now - node.last_heartbeat > cfg.node_death_timeout_s:
+                    node.alive = False
+                    await self._publish(
+                        "node", {"event": "node_dead", "node": node.snapshot()}
+                    )
+                    await self._handle_node_death(node)
+
+    async def _handle_node_death(self, node: NodeInfo):
+        # Fail/restart actors that lived there (ref: gcs_actor_manager.cc
+        # OnNodeDead → reconstruct or destroy).
+        for actor in list(self.actors.values()):
+            if actor.node_id == node.node_id and actor.state in (ACTOR_ALIVE, ACTOR_PENDING):
+                await self.actor_died(actor.actor_id, reason=f"node {node.node_id} died",
+                                      worker_failed=True)
+
+    # ------------------------------------------------------------------ kv
+    async def kv_put(self, ns: str, key: str, value: bytes, overwrite: bool = True):
+        if not overwrite and key in self.kv[ns]:
+            return False
+        self.kv[ns][key] = value
+        return True
+
+    async def kv_get(self, ns: str, key: str):
+        return self.kv[ns].get(key)
+
+    async def kv_del(self, ns: str, key: str):
+        return self.kv[ns].pop(key, None) is not None
+
+    async def kv_keys(self, ns: str, prefix: str = ""):
+        return [k for k in self.kv[ns] if k.startswith(prefix)]
+
+    async def kv_exists(self, ns: str, key: str):
+        return key in self.kv[ns]
+
+    # ------------------------------------------------------------------ actors
+    async def register_actor(self, actor_id: str, spec: Dict[str, Any]):
+        name = spec.get("name")
+        namespace = spec.get("namespace", "")
+        if name:
+            existing_id = self.named_actors.get((namespace, name))
+            if existing_id is not None:
+                existing = self.actors.get(existing_id)
+                if existing is not None and existing.state != ACTOR_DEAD:
+                    if spec.get("get_if_exists"):
+                        return {"status": "exists", "actor_id": existing_id}
+                    return {"status": "name_taken", "actor_id": existing_id}
+        info = ActorInfo(actor_id, spec)
+        self.actors[actor_id] = info
+        if name:
+            self.named_actors[(namespace, name)] = actor_id
+        asyncio.ensure_future(self._schedule_actor(info))
+        return {"status": "registered", "actor_id": actor_id}
+
+    async def _schedule_actor(self, info: ActorInfo):
+        """GCS-based actor scheduling (ref: gcs_actor_scheduler.cc:65
+        ScheduleByGcs): pick a node, lease a worker there directly."""
+        spec = info.spec
+        resources = dict(spec.get("resources") or {})
+        delay = 0.05
+        while info.state in (ACTOR_PENDING, ACTOR_RESTARTING):
+            node = scheduling.pick_node_for(
+                list(self.nodes.values()), resources,
+                strategy=spec.get("scheduling_strategy") or "HYBRID",
+                pg=self.placement_groups.get(spec.get("placement_group_id") or ""),
+                bundle_index=spec.get("bundle_index", -1),
+            )
+            if node is not None:
+                try:
+                    ok = await node.client.call_async(
+                        "lease_worker_for_actor", spec=spec, actor_id=info.actor_id
+                    )
+                except Exception:
+                    ok = False
+                if ok:
+                    info.node_id = node.node_id
+                    return
+            await asyncio.sleep(min(delay, 2.0))
+            delay *= 2
+
+    async def actor_ready(self, actor_id: str, address: str, worker_id: str,
+                          node_id: str):
+        info = self.actors.get(actor_id)
+        if info is None:
+            return False
+        info.state = ACTOR_ALIVE
+        info.address = address
+        info.worker_id = worker_id
+        info.node_id = node_id
+        await self._publish(f"actor:{actor_id}", info.snapshot())
+        return True
+
+    async def actor_died(self, actor_id: str, reason: str = "",
+                         worker_failed: bool = True):
+        info = self.actors.get(actor_id)
+        if info is None or info.state == ACTOR_DEAD:
+            return False
+        max_restarts = info.spec.get("max_restarts", 0)
+        if worker_failed and (max_restarts == -1 or info.num_restarts < max_restarts):
+            info.num_restarts += 1
+            info.state = ACTOR_RESTARTING
+            info.address = None
+            await self._publish(f"actor:{actor_id}", info.snapshot())
+            asyncio.ensure_future(self._schedule_actor(info))
+        else:
+            info.state = ACTOR_DEAD
+            info.death_cause = reason
+            name = info.spec.get("name")
+            if name:
+                self.named_actors.pop((info.spec.get("namespace", ""), name), None)
+            await self._publish(f"actor:{actor_id}", info.snapshot())
+        return True
+
+    async def get_actor(self, actor_id: str = None, name: str = None,
+                        namespace: str = ""):
+        if actor_id is None and name is not None:
+            actor_id = self.named_actors.get((namespace, name))
+        if actor_id is None:
+            return None
+        info = self.actors.get(actor_id)
+        return info.snapshot() if info else None
+
+    async def list_actors(self):
+        return [a.snapshot() for a in self.actors.values()]
+
+    async def kill_actor(self, actor_id: str, no_restart: bool = True):
+        info = self.actors.get(actor_id)
+        if info is None:
+            return False
+        if no_restart:
+            info.spec["max_restarts"] = 0
+        if info.address:
+            try:
+                client = RpcClient(info.address)
+                await client.notify_async("kill_self")
+            except Exception:
+                pass
+        if info.state != ACTOR_ALIVE:
+            await self.actor_died(actor_id, reason="killed via kill_actor",
+                                  worker_failed=not no_restart)
+        return True
+
+    # ------------------------------------------------------------------ scheduling
+    async def pick_node(self, resources: Dict[str, float], strategy: str = "HYBRID",
+                        exclude: List[str] = None,
+                        placement_group_id: str = None, bundle_index: int = -1):
+        node = scheduling.pick_node_for(
+            [n for n in self.nodes.values() if not exclude or n.node_id not in exclude],
+            resources, strategy=strategy,
+            pg=self.placement_groups.get(placement_group_id or ""),
+            bundle_index=bundle_index,
+        )
+        if node is None:
+            return None
+        return {"node_id": node.node_id, "address": node.address}
+
+    async def report_backlog(self, node_id: str, backlog: int):
+        node = self.nodes.get(node_id)
+        if node is not None:
+            node.last_heartbeat = time.monotonic()
+        return True
+
+    # ------------------------------------------------------------------ placement groups
+    async def create_placement_group(self, pg_id: str, bundles: List[Dict[str, float]],
+                                     strategy: str = "PACK", name: str = ""):
+        """Two-phase bundle placement (ref: gcs_placement_group_scheduler.cc
+        — prepare on every node, then commit; rollback on any failure)."""
+        placement = scheduling.place_bundles(list(self.nodes.values()), bundles, strategy)
+        if placement is None:
+            pg = {"pg_id": pg_id, "state": "PENDING", "bundles": bundles,
+                  "strategy": strategy, "name": name, "placement": None}
+            self.placement_groups[pg_id] = pg
+            asyncio.ensure_future(self._retry_pg(pg))
+            return {"state": "PENDING"}
+        ok = await self._reserve_placement(pg_id, bundles, placement)
+        if not ok:
+            pg = {"pg_id": pg_id, "state": "PENDING", "bundles": bundles,
+                  "strategy": strategy, "name": name, "placement": None}
+            self.placement_groups[pg_id] = pg
+            asyncio.ensure_future(self._retry_pg(pg))
+            return {"state": "PENDING"}
+        self.placement_groups[pg_id] = {
+            "pg_id": pg_id, "state": "CREATED", "bundles": bundles,
+            "strategy": strategy, "name": name, "placement": placement,
+        }
+        await self._publish(f"pg:{pg_id}", self.placement_groups[pg_id])
+        return {"state": "CREATED", "placement": placement}
+
+    async def _reserve_placement(self, pg_id, bundles, placement) -> bool:
+        reserved = []
+        for idx, node_id in enumerate(placement):
+            node = self.nodes.get(node_id)
+            try:
+                ok = await node.client.call_async(
+                    "reserve_bundle", pg_id=pg_id, bundle_index=idx,
+                    resources=bundles[idx])
+            except Exception:
+                ok = False
+            if not ok:
+                for ridx, rnode_id in reserved:
+                    rnode = self.nodes.get(rnode_id)
+                    try:
+                        await rnode.client.call_async(
+                            "return_bundle", pg_id=pg_id, bundle_index=ridx)
+                    except Exception:
+                        pass
+                return False
+            reserved.append((idx, node_id))
+        return True
+
+    async def _retry_pg(self, pg):
+        delay = 0.1
+        while pg["state"] == "PENDING" and pg["pg_id"] in self.placement_groups:
+            await asyncio.sleep(min(delay, 2.0))
+            delay *= 2
+            placement = scheduling.place_bundles(
+                list(self.nodes.values()), pg["bundles"], pg["strategy"])
+            if placement is not None:
+                if await self._reserve_placement(pg["pg_id"], pg["bundles"], placement):
+                    pg["state"] = "CREATED"
+                    pg["placement"] = placement
+                    await self._publish(f"pg:{pg['pg_id']}", pg)
+
+    async def remove_placement_group(self, pg_id: str):
+        pg = self.placement_groups.pop(pg_id, None)
+        if pg is None:
+            return False
+        if pg.get("placement"):
+            for idx, node_id in enumerate(pg["placement"]):
+                node = self.nodes.get(node_id)
+                if node is not None:
+                    try:
+                        await node.client.call_async(
+                            "return_bundle", pg_id=pg_id, bundle_index=idx)
+                    except Exception:
+                        pass
+        return True
+
+    async def get_placement_group(self, pg_id: str):
+        return self.placement_groups.get(pg_id)
+
+    async def list_placement_groups(self):
+        return list(self.placement_groups.values())
+
+    # ------------------------------------------------------------------ pubsub
+    async def subscribe(self, channel: str, _conn: ServerConn = None):
+        self.subscribers[channel].append(_conn)
+        return True
+
+    async def publish(self, channel: str, message: Any):
+        await self._publish(channel, message)
+        return True
+
+    async def _publish(self, channel: str, message: Any):
+        conns = self.subscribers.get(channel)
+        if not conns:
+            return
+        dead = []
+        for conn in conns:
+            if conn.closed:
+                dead.append(conn)
+                continue
+            await conn.notify("pubsub", channel=channel, message=message)
+        for conn in dead:
+            conns.remove(conn)
+
+    def _on_disconnect(self, conn: ServerConn):
+        for conns in self.subscribers.values():
+            if conn in conns:
+                conns.remove(conn)
+
+    # ------------------------------------------------------------------ jobs
+    async def register_job(self, job_id: str, info: Dict[str, Any]):
+        self.jobs[job_id] = dict(info, job_id=job_id, state="RUNNING",
+                                 start_time=time.time())
+        return True
+
+    async def mark_job_finished(self, job_id: str):
+        if job_id in self.jobs:
+            self.jobs[job_id]["state"] = "FINISHED"
+            self.jobs[job_id]["end_time"] = time.time()
+        return True
+
+    async def list_jobs(self):
+        return list(self.jobs.values())
+
+    # ------------------------------------------------------------------ observability
+    async def add_task_events(self, events: List[Dict[str, Any]]):
+        self.task_events.extend(events)
+        return True
+
+    async def list_task_events(self, limit: int = 1000):
+        return list(self.task_events)[-limit:]
+
+    async def report_metrics(self, node_id: str, metrics: Dict[str, Any]):
+        self.metrics[node_id] = metrics
+        return True
+
+    async def get_metrics(self):
+        return self.metrics
+
+    async def cluster_status(self):
+        return {
+            "session_name": self.session_name,
+            "uptime_s": time.time() - self.start_time,
+            "nodes": {nid: n.snapshot() for nid, n in self.nodes.items()},
+            "num_actors": len(self.actors),
+            "num_placement_groups": len(self.placement_groups),
+        }
+
+    async def ping(self):
+        return "pong"
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--session-name", required=True)
+    parser.add_argument("--address", required=True)
+    args = parser.parse_args()
+
+    async def run():
+        controller = Controller(args.session_name, args.address)
+        await controller.start()
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,711 @@
+"""CoreWorker: per-process runtime client (driver and workers).
+
+Equivalent of the reference's core worker (ref: src/ray/core_worker/
+core_worker.h:166 — SubmitTask core_worker.cc:2500, Get :1838, Put :1525,
+Wait :2021, CreateActor :2582, SubmitActorTask :2830) plus the owner-side
+pieces of TaskManager (task_manager.cc — pending task table, retries) and the
+in-process memory store (store_provider/memory_store/). Ownership model: the
+process that submits a task / calls put() owns the returned objects, serves
+them to borrowers, and drives retries — same as the reference's
+ownership-based object model.
+
+Differences from the reference, by design:
+- results are pushed by the executing worker directly to the owner over one
+  socket hop (no raylet in the result path),
+- small objects live in the owner's memory store and are fetched on demand;
+  large objects go to the host shm store where readers mmap them zero-copy.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import exceptions
+from . import serialization
+from .config import get_config
+from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from .object_store import ObjectStoreClient
+from .rpc import EventLoopThread, RpcClient, RpcServer, ConnectionLost, RemoteHandlerError
+
+_core_lock = threading.Lock()
+_global_core: Optional["CoreWorker"] = None
+
+
+def get_core(required: bool = True) -> Optional["CoreWorker"]:
+    if _global_core is None and required:
+        raise RuntimeError(
+            "ray_tpu has not been initialized; call ray_tpu.init() first."
+        )
+    return _global_core
+
+
+def set_core(core: Optional["CoreWorker"]):
+    global _global_core
+    with _core_lock:
+        _global_core = core
+
+
+def _deserialize_object_ref(id_bytes: bytes, owner_addr: Optional[str]):
+    return ObjectRef(ObjectID(id_bytes), owner_addr=owner_addr, borrowed=True)
+
+
+class ObjectRef:
+    """A future for an object (ref: python/ray/includes/object_ref.pxi)."""
+
+    __slots__ = ("_oid", "_owner_addr", "_registered", "__weakref__")
+
+    def __init__(self, oid: ObjectID, owner_addr: Optional[str] = None,
+                 borrowed: bool = False):
+        self._oid = oid
+        self._owner_addr = owner_addr
+        core = get_core(required=False)
+        self._registered = False
+        if core is not None:
+            core._add_local_ref(oid)
+            self._registered = True
+
+    def id(self) -> ObjectID:
+        return self._oid
+
+    def binary(self) -> bytes:
+        return self._oid.binary()
+
+    def hex(self) -> str:
+        return self._oid.hex()
+
+    @property
+    def owner_address(self) -> Optional[str]:
+        return self._owner_addr
+
+    def __reduce__(self):
+        return (_deserialize_object_ref, (self._oid.binary(), self._owner_addr))
+
+    def __hash__(self):
+        return hash(self._oid)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._oid == self._oid
+
+    def __repr__(self):
+        return f"ObjectRef({self._oid.hex()})"
+
+    def __del__(self):
+        if self._registered:
+            core = get_core(required=False)
+            if core is not None and not core._shutting_down:
+                try:
+                    core._remove_local_ref(self._oid)
+                except Exception:
+                    pass
+
+    def future(self):
+        """concurrent.futures.Future resolving to the object's value."""
+        import concurrent.futures
+
+        core = get_core()
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        async def _resolve():
+            try:
+                fut.set_result(await core.get_async(self))
+            except Exception as e:
+                fut.set_exception(e)
+
+        EventLoopThread.get().spawn(_resolve())
+        return fut
+
+    def __await__(self):
+        core = get_core()
+        return core.get_async(self).__await__()
+
+
+_IN_SHM = object()  # memory-store marker: value lives in the shm store
+
+
+class _PendingTask:
+    __slots__ = ("spec", "return_ids", "retries_left", "arg_refs", "submitted_at")
+
+    def __init__(self, spec, return_ids, retries_left, arg_refs):
+        self.spec = spec
+        self.return_ids = return_ids
+        self.retries_left = retries_left
+        self.arg_refs = arg_refs  # pin args for the task's lifetime
+        self.submitted_at = time.time()
+
+
+class CoreWorker:
+    def __init__(self, *, mode: str, session_name: str, session_dir: str,
+                 controller_addr: str, nodelet_addr: str, node_id: str,
+                 worker_id: Optional[WorkerID] = None,
+                 job_id: Optional[JobID] = None):
+        self.mode = mode  # "driver" | "worker"
+        self.session_name = session_name
+        self.session_dir = session_dir
+        self.controller_addr = controller_addr
+        self.nodelet_addr = nodelet_addr
+        self.node_id = node_id
+        self.worker_id = worker_id or WorkerID.from_random()
+        self.job_id = job_id or JobID.from_random()
+        self.address = f"unix:{session_dir}/sock/{self.worker_id.hex()}.sock"
+
+        self.controller = RpcClient(controller_addr,
+                                    notify_handlers={"pubsub": self._on_pubsub,
+                                                     "shutdown": self._on_shutdown_ntf})
+        self.nodelet = RpcClient(nodelet_addr)
+        self.store = ObjectStoreClient(session_name)
+
+        self.memory_store: Dict[ObjectID, Any] = {}
+        self._events: Dict[ObjectID, asyncio.Event] = {}
+        self.pending_tasks: Dict[TaskID, _PendingTask] = {}
+        self.local_refs: Dict[ObjectID, int] = {}
+        self.owned: set = set()  # ObjectIDs owned by this process
+
+        self._clients: Dict[str, RpcClient] = {}
+        self._actor_addr: Dict[str, str] = {}
+        self._actor_seq: Dict[str, int] = {}
+        self._actor_inflight: Dict[str, set] = {}
+        self._actor_subs: set = set()
+        self._fn_exported: set = set()
+        self._fn_cache: Dict[str, Any] = {}
+        self._shutting_down = False
+        self._extra_handlers: Dict[str, Any] = {}
+        self._server: Optional[RpcServer] = None
+        self._task_events: List[dict] = []
+        self._pubsub_handlers: Dict[str, list] = {}
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self, extra_handlers: Optional[dict] = None):
+        handlers = {
+            "task_result": self._h_task_result,
+            "fetch_object": self._h_fetch_object,
+            "ping": lambda: "pong",
+        }
+        if extra_handlers:
+            handlers.update(extra_handlers)
+        self._server = RpcServer(self.address, handlers)
+        EventLoopThread.get().run(self._server.start())
+
+    def shutdown(self):
+        self._shutting_down = True
+        try:
+            if self._server is not None:
+                EventLoopThread.get().run(self._server.stop())
+        except Exception:
+            pass
+        for c in self._clients.values():
+            c.close()
+        self.controller.close()
+        self.nodelet.close()
+
+    def _on_shutdown_ntf(self):
+        self._shutting_down = True
+
+    # ------------------------------------------------------------ pubsub
+    def _on_pubsub(self, channel: str, message: Any):
+        for fn in self._pubsub_handlers.get(channel, []):
+            try:
+                fn(message)
+            except Exception:
+                traceback.print_exc()
+
+    def subscribe(self, channel: str, handler):
+        self._pubsub_handlers.setdefault(channel, []).append(handler)
+        self.controller.call("subscribe", channel=channel)
+
+    # ------------------------------------------------------------ refs
+    def _add_local_ref(self, oid: ObjectID):
+        self.local_refs[oid] = self.local_refs.get(oid, 0) + 1
+
+    def _remove_local_ref(self, oid: ObjectID):
+        count = self.local_refs.get(oid, 0) - 1
+        if count <= 0:
+            self.local_refs.pop(oid, None)
+            if oid in self.owned:
+                self._delete_object(oid)
+            else:
+                self.store.release(oid)
+        else:
+            self.local_refs[oid] = count
+
+    def _delete_object(self, oid: ObjectID):
+        self.owned.discard(oid)
+        self.memory_store.pop(oid, None)
+        self._events.pop(oid, None)
+        self.store.delete(oid)
+
+    # ------------------------------------------------------------ events
+    def _event(self, oid: ObjectID) -> asyncio.Event:
+        ev = self._events.get(oid)
+        if ev is None:
+            ev = asyncio.Event()
+            self._events[oid] = ev
+        return ev
+
+    def _resolve(self, oid: ObjectID, value: Any):
+        self.memory_store[oid] = value
+        ev = self._events.get(oid)
+        if ev is not None:
+            ev.set()
+
+    # ------------------------------------------------------------ clients
+    def client_for(self, address: str) -> RpcClient:
+        client = self._clients.get(address)
+        if client is None:
+            client = RpcClient(address)
+            self._clients[address] = client
+        return client
+
+    # ------------------------------------------------------------ put / get
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.for_put()
+        sv = serialization.serialize(value)
+        self.owned.add(oid)
+        if sv.total_size() <= get_config().max_direct_call_object_size:
+            self._resolve_threadsafe(oid, value)
+        else:
+            self.store.put_serialized(oid, sv)
+            self._resolve_threadsafe(oid, _IN_SHM)
+        return ObjectRef(oid, owner_addr=self.address)
+
+    def _resolve_threadsafe(self, oid, value):
+        loop = EventLoopThread.get().loop
+        loop.call_soon_threadsafe(self._resolve, oid, value)
+
+    async def get_async(self, ref: "ObjectRef", timeout: Optional[float] = None):
+        value = await self._get_value(ref, timeout)
+        if isinstance(value, exceptions.RtpuError):
+            raise value
+        return value
+
+    async def _get_value(self, ref: "ObjectRef", timeout: Optional[float] = None):
+        oid = ref.id()
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        if oid in self.memory_store:
+            return self._materialize(oid)
+        if oid in self.owned or oid in self._events:
+            ev = self._event(oid)
+            try:
+                remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                raise exceptions.GetTimeoutError(
+                    f"get() timed out waiting for {oid.hex()}")
+            return self._materialize(oid)
+        # borrowed object: shm first, then the owner
+        if self.store.contains(oid):
+            return self.store.get(oid)
+        owner = ref.owner_address
+        if owner is None or owner == self.address:
+            # unresolvable locally; wait for it to appear
+            ev = self._event(oid)
+            try:
+                remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                raise exceptions.GetTimeoutError(
+                    f"get() timed out waiting for {oid.hex()}")
+            return self._materialize(oid)
+        client = self.client_for(owner)
+        remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+        try:
+            kind, payload = await client.call_async(
+                "fetch_object", _timeout=remaining, oid=oid.binary())
+        except asyncio.TimeoutError:
+            raise exceptions.GetTimeoutError(
+                f"get() timed out fetching {oid.hex()} from owner")
+        except (ConnectionLost, RemoteHandlerError) as e:
+            raise exceptions.ObjectLostError(oid.hex(), f"owner unreachable: {e}")
+        if kind == "inline":
+            value = serialization.loads_inline(payload)
+            self.memory_store[oid] = value
+            return value
+        elif kind == "shm":
+            return self.store.get(oid)
+        raise exceptions.ObjectLostError(oid.hex(), f"unexpected fetch kind {kind}")
+
+    def _materialize(self, oid: ObjectID):
+        value = self.memory_store.get(oid)
+        if value is _IN_SHM:
+            return self.store.get(oid)
+        return value
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        for r in refs:
+            if not isinstance(r, ObjectRef):
+                raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
+
+        async def _gather():
+            return await asyncio.gather(*(self._get_value(r, timeout) for r in refs))
+
+        values = EventLoopThread.get().run(_gather())
+        for v in values:
+            if isinstance(v, exceptions.RtpuError):
+                raise v
+        return values[0] if single else values
+
+    def wait(self, refs: List["ObjectRef"], num_returns: int = 1,
+             timeout: Optional[float] = None,
+             fetch_local: bool = True) -> Tuple[list, list]:
+        async def _wait():
+            pending = {r: None for r in refs}
+            ready = []
+            deadline = time.monotonic() + timeout if timeout is not None else None
+
+            async def _one(r):
+                await self._get_value(r, None)
+                return r
+
+            tasks = {asyncio.ensure_future(_one(r)): r for r in pending}
+            try:
+                while tasks and len(ready) < num_returns:
+                    remaining = None if deadline is None else max(
+                        0.0, deadline - time.monotonic())
+                    done, _ = await asyncio.wait(
+                        tasks, timeout=remaining,
+                        return_when=asyncio.FIRST_COMPLETED)
+                    if not done:
+                        break
+                    for d in done:
+                        ready.append(tasks.pop(d))
+            finally:
+                for t in tasks:
+                    t.cancel()
+            ready_set = set(ready)
+            return ready, [r for r in refs if r not in ready_set]
+
+        return EventLoopThread.get().run(_wait())
+
+    # ------------------------------------------------------------ function export
+    def export_function(self, blob: bytes) -> str:
+        """Publish a pickled function/class once to the controller KV
+        (ref: python/ray/_private/function_manager.py — GCS function table)."""
+        key = hashlib.blake2b(blob, digest_size=16).hexdigest()
+        if key not in self._fn_exported:
+            self.controller.call("kv_put", ns="fn", key=key, value=blob)
+            self._fn_exported.add(key)
+        return key
+
+    def load_function(self, fn_key: str):
+        fn = self._fn_cache.get(fn_key)
+        if fn is None:
+            blob = self.controller.call("kv_get", ns="fn", key=fn_key)
+            if blob is None:
+                raise RuntimeError(f"function {fn_key} not found in cluster KV")
+            fn = serialization.loads_inline(blob)
+            self._fn_cache[fn_key] = fn
+        return fn
+
+    # ------------------------------------------------------------ task submission
+    def _pack_args(self, args: tuple, kwargs: dict):
+        sv = serialization.serialize((args, kwargs))
+        if sv.total_size() <= get_config().max_direct_call_object_size:
+            data = sv.meta if not sv.buffers else None
+            if data is not None:
+                return {"args_inline": data}
+            # has out-of-band buffers but small: re-pickle in-band
+            return {"args_inline": serialization.dumps_inline((args, kwargs))}
+        oid = ObjectID.for_put()
+        self.store.put_serialized(oid, sv)
+        self.owned.add(oid)
+        self._resolve_threadsafe(oid, _IN_SHM)
+        return {"args_oid": oid.binary(), "args_owner": self.address}
+
+    def submit_task(self, fn_key: str, args: tuple, kwargs: dict,
+                    opts: Dict[str, Any]) -> List[ObjectRef]:
+        task_id = TaskID.from_random()
+        num_returns = opts.get("num_returns", 1)
+        return_ids = [ObjectID.for_task_return(task_id, i)
+                      for i in range(num_returns)]
+        arg_refs = _collect_refs(args, kwargs)
+        spec = {
+            "type": "task",
+            "task_id": task_id.binary(),
+            "fn_key": fn_key,
+            "name": opts.get("name", ""),
+            "num_returns": num_returns,
+            "resources": opts.get("resources") or {"CPU": 1},
+            "owner_addr": self.address,
+            "caller_id": self.worker_id.hex(),
+            "max_retries": opts.get("max_retries", get_config().default_max_retries),
+            "retry_exceptions": opts.get("retry_exceptions", False),
+            "placement_group_id": opts.get("placement_group_id"),
+            "bundle_index": opts.get("bundle_index", -1),
+            "scheduling_strategy": opts.get("scheduling_strategy"),
+        }
+        spec.update(self._pack_args(args, kwargs))
+        for oid in return_ids:
+            self.owned.add(oid)
+            # create events eagerly on the io loop so get() can wait
+        loop = EventLoopThread.get().loop
+        loop.call_soon_threadsafe(self._register_pending, task_id, spec,
+                                  return_ids, arg_refs)
+        self.nodelet.call("submit_task", spec=spec)
+        self._record_event(task_id, spec["name"], "SUBMITTED")
+        return [ObjectRef(oid, owner_addr=self.address) for oid in return_ids]
+
+    def _register_pending(self, task_id, spec, return_ids, arg_refs):
+        self.pending_tasks[task_id] = _PendingTask(
+            spec, return_ids, spec.get("max_retries", 0), arg_refs)
+        for oid in return_ids:
+            self._event(oid)
+        actor_id = spec.get("actor_id")
+        if actor_id is not None:
+            # mutated only on the io loop (no lock needed)
+            self._actor_inflight.setdefault(actor_id, set()).add(spec["task_id"])
+
+    # handler: executing worker pushed results to us (the owner)
+    async def _h_task_result(self, task_id: bytes, status: str, results=None,
+                             error=None):
+        tid = TaskID(task_id)
+        pending = self.pending_tasks.get(tid)
+        if pending is None:
+            return True
+        actor_id = pending.spec.get("actor_id")
+        if actor_id is not None:
+            self._actor_inflight.get(actor_id, set()).discard(task_id)
+        if status == "ok":
+            self.pending_tasks.pop(tid, None)
+            for oid, (kind, payload) in zip(pending.return_ids, results):
+                if kind == "inline":
+                    self._resolve(oid, serialization.loads_inline(payload))
+                else:
+                    self._resolve(oid, _IN_SHM)
+            self._record_event(tid, pending.spec.get("name", ""), "FINISHED")
+        elif status == "app_error":
+            err = serialization.loads_inline(error)
+            if pending.spec.get("retry_exceptions") and pending.retries_left > 0:
+                pending.retries_left -= 1
+                await self._resubmit(pending)
+                return True
+            self.pending_tasks.pop(tid, None)
+            for oid in pending.return_ids:
+                self._resolve(oid, err)
+            self._record_event(tid, pending.spec.get("name", ""), "FAILED")
+        else:  # system failure (worker crash, node death)
+            if pending.retries_left > 0:
+                pending.retries_left -= 1
+                await self._resubmit(pending)
+                return True
+            self.pending_tasks.pop(tid, None)
+            err = exceptions.WorkerCrashedError(
+                f"task {tid.hex()} failed: {error}")
+            for oid in pending.return_ids:
+                self._resolve(oid, err)
+            self._record_event(tid, pending.spec.get("name", ""), "FAILED")
+        return True
+
+    async def _resubmit(self, pending: _PendingTask):
+        await asyncio.sleep(get_config().task_retry_delay_s)
+        try:
+            await self.nodelet.call_async("submit_task", spec=pending.spec)
+        except Exception:
+            for oid in pending.return_ids:
+                self._resolve(oid, exceptions.WorkerCrashedError("resubmit failed"))
+
+    # handler: a borrower asks us (the owner) for an object
+    async def _h_fetch_object(self, oid: bytes):
+        obj_id = ObjectID(oid)
+        if obj_id not in self.memory_store:
+            if obj_id in self._events or obj_id in self.owned:
+                await self._event(obj_id).wait()
+            elif self.store.contains(obj_id):
+                return ("shm", None)
+            else:
+                raise exceptions.ObjectLostError(obj_id.hex(), "not owned here")
+        value = self.memory_store.get(obj_id)
+        if value is _IN_SHM:
+            return ("shm", None)
+        return ("inline", serialization.dumps_inline(value))
+
+    # ------------------------------------------------------------ actors
+    def create_actor(self, cls_key: str, class_name: str, args: tuple,
+                     kwargs: dict, opts: Dict[str, Any]) -> str:
+        actor_id = ActorID.from_random().hex()
+        spec = {
+            "actor_id": actor_id,
+            "cls_key": cls_key,
+            "class_name": class_name,
+            "name": opts.get("name"),
+            "namespace": opts.get("namespace", ""),
+            "get_if_exists": opts.get("get_if_exists", False),
+            "resources": opts.get("resources") or {},
+            "max_restarts": opts.get("max_restarts", 0),
+            "max_concurrency": opts.get("max_concurrency", 1),
+            "placement_group_id": opts.get("placement_group_id"),
+            "bundle_index": opts.get("bundle_index", -1),
+            "scheduling_strategy": opts.get("scheduling_strategy"),
+            "owner_addr": self.address,
+        }
+        spec.update(self._pack_args(args, kwargs))
+        res = self.controller.call("register_actor", actor_id=actor_id, spec=spec)
+        if res["status"] == "name_taken":
+            raise ValueError(
+                f"actor name {opts.get('name')!r} already taken")
+        return res["actor_id"]
+
+    async def _resolve_actor(self, actor_id: str) -> str:
+        addr = self._actor_addr.get(actor_id)
+        if addr is not None:
+            return addr
+        delay = 0.02
+        while True:
+            info = await self.controller.call_async("get_actor", actor_id=actor_id)
+            if info is None:
+                raise exceptions.ActorDiedError(actor_id, "unknown actor")
+            if info["state"] == "ALIVE":
+                self._actor_addr[actor_id] = info["address"]
+                return info["address"]
+            if info["state"] == "DEAD":
+                raise exceptions.ActorDiedError(
+                    actor_id, info.get("death_cause") or "actor is dead")
+            await asyncio.sleep(min(delay, 1.0))
+            delay *= 1.5
+
+    def submit_actor_task(self, actor_id: str, method: str, args: tuple,
+                          kwargs: dict, opts: Dict[str, Any]) -> List[ObjectRef]:
+        task_id = TaskID.from_random()
+        num_returns = opts.get("num_returns", 1)
+        return_ids = [ObjectID.for_task_return(task_id, i)
+                      for i in range(num_returns)]
+        seq = self._actor_seq.get(actor_id, 0)
+        self._actor_seq[actor_id] = seq + 1
+        spec = {
+            "type": "actor_call",
+            "task_id": task_id.binary(),
+            "actor_id": actor_id,
+            "method": method,
+            "name": f"{actor_id[:8]}.{method}",
+            "num_returns": num_returns,
+            "owner_addr": self.address,
+            "caller_id": self.worker_id.hex(),
+            "seq": seq,
+            "max_retries": 0,
+        }
+        spec.update(self._pack_args(args, kwargs))
+        arg_refs = _collect_refs(args, kwargs)
+        for oid in return_ids:
+            self.owned.add(oid)
+        loop = EventLoopThread.get().loop
+        loop.call_soon_threadsafe(self._register_pending, task_id, spec,
+                                  return_ids, arg_refs)
+        EventLoopThread.get().spawn(self._send_actor_task(actor_id, spec))
+        return [ObjectRef(oid, owner_addr=self.address) for oid in return_ids]
+
+    async def _ensure_actor_sub(self, actor_id: str):
+        """Watch actor state so in-flight calls fail fast when it dies
+        (ref: transport/actor_task_submitter.cc DisconnectActor — fails
+        queued tasks on death notification from GCS pubsub)."""
+        if actor_id in self._actor_subs:
+            return
+        self._actor_subs.add(actor_id)
+        self._pubsub_handlers.setdefault(f"actor:{actor_id}", []).append(
+            lambda msg: self._on_actor_update(actor_id, msg))
+        try:
+            await self.controller.call_async("subscribe",
+                                             channel=f"actor:{actor_id}")
+        except Exception:
+            self._actor_subs.discard(actor_id)
+
+    def _on_actor_update(self, actor_id: str, msg: dict):
+        state = msg.get("state")
+        if state == "ALIVE":
+            self._actor_addr[actor_id] = msg.get("address")
+        elif state in ("RESTARTING", "DEAD"):
+            # Fail calls in flight to the lost incarnation (actor tasks are
+            # not retried by default, matching the reference); a restarted
+            # incarnation expects sequence numbers from zero again.
+            self._actor_addr.pop(actor_id, None)
+            self._actor_seq[actor_id] = 0
+            err = exceptions.ActorDiedError(
+                actor_id, msg.get("death_cause")
+                or ("actor restarting" if state == "RESTARTING" else "actor died"))
+            inflight = self._actor_inflight.get(actor_id, set())
+            failed, inflight_left = list(inflight), set()
+            self._actor_inflight[actor_id] = inflight_left
+            for tid in failed:
+                asyncio.ensure_future(self._h_task_result(
+                    tid, "app_error", error=serialization.dumps_inline(err)))
+
+    async def _send_actor_task(self, actor_id: str, spec: dict, attempt: int = 0):
+        try:
+            await self._ensure_actor_sub(actor_id)
+            addr = await self._resolve_actor(actor_id)
+            if spec["task_id"] not in self._actor_inflight.get(actor_id, set()):
+                return  # already failed (incarnation lost); don't deliver stale
+            client = self.client_for(addr)
+            await client.call_async("actor_call", spec=spec)
+        except exceptions.ActorDiedError as e:
+            await self._h_task_result(spec["task_id"], "app_error",
+                                      error=serialization.dumps_inline(e))
+        except (ConnectionLost, RemoteHandlerError, OSError) as e:
+            # address may be stale (actor restarting); re-resolve and retry
+            stale = self._actor_addr.pop(actor_id, None)
+            if stale is not None:
+                old = self._clients.pop(stale, None)
+                if old is not None:
+                    old.close()
+            if attempt < 30:
+                await asyncio.sleep(min(0.05 * (attempt + 1), 1.0))
+                await self._send_actor_task(actor_id, spec, attempt + 1)
+            else:
+                await self._h_task_result(
+                    spec["task_id"], "system_error",
+                    error=f"actor {actor_id} unreachable: {e}")
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True):
+        self.controller.call("kill_actor", actor_id=actor_id,
+                             no_restart=no_restart)
+        self._actor_addr.pop(actor_id, None)
+
+    # ------------------------------------------------------------ misc
+    def cancel(self, ref: ObjectRef, force: bool = False):
+        # find the producing task
+        for tid, pending in list(self.pending_tasks.items()):
+            if ref.id() in pending.return_ids:
+                self.nodelet.call("cancel_task", task_id=tid.binary(),
+                                  force=force)
+                return True
+        return False
+
+    def free(self, refs: List[ObjectRef]):
+        for r in refs:
+            self._delete_object(r.id())
+
+    def _record_event(self, task_id: TaskID, name: str, state: str):
+        if not get_config().enable_timeline:
+            return
+        self._task_events.append({
+            "task_id": task_id.hex(), "name": name, "state": state,
+            "ts": time.time(), "worker_id": self.worker_id.hex(),
+        })
+        if len(self._task_events) >= 512:
+            batch, self._task_events = self._task_events, []
+            try:
+                EventLoopThread.get().spawn(
+                    self.controller.call_async("add_task_events", events=batch))
+            except Exception:
+                pass
+
+    def flush_events(self):
+        if self._task_events:
+            batch, self._task_events = self._task_events, []
+            try:
+                self.controller.call("add_task_events", events=batch)
+            except Exception:
+                pass
+
+
+def _collect_refs(args, kwargs) -> List[ObjectRef]:
+    refs = []
+    for a in list(args) + list(kwargs.values()):
+        if isinstance(a, ObjectRef):
+            refs.append(a)
+    return refs

@@ -1,0 +1,199 @@
+"""Session bootstrap: process supervision for a node.
+
+Equivalent of the reference's Node process supervisor (ref:
+python/ray/_private/node.py:44, start_ray_processes :1479,
+services.py start_gcs_server :1450 / start_raylet :1534).
+
+TPU-native simplification: for a single-host session the controller and
+nodelet run *in-process* on the driver's io loop (zero extra control-plane
+processes; the reference spawns gcs_server + raylet binaries). For multi-node
+clusters the same components run standalone (``python -m
+ray_tpu.runtime.controller`` / ``...nodelet``) and drivers connect with
+``init(address=...)``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import subprocess
+import sys
+import time
+import uuid
+from typing import Dict, Optional
+
+from . import object_store
+from .config import get_config
+from .controller import Controller
+from .core import CoreWorker, set_core, get_core
+from .ids import JobID, NodeID
+from .nodelet import Nodelet
+from .rpc import EventLoopThread, RpcClient
+
+
+def _detect_resources(num_cpus=None, num_tpus=None, resources=None):
+    out = dict(resources or {})
+    out["CPU"] = float(num_cpus if num_cpus is not None else (os.cpu_count() or 1))
+    if num_tpus is not None:
+        out["TPU"] = float(num_tpus)
+    else:
+        # TPU autodetection (ref: python/ray/_private/accelerators/tpu.py:137
+        # chip autodetection): trust env hints, never import jax here.
+        chips = os.environ.get("TPU_CHIPS_PER_HOST") or os.environ.get(
+            "RTPU_NUM_TPUS")
+        if chips:
+            out["TPU"] = float(chips)
+    out.setdefault("memory", float(_default_memory()))
+    return out
+
+
+def _default_memory():
+    try:
+        import psutil
+
+        return int(psutil.virtual_memory().available * 0.7)
+    except Exception:
+        return 4 << 30
+
+
+class Session:
+    """A running single-host session (head node + driver)."""
+
+    def __init__(self, *, address: Optional[str] = None, num_cpus=None,
+                 num_tpus=None, resources=None, labels=None,
+                 namespace: str = "", session_name: Optional[str] = None):
+        self.namespace = namespace
+        self.session_name = session_name or f"{int(time.time())}_{uuid.uuid4().hex[:8]}"
+        self.session_dir = f"/tmp/ray_tpu/{self.session_name}"
+        os.makedirs(os.path.join(self.session_dir, "sock"), exist_ok=True)
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        self.controller_inproc: Optional[Controller] = None
+        self.nodelet_inproc: Optional[Nodelet] = None
+        self.node_id = NodeID.from_random().hex()
+        self._extra_nodelet_procs = []
+
+        loop_thread = EventLoopThread.get()
+        if address is None:
+            # head: in-process controller + nodelet
+            self.controller_addr = f"unix:{self.session_dir}/sock/controller.sock"
+            self.nodelet_addr = f"unix:{self.session_dir}/sock/nodelet-head.sock"
+            self.controller_inproc = Controller(self.session_name, self.controller_addr)
+            loop_thread.run(self.controller_inproc.start())
+            self.nodelet_inproc = Nodelet(
+                session_name=self.session_name, session_dir=self.session_dir,
+                node_id=self.node_id, address=self.nodelet_addr,
+                controller_addr=self.controller_addr,
+                resources=_detect_resources(num_cpus, num_tpus, resources),
+                labels=labels or {})
+            loop_thread.run(self.nodelet_inproc.start())
+        else:
+            self.controller_addr = address
+            # connecting driver: attach to the head nodelet
+            client = RpcClient(address)
+            nodes = client.call("list_nodes")
+            client.close()
+            if not nodes:
+                raise ConnectionError("no nodes registered at controller")
+            head = next(iter(nodes.values()))
+            self.nodelet_addr = head["address"]
+            self.session_name = self._session_name_from(address)
+            self.session_dir = f"/tmp/ray_tpu/{self.session_name}"
+
+        core = CoreWorker(
+            mode="driver", session_name=self.session_name,
+            session_dir=self.session_dir,
+            controller_addr=self.controller_addr,
+            nodelet_addr=self.nodelet_addr, node_id=self.node_id)
+        core.start()
+        core.namespace = namespace
+        set_core(core)
+        self.core = core
+        core.controller.call("register_job", job_id=core.job_id.hex(),
+                             info={"driver_pid": os.getpid(),
+                                   "namespace": namespace})
+        atexit.register(self._atexit)
+
+    def _session_name_from(self, address: str) -> str:
+        client = RpcClient(address)
+        try:
+            return client.call("cluster_status")["session_name"]
+        finally:
+            client.close()
+
+    def add_node(self, num_cpus=1, num_tpus=None, resources=None, labels=None):
+        """Start an extra nodelet process on this host — the multi-node test
+        fixture (ref: python/ray/cluster_utils.py:135 Cluster.add_node)."""
+        node_id = NodeID.from_random().hex()
+        addr = f"unix:{self.session_dir}/sock/nodelet-{node_id[:8]}.sock"
+        log = open(os.path.join(self.session_dir, "logs",
+                                f"nodelet-{node_id[:8]}.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.runtime.nodelet",
+             "--session-name", self.session_name,
+             "--session-dir", self.session_dir,
+             "--node-id", node_id,
+             "--address", addr,
+             "--controller-addr", self.controller_addr,
+             "--resources", json.dumps(_detect_resources(num_cpus, num_tpus,
+                                                         resources)),
+             "--labels", json.dumps(labels or {})],
+            stdout=log, stderr=subprocess.STDOUT, start_new_session=True)
+        self._extra_nodelet_procs.append(proc)
+        # wait for registration
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            nodes = self.core.controller.call("list_nodes")
+            if node_id in nodes:
+                return node_id
+            time.sleep(0.05)
+        raise TimeoutError("nodelet failed to register")
+
+    def _atexit(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+    def shutdown(self):
+        atexit.unregister(self._atexit)
+        core = get_core(required=False)
+        if core is not None:
+            try:
+                core.flush_events()
+                core.controller.call("mark_job_finished",
+                                     job_id=core.job_id.hex(), _timeout=2)
+            except Exception:
+                pass
+        loop_thread = EventLoopThread.get()
+        if self.nodelet_inproc is not None:
+            try:
+                loop_thread.run(self.nodelet_inproc.stop(), timeout=5)
+            except Exception:
+                pass
+        for proc in self._extra_nodelet_procs:
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        if self.controller_inproc is not None:
+            try:
+                loop_thread.run(self.controller_inproc.stop(), timeout=5)
+            except Exception:
+                pass
+        if core is not None:
+            core.shutdown()
+            set_core(None)
+        object_store.cleanup_session(self.session_name)
+
+
+_current_session: Optional[Session] = None
+
+
+def current_session() -> Optional[Session]:
+    return _current_session
+
+
+def set_session(session: Optional[Session]):
+    global _current_session
+    _current_session = session

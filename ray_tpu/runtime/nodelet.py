@@ -1,0 +1,541 @@
+"""Nodelet: per-node manager (worker pool + local scheduler).
+
+Equivalent of the reference's raylet (ref: src/ray/raylet/node_manager.h:124;
+lease path node_manager.cc:1887 HandleRequestWorkerLease; dispatch loop
+src/ray/raylet/scheduling/local_task_manager.cc:119
+DispatchScheduledTasksToWorkers; worker pool src/ray/raylet/worker_pool.cc).
+
+Differences by design: tasks are *pushed* (submit → queue → dispatch to an
+idle worker) rather than leased back to the submitter — one fewer round trip
+per task on a fabric where all workers are trusted peers; spillback to other
+nodes goes through the controller's pick_node (the reference spills via
+ClusterTaskManager::ScheduleOnNode, cluster_task_manager.cc:422).
+
+Can run in-process with the driver (single host) or standalone via
+``python -m ray_tpu.runtime.nodelet`` (multi-node clusters and tests, like
+the reference's cluster_utils.Cluster multi-raylet fixture,
+python/ray/cluster_utils.py:135).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import os
+import subprocess
+import sys
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+from .. import exceptions
+from . import serialization
+from .config import get_config
+from .ids import NodeID, TaskID, WorkerID
+from .rpc import RpcClient, RpcServer, ServerConn
+
+
+class WorkerState:
+    def __init__(self, worker_id: str, address: str, pid: int, proc=None):
+        self.worker_id = worker_id
+        self.address = address
+        self.pid = pid
+        self.proc = proc
+        self.client: Optional[RpcClient] = None
+        self.current_task: Optional[dict] = None
+        self.actor_id: Optional[str] = None
+        self.idle_since = time.monotonic()
+
+    @property
+    def is_actor(self):
+        return self.actor_id is not None
+
+
+class Nodelet:
+    def __init__(self, *, session_name: str, session_dir: str, node_id: str,
+                 address: str, controller_addr: str,
+                 resources: Dict[str, float], labels: Dict[str, str] = None,
+                 max_workers: Optional[int] = None):
+        self.session_name = session_name
+        self.session_dir = session_dir
+        self.node_id = node_id
+        self.address = address
+        self.controller_addr = controller_addr
+        self.total_resources = dict(resources)
+        self.available = dict(resources)
+        self.labels = labels or {}
+        cpus = int(resources.get("CPU", 1)) or 1
+        self.max_workers = max_workers or max(cpus * 2, 8)
+
+        self.controller = RpcClient(controller_addr,
+                                    notify_handlers={"shutdown": self._on_shutdown})
+        self.workers: Dict[str, WorkerState] = {}
+        self.idle: collections.deque = collections.deque()
+        self.starting = 0
+        self.queue: collections.deque = collections.deque()
+        self.pending_actor_leases: collections.deque = collections.deque()
+        self.bundles: Dict[tuple, Dict[str, Dict[str, float]]] = {}
+        self.cancelled: set = set()
+        self.running_tasks: Dict[bytes, str] = {}  # task_id -> worker_id
+        self._server = RpcServer(address, self._handlers(),
+                                 on_disconnect=self._on_worker_disconnect)
+        self._bg: List[asyncio.Task] = []
+        self._stopping = False
+        self.object_bytes = 0
+
+    def _handlers(self):
+        return {
+            "submit_task": self.submit_task,
+            "lease_worker_for_actor": self.lease_worker_for_actor,
+            "worker_register": self.worker_register,
+            "task_finished": self.task_finished,
+            "actor_exited": self.actor_exited,
+            "reserve_bundle": self.reserve_bundle,
+            "return_bundle": self.return_bundle,
+            "cancel_task": self.cancel_task,
+            "object_sealed": self.object_sealed,
+            "object_deleted": self.object_deleted,
+            "get_node_info": self.get_node_info,
+            "shutdown": self._on_shutdown,
+            "ping": lambda: "pong",
+        }
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self):
+        await self._server.start()
+        await self.controller.call_async(
+            "register_node", node_id=self.node_id, address=self.address,
+            resources=self.total_resources, labels=self.labels)
+        self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
+        self._bg.append(asyncio.ensure_future(self._reap_loop()))
+        for _ in range(get_config().prestart_workers):
+            self._start_worker()
+
+    async def stop(self):
+        self._stopping = True
+        for t in self._bg:
+            t.cancel()
+        for w in list(self.workers.values()):
+            self._kill_worker(w)
+        await self._server.stop()
+
+    def _on_shutdown(self):
+        if not self._stopping:
+            asyncio.ensure_future(self.stop())
+
+    async def _heartbeat_loop(self):
+        cfg = get_config()
+        while True:
+            await asyncio.sleep(cfg.heartbeat_interval_s)
+            try:
+                await self.controller.call_async(
+                    "heartbeat", node_id=self.node_id,
+                    available_resources=self.available,
+                    load={"queued": len(self.queue),
+                          "workers": len(self.workers),
+                          "object_bytes": self.object_bytes})
+            except Exception:
+                pass
+
+    async def _reap_loop(self):
+        """Detect dead worker processes and idle-timeout extras (ref:
+        worker_pool.cc idle worker killing; node_manager.cc worker failure)."""
+        cfg = get_config()
+        while True:
+            await asyncio.sleep(0.2)
+            now = time.monotonic()
+            for w in list(self.workers.values()):
+                if w.proc is not None and w.proc.poll() is not None:
+                    await self._on_worker_death(w)
+                elif (not w.is_actor and w.current_task is None
+                      and len(self.workers) > get_config().prestart_workers
+                      and now - w.idle_since > cfg.worker_idle_timeout_s):
+                    self._kill_worker(w)
+
+    # ------------------------------------------------------------ worker pool
+    def _start_worker(self, force: bool = False):
+        if not force and self.starting + len(self.workers) >= self.max_workers:
+            return
+        self.starting += 1
+        worker_id = WorkerID.from_random().hex()
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"worker-{worker_id[:8]}.log"), "ab")
+        env = dict(os.environ)
+        env["RTPU_WORKER_ID"] = worker_id
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.runtime.worker",
+             "--session-name", self.session_name,
+             "--session-dir", self.session_dir,
+             "--node-id", self.node_id,
+             "--nodelet-addr", self.address,
+             "--controller-addr", self.controller_addr,
+             "--worker-id", worker_id],
+            stdout=out, stderr=subprocess.STDOUT, env=env,
+            start_new_session=True)
+        # record a placeholder so death-before-register is detectable
+        ws = WorkerState(worker_id, "", proc.pid, proc)
+        ws.current_task = {"placeholder": True}
+        self.workers[worker_id] = ws
+
+    async def worker_register(self, worker_id: str, address: str, pid: int):
+        ws = self.workers.get(worker_id)
+        if ws is None:
+            ws = WorkerState(worker_id, address, pid)
+            self.workers[worker_id] = ws
+        else:
+            self.starting -= 1
+        ws.address = address
+        ws.current_task = None
+        ws.client = RpcClient(address)
+        ws.idle_since = time.monotonic()
+        self.idle.append(worker_id)
+        self._dispatch()
+        return {"session_name": self.session_name}
+
+    def _kill_worker(self, ws: WorkerState):
+        self.workers.pop(ws.worker_id, None)
+        if ws.worker_id in self.idle:
+            try:
+                self.idle.remove(ws.worker_id)
+            except ValueError:
+                pass
+        if ws.proc is not None:
+            try:
+                ws.proc.terminate()
+            except Exception:
+                pass
+
+    async def _on_worker_death(self, ws: WorkerState):
+        self.workers.pop(ws.worker_id, None)
+        try:
+            self.idle.remove(ws.worker_id)
+        except ValueError:
+            pass
+        if ws.is_actor:
+            if ws.current_task and not ws.current_task.get("placeholder"):
+                self._release(ws.current_task)
+            try:
+                await self.controller.call_async(
+                    "actor_died", actor_id=ws.actor_id,
+                    reason=f"worker {ws.worker_id[:8]} died", worker_failed=True)
+            except Exception:
+                pass
+        elif ws.current_task and ws.current_task.get("placeholder"):
+            self.starting = max(0, self.starting - 1)
+        elif ws.current_task is not None:
+            spec = ws.current_task
+            self._release(spec)
+            await self._report_failure(spec, "worker process died")
+        self._dispatch()
+
+    def _on_worker_disconnect(self, conn: ServerConn):
+        pass  # process death is authoritative (reap loop)
+
+    async def _report_failure(self, spec: dict, reason: str):
+        try:
+            client = RpcClient(spec["owner_addr"])
+            await client.notify_async(
+                "task_result", task_id=spec["task_id"],
+                status="system_error", error=reason)
+            client.close()
+        except Exception:
+            traceback.print_exc()
+
+    # ------------------------------------------------------------ resources
+    def _feasible_now(self, spec) -> bool:
+        pg_id = spec.get("placement_group_id")
+        req = spec.get("resources", {})
+        if pg_id:
+            pool = self.bundles.get((pg_id, spec.get("bundle_index", -1)))
+            if pool is None:
+                pool = self._any_bundle(pg_id, req)
+                return pool is not None
+            return _leq(req, pool["available"])
+        return _leq(req, self.available)
+
+    def _feasible_ever(self, spec) -> bool:
+        pg_id = spec.get("placement_group_id")
+        if pg_id:
+            return any(k[0] == pg_id for k in self.bundles)
+        return _leq(spec.get("resources", {}), self.total_resources)
+
+    def _any_bundle(self, pg_id, req):
+        for (pid, idx), pool in self.bundles.items():
+            if pid == pg_id and _leq(req, pool["available"]):
+                return pool
+        return None
+
+    def _acquire(self, spec) -> bool:
+        req = spec.get("resources", {})
+        pg_id = spec.get("placement_group_id")
+        if pg_id:
+            idx = spec.get("bundle_index", -1)
+            pool = (self.bundles.get((pg_id, idx)) if idx >= 0
+                    else self._any_bundle(pg_id, req))
+            if pool is None or not _leq(req, pool["available"]):
+                return False
+            _sub(pool["available"], req)
+            spec["_bundle_key"] = (pg_id, idx if idx >= 0 else
+                                   self._key_of(pool, pg_id))
+            return True
+        if not _leq(req, self.available):
+            return False
+        _sub(self.available, req)
+        return True
+
+    def _key_of(self, pool, pg_id):
+        for (pid, idx), p in self.bundles.items():
+            if p is pool and pid == pg_id:
+                return idx
+        return -1
+
+    def _release(self, spec):
+        req = spec.get("resources", {})
+        key = spec.get("_bundle_key")
+        if key is not None:
+            pool = self.bundles.get(tuple(key))
+            if pool is not None:
+                _add(pool["available"], req)
+            return
+        _add(self.available, req)
+        for k in list(self.available):
+            if self.available[k] > self.total_resources.get(k, 0):
+                self.available[k] = self.total_resources[k]
+
+    # ------------------------------------------------------------ task path
+    async def submit_task(self, spec: dict):
+        if spec["task_id"] in self.cancelled:
+            self.cancelled.discard(spec["task_id"])
+            await self._report_cancelled(spec)
+            return True
+        if not self._feasible_ever(spec) and not spec.get("_spilled"):
+            # not runnable on this node at all: spill to another node via the
+            # controller (ref: cluster_task_manager.cc:422 ScheduleOnNode)
+            target = await self.controller.call_async(
+                "pick_node", resources=spec.get("resources", {}),
+                strategy=spec.get("scheduling_strategy") or "HYBRID",
+                placement_group_id=spec.get("placement_group_id"),
+                bundle_index=spec.get("bundle_index", -1))
+            if target is not None and target["node_id"] != self.node_id:
+                spec["_spilled"] = True
+                client = RpcClient(target["address"])
+                try:
+                    await client.call_async("submit_task", spec=spec)
+                    return True
+                finally:
+                    client.close()
+        self.queue.append(spec)
+        self._dispatch()
+        return True
+
+    def _dispatch(self):
+        """Local dispatch loop (ref: local_task_manager.cc:119)."""
+        if self._stopping:
+            return
+        made_progress = True
+        while made_progress and self.queue:
+            made_progress = False
+            for _ in range(len(self.queue)):
+                spec = self.queue.popleft()
+                if spec["task_id"] in self.cancelled:
+                    self.cancelled.discard(spec["task_id"])
+                    asyncio.ensure_future(self._report_cancelled(spec))
+                    made_progress = True
+                    continue
+                if not self.idle:
+                    self.queue.appendleft(spec)
+                    if self.starting == 0 or (
+                            self.starting + len(self.workers) < self.max_workers
+                            and len(self.queue) > self.starting):
+                        self._start_worker()
+                    break
+                if not self._acquire(spec):
+                    self.queue.append(spec)
+                    continue
+                worker_id = self.idle.popleft()
+                ws = self.workers.get(worker_id)
+                if ws is None:
+                    self._release(spec)
+                    self.queue.append(spec)
+                    continue
+                ws.current_task = spec
+                self.running_tasks[spec["task_id"]] = worker_id
+                made_progress = True
+                asyncio.ensure_future(self._push_to_worker(ws, spec))
+        # actor leases piggyback on the same pool
+        while self.pending_actor_leases and self.idle:
+            actor_id, spec = self.pending_actor_leases.popleft()
+            if not self._acquire(spec):
+                self.pending_actor_leases.appendleft((actor_id, spec))
+                break
+            worker_id = self.idle.popleft()
+            ws = self.workers[worker_id]
+            ws.actor_id = actor_id
+            ws.current_task = spec
+            asyncio.ensure_future(self._push_actor_to_worker(ws, spec))
+        # actor workers are demand-driven and bounded by resources, not by
+        # the task-pool cap (each actor is an explicit user-created process)
+        if self.pending_actor_leases and not self.idle:
+            if self.starting < len(self.pending_actor_leases):
+                self._start_worker(force=True)
+
+    async def _push_to_worker(self, ws: WorkerState, spec: dict):
+        try:
+            await ws.client.notify_async("execute_task", spec=spec)
+        except Exception:
+            await self._on_worker_death(ws)
+
+    async def _push_actor_to_worker(self, ws: WorkerState, spec: dict):
+        try:
+            await ws.client.notify_async("create_actor", spec=spec)
+        except Exception:
+            await self._on_worker_death(ws)
+
+    async def task_finished(self, worker_id: str, task_id: bytes):
+        ws = self.workers.get(worker_id)
+        self.running_tasks.pop(task_id, None)
+        if ws is None:
+            return True
+        spec, ws.current_task = ws.current_task, None
+        if spec is not None:
+            self._release(spec)
+        ws.idle_since = time.monotonic()
+        if not ws.is_actor:
+            self.idle.append(worker_id)
+        self._dispatch()
+        return True
+
+    async def cancel_task(self, task_id: bytes, force: bool = False):
+        # queued?
+        for spec in list(self.queue):
+            if spec["task_id"] == task_id:
+                self.queue.remove(spec)
+                await self._report_cancelled(spec)
+                return True
+        worker_id = self.running_tasks.get(task_id)
+        if worker_id is not None and force:
+            ws = self.workers.get(worker_id)
+            if ws is not None:
+                self._kill_worker(ws)
+                if ws.current_task:
+                    self._release(ws.current_task)
+                    await self._report_cancelled(ws.current_task)
+                return True
+        self.cancelled.add(task_id)
+        return False
+
+    async def _report_cancelled(self, spec):
+        try:
+            client = RpcClient(spec["owner_addr"])
+            await client.notify_async(
+                "task_result", task_id=spec["task_id"], status="app_error",
+                error=serialization.dumps_inline(
+                    exceptions.TaskCancelledError("task was cancelled")))
+            client.close()
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------ actors
+    async def lease_worker_for_actor(self, spec: dict, actor_id: str):
+        if not self._feasible_ever({"resources": spec.get("resources", {}),
+                                    "placement_group_id": spec.get("placement_group_id"),
+                                    "bundle_index": spec.get("bundle_index", -1)}):
+            return False
+        self.pending_actor_leases.append((actor_id, dict(
+            spec, type="actor_create", task_id=os.urandom(16))))
+        self._dispatch()
+        return True
+
+    async def actor_exited(self, worker_id: str, actor_id: str, reason: str = "",
+                           intended: bool = False):
+        ws = self.workers.get(worker_id)
+        if ws is not None:
+            self._release(ws.current_task or {})
+            self._kill_worker(ws)
+        try:
+            await self.controller.call_async(
+                "actor_died", actor_id=actor_id, reason=reason,
+                worker_failed=not intended)
+        except Exception:
+            pass
+        return True
+
+    # ------------------------------------------------------------ bundles
+    async def reserve_bundle(self, pg_id: str, bundle_index: int,
+                             resources: Dict[str, float]):
+        if not _leq(resources, self.available):
+            return False
+        _sub(self.available, resources)
+        self.bundles[(pg_id, bundle_index)] = {
+            "total": dict(resources), "available": dict(resources)}
+        return True
+
+    async def return_bundle(self, pg_id: str, bundle_index: int):
+        pool = self.bundles.pop((pg_id, bundle_index), None)
+        if pool is not None:
+            _add(self.available, pool["total"])
+        return True
+
+    # ------------------------------------------------------------ objects
+    async def object_sealed(self, oid: bytes, size: int):
+        self.object_bytes += size
+        return True
+
+    async def object_deleted(self, oid: bytes, size: int):
+        self.object_bytes -= size
+        return True
+
+    async def get_node_info(self):
+        return {
+            "node_id": self.node_id,
+            "resources": self.total_resources,
+            "available": self.available,
+            "workers": len(self.workers),
+            "queued": len(self.queue),
+        }
+
+
+def _leq(req: Dict[str, float], avail: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) >= v - 1e-9 for k, v in req.items() if v > 0)
+
+
+def _sub(avail: Dict[str, float], req: Dict[str, float]):
+    for k, v in req.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def _add(avail: Dict[str, float], req: Dict[str, float]):
+    for k, v in req.items():
+        avail[k] = avail.get(k, 0.0) + v
+
+
+def main():
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--session-name", required=True)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--address", required=True)
+    parser.add_argument("--controller-addr", required=True)
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--labels", default="{}")
+    args = parser.parse_args()
+
+    async def run():
+        nodelet = Nodelet(
+            session_name=args.session_name, session_dir=args.session_dir,
+            node_id=args.node_id, address=args.address,
+            controller_addr=args.controller_addr,
+            resources=json.loads(args.resources),
+            labels=json.loads(args.labels))
+        await nodelet.start()
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

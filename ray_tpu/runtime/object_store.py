@@ -1,0 +1,179 @@
+"""Host shared-memory object store (plasma equivalent).
+
+TPU-native redesign of the reference's plasma store (ref:
+src/ray/object_manager/plasma/store.h:55, client.cc mmap zero-copy). Instead
+of a store *server* process with an fd-passing protocol (plasma.fbs,
+fling.cc), every object is a file in /dev/shm that any process on the host
+can mmap directly — the kernel's tmpfs is the store, the nodelet only keeps
+the index and does capacity accounting/eviction. This removes one IPC hop
+from both put and get: readers mmap and reconstruct numpy/arrow views
+zero-copy with pickle5 out-of-band buffers.
+
+Layout of a segment:
+    [8 bytes meta length][meta pickle][buffer 0][buffer 1]...
+buffers are 64-byte aligned (TPU DMA and numpy both like alignment).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import pickle
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+from .ids import ObjectID
+from . import serialization
+
+_HDR = struct.Struct(">Q")
+_ALIGN = 64
+
+
+def _aligned(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def _shm_dir(session_name: str) -> str:
+    return f"/dev/shm/rtpu_{session_name}"
+
+
+def _seg_path(session_name: str, oid: ObjectID) -> str:
+    return os.path.join(_shm_dir(session_name), oid.hex())
+
+
+class _Segment:
+    """An mmap'ed shared-memory file."""
+
+    __slots__ = ("path", "mm", "fd", "size")
+
+    def __init__(self, path: str, mm: mmap.mmap, fd: int, size: int):
+        self.path = path
+        self.mm = mm
+        self.fd = fd
+        self.size = size
+
+    @classmethod
+    def create(cls, path: str, size: int) -> "_Segment":
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd = os.open(path + ".tmp", os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+        os.ftruncate(fd, size)
+        mm = mmap.mmap(fd, size)
+        return cls(path, mm, fd, size)
+
+    def seal(self):
+        """Atomically publish: readers only ever see fully-written objects
+        (the reference's plasma Seal; ref: plasma/store.cc seal path)."""
+        os.rename(self.path + ".tmp", self.path)
+
+    @classmethod
+    def open(cls, path: str) -> "_Segment":
+        fd = os.open(path, os.O_RDONLY)
+        size = os.fstat(fd).st_size
+        mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+        return cls(path, mm, fd, size)
+
+    def close(self):
+        try:
+            self.mm.close()
+        finally:
+            os.close(self.fd)
+
+
+class ObjectStoreClient:
+    """Per-process client: put/get objects in the host store.
+
+    Pins mmaps for objects whose zero-copy views may be alive in this
+    process; `release` unpins (driven by the owner's reference counting, the
+    moral equivalent of plasma client Release; ref: plasma/client.cc).
+    """
+
+    def __init__(self, session_name: str):
+        self.session_name = session_name
+        self._pinned: Dict[ObjectID, _Segment] = {}
+
+    # ---- write path ----
+    def put_serialized(self, oid: ObjectID, sv: serialization.SerializedValue) -> int:
+        meta = sv.meta
+        offsets: List[Tuple[int, int]] = []
+        cursor = _aligned(_HDR.size + len(meta) + 8 * (1 + 2 * len(sv.buffers)))
+        # header block: meta_len, meta, nbuf, (off,len)*
+        header_tail = struct.pack(">Q", len(sv.buffers))
+        raws = [b.raw() for b in sv.buffers]
+        for raw in raws:
+            offsets.append((cursor, len(raw)))
+            header_tail += struct.pack(">QQ", cursor, len(raw))
+            cursor = _aligned(cursor + len(raw))
+        total = cursor
+        seg = _Segment.create(_seg_path(self.session_name, oid), max(total, 1))
+        mv = memoryview(seg.mm)
+        pos = 0
+        mv[pos:pos + _HDR.size] = _HDR.pack(len(meta)); pos += _HDR.size
+        mv[pos:pos + len(meta)] = meta; pos += len(meta)
+        mv[pos:pos + len(header_tail)] = header_tail
+        for (off, ln), raw in zip(offsets, raws):
+            mv[off:off + ln] = raw
+        del mv
+        seg.seal()
+        seg.close()
+        return total
+
+    def put(self, oid: ObjectID, value: Any) -> int:
+        return self.put_serialized(oid, serialization.serialize(value))
+
+    # ---- read path ----
+    def contains(self, oid: ObjectID) -> bool:
+        return os.path.exists(_seg_path(self.session_name, oid))
+
+    def get(self, oid: ObjectID) -> Any:
+        """Zero-copy deserialize. The segment stays pinned in this process
+        until `release(oid)` (views may alias the mmap)."""
+        seg = self._pinned.get(oid)
+        if seg is None:
+            seg = _Segment.open(_seg_path(self.session_name, oid))
+            self._pinned[oid] = seg
+        mv = memoryview(seg.mm)
+        (meta_len,) = _HDR.unpack_from(mv, 0)
+        pos = _HDR.size
+        meta = bytes(mv[pos:pos + meta_len]); pos += meta_len
+        (nbuf,) = struct.unpack_from(">Q", mv, pos); pos += 8
+        buffers = []
+        for _ in range(nbuf):
+            off, ln = struct.unpack_from(">QQ", mv, pos); pos += 16
+            buffers.append(mv[off:off + ln])
+        return serialization.deserialize(meta, buffers)
+
+    def release(self, oid: ObjectID):
+        seg = self._pinned.pop(oid, None)
+        if seg is not None:
+            try:
+                seg.close()
+            except BufferError:
+                # views still alive in this process; keep pinned
+                self._pinned[oid] = seg
+
+    def delete(self, oid: ObjectID):
+        self.release(oid)
+        try:
+            os.unlink(_seg_path(self.session_name, oid))
+        except FileNotFoundError:
+            pass
+
+    def size_of(self, oid: ObjectID) -> Optional[int]:
+        try:
+            return os.stat(_seg_path(self.session_name, oid)).st_size
+        except FileNotFoundError:
+            return None
+
+
+def cleanup_session(session_name: str):
+    d = _shm_dir(session_name)
+    if os.path.isdir(d):
+        for name in os.listdir(d):
+            try:
+                os.unlink(os.path.join(d, name))
+            except OSError:
+                pass
+        try:
+            os.rmdir(d)
+        except OSError:
+            pass

@@ -1,0 +1,432 @@
+"""Asyncio message-passing RPC over unix/TCP sockets.
+
+TPU-native replacement for the reference's gRPC layer (ref:
+src/ray/rpc/grpc_server.h:88, grpc_client.h:96, client_call.h:203). The
+control plane does not need gRPC's HTTP/2 machinery on a single fabric;
+length-prefixed pickle frames over asyncio sockets give the same
+request/response + server-push semantics with far less overhead per call.
+
+Includes the probabilistic fault-injection hook equivalent to the reference's
+RpcFailureManager (ref: src/ray/rpc/rpc_chaos.cc:30-49), driven by
+RuntimeConfig.testing_rpc_failure ("Method=max_failures:req_prob:resp_prob").
+
+Every process owns one background event-loop thread (`EventLoopThread`);
+synchronous callers bridge onto it with run_coroutine_threadsafe.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import os
+import random
+import struct
+import threading
+import traceback
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+from . import serialization
+
+_LEN = struct.Struct(">Q")
+
+REQ, RES, NTF = 0, 1, 2
+
+
+class RpcError(Exception):
+    pass
+
+
+class RemoteHandlerError(RpcError):
+    """The remote handler raised; carries the remote traceback."""
+
+    def __init__(self, method: str, exc_repr: str, tb: str):
+        self.method = method
+        self.exc_repr = exc_repr
+        self.tb = tb
+        super().__init__(f"rpc handler {method!r} failed: {exc_repr}\n{tb}")
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+# --------------------------------------------------------------------------
+# Fault injection (chaos) — parsed once per process from config.
+# --------------------------------------------------------------------------
+class _Chaos:
+    def __init__(self, spec: str):
+        self.rules: Dict[str, list] = {}
+        for part in filter(None, (spec or "").split(",")):
+            method, params = part.split("=")
+            mx, req_p, res_p = params.split(":")
+            self.rules[method] = [int(mx), float(req_p), float(res_p)]
+
+    def should_drop_request(self, method: str) -> bool:
+        rule = self.rules.get(method) or self.rules.get("*")
+        if not rule or rule[0] == 0:
+            return False
+        if random.random() < rule[1]:
+            rule[0] -= 1
+            return True
+        return False
+
+
+_chaos: Optional[_Chaos] = None
+
+
+def _get_chaos() -> _Chaos:
+    global _chaos
+    if _chaos is None:
+        from .config import get_config
+
+        _chaos = _Chaos(get_config().testing_rpc_failure)
+    return _chaos
+
+
+# --------------------------------------------------------------------------
+# Event loop thread
+# --------------------------------------------------------------------------
+class EventLoopThread:
+    """One asyncio loop on a daemon thread, shared per process."""
+
+    _instance: Optional["EventLoopThread"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(
+            target=self._run, name="rtpu-io", daemon=True
+        )
+        self.thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    @classmethod
+    def get(cls) -> "EventLoopThread":
+        with cls._lock:
+            if cls._instance is None or not cls._instance.thread.is_alive():
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            inst, cls._instance = cls._instance, None
+        if inst is not None and inst.thread.is_alive():
+            inst.loop.call_soon_threadsafe(inst.loop.stop)
+
+    def run(self, coro: Awaitable, timeout: Optional[float] = None):
+        """Run coroutine on the loop from a sync thread, return its result."""
+        if threading.current_thread() is self.thread:
+            raise RuntimeError(
+                "sync RPC bridge used from the io loop thread (deadlock); "
+                "use the *_async coroutine form inside handlers")
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def spawn(self, coro: Awaitable) -> None:
+        asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+
+# --------------------------------------------------------------------------
+# Framing
+# --------------------------------------------------------------------------
+async def _read_frame(reader: asyncio.StreamReader) -> bytes:
+    header = await reader.readexactly(_LEN.size)
+    (length,) = _LEN.unpack(header)
+    return await reader.readexactly(length)
+
+
+def _frame(payload: bytes) -> bytes:
+    return _LEN.pack(len(payload)) + payload
+
+
+def parse_address(address: str):
+    """'unix:/path' or 'tcp:host:port'."""
+    if address.startswith("unix:"):
+        return ("unix", address[5:])
+    if address.startswith("tcp:"):
+        host, port = address[4:].rsplit(":", 1)
+        return ("tcp", host, int(port))
+    raise ValueError(f"bad address {address!r}")
+
+
+async def _open_connection(address: str):
+    parsed = parse_address(address)
+    if parsed[0] == "unix":
+        return await asyncio.open_unix_connection(parsed[1])
+    return await asyncio.open_connection(parsed[1], parsed[2])
+
+
+# --------------------------------------------------------------------------
+# Server
+# --------------------------------------------------------------------------
+class ServerConn:
+    """One inbound connection; lets handlers push notifications back."""
+
+    def __init__(self, server: "RpcServer", writer: asyncio.StreamWriter):
+        self.server = server
+        self.writer = writer
+        self.wlock = asyncio.Lock()
+        self.closed = False
+        self.meta: Dict[str, Any] = {}  # handlers can stash identity here
+
+    async def send(self, msg_tuple) -> None:
+        payload = serialization.dumps_inline(msg_tuple)
+        async with self.wlock:
+            if self.closed:
+                raise ConnectionLost("connection closed")
+            self.writer.write(_frame(payload))
+            await self.writer.drain()
+
+    async def notify(self, method: str, **kwargs) -> None:
+        try:
+            await self.send((NTF, method, kwargs))
+        except (ConnectionLost, ConnectionError, RuntimeError):
+            self.closed = True
+
+
+class RpcServer:
+    """Dispatches named handlers. Handlers may be sync or async; they receive
+    their kwargs plus `_conn` (the ServerConn) if they declare it."""
+
+    def __init__(self, address: str,
+                 handlers: Dict[str, Callable],
+                 on_disconnect: Optional[Callable[[ServerConn], None]] = None):
+        self.address = address
+        self.handlers = dict(handlers)
+        self.on_disconnect = on_disconnect
+        self._server: Optional[asyncio.base_events.Server] = None
+        self.conns: set[ServerConn] = set()
+
+    async def start(self):
+        parsed = parse_address(self.address)
+        if parsed[0] == "unix":
+            os.makedirs(os.path.dirname(parsed[1]), exist_ok=True)
+            if os.path.exists(parsed[1]):
+                os.unlink(parsed[1])
+            self._server = await asyncio.start_unix_server(self._on_conn, parsed[1])
+        else:
+            self._server = await asyncio.start_server(self._on_conn, parsed[1], parsed[2])
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+        for conn in list(self.conns):
+            try:
+                conn.writer.close()
+            except Exception:
+                pass
+
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        conn = ServerConn(self, writer)
+        self.conns.add(conn)
+        try:
+            while True:
+                data = await _read_frame(reader)
+                msg = serialization.loads_inline(data)
+                kind = msg[0]
+                if kind == REQ:
+                    _, msg_id, method, kwargs = msg
+                    asyncio.ensure_future(self._dispatch(conn, msg_id, method, kwargs))
+                elif kind == NTF:
+                    _, method, kwargs = msg
+                    asyncio.ensure_future(self._dispatch(conn, None, method, kwargs))
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            conn.closed = True
+            self.conns.discard(conn)
+            if self.on_disconnect is not None:
+                try:
+                    res = self.on_disconnect(conn)
+                    if asyncio.iscoroutine(res):
+                        await res
+                except Exception:
+                    traceback.print_exc()
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, conn: ServerConn, msg_id, method: str, kwargs):
+        if _get_chaos().should_drop_request(method):
+            return  # simulated network drop; caller sees a hang/timeout
+        handler = self.handlers.get(method)
+        try:
+            if handler is None:
+                raise RpcError(f"no handler for {method!r}")
+            if _wants_conn(handler):
+                kwargs = dict(kwargs, _conn=conn)
+            result = handler(**kwargs)
+            if asyncio.iscoroutine(result):
+                result = await result
+            if msg_id is not None:
+                await conn.send((RES, msg_id, True, result))
+        except (ConnectionLost, ConnectionError):
+            pass
+        except Exception as e:
+            if msg_id is not None:
+                try:
+                    await conn.send(
+                        (RES, msg_id, False, (type(e).__name__, repr(e), traceback.format_exc()))
+                    )
+                except (ConnectionLost, ConnectionError):
+                    pass
+            else:
+                traceback.print_exc()
+
+
+def _wants_conn(handler) -> bool:
+    cached = getattr(handler, "_rtpu_wants_conn", None)
+    if cached is None:
+        import inspect
+
+        try:
+            cached = "_conn" in inspect.signature(handler).parameters
+        except (TypeError, ValueError):
+            cached = False
+        try:
+            handler._rtpu_wants_conn = cached
+        except AttributeError:
+            pass
+    return cached
+
+
+# --------------------------------------------------------------------------
+# Client
+# --------------------------------------------------------------------------
+class RpcClient:
+    """Persistent client to one server address.
+
+    `call` blocks the calling (sync) thread; `call_async` is the coroutine
+    form for use on the io loop. Notifications pushed by the server are routed
+    to `notify_handlers`.
+    """
+
+    def __init__(self, address: str,
+                 notify_handlers: Optional[Dict[str, Callable]] = None):
+        self.address = address
+        self.notify_handlers = notify_handlers or {}
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._wlock: Optional[asyncio.Lock] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._ids = itertools.count(1)
+        self._connect_lock: Optional[asyncio.Lock] = None
+        self._closed = False
+
+    # -- async interface (must run on the io loop) --
+    async def _ensure_connected(self):
+        if self._writer is not None and not self._writer.is_closing():
+            return
+        if self._connect_lock is None:
+            self._connect_lock = asyncio.Lock()
+        async with self._connect_lock:
+            if self._writer is not None and not self._writer.is_closing():
+                return
+            from .config import get_config
+
+            deadline = asyncio.get_event_loop().time() + get_config().rpc_connect_timeout_s
+            last_err = None
+            while asyncio.get_event_loop().time() < deadline:
+                try:
+                    self._reader, self._writer = await _open_connection(self.address)
+                    break
+                except (ConnectionRefusedError, FileNotFoundError, OSError) as e:
+                    last_err = e
+                    await asyncio.sleep(0.05)
+            else:
+                raise ConnectionLost(
+                    f"could not connect to {self.address}: {last_err}"
+                )
+            self._wlock = asyncio.Lock()
+            asyncio.ensure_future(self._read_loop(self._reader))
+
+    async def _read_loop(self, reader):
+        try:
+            while True:
+                data = await _read_frame(reader)
+                msg = serialization.loads_inline(data)
+                if msg[0] == RES:
+                    _, msg_id, ok, payload = msg
+                    fut = self._pending.pop(msg_id, None)
+                    if fut is not None and not fut.done():
+                        if ok:
+                            fut.set_result(payload)
+                        else:
+                            name, erepr, tb = payload
+                            fut.set_exception(RemoteHandlerError(name, erepr, tb))
+                elif msg[0] == NTF:
+                    _, method, kwargs = msg
+                    handler = self.notify_handlers.get(method)
+                    if handler is not None:
+                        try:
+                            res = handler(**kwargs)
+                            if asyncio.iscoroutine(res):
+                                asyncio.ensure_future(res)
+                        except Exception:
+                            traceback.print_exc()
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError, OSError):
+            pass
+        finally:
+            self._writer = None
+            err = ConnectionLost(f"connection to {self.address} lost")
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(err)
+            self._pending.clear()
+
+    async def call_async(self, method: str, _timeout: Optional[float] = None, **kwargs):
+        await self._ensure_connected()
+        msg_id = next(self._ids)
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[msg_id] = fut
+        payload = serialization.dumps_inline((REQ, msg_id, method, kwargs))
+        async with self._wlock:
+            self._writer.write(_frame(payload))
+            await self._writer.drain()
+        if _timeout:
+            return await asyncio.wait_for(fut, _timeout)
+        return await fut
+
+    async def notify_async(self, method: str, **kwargs):
+        await self._ensure_connected()
+        payload = serialization.dumps_inline((NTF, method, kwargs))
+        async with self._wlock:
+            self._writer.write(_frame(payload))
+            await self._writer.drain()
+
+    # -- sync interface (from any non-io thread) --
+    def call(self, method: str, _timeout: Optional[float] = None, **kwargs):
+        return EventLoopThread.get().run(
+            self.call_async(method, _timeout=_timeout, **kwargs)
+        )
+
+    def notify(self, method: str, **kwargs):
+        EventLoopThread.get().run(self.notify_async(method, **kwargs))
+
+    def close(self):
+        self._closed = True
+
+        async def _close():
+            if self._writer is not None:
+                try:
+                    self._writer.close()
+                except Exception:
+                    pass
+
+        elt = EventLoopThread.get()
+        try:
+            if threading.current_thread() is elt.thread:
+                asyncio.ensure_future(_close())
+            else:
+                elt.run(_close())
+        except Exception:
+            pass

@@ -1,0 +1,69 @@
+"""Serialization: cloudpickle + pickle protocol 5 out-of-band buffers.
+
+Equivalent of the reference's python/ray/_private/serialization.py: values are
+pickled with a buffer_callback so large contiguous payloads (numpy arrays,
+arrow buffers, bytes) travel as raw buffers and can be reconstructed
+zero-copy as views over shared memory on the read side.
+
+JAX device arrays are converted to host numpy on serialize (a device array is
+not addressable from another process); the device-channel path for
+actor-to-actor device buffers lives in ray_tpu.channels instead.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, List, Sequence
+
+import cloudpickle
+
+
+class SerializedValue:
+    """A pickled value split into metadata + out-of-band buffers."""
+
+    __slots__ = ("meta", "buffers")
+
+    def __init__(self, meta: bytes, buffers: List[pickle.PickleBuffer]):
+        self.meta = meta
+        self.buffers = buffers
+
+    def total_size(self) -> int:
+        return len(self.meta) + sum(len(b.raw()) for b in self.buffers)
+
+
+def _convert_jax_arrays(obj: Any) -> Any:
+    # Lazily handle jax.Array without importing jax unless it is already
+    # loaded in this process (workers that never touch jax stay light).
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return obj
+    try:
+        import numpy as np
+
+        if isinstance(obj, jax.Array):
+            return np.asarray(obj)
+    except Exception:
+        pass
+    return obj
+
+
+def serialize(value: Any) -> SerializedValue:
+    buffers: List[pickle.PickleBuffer] = []
+    value = _convert_jax_arrays(value)
+    meta = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    return SerializedValue(meta, buffers)
+
+
+def deserialize(meta: bytes, buffers: Sequence[Any]) -> Any:
+    return pickle.loads(meta, buffers=buffers)
+
+
+def dumps_inline(value: Any) -> bytes:
+    """Single-buffer pickle for small inline payloads (RPC args, messages)."""
+    return cloudpickle.dumps(value, protocol=5)
+
+
+def loads_inline(data: bytes) -> Any:
+    return pickle.loads(data)

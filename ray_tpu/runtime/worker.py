@@ -1,0 +1,295 @@
+"""Worker process: executes tasks and hosts actors.
+
+Equivalent of the reference's worker side of the core worker (ref:
+src/ray/core_worker/core_worker_process.cc:103 RunTaskExecutionLoop; task
+receive path core_worker.cc:3847 HandlePushTask → :3264 ExecuteTask) and the
+actor scheduling queues (ref: src/ray/core_worker/transport/
+actor_scheduling_queue.cc — in-order per-caller sequencing;
+fiber.h async actors; ConcurrencyGroupManager threaded actors).
+
+One worker hosts at most one actor (like the reference). Sync work runs on an
+execution thread pool; async actor methods run on a dedicated user asyncio
+loop thread so the RPC io loop never blocks on user code.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import inspect
+import os
+import sys
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+from .. import exceptions
+from . import serialization
+from .config import get_config
+from .core import CoreWorker, ObjectRef, set_core
+from .ids import ObjectID, TaskID, WorkerID
+from .rpc import EventLoopThread
+
+
+class _UserLoop:
+    """Dedicated asyncio loop thread for async actor methods."""
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._run, name="rtpu-user-loop",
+                                       daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+
+class Executor:
+    def __init__(self, core: CoreWorker):
+        self.core = core
+        self.exec_pool = ThreadPoolExecutor(max_workers=1,
+                                            thread_name_prefix="rtpu-exec")
+        self.actor_instance: Any = None
+        self.actor_id: Optional[str] = None
+        self.actor_spec: Optional[dict] = None
+        self.max_concurrency = 1
+        self.user_loop: Optional[_UserLoop] = None
+        self._async_sem: Optional[asyncio.Semaphore] = None
+        # per-caller in-order delivery (ref: actor_scheduling_queue.cc)
+        self._expected_seq: Dict[str, int] = collections.defaultdict(int)
+        self._seq_buffer: Dict[str, Dict[int, dict]] = collections.defaultdict(dict)
+        self.shutdown_event = threading.Event()
+
+    def handlers(self):
+        return {
+            "execute_task": self.h_execute_task,
+            "create_actor": self.h_create_actor,
+            "actor_call": self.h_actor_call,
+            "kill_self": self.h_kill_self,
+            "shutdown": self.h_kill_self,
+        }
+
+    # ------------------------------------------------------------ plain tasks
+    async def h_execute_task(self, spec: dict):
+        self.exec_pool.submit(self._run_task, spec)
+        return True
+
+    def _unpack_args(self, spec):
+        if "args_inline" in spec:
+            args, kwargs = serialization.loads_inline(spec["args_inline"])
+        else:
+            oid = ObjectID(spec["args_oid"])
+            ref = ObjectRef(oid, owner_addr=spec.get("args_owner"), borrowed=True)
+            args, kwargs = self.core.get(ref)
+        # resolve ObjectRef arguments by value (ref: DependencyResolver —
+        # transport/dependency_resolver.cc inlines resolved deps)
+        args = tuple(self.core.get(a) if isinstance(a, ObjectRef) else a
+                     for a in args)
+        kwargs = {k: self.core.get(v) if isinstance(v, ObjectRef) else v
+                  for k, v in kwargs.items()}
+        return args, kwargs
+
+    def _run_task(self, spec: dict):
+        task_id = spec["task_id"]
+        try:
+            fn = self.core.load_function(spec["fn_key"])
+            args, kwargs = self._unpack_args(spec)
+            result = fn(*args, **kwargs)
+            if inspect.isgenerator(result):
+                result = list(result)
+            self._send_results(spec, result)
+        except Exception as e:
+            self._send_error(spec, e)
+        finally:
+            try:
+                self.core.nodelet.notify("task_finished",
+                                         worker_id=self.core.worker_id.hex(),
+                                         task_id=task_id)
+            except Exception:
+                pass
+
+    def _package(self, value: Any):
+        sv = serialization.serialize(value)
+        return sv
+
+    def _send_results(self, spec: dict, result: Any):
+        num_returns = spec.get("num_returns", 1)
+        if num_returns == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != num_returns:
+                return self._send_error(spec, ValueError(
+                    f"task declared num_returns={num_returns} but returned "
+                    f"{len(values)} values"))
+        task_id = TaskID(spec["task_id"])
+        results = []
+        for i, value in enumerate(values):
+            sv = serialization.serialize(value)
+            if sv.total_size() <= get_config().max_direct_call_object_size:
+                results.append(("inline", serialization.dumps_inline(value)))
+            else:
+                oid = ObjectID.for_task_return(task_id, i)
+                self.core.store.put_serialized(oid, sv)
+                try:
+                    self.core.nodelet.notify("object_sealed", oid=oid.binary(),
+                                             size=sv.total_size())
+                except Exception:
+                    pass
+                results.append(("shm", None))
+        owner = self.core.client_for(spec["owner_addr"])
+        owner.notify("task_result", task_id=spec["task_id"], status="ok",
+                     results=results)
+
+    def _send_error(self, spec: dict, exc: Exception):
+        if isinstance(exc, exceptions.RtpuError):
+            err = exc
+        else:
+            err = exceptions.TaskError(
+                type(exc).__name__, repr(exc), traceback.format_exc(),
+                task_desc=spec.get("name", "task"))
+        try:
+            owner = self.core.client_for(spec["owner_addr"])
+            owner.notify("task_result", task_id=spec["task_id"],
+                         status="app_error",
+                         error=serialization.dumps_inline(err))
+        except Exception:
+            traceback.print_exc()
+
+    # ------------------------------------------------------------ actors
+    async def h_create_actor(self, spec: dict):
+        self.exec_pool.submit(self._create_actor, spec)
+        return True
+
+    def _create_actor(self, spec: dict):
+        self.actor_id = spec["actor_id"]
+        self.actor_spec = spec
+        self.max_concurrency = spec.get("max_concurrency", 1)
+        if self.max_concurrency > 1:
+            self.exec_pool = ThreadPoolExecutor(
+                max_workers=self.max_concurrency,
+                thread_name_prefix="rtpu-actor")
+        try:
+            cls = self.core.load_function(spec["cls_key"])
+            args, kwargs = self._unpack_args(spec)
+            self.actor_instance = cls(*args, **kwargs)
+            self.core.controller.call(
+                "actor_ready", actor_id=self.actor_id,
+                address=self.core.address,
+                worker_id=self.core.worker_id.hex(),
+                node_id=self.core.node_id)
+        except Exception:
+            tb = traceback.format_exc()
+            try:
+                self.core.nodelet.notify(
+                    "actor_exited", worker_id=self.core.worker_id.hex(),
+                    actor_id=self.actor_id,
+                    reason=f"creation failed: {tb}", intended=False)
+            except Exception:
+                pass
+            self.shutdown_event.set()
+
+    async def h_actor_call(self, spec: dict):
+        caller = spec["caller_id"]
+        seq = spec["seq"]
+        buf = self._seq_buffer[caller]
+        buf[seq] = spec
+        while self._expected_seq[caller] in buf:
+            next_spec = buf.pop(self._expected_seq[caller])
+            self._expected_seq[caller] += 1
+            self._start_actor_task(next_spec)
+        return True
+
+    def _start_actor_task(self, spec: dict):
+        method_name = spec["method"]
+        method = getattr(type(self.actor_instance), method_name, None) \
+            if self.actor_instance is not None else None
+        if method is not None and inspect.iscoroutinefunction(method):
+            if self.user_loop is None:
+                self.user_loop = _UserLoop()
+                sem_conc = max(self.max_concurrency, 1000
+                               if self.max_concurrency == 1 else self.max_concurrency)
+                fut = asyncio.run_coroutine_threadsafe(
+                    self._make_sem(sem_conc), self.user_loop.loop)
+                fut.result()
+            asyncio.run_coroutine_threadsafe(
+                self._run_actor_coro(spec), self.user_loop.loop)
+        else:
+            self.exec_pool.submit(self._run_actor_sync, spec)
+
+    async def _make_sem(self, n):
+        self._async_sem = asyncio.Semaphore(n)
+
+    async def _run_actor_coro(self, spec: dict):
+        async with self._async_sem:
+            try:
+                method = getattr(self.actor_instance, spec["method"])
+                loop = asyncio.get_event_loop()
+                args, kwargs = await loop.run_in_executor(
+                    None, lambda: self._unpack_args(spec))
+                result = await method(*args, **kwargs)
+                await loop.run_in_executor(
+                    None, lambda: self._send_results(spec, result))
+            except Exception as e:
+                self._send_error(spec, e)
+
+    def _run_actor_sync(self, spec: dict):
+        try:
+            if self.actor_instance is None:
+                raise exceptions.ActorDiedError(
+                    self.actor_id or "?", "actor instance not initialized")
+            method = getattr(self.actor_instance, spec["method"])
+            args, kwargs = self._unpack_args(spec)
+            result = method(*args, **kwargs)
+            if inspect.isgenerator(result):
+                result = list(result)
+            self._send_results(spec, result)
+        except Exception as e:
+            self._send_error(spec, e)
+
+    # ------------------------------------------------------------ control
+    async def h_kill_self(self):
+        if self.actor_id is not None:
+            try:
+                await self.core.nodelet.call_async(
+                    "actor_exited", worker_id=self.core.worker_id.hex(),
+                    actor_id=self.actor_id, reason="killed", intended=False)
+            except Exception:
+                pass
+        self.shutdown_event.set()
+        return True
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--session-name", required=True)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--nodelet-addr", required=True)
+    parser.add_argument("--controller-addr", required=True)
+    parser.add_argument("--worker-id", required=True)
+    args = parser.parse_args()
+
+    core = CoreWorker(
+        mode="worker", session_name=args.session_name,
+        session_dir=args.session_dir, controller_addr=args.controller_addr,
+        nodelet_addr=args.nodelet_addr, node_id=args.node_id,
+        worker_id=WorkerID.from_hex(args.worker_id))
+    set_core(core)
+    executor = Executor(core)
+    core.start(extra_handlers=executor.handlers())
+    core.nodelet.call("worker_register", worker_id=args.worker_id,
+                      address=core.address, pid=os.getpid())
+    executor.shutdown_event.wait()
+    core.flush_events()
+    core.shutdown()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Placement groups (ref: python/ray/util/placement_group.py:146;
+server side gcs_placement_group_mgr.cc / gcs_placement_group_scheduler.cc
+two-phase bundle commit).
+
+TPU addition: strategy ``SLICE_PACK`` gang-places all bundles onto nodes of
+one ICI-connected TPU slice (see runtime/scheduling.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ..exceptions import PlacementGroupSchedulingError
+from ..runtime.core import get_core
+from ..runtime.ids import PlacementGroupID
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: str, bundles: List[Dict[str, float]],
+                 strategy: str):
+        self.id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+
+    def ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until all bundles are reserved (the reference returns an
+        ObjectRef from pg.ready(); blocking bool is the simpler equivalent —
+        use wait(timeout=0) for a non-blocking probe)."""
+        return self.wait(timeout)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        core = get_core()
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        delay = 0.02
+        while True:
+            info = core.controller.call("get_placement_group", pg_id=self.id)
+            if info is None:
+                raise PlacementGroupSchedulingError(
+                    f"placement group {self.id} was removed")
+            if info["state"] == "CREATED":
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(min(delay, 0.5))
+            delay *= 1.5
+
+    @property
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        return self.bundles
+
+    def __repr__(self):
+        return f"PlacementGroup({self.id[:16]}, {self.strategy})"
+
+
+def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
+                    name: str = "") -> PlacementGroup:
+    core = get_core()
+    pg_id = PlacementGroupID.from_random().hex()
+    core.controller.call("create_placement_group", pg_id=pg_id,
+                         bundles=bundles, strategy=strategy, name=name)
+    return PlacementGroup(pg_id, bundles, strategy)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    core = get_core()
+    core.controller.call("remove_placement_group", pg_id=pg.id)
+
+
+def placement_group_table() -> list:
+    core = get_core()
+    return core.controller.call("list_placement_groups")

@@ -1,0 +1,48 @@
+"""Scheduling strategies (ref: python/ray/util/scheduling_strategies.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class PlacementGroupSchedulingStrategy:
+    def __init__(self, placement_group, placement_group_bundle_index: int = -1,
+                 placement_group_capture_child_tasks: bool = False):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.placement_group_capture_child_tasks = placement_group_capture_child_tasks
+
+
+class NodeAffinitySchedulingStrategy:
+    def __init__(self, node_id: str, soft: bool = False):
+        self.node_id = node_id
+        self.soft = soft
+
+
+class SliceAffinitySchedulingStrategy:
+    """TPU-native: constrain to nodes of one ICI slice (no reference
+    equivalent; the reference approximates with TPU-<pod>-head custom
+    resources, ref: python/ray/_private/accelerators/tpu.py:376)."""
+
+    def __init__(self, slice_id: str):
+        self.slice_id = slice_id
+
+
+def resolve_strategy(strategy) -> Dict[str, Any]:
+    """Convert a strategy object into task-spec fields."""
+    if strategy is None:
+        return {}
+    if isinstance(strategy, str):
+        return {"scheduling_strategy": strategy}
+    if isinstance(strategy, PlacementGroupSchedulingStrategy):
+        pg = strategy.placement_group
+        pg_id = pg.id if hasattr(pg, "id") else pg
+        return {"placement_group_id": pg_id,
+                "bundle_index": strategy.placement_group_bundle_index}
+    if isinstance(strategy, NodeAffinitySchedulingStrategy):
+        soft = ":soft" if strategy.soft else ""
+        return {"scheduling_strategy":
+                f"NODE_AFFINITY:{strategy.node_id}{soft}"}
+    if isinstance(strategy, SliceAffinitySchedulingStrategy):
+        return {"scheduling_strategy": f"SLICE_AFFINITY:{strategy.slice_id}"}
+    raise TypeError(f"unknown scheduling strategy {strategy!r}")

@@ -1,0 +1,50 @@
+"""Test fixtures.
+
+Mirrors the reference's test strategy (ref: python/ray/tests/conftest.py —
+ray_start_regular :588, ray_start_cluster :678): a shared session fixture for
+cheap tests, fresh-session fixtures for fault-tolerance/cluster tests.
+
+JAX tests run on a virtual 8-device CPU mesh (the reference tests multi-node
+without a real cluster the same way, via cluster_utils.Cluster).
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def shared_cluster():
+    """One session shared by tests that only need basic cluster services."""
+    import ray_tpu
+
+    session = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield session
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def fresh_cluster():
+    """A private session for tests that mutate cluster state."""
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    session = ray_tpu.init(num_cpus=4)
+    yield session
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_devices():
+    import jax
+
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, (
+        "tests expect XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    return devices

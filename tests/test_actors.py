@@ -1,0 +1,135 @@
+"""Actor tests (modeled on the reference's tests/test_actor.py coverage)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, k=1):
+        self.n += k
+        return self.n
+
+    def get(self):
+        return self.n
+
+    def crash(self):
+        raise RuntimeError("actor method boom")
+
+
+def test_actor_basic(shared_cluster):
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+    assert ray_tpu.get(c.incr.remote(5), timeout=60) == 6
+
+
+def test_actor_call_ordering(shared_cluster):
+    c = Counter.remote()
+    refs = [c.incr.remote() for _ in range(20)]
+    assert ray_tpu.get(refs, timeout=60) == list(range(1, 21))
+
+
+def test_actor_init_args(shared_cluster):
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.get.remote(), timeout=60) == 100
+
+
+def test_actor_method_error(shared_cluster):
+    c = Counter.remote()
+    with pytest.raises(exceptions.TaskError):
+        ray_tpu.get(c.crash.remote(), timeout=60)
+    # actor survives method errors
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+
+
+def test_named_actor(shared_cluster):
+    Counter.options(name="counter-xyz").remote(7)
+    handle = ray_tpu.get_actor("counter-xyz")
+    assert ray_tpu.get(handle.get.remote(), timeout=60) == 7
+
+
+def test_get_if_exists(shared_cluster):
+    a = Counter.options(name="gie", get_if_exists=True).remote(1)
+    ray_tpu.get(a.incr.remote(), timeout=60)
+    b = Counter.options(name="gie", get_if_exists=True).remote(1)
+    # same actor: state is shared
+    assert ray_tpu.get(b.get.remote(), timeout=60) == 2
+
+
+def test_actor_handle_passing(shared_cluster):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def use(handle):
+        return ray_tpu.get(handle.incr.remote(), timeout=60)
+
+    assert ray_tpu.get(use.remote(c), timeout=90) == 1
+
+
+def test_async_actor(shared_cluster):
+    @ray_tpu.remote
+    class AsyncWorker:
+        async def work(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    a = AsyncWorker.remote()
+    refs = [a.work.remote(i) for i in range(10)]
+    assert ray_tpu.get(refs, timeout=60) == [2 * i for i in range(10)]
+
+
+def test_kill_actor(shared_cluster):
+    c = Counter.remote()
+    ray_tpu.get(c.incr.remote(), timeout=60)
+    ray_tpu.kill(c)
+    with pytest.raises((exceptions.ActorDiedError, exceptions.TaskError,
+                        exceptions.WorkerCrashedError)):
+        for _ in range(20):
+            ray_tpu.get(c.incr.remote(), timeout=60)
+            time.sleep(0.2)
+
+
+def test_actor_restart(fresh_cluster):
+    @ray_tpu.remote(max_restarts=2)
+    class Flaky:
+        def __init__(self):
+            self.n = 0
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    f = Flaky.remote()
+    pid1 = ray_tpu.get(f.pid.remote(), timeout=60)
+    assert ray_tpu.get(f.incr.remote(), timeout=60) == 1
+    f.die.remote()
+    # actor should come back (state reset), possibly after a few retries
+    deadline = time.time() + 60
+    pid2 = None
+    while time.time() < deadline:
+        try:
+            pid2 = ray_tpu.get(f.pid.remote(), timeout=60)
+            break
+        except (exceptions.RtpuError, Exception):
+            time.sleep(0.3)
+    assert pid2 is not None and pid2 != pid1
+    assert ray_tpu.get(f.incr.remote(), timeout=60) == 1  # state reset

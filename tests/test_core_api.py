@@ -1,0 +1,130 @@
+"""Core API tests: tasks, objects, errors.
+
+Modeled on the reference's python/ray/tests/test_basic.py coverage.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def fail():
+    raise ValueError("boom")
+
+
+def test_simple_task(shared_cluster):
+    assert ray_tpu.get(add.remote(1, 2), timeout=60) == 3
+
+
+def test_task_chain_dependencies(shared_cluster):
+    x = add.remote(1, 1)
+    y = add.remote(x, 1)
+    z = add.remote(y, y)
+    assert ray_tpu.get(z, timeout=60) == 6
+
+
+def test_many_small_tasks(shared_cluster):
+    refs = [add.remote(i, i) for i in range(50)]
+    assert ray_tpu.get(refs, timeout=60) == [2 * i for i in range(50)]
+
+
+def test_task_error_propagates(shared_cluster):
+    with pytest.raises(exceptions.TaskError) as ei:
+        ray_tpu.get(fail.remote(), timeout=60)
+    assert "boom" in str(ei.value)
+    assert "ValueError" in str(ei.value)
+
+
+def test_error_propagates_through_dependency(shared_cluster):
+    bad = fail.remote()
+    out = add.remote(bad, 1)
+    with pytest.raises(exceptions.TaskError):
+        ray_tpu.get(out, timeout=60)
+
+
+def test_num_returns(shared_cluster):
+    @ray_tpu.remote
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.options(num_returns=3).remote()
+    assert ray_tpu.get([a, b, c], timeout=60) == [1, 2, 3]
+
+
+def test_large_args_and_returns_via_shm(shared_cluster):
+    @ray_tpu.remote
+    def double(arr):
+        return arr * 2
+
+    arr = np.ones((512, 1024), dtype=np.float32)  # 2 MB
+    out = ray_tpu.get(double.remote(arr), timeout=60)
+    assert out.shape == arr.shape
+    assert float(out[0, 0]) == 2.0
+
+
+def test_put_get_roundtrip(shared_cluster):
+    for value in (1, "s", {"a": [1, 2]}, np.arange(10)):
+        got = ray_tpu.get(ray_tpu.put(value))
+        if isinstance(value, np.ndarray):
+            np.testing.assert_array_equal(got, value)
+        else:
+            assert got == value
+
+
+def test_put_large_zero_copy(shared_cluster):
+    arr = np.random.rand(1 << 18)  # 2 MB
+    ref = ray_tpu.put(arr)
+    got = ray_tpu.get(ref)
+    np.testing.assert_array_equal(got, arr)
+
+
+def test_object_ref_as_arg(shared_cluster):
+    ref = ray_tpu.put(10)
+    assert ray_tpu.get(add.remote(ref, 5), timeout=60) == 15
+
+
+def test_wait(shared_cluster):
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    fast_ref = slow.remote(0.0)
+    slow_ref = slow.remote(5.0)
+    ready, not_ready = ray_tpu.wait([fast_ref, slow_ref], num_returns=1,
+                                    timeout=30)
+    assert ready == [fast_ref]
+    assert not_ready == [slow_ref]
+
+
+def test_get_timeout(shared_cluster):
+    @ray_tpu.remote
+    def hang():
+        time.sleep(60)
+
+    with pytest.raises(exceptions.GetTimeoutError):
+        ray_tpu.get(hang.remote(), timeout=0.5)
+
+
+def test_nested_tasks(shared_cluster):
+    @ray_tpu.remote
+    def outer():
+        inner_ref = add.remote(3, 4)
+        return ray_tpu.get(inner_ref, timeout=60)
+
+    assert ray_tpu.get(outer.remote(), timeout=90) == 7
+
+
+def test_cluster_resources(shared_cluster):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] >= 4

@@ -1,0 +1,76 @@
+"""Fault tolerance: worker crashes, retries, chaos injection.
+
+Modeled on the reference's FT tests (tests/test_gcs_fault_tolerance.py,
+RpcFailureManager chaos rpc_chaos.cc:30-49).
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+
+
+def test_task_retry_on_worker_crash(fresh_cluster):
+    """A task whose worker dies must be retried on a fresh worker
+    (ref: task_manager.cc retries; owner-side resubmission)."""
+    marker = f"/tmp/rtpu_test_crash_{os.getpid()}"
+    if os.path.exists(marker):
+        os.unlink(marker)
+
+    @ray_tpu.remote(max_retries=2)
+    def crash_once(path):
+        if not os.path.exists(path):
+            with open(path, "w") as f:
+                f.write("1")
+            os._exit(1)  # simulate worker crash
+        return "recovered"
+
+    assert ray_tpu.get(crash_once.remote(marker), timeout=120) == "recovered"
+    os.unlink(marker)
+
+
+def test_task_no_retry_exhausted(fresh_cluster):
+    @ray_tpu.remote(max_retries=1)
+    def always_crash():
+        os._exit(1)
+
+    with pytest.raises(exceptions.WorkerCrashedError):
+        ray_tpu.get(always_crash.remote(), timeout=120)
+
+
+def test_app_error_not_retried_by_default(fresh_cluster):
+    counter_file = f"/tmp/rtpu_test_count_{os.getpid()}"
+    if os.path.exists(counter_file):
+        os.unlink(counter_file)
+
+    @ray_tpu.remote
+    def fail_and_count(path):
+        with open(path, "a") as f:
+            f.write("x")
+        raise ValueError("app error")
+
+    with pytest.raises(exceptions.TaskError):
+        ray_tpu.get(fail_and_count.remote(counter_file), timeout=120)
+    with open(counter_file) as f:
+        assert len(f.read()) == 1  # executed exactly once
+    os.unlink(counter_file)
+
+
+def test_retry_exceptions_opt_in(fresh_cluster):
+    marker = f"/tmp/rtpu_test_retry_exc_{os.getpid()}"
+    if os.path.exists(marker):
+        os.unlink(marker)
+
+    @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+    def flaky(path):
+        if not os.path.exists(path):
+            with open(path, "w") as f:
+                f.write("1")
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert ray_tpu.get(flaky.remote(marker), timeout=120) == "ok"
+    os.unlink(marker)

@@ -1,0 +1,109 @@
+"""Scheduling policy unit tests (pure, no cluster) + placement group tests.
+
+Modeled on the reference's scheduling unit tests (ref:
+src/ray/raylet/scheduling/cluster_resource_scheduler_test.cc,
+bundle scheduling policies bundle_scheduling_policy.h:82-106).
+"""
+
+import pytest
+
+from ray_tpu.runtime import scheduling
+
+
+class FakeNode:
+    def __init__(self, node_id, resources, labels=None, alive=True):
+        self.node_id = node_id
+        self.total_resources = dict(resources)
+        self.available_resources = dict(resources)
+        self.labels = labels or {}
+        self.alive = alive
+
+
+def test_pick_node_feasibility():
+    nodes = [FakeNode("a", {"CPU": 2}), FakeNode("b", {"CPU": 8})]
+    chosen = scheduling.pick_node_for(nodes, {"CPU": 4})
+    assert chosen.node_id == "b"
+    assert scheduling.pick_node_for(nodes, {"CPU": 100}) is None
+
+
+def test_pick_node_affinity():
+    nodes = [FakeNode("a", {"CPU": 2}), FakeNode("b", {"CPU": 8})]
+    chosen = scheduling.pick_node_for(nodes, {"CPU": 1},
+                                      strategy="NODE_AFFINITY:a")
+    assert chosen.node_id == "a"
+    assert scheduling.pick_node_for(
+        nodes, {"CPU": 100}, strategy="NODE_AFFINITY:a") is None
+    # soft affinity falls back
+    chosen = scheduling.pick_node_for(nodes, {"CPU": 4},
+                                      strategy="NODE_AFFINITY:a:soft")
+    assert chosen.node_id == "b"
+
+
+def test_spread_prefers_empty():
+    a = FakeNode("a", {"CPU": 8})
+    a.available_resources = {"CPU": 1}
+    b = FakeNode("b", {"CPU": 8})
+    chosen = scheduling.pick_node_for([a, b], {"CPU": 1}, strategy="SPREAD")
+    assert chosen.node_id == "b"
+
+
+def test_place_bundles_strict_pack():
+    nodes = [FakeNode("a", {"CPU": 2}), FakeNode("b", {"CPU": 8})]
+    placement = scheduling.place_bundles(
+        nodes, [{"CPU": 2}, {"CPU": 2}], "STRICT_PACK")
+    assert placement == ["b", "b"]
+
+
+def test_place_bundles_strict_spread():
+    nodes = [FakeNode("a", {"CPU": 4}), FakeNode("b", {"CPU": 4})]
+    placement = scheduling.place_bundles(
+        nodes, [{"CPU": 2}, {"CPU": 2}], "STRICT_SPREAD")
+    assert placement is not None
+    assert len(set(placement)) == 2
+    assert scheduling.place_bundles(
+        nodes, [{"CPU": 1}] * 3, "STRICT_SPREAD") is None
+
+
+def test_place_bundles_slice_pack():
+    nodes = [
+        FakeNode("a", {"TPU": 4}, labels={"slice_id": "s0"}),
+        FakeNode("b", {"TPU": 4}, labels={"slice_id": "s0"}),
+        FakeNode("c", {"TPU": 4}, labels={"slice_id": "s1"}),
+    ]
+    placement = scheduling.place_bundles(
+        nodes, [{"TPU": 4}, {"TPU": 4}], "SLICE_PACK")
+    assert placement is not None
+    assert {n for n in placement} <= {"a", "b"}  # all in slice s0
+    # a 3-bundle slice gang cannot fit in any single slice
+    assert scheduling.place_bundles(
+        nodes, [{"TPU": 4}] * 3, "SLICE_PACK") is None
+
+
+def test_placement_group_end_to_end(shared_cluster):
+    import ray_tpu
+    from ray_tpu.util.placement_group import (
+        placement_group, remove_placement_group)
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy)
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote
+    def where():
+        return "ok"
+
+    ref = where.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0)).remote()
+    assert ray_tpu.get(ref, timeout=60) == "ok"
+    remove_placement_group(pg)
+
+
+def test_infeasible_pg_pending(shared_cluster):
+    from ray_tpu.util.placement_group import (
+        placement_group, remove_placement_group)
+
+    pg = placement_group([{"CPU": 10000}], strategy="PACK")
+    assert pg.wait(timeout=0.5) is False
+    remove_placement_group(pg)
