@@ -1,0 +1,97 @@
+"""Headline benchmark: Llama training MFU on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: the reference publishes no TPU training numbers; the north-star
+target from BASELINE.json is >=40% MFU for Llama-class training, so
+vs_baseline = measured_mfu / 40.
+"""
+
+import json
+import sys
+import time
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    # bf16 peak TFLOP/s per chip
+    table = {
+        "tpu v5 lite": 197e12, "tpu v5e": 197e12,
+        "tpu v5p": 459e12, "tpu v5": 459e12,
+        "tpu v4": 275e12, "tpu v6e": 918e12, "tpu v6 lite": 918e12,
+    }
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models.llama import LlamaModel, get_config
+    from ray_tpu.parallel.mesh import create_mesh, MeshConfig
+    from ray_tpu.parallel.train_lib import ShardedTrainer, default_optimizer
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = get_config("llama-500m", param_dtype=jnp.float32)
+        batch_size, seq = 8, 2048
+        steps, warmup = 20, 3
+    else:  # CPU smoke so the bench always emits a line
+        cfg = get_config("tiny")
+        batch_size, seq = 4, 128
+        steps, warmup = 3, 1
+
+    model = LlamaModel(cfg)
+    mesh = create_mesh(MeshConfig(dp=1, fsdp=1, sp=1, tp=1),
+                       devices=jax.devices()[:1])
+    trainer = ShardedTrainer(model, mesh, optimizer=default_optimizer())
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, cfg.vocab_size, (batch_size, seq + 1), dtype=np.int32)}
+
+    state = trainer.init(jax.random.PRNGKey(0), batch)
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+
+    for _ in range(warmup):
+        state, metrics = trainer.step(state, batch)
+    # NOTE: block_until_ready is a no-op on the tunneled TPU platform in this
+    # image; a host transfer is the reliable synchronization point.
+    float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = trainer.step(state, batch)
+    float(metrics["loss"])  # final loss depends on every step: full sync
+    dt = time.perf_counter() - t0
+
+    tokens = batch_size * seq * steps
+    tokens_per_s = tokens / dt
+    # training FLOPs: 6*N per token (fwd+bwd) + attention term
+    hd = cfg.head_dim_
+    attn_flops_per_tok = 12 * cfg.num_layers * cfg.num_heads * hd * seq
+    flops_per_tok = 6 * n_params + attn_flops_per_tok
+    achieved = tokens_per_s * flops_per_tok
+    peak = _peak_flops(jax.devices()[0])
+    mfu = 100.0 * achieved / peak
+
+    result = {
+        "metric": "llama500m_train_mfu_1chip" if on_tpu else "llama_tiny_cpu_smoke",
+        "value": round(mfu, 2),
+        "unit": "% MFU",
+        "vs_baseline": round(mfu / 40.0, 3),
+        "detail": {
+            "tokens_per_s": round(tokens_per_s, 1),
+            "params": n_params,
+            "batch": batch_size, "seq": seq,
+            "loss": round(float(metrics["loss"]), 4),
+            "backend": jax.default_backend(),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,243 @@
+"""Llama-family decoder-only transformer in Flax, TPU-first.
+
+This is the flagship model family (the reference frames its LLM story around
+Llama-3 via external engines; here the model is native). Design choices for
+the MXU/XLA:
+- bfloat16 activations, fp32 RMSNorm statistics and softmax logits
+- fused QKV and gate+up projections (fewer, larger matmuls)
+- `nn.scan` over layers: one compiled layer body, weights stacked with a
+  leading `layers` axis (fast compiles, enables pipelining later)
+- optional `jax.checkpoint` rematerialisation per layer (HBM for FLOPs)
+- logical axis names on every param so one rule table maps the model onto
+  any mesh (see ray_tpu/parallel/sharding.py)
+- attention dispatches to the Pallas flash kernel on TPU (ray_tpu/ops)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention
+
+A = nn.with_logical_partitioning  # annotate param init with logical axes
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 5632
+    num_layers: int = 22
+    num_heads: int = 16
+    num_kv_heads: int = 8
+    head_dim: Optional[int] = None
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    scan_layers: bool = True
+    attention_impl: Optional[str] = None  # None = auto (flash on TPU)
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    def num_params(self) -> int:
+        h, f, v, l = (self.hidden_size, self.intermediate_size,
+                      self.vocab_size, self.num_layers)
+        hd = self.head_dim_
+        attn = h * hd * (self.num_heads + 2 * self.num_kv_heads) \
+            + self.num_heads * hd * h
+        mlp = 3 * h * f
+        return l * (attn + mlp + 2 * h) + 2 * v * h + h
+
+
+# ---------------------------------------------------------------- components
+class RMSNorm(nn.Module):
+    eps: float
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", A(nn.initializers.ones, ("embed",)),
+                           (x.shape[-1],), jnp.float32)
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        y = x.astype(jnp.float32) * jax.lax.rsqrt(var + self.eps)
+        return (y * scale).astype(self.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: [B, S, H, D], positions: [B, S]."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, kv_cache=None, segment_ids=None):
+        cfg = self.config
+        hd = cfg.head_dim_
+        nq, nkv = cfg.num_heads, cfg.num_kv_heads
+        # fused QKV: one [h, (nq+2*nkv)*hd] matmul feeds the MXU better than 3
+        qkv = nn.DenseGeneral(
+            features=(nq + 2 * nkv) * hd, use_bias=False, axis=-1,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=A(nn.initializers.lecun_normal(), ("embed", "qkv")),
+            name="qkv_proj")(x)
+        q, k, v = jnp.split(qkv, [nq * hd, (nq + nkv) * hd], axis=-1)
+        b, s = x.shape[:2]
+        q = q.reshape(b, s, nq, hd)
+        k = k.reshape(b, s, nkv, hd)
+        v = v.reshape(b, s, nkv, hd)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        if kv_cache is not None:
+            # decode path: append to cache (serving engine manages layout)
+            k = jnp.concatenate([kv_cache[0], k], axis=1)
+            v = jnp.concatenate([kv_cache[1], v], axis=1)
+        # always causal: reference_attention masks relative to the cache
+        # length (tril k=sk-sq), which is correct for multi-token decode
+        # and chunked prefill as well as plain training
+        out = attention(q, k, v, causal=True,
+                        segment_ids=segment_ids, impl=cfg.attention_impl)
+        out = out.reshape(b, s, nq * hd)
+        out = nn.DenseGeneral(
+            features=cfg.hidden_size, use_bias=False, axis=-1,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=A(nn.initializers.lecun_normal(), ("heads", "embed")),
+            name="o_proj")(out)
+        new_cache = (k, v) if kv_cache is not None else None
+        return out, new_cache
+
+
+class MLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        # fused gate+up projection
+        gate_up = nn.DenseGeneral(
+            features=2 * cfg.intermediate_size, use_bias=False, axis=-1,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=A(nn.initializers.lecun_normal(), ("embed", "mlp")),
+            name="gate_up_proj")(x)
+        gate, up = jnp.split(gate_up, 2, axis=-1)
+        y = nn.silu(gate) * up
+        return nn.DenseGeneral(
+            features=cfg.hidden_size, use_bias=False, axis=-1,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=A(nn.initializers.lecun_normal(), ("mlp", "embed")),
+            name="down_proj")(y)
+
+
+class DecoderLayer(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.config
+        h, _ = Attention(cfg, name="attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="attn_norm")(x),
+            positions, segment_ids=segment_ids)
+        x = x + h
+        h = MLP(cfg, name="mlp")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="mlp_norm")(x))
+        return x + h
+
+
+class ScannedLayer(nn.Module):
+    """One layer body, scanned over a stacked `layers` param axis."""
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, positions, segment_ids = carry
+        x = DecoderLayer(self.config, name="layer")(x, positions, segment_ids)
+        return (x, positions, segment_ids), None
+
+
+class LlamaModel(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, segment_ids=None):
+        cfg = self.config
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(input_ids.shape[1]), input_ids.shape)
+        embed = self.param(
+            "embed", A(nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        x = embed[input_ids].astype(cfg.dtype)
+
+        if cfg.scan_layers:
+            layer_cls = ScannedLayer
+            if cfg.remat:
+                layer_cls = nn.remat(
+                    ScannedLayer, prevent_cse=False,
+                    policy=jax.checkpoint_policies.nothing_saveable)
+            (x, _, _), _ = nn.scan(
+                layer_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layers")((x, positions, segment_ids), None)
+        else:
+            layer_cls = DecoderLayer
+            if cfg.remat:
+                layer_cls = nn.remat(DecoderLayer, prevent_cse=False)
+            for i in range(cfg.num_layers):
+                x = layer_cls(cfg, name=f"layer_{i}")(x, positions, segment_ids)
+
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="final_norm")(x)
+        logits = nn.DenseGeneral(
+            features=cfg.vocab_size, use_bias=False, axis=-1,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=A(nn.initializers.lecun_normal(), ("embed", "vocab")),
+            name="lm_head")(x)
+        return logits
+
+
+# ---------------------------------------------------------------- registry
+CONFIGS = {
+    "tiny": LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_layers=2, num_heads=4, num_kv_heads=2,
+                        max_seq_len=256, remat=False),
+    "debug-sharded": LlamaConfig(vocab_size=512, hidden_size=128,
+                                 intermediate_size=256, num_layers=2,
+                                 num_heads=8, num_kv_heads=4,
+                                 max_seq_len=512, remat=False),
+    "llama-500m": LlamaConfig(vocab_size=32000, hidden_size=1024,
+                              intermediate_size=4096, num_layers=24,
+                              num_heads=16, num_kv_heads=8),
+    "llama-1b": LlamaConfig(vocab_size=32000, hidden_size=2048,
+                            intermediate_size=5632, num_layers=22,
+                            num_heads=32, num_kv_heads=8),
+    "llama3-8b": LlamaConfig(vocab_size=128256, hidden_size=4096,
+                             intermediate_size=14336, num_layers=32,
+                             num_heads=32, num_kv_heads=8,
+                             rope_theta=500000.0),
+}
+
+
+def get_config(name: str, **overrides) -> LlamaConfig:
+    return dataclasses.replace(CONFIGS[name], **overrides)

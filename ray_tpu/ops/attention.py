@@ -1,0 +1,88 @@
+"""Attention ops with swappable backends.
+
+The compute core the reference delegates to external engines (torch SDPA /
+vLLM CUDA kernels; the reference itself ships no attention kernels — see
+SURVEY.md §2.4) implemented TPU-native: a jnp reference implementation that
+XLA fuses well on any backend, and a Pallas flash-attention kernel for TPU
+(ray_tpu/ops/flash_attention.py). GQA (grouped KV heads) is supported
+everywhere; selection is automatic by platform unless forced via `impl`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """[B, S, Hkv, D] -> [B, S, Hkv*n_rep, D] for grouped-query attention."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d)
+
+
+def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        *, causal: bool = True,
+                        segment_ids: Optional[jax.Array] = None,
+                        scale: Optional[float] = None) -> jax.Array:
+    """Plain softmax attention. Shapes: q [B,Sq,Hq,D], k/v [B,Sk,Hkv,D]."""
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
+    if hq != hkv:
+        assert hq % hkv == 0, (hq, hkv)
+        k = _repeat_kv(k, hq // hkv)
+        v = _repeat_kv(v, hq // hkv)
+    scale = scale if scale is not None else d ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        logits = jnp.where(seg_mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+              causal: bool = True,
+              segment_ids: Optional[jax.Array] = None,
+              impl: Optional[str] = None) -> jax.Array:
+    """Dispatch to the best backend for this platform.
+
+    impl: None (auto) | "reference" | "flash" (Pallas TPU kernel).
+    """
+    auto = impl is None
+    if auto:
+        impl = "flash" if jax.default_backend() == "tpu" else "reference"
+    if impl == "flash":
+        try:
+            from .flash_attention import flash_attention
+        except ImportError:
+            if not auto:
+                raise  # explicitly requested flash: surface the error
+            _warn_flash_fallback("kernel module unavailable")
+        else:
+            return flash_attention(q, k, v, causal=causal,
+                                   segment_ids=segment_ids)
+    return reference_attention(q, k, v, causal=causal,
+                               segment_ids=segment_ids)
+
+
+_warned = set()
+
+
+def _warn_flash_fallback(reason: str):
+    if reason not in _warned:
+        _warned.add(reason)
+        import warnings
+
+        warnings.warn(f"falling back to reference attention: {reason}")

@@ -1,0 +1,90 @@
+"""Device mesh construction and axis conventions.
+
+The TPU-native replacement for the reference's process-group world: where the
+reference wires torch.distributed NCCL groups per strategy (ref:
+python/ray/train/torch/config.py:66 _setup_torch_process_group), we express
+every parallelism strategy as an axis of one jax.sharding.Mesh and let XLA
+insert ICI/DCN collectives (ref inventory of strategies: SURVEY.md §2.4).
+
+Axis conventions (order matters — outer axes ride DCN, inner ride ICI):
+  dp    data parallel (pure replication of params)
+  fsdp  data parallel with parameter sharding (ZeRO-3 style)
+  sp    sequence/context parallel (ring attention axis)
+  tp    tensor parallel (megatron-style in/out sharding)
+No NCCL anywhere: inside a slice collectives ride ICI; across slices the
+same mesh axes map onto DCN via the standard JAX device order.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Degrees for each parallelism axis. -1 on one axis = fill remaining."""
+
+    dp: int = 1
+    fsdp: int = -1
+    sp: int = 1
+    tp: int = 1
+
+    def resolved(self, n_devices: int) -> Dict[str, int]:
+        sizes = {"dp": self.dp, "fsdp": self.fsdp, "sp": self.sp, "tp": self.tp}
+        fill_axes = [a for a, s in sizes.items() if s == -1]
+        known = math.prod(s for s in sizes.values() if s != -1)
+        if n_devices % known != 0:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fixed axes {sizes}")
+        rest = n_devices // known
+        if not fill_axes:
+            if known != n_devices:
+                raise ValueError(
+                    f"mesh {sizes} covers {known} devices, have {n_devices}")
+        elif len(fill_axes) == 1:
+            sizes[fill_axes[0]] = rest
+        else:
+            raise ValueError("at most one axis may be -1")
+        return sizes
+
+
+def create_mesh(config: Optional[MeshConfig] = None,
+                devices: Optional[Sequence] = None) -> Mesh:
+    """Build the global mesh. Device order follows jax.devices(), which on
+    TPU enumerates ICI-adjacent chips contiguously — inner (rightmost) mesh
+    axes therefore map to ICI neighbours, which is where tp/sp belong."""
+    devices = list(devices if devices is not None else jax.devices())
+    config = config or MeshConfig()
+    sizes = config.resolved(len(devices))
+    shape = tuple(sizes[a] for a in AXES)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXES)
+
+
+def single_device_mesh() -> Mesh:
+    return Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1, 1, 1), AXES)
+
+
+# ---------------------------------------------------------------------------
+# PartitionSpec helpers
+# ---------------------------------------------------------------------------
+def batch_spec() -> P:
+    """Batch dim sharded over both replication axes."""
+    return P(("dp", "fsdp"))
+
+
+def activation_spec(seq_sharded: bool = False) -> P:
+    """[batch, seq, hidden] activations."""
+    return P(("dp", "fsdp"), "sp" if seq_sharded else None, None)
+
+
+def sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))

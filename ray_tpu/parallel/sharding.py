@@ -1,0 +1,55 @@
+"""Logical-axis → mesh-axis sharding rules (GSPMD).
+
+One rule table maps every model onto any MeshConfig — the TPU-native
+equivalent of the reference's per-strategy wrapper classes (torch DDP/FSDP
+wrapping at ref: python/ray/train/torch/train_loop_utils.py:153-181). There
+is no wrapper: parameters carry logical axis names (see models/llama.py) and
+these rules place them, XLA inserts the collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (logical axis name, mesh axis/axes or None)
+DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
+    ("batch", ("dp", "fsdp")),
+    ("seq", "sp"),
+    ("embed", "fsdp"),      # ZeRO-style parameter sharding
+    ("qkv", "tp"),
+    ("heads", "tp"),
+    ("mlp", "tp"),
+    ("vocab", "tp"),
+    ("layers", None),       # scan axis never sharded (pipeline uses stages)
+)
+
+
+def logical_to_sharding(logical_specs, mesh: Mesh,
+                        rules=DEFAULT_RULES):
+    """Map a pytree of logical PartitionSpecs to NamedShardings."""
+    return nn.logical_to_mesh_sharding(logical_specs, mesh, rules)
+
+
+def param_shardings(model: nn.Module, mesh: Mesh, example_inputs,
+                    rules=DEFAULT_RULES, rngs=None):
+    """Shape-evaluate init to derive parameter shardings without allocating."""
+    import jax.numpy as jnp
+
+    rngs = rngs or jax.random.PRNGKey(0)
+    abstract = jax.eval_shape(lambda: model.init(rngs, *example_inputs))
+    logical = nn.get_partition_spec(abstract)
+    return logical_to_sharding(logical, mesh, rules), abstract
+
+
+def constrain(x, mesh: Mesh, *spec, rules=DEFAULT_RULES):
+    """with_sharding_constraint using logical names."""
+    resolved = nn.logical_to_mesh_sharding(P(*spec), mesh, rules)
+    return jax.lax.with_sharding_constraint(x, resolved)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

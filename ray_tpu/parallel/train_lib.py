@@ -1,0 +1,193 @@
+"""Sharded training-step builder (pure JAX; used by bench, Train, tests).
+
+The compute-path counterpart of the reference's training loop utilities
+(ref: python/ray/train/torch/train_loop_utils.py prepare_model/prepare_data):
+instead of wrapping a model in DDP/FSDP, we jit one train step whose
+in/out shardings place parameters by the logical rule table and let GSPMD
+derive gradient collectives (reduce-scatter/all-gather over fsdp, psum over
+dp) on ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import sharding as shd
+from .mesh import create_mesh, MeshConfig
+
+
+@dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+    def tree_flatten(self):
+        return ((self.step, self.params, self.opt_state), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    TrainState, TrainState.tree_flatten, TrainState.tree_unflatten)
+
+
+def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
+                       mask: Optional[jax.Array] = None) -> jax.Array:
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    return jnp.mean(nll)
+
+
+def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
+                      warmup: int = 100, total_steps: int = 10000,
+                      grad_clip: float = 1.0) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, lr, warmup, max(total_steps, warmup + 1), lr * 0.1)
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )
+
+
+class ShardedTrainer:
+    """Holds model + mesh + jitted step. One instance per host process.
+
+    Usage:
+        trainer = ShardedTrainer(model, mesh)
+        state = trainer.init(rng, example_batch)
+        state, metrics = trainer.step(state, batch)
+    """
+
+    def __init__(self, model: nn.Module, mesh: Optional[Mesh] = None,
+                 optimizer: Optional[optax.GradientTransformation] = None,
+                 rules=shd.DEFAULT_RULES,
+                 loss_fn: Optional[Callable] = None,
+                 donate_state: bool = True):
+        self.model = model
+        self.mesh = mesh if mesh is not None else create_mesh(MeshConfig())
+        self.tx = optimizer or default_optimizer()
+        self.rules = rules
+        self.loss_fn = loss_fn or self._default_loss
+        self._batch_sharding = NamedSharding(self.mesh, P(("dp", "fsdp"), None))
+        self._state_shardings = None
+        self._jit_step = None
+        self._jit_eval = None
+        self._donate = donate_state
+
+    # -------------------------------------------------------------- loss
+    def _default_loss(self, params, batch):
+        input_ids = batch["input_ids"]
+        logits = self.model.apply({"params": params}, input_ids[:, :-1])
+        targets = input_ids[:, 1:]
+        mask = batch.get("loss_mask")
+        mask = mask[:, 1:] if mask is not None else None
+        return cross_entropy_loss(logits, targets, mask)
+
+    # -------------------------------------------------------------- init
+    def state_shardings(self, example_batch):
+        if self._state_shardings is not None:
+            return self._state_shardings
+        ids = example_batch["input_ids"]
+        abstract = jax.eval_shape(
+            lambda: self.model.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((1,) + tuple(ids.shape[1:]), jnp.int32)))
+        logical = nn.get_partition_spec(abstract)
+        params_shardings = shd.logical_to_sharding(
+            logical, self.mesh, self.rules)["params"]
+        opt_shardings = self._opt_shardings(nn.meta.unbox(abstract["params"]),
+                                            params_shardings)
+        self._state_shardings = TrainState(
+            step=NamedSharding(self.mesh, P()),
+            params=params_shardings,
+            opt_state=opt_shardings)
+        return self._state_shardings
+
+    def _opt_shardings(self, abstract_params, params_shardings):
+        """Optimizer slots that mirror a param shape get its sharding."""
+        abstract_opt = jax.eval_shape(
+            lambda p: self.tx.init(p),
+            jax.tree.map(lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype),
+                         abstract_params))
+        shapes = {}
+        jax.tree.map(lambda s, sh: shapes.setdefault(s.shape, sh),
+                     abstract_params, params_shardings)
+
+        def pick(leaf):
+            sh = shapes.get(leaf.shape)
+            if sh is not None and len(leaf.shape) > 0:
+                return sh
+            return NamedSharding(self.mesh, P())
+
+        return jax.tree.map(pick, abstract_opt)
+
+    def init(self, rng, example_batch) -> TrainState:
+        shardings = self.state_shardings(example_batch)
+
+        def _init(rng):
+            params = self.model.init(
+                rng, jnp.zeros_like(example_batch["input_ids"])[:, :-1]
+            )["params"]
+            params = nn.meta.unbox(params)
+            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                              opt_state=self.tx.init(params))
+
+        with self.mesh:
+            init_jit = jax.jit(_init, out_shardings=shardings)
+            return init_jit(rng)
+
+    # -------------------------------------------------------------- step
+    def _build_step(self, example_batch):
+        shardings = self.state_shardings(example_batch)
+
+        def _step(state: TrainState, batch):
+            def loss_fn(params):
+                return self.loss_fn(params, batch)
+
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            updates, new_opt = self.tx.update(grads, state.opt_state,
+                                              state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            gnorm = optax.global_norm(grads)
+            return (TrainState(step=state.step + 1, params=new_params,
+                               opt_state=new_opt),
+                    {"loss": loss, "grad_norm": gnorm})
+
+        metric_shardings = {"loss": NamedSharding(self.mesh, P()),
+                            "grad_norm": NamedSharding(self.mesh, P())}
+        self._jit_step = jax.jit(
+            _step,
+            in_shardings=(shardings, self._batch_sharding),
+            out_shardings=(shardings, metric_shardings),
+            donate_argnums=(0,) if self._donate else ())
+        return self._jit_step
+
+    def step(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
+        if self._jit_step is None:
+            self._build_step(batch)
+        if not isinstance(batch, dict):
+            batch = {"input_ids": batch}
+        batch = {k: jax.device_put(v, self._batch_sharding)
+                 for k, v in batch.items()}
+        with self.mesh:
+            return self._jit_step(state, batch)
+
+    def eval_loss(self, state: TrainState, batch) -> jax.Array:
+        if self._jit_eval is None:
+            self._jit_eval = jax.jit(self.loss_fn)
+        with self.mesh:
+            return self._jit_eval(state.params, batch)

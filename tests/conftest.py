@@ -10,10 +10,17 @@ without a real cluster the same way, via cluster_utils.Cluster).
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
+# Force the CPU backend with 8 virtual devices. Env vars are unreliable in
+# this image (a site hook pre-imports jax._src at interpreter startup and
+# snapshots the env), so set the config directly — this must happen before
+# any test initializes a backend. Subprocesses (cluster workers) inherit the
+# env vars instead.
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 
