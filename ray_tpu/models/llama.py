@@ -112,9 +112,20 @@ class Attention(nn.Module):
             # decode path: append to cache (serving engine manages layout)
             k = jnp.concatenate([kv_cache[0], k], axis=1)
             v = jnp.concatenate([kv_cache[1], v], axis=1)
-        # always causal: reference_attention masks relative to the cache
-        # length (tril k=sk-sq), which is correct for multi-token decode
-        # and chunked prefill as well as plain training
+            if segment_ids is not None:
+                if not isinstance(segment_ids, tuple):
+                    # a single array must cover the FULL kv axis (cache +
+                    # new tokens); the query part is its suffix
+                    segment_ids = (segment_ids[:, -s:], segment_ids)
+                q_seg, kv_seg = segment_ids
+                if kv_seg.shape[1] != k.shape[1]:
+                    raise ValueError(
+                        f"kv segment_ids length {kv_seg.shape[1]} must "
+                        f"equal cache+input length {k.shape[1]}")
+                segment_ids = (q_seg, kv_seg)
+        # always causal: the kernels mask relative to the end of the kv axis
+        # (tril k=sk-sq), which is correct for multi-token decode and
+        # chunked prefill as well as plain training
         out = attention(q, k, v, causal=True,
                         segment_ids=segment_ids, impl=cfg.attention_impl)
         out = out.reshape(b, s, nq * hd)
@@ -152,33 +163,48 @@ class DecoderLayer(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None):
+    def __call__(self, x, positions, segment_ids=None, kv_cache=None):
         cfg = self.config
-        h, _ = Attention(cfg, name="attn")(
+        h, new_cache = Attention(cfg, name="attn")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="attn_norm")(x),
-            positions, segment_ids=segment_ids)
+            positions, kv_cache=kv_cache, segment_ids=segment_ids)
         x = x + h
         h = MLP(cfg, name="mlp")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="mlp_norm")(x))
-        return x + h
+        return x + h, new_cache
 
 
 class ScannedLayer(nn.Module):
-    """One layer body, scanned over a stacked `layers` param axis."""
+    """One layer body, scanned over a stacked `layers` param axis.
+
+    The per-layer kv cache rides the scan's xs/ys axis: caches come in
+    stacked [L, ...] and updated caches come out the same way.
+    """
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, carry, _):
+    def __call__(self, carry, kv_cache):
         x, positions, segment_ids = carry
-        x = DecoderLayer(self.config, name="layer")(x, positions, segment_ids)
-        return (x, positions, segment_ids), None
+        x, new_cache = DecoderLayer(self.config, name="layer")(
+            x, positions, segment_ids, kv_cache)
+        return (x, positions, segment_ids), new_cache
 
 
 class LlamaModel(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, segment_ids=None):
+    def __call__(self, input_ids, positions=None, segment_ids=None,
+                 kv_caches=None):
+        """Forward pass.
+
+        kv_caches: None (training / full prefill), or a (k, v) pair stacked
+        over layers — k/v shaped [L, B, S_cache, Hkv, D] when scan_layers,
+        else a list of L per-layer (k, v) tuples.  When given, returns
+        (logits, new_kv_caches); `positions` must then hold the absolute
+        positions of `input_ids` and `segment_ids` (if any) must span the
+        full cache+input kv axis.
+        """
         cfg = self.config
         if positions is None:
             positions = jnp.broadcast_to(
@@ -194,19 +220,24 @@ class LlamaModel(nn.Module):
                 layer_cls = nn.remat(
                     ScannedLayer, prevent_cse=False,
                     policy=jax.checkpoint_policies.nothing_saveable)
-            (x, _, _), _ = nn.scan(
+            (x, _, _), new_caches = nn.scan(
                 layer_cls,
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, name="layers")((x, positions, segment_ids), None)
+            )(cfg, name="layers")((x, positions, segment_ids), kv_caches)
         else:
             layer_cls = DecoderLayer
             if cfg.remat:
                 layer_cls = nn.remat(DecoderLayer, prevent_cse=False)
+            new_caches = [] if kv_caches is not None else None
             for i in range(cfg.num_layers):
-                x = layer_cls(cfg, name=f"layer_{i}")(x, positions, segment_ids)
+                cache_i = kv_caches[i] if kv_caches is not None else None
+                x, new_cache = layer_cls(cfg, name=f"layer_{i}")(
+                    x, positions, segment_ids, cache_i)
+                if kv_caches is not None:
+                    new_caches.append(new_cache)
 
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="final_norm")(x)
         logits = nn.DenseGeneral(
@@ -214,6 +245,8 @@ class LlamaModel(nn.Module):
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             kernel_init=A(nn.initializers.lecun_normal(), ("embed", "vocab")),
             name="lm_head")(x)
+        if kv_caches is not None:
+            return logits, new_caches
         return logits
 
 

@@ -28,11 +28,34 @@ def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
         b, s, h * n_rep, d)
 
 
+def split_segment_ids(segment_ids, sq: int, sk: int):
+    """Normalize segment_ids to a (q_seg [B,Sq], kv_seg [B,Sk]) pair.
+
+    Accepts None, a single [B,S] array (requires Sq == Sk), or an explicit
+    pair — the pair form is what cached decode / chunked prefill of packed
+    sequences needs, where the kv axis is longer than the query axis.
+    """
+    if segment_ids is None:
+        return None, None
+    if isinstance(segment_ids, tuple):
+        q_seg, kv_seg = segment_ids
+    else:
+        if sq != sk:
+            raise ValueError(
+                "single segment_ids array requires Sq == Sk; pass a "
+                "(q_segment_ids, kv_segment_ids) tuple when using a kv cache")
+        q_seg = kv_seg = segment_ids
+    return q_seg, kv_seg
+
+
 def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         *, causal: bool = True,
-                        segment_ids: Optional[jax.Array] = None,
+                        segment_ids=None,
                         scale: Optional[float] = None) -> jax.Array:
-    """Plain softmax attention. Shapes: q [B,Sq,Hq,D], k/v [B,Sk,Hkv,D]."""
+    """Plain softmax attention. Shapes: q [B,Sq,Hq,D], k/v [B,Sk,Hkv,D].
+
+    segment_ids: None | [B,S] array | (q_seg [B,Sq], kv_seg [B,Sk]) tuple.
+    """
     b, sq, hq, d = q.shape
     _, sk, hkv, _ = k.shape
     if hq != hkv:
@@ -45,8 +68,9 @@ def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if causal:
         mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
         logits = jnp.where(mask[None, None], logits, NEG_INF)
-    if segment_ids is not None:
-        seg_mask = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+    q_seg, kv_seg = split_segment_ids(segment_ids, sq, sk)
+    if q_seg is not None:
+        seg_mask = q_seg[:, None, :, None] == kv_seg[:, None, None, :]
         logits = jnp.where(seg_mask, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
@@ -54,11 +78,15 @@ def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
               causal: bool = True,
-              segment_ids: Optional[jax.Array] = None,
+              segment_ids=None,
               impl: Optional[str] = None) -> jax.Array:
     """Dispatch to the best backend for this platform.
 
-    impl: None (auto) | "reference" | "flash" (Pallas TPU kernel).
+    impl: None (auto) | "reference" | "flash" (Pallas TPU kernel, runs in
+    interpret mode off-TPU).
+
+    segment_ids: None | [B,S] array | (q_seg, kv_seg) tuple (see
+    reference_attention).
     """
     auto = impl is None
     if auto:
@@ -69,7 +97,7 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         except ImportError:
             if not auto:
                 raise  # explicitly requested flash: surface the error
-            _warn_flash_fallback("kernel module unavailable")
+            _warn_flash_fallback("pallas kernel module unavailable")
         else:
             return flash_attention(q, k, v, causal=causal,
                                    segment_ids=segment_ids)

@@ -1,0 +1,432 @@
+"""Pallas TPU flash attention, forward + backward.
+
+The reference framework ships no attention kernels at all — it delegates to
+external engines (torch SDPA / vLLM; see SURVEY.md §2.4 "sequence parallel:
+ABSENT").  Here the hot op is owned natively: a blocked online-softmax
+(FlashAttention-2 style) kernel laid out for the TPU MXU/VMEM:
+
+- blocks of 128 on both query and key axes (MXU-native tiling),
+- K/V for one (batch, kv-head) kept resident in VMEM; the inner k-loop is a
+  `fori_loop` of MXU matmuls with f32 accumulation,
+- GQA handled in the BlockSpec index map (q-head h reads kv-head h // n_rep),
+  so no materialised `repeat_kv`,
+- causal masking is relative to the *end* of the kv sequence (tril with
+  offset sk - sq), which makes the same kernel correct for training
+  (sq == sk), chunked prefill and multi-token decode (sq < sk),
+- packed-sequence masking via (q_segment_ids, kv_segment_ids),
+- backward pass as two Pallas kernels (dq; dk/dv) using the saved
+  log-sum-exp, flash-2 style.
+
+Interpret mode (`interpret=True`, default off-TPU) runs the same kernels on
+CPU for tests: tests/test_flash_attention.py checks parity with
+`reference_attention` for values and grads.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+BLOCK = 128  # MXU-native tile edge
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _pick_blocks(sq: int, sk: int, block_q: int, block_k: int):
+    bq = min(block_q, _round_up(sq, BLOCK))
+    bk = min(block_k, _round_up(sk, BLOCK))
+    return bq, bk
+
+
+def _dummy_arg():
+    """Placeholder operand for the unused segment-id refs (the kernels
+    never read it when have_segs=False); (1, 1) scalar keeps SMEM happy."""
+    return jnp.zeros((1, 1), jnp.int32)
+
+
+def _dummy_spec():
+    return pl.BlockSpec((1, 1), lambda *_: (0, 0), memory_space=pltpu.SMEM)
+
+
+# =============================================================== forward
+def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref, *,
+                sm_scale: float, causal: bool, block_k: int,
+                sq: int, sk: int, have_segs: bool):
+    qblk = pl.program_id(2)
+    bq, d = q_ref.shape[2], q_ref.shape[3]
+    q = q_ref[0, 0]  # [bq, d]
+    q_pos = qblk * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    offset = sk - sq
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+
+    if causal:
+        # last k block any row of this q block may attend to
+        num_kb = jnp.minimum(
+            pl.cdiv((qblk + 1) * bq + offset, block_k), pl.cdiv(sk, block_k))
+    else:
+        num_kb = pl.cdiv(sk, block_k)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        mask = k_pos < sk  # kv padding
+        if causal:
+            mask = jnp.logical_and(mask, k_pos <= q_pos + offset)
+        if have_segs:
+            qs = qseg_ref[0]  # [bq, 1]
+            ks = kseg_ref[0, pl.ds(kb * block_k, block_k), :].reshape(
+                1, block_k)
+            mask = jnp.logical_and(mask, qs == ks)
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc = acc * alpha + pv
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l_safe)  # [bq, 1]
+
+
+def _fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
+         interpret, sq, sk):
+    """q: [B,Hq,Sq_p,D]; k/v: [B,Hkv,Sk_p,D] (padded to block multiples).
+
+    sq/sk are the TRUE lengths: the kernels mask kv padding with
+    `k_pos < sk` and compute the causal offset from true lengths.
+    Returns o [B,Hq,Sq_p,D], lse [B,Hq,Sq_p] (padded lengths).
+    """
+    b, hq, sq_p, d = q.shape
+    _, hkv, sk_p, _ = k.shape
+    n_rep = hq // hkv
+    bq, bk = block_q, block_k
+    have_segs = q_seg is not None
+    grid = (b, hq, sq_p // bq)
+
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_k=bk,
+        sq=sq, sk=sk, have_segs=have_segs)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda b_, h, i: (b_, h, i, 0)),
+        pl.BlockSpec((1, 1, sk_p, d), lambda b_, h, i: (b_, h // n_rep, 0, 0)),
+        pl.BlockSpec((1, 1, sk_p, d), lambda b_, h, i: (b_, h // n_rep, 0, 0)),
+    ]
+    args = [q, k, v]
+    if have_segs:
+        in_specs += [
+            pl.BlockSpec((1, bq, 1), lambda b_, h, i: (b_, i, 0)),
+            pl.BlockSpec((1, sk_p, 1), lambda b_, h, i: (b_, 0, 0)),
+        ]
+        args += [q_seg, kv_seg]
+    else:
+        in_specs += [_dummy_spec()] * 2
+        args += [_dummy_arg(), _dummy_arg()]
+
+    out_shape = [
+        jax.ShapeDtypeStruct((b, hq, sq_p, d), q.dtype),
+        jax.ShapeDtypeStruct((b, hq, sq_p, 1), jnp.float32),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda b_, h, i: (b_, h, i, 0)),
+        pl.BlockSpec((1, 1, bq, 1), lambda b_, h, i: (b_, h, i, 0)),
+    ]
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel")),
+        interpret=interpret,
+    )(*args)
+    return o, lse
+
+
+# =============================================================== backward
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   qseg_ref, kseg_ref, dq_ref, *,
+                   sm_scale: float, causal: bool, block_k: int,
+                   sq: int, sk: int, have_segs: bool):
+    qblk = pl.program_id(2)
+    bq, d = q_ref.shape[2], q_ref.shape[3]
+    q = q_ref[0, 0]
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]      # [bq, 1]
+    delta = delta_ref[0, 0]  # [bq, 1]
+    q_pos = qblk * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    offset = sk - sq
+
+    if causal:
+        num_kb = jnp.minimum(
+            pl.cdiv((qblk + 1) * bq + offset, block_k), pl.cdiv(sk, block_k))
+    else:
+        num_kb = pl.cdiv(sk, block_k)
+
+    def body(kb, dq_acc):
+        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        mask = k_pos < sk
+        if causal:
+            mask = jnp.logical_and(mask, k_pos <= q_pos + offset)
+        if have_segs:
+            qs = qseg_ref[0]  # [bq, 1]
+            ks = kseg_ref[0, pl.ds(kb * block_k, block_k), :].reshape(
+                1, block_k)
+            mask = jnp.logical_and(mask, qs == ks)
+        p = jnp.exp(jnp.where(mask, s, NEG_INF) - lse)
+        p = jnp.where(mask, p, 0.0)
+        dp = jax.lax.dot_general(
+            do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_acc += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dq_acc
+
+    dq = jax.lax.fori_loop(0, num_kb, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    qseg_ref, kseg_ref, dk_ref, dv_ref, *,
+                    sm_scale: float, causal: bool, block_q: int,
+                    sq: int, sk: int, have_segs: bool):
+    kblk = pl.program_id(2)
+    bk, d = k_ref.shape[2], k_ref.shape[3]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    k_pos = kblk * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+    offset = sk - sq
+    nqb = pl.cdiv(sq, block_q)
+
+    if causal:
+        # first q block whose last row can see this k block
+        qb0 = jnp.maximum((kblk * bk - offset) // block_q, 0)
+    else:
+        qb0 = 0
+
+    def body(qb, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[0, 0, pl.ds(qb * block_q, block_q), :]
+        do = do_ref[0, 0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q), :]      # [bq,1]
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q), :]  # [bq,1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        q_pos = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)
+        mask = k_pos < sk
+        if causal:
+            mask = jnp.logical_and(mask, k_pos <= q_pos + offset)
+        if have_segs:
+            qs = qseg_ref[0, pl.ds(qb * block_q, block_q), :]  # [bq,1]
+            ks = kseg_ref[0].reshape(1, bk)
+            mask = jnp.logical_and(mask, qs == ks)
+        p = jnp.exp(jnp.where(mask, s, NEG_INF) - lse)
+        p = jnp.where(mask, p, 0.0)
+        dv_acc += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bk, d]
+        dp = jax.lax.dot_general(
+            do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        ds = p * (dp - delta) * sm_scale
+        dk_acc += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bk, d]
+        return dk_acc, dv_acc
+
+    dk, dv = jax.lax.fori_loop(
+        qb0, nqb, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, q_seg, kv_seg, o, lse, do, causal, sm_scale,
+         block_q, block_k, interpret, sq, sk):
+    b, hq, sq_p, d = q.shape
+    _, hkv, sk_p, _ = k.shape
+    n_rep = hq // hkv
+    bq, bk = block_q, block_k
+    have_segs = q_seg is not None
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [B,Hq,Sq_p,1]
+
+    kv_spec = pl.BlockSpec((1, 1, sk_p, d),
+                           lambda b_, h, i: (b_, h // n_rep, 0, 0))
+    q_blk_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h, i: (b_, h, i, 0))
+    vec_blk_spec = pl.BlockSpec((1, 1, bq, 1), lambda b_, h, i: (b_, h, i, 0))
+
+    if have_segs:
+        qseg_blk = pl.BlockSpec((1, bq, 1), lambda b_, h, i: (b_, i, 0))
+        kseg_full = pl.BlockSpec((1, sk_p, 1), lambda b_, h, i: (b_, 0, 0))
+        qseg_full = pl.BlockSpec((1, sq_p, 1), lambda b_, h, i: (b_, 0, 0))
+        kseg_blk = pl.BlockSpec((1, bk, 1), lambda b_, h, i: (b_, i, 0))
+        seg_args = [q_seg, kv_seg]
+    else:
+        qseg_blk = kseg_full = qseg_full = kseg_blk = _dummy_spec()
+        seg_args = [_dummy_arg(), _dummy_arg()]
+
+    # ---- dq: grid over q blocks
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_k=bk,
+            sq=sq, sk=sk, have_segs=have_segs),
+        grid=(b, hq, sq_p // bq),
+        in_specs=[q_blk_spec, kv_spec, kv_spec, q_blk_spec, vec_blk_spec,
+                  vec_blk_spec, qseg_blk, kseg_full],
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h, i: (b_, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, hq, sq_p, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta, *seg_args)
+
+    # ---- dk/dv: grid over k blocks; per-q-head partials, summed over groups
+    q_full_spec = pl.BlockSpec((1, 1, sq_p, d), lambda b_, h, i: (b_, h, 0, 0))
+    kv_blk_spec = pl.BlockSpec((1, 1, bk, d),
+                               lambda b_, h, i: (b_, h // n_rep, i, 0))
+    vec_full_spec = pl.BlockSpec((1, 1, sq_p, 1),
+                                 lambda b_, h, i: (b_, h, 0, 0))
+    dk_hq, dv_hq = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
+            sq=sq, sk=sk, have_segs=have_segs),
+        grid=(b, hq, sk_p // bk),
+        in_specs=[q_full_spec, kv_blk_spec, kv_blk_spec, q_full_spec,
+                  vec_full_spec, vec_full_spec, qseg_full, kseg_blk],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h, i: (b_, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h, i: (b_, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, sk_p, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, sk_p, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta, *seg_args)
+
+    if n_rep > 1:
+        dk = dk_hq.reshape(b, hkv, n_rep, sk_p, d).sum(axis=2)
+        dv = dv_hq.reshape(b, hkv, n_rep, sk_p, d).sum(axis=2)
+    else:
+        dk, dv = dk_hq, dv_hq
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ============================================================ custom_vjp
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
+           interpret, sq, sk):
+    o, _ = _fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
+                interpret, sq, sk)
+    return o
+
+
+def _flash_fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
+               interpret, sq, sk):
+    o, lse = _fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
+                  interpret, sq, sk)
+    return o, (q, k, v, q_seg, kv_seg, o, lse)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, sq, sk, res,
+               do):
+    q, k, v, q_seg, kv_seg, o, lse = res
+    dq, dk, dv = _bwd(q, k, v, q_seg, kv_seg, o, lse, do, causal, sm_scale,
+                      block_q, block_k, interpret, sq, sk)
+    return dq, dk, dv, None, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ================================================================= public
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *,
+    causal: bool = True,
+    segment_ids: Optional[Union[jax.Array, Tuple[jax.Array, jax.Array]]] = None,
+    scale: Optional[float] = None,
+    block_q: int = BLOCK, block_k: int = BLOCK,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention. q: [B,Sq,Hq,D]; k/v: [B,Sk,Hkv,D] -> [B,Sq,Hq,D].
+
+    segment_ids: one [B,S] array (requires Sq == Sk), or a
+    (q_segment_ids [B,Sq], kv_segment_ids [B,Sk]) pair for cached decode /
+    chunked prefill of packed sequences.
+    """
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
+    if hq % hkv != 0:
+        raise ValueError(f"num q heads {hq} not a multiple of kv heads {hkv}")
+    if causal and sk < sq:
+        raise ValueError(f"causal attention needs sk >= sq, got {sq=} {sk=}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = float(scale if scale is not None else d ** -0.5)
+
+    from .attention import split_segment_ids
+
+    q_seg, kv_seg = split_segment_ids(segment_ids, sq, sk)
+    # padded kv positions are masked by the in-kernel `k_pos < sk` bound, and
+    # padded q rows are sliced off below, so padding needs no sentinel segs
+    bq, bk = _pick_blocks(sq, sk, block_q, block_k)
+    sq_p, sk_p = _round_up(sq, bq), _round_up(sk, bk)
+
+    def pad(x, s_p, axis):
+        pad_n = s_p - x.shape[axis]
+        if pad_n == 0:
+            return x
+        widths = [(0, 0)] * x.ndim
+        widths[axis] = (0, pad_n)
+        return jnp.pad(x, widths)
+
+    # [B,S,H,D] -> [B,H,S,D] for MXU-friendly blocking
+    qt = pad(q.transpose(0, 2, 1, 3), sq_p, 2)
+    kt = pad(k.transpose(0, 2, 1, 3), sk_p, 2)
+    vt = pad(v.transpose(0, 2, 1, 3), sk_p, 2)
+    if q_seg is not None:
+        q_seg = pad(q_seg.astype(jnp.int32), sq_p, 1)[..., None]
+        kv_seg = pad(kv_seg.astype(jnp.int32), sk_p, 1)[..., None]
+
+    o = _flash(qt, kt, vt, q_seg, kv_seg, causal, scale, bq, bk, interpret,
+               sq, sk)
+    return o[:, :, :sq, :].transpose(0, 2, 1, 3)

@@ -118,22 +118,29 @@ class ShardedTrainer:
         return self._state_shardings
 
     def _opt_shardings(self, abstract_params, params_shardings):
-        """Optimizer slots that mirror a param shape get its sharding."""
+        """Optimizer slots whose subtree mirrors the param tree (adam mu/nu,
+        momentum, …) get the params' shardings; everything else (counts,
+        scalars) is replicated.  Matching is by tree structure, not shape,
+        so same-shaped params with different layouts can't collide."""
         abstract_opt = jax.eval_shape(
             lambda p: self.tx.init(p),
             jax.tree.map(lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype),
                          abstract_params))
-        shapes = {}
-        jax.tree.map(lambda s, sh: shapes.setdefault(s.shape, sh),
-                     abstract_params, params_shardings)
+        params_treedef = jax.tree.structure(abstract_params)
+        replicated = NamedSharding(self.mesh, P())
 
-        def pick(leaf):
-            sh = shapes.get(leaf.shape)
-            if sh is not None and len(leaf.shape) > 0:
-                return sh
-            return NamedSharding(self.mesh, P())
+        def is_params_like(subtree):
+            try:
+                return jax.tree.structure(subtree) == params_treedef
+            except Exception:
+                return False
 
-        return jax.tree.map(pick, abstract_opt)
+        def assign(subtree):
+            if is_params_like(subtree):
+                return params_shardings
+            return jax.tree.map(lambda _: replicated, subtree)
+
+        return jax.tree.map(assign, abstract_opt, is_leaf=is_params_like)
 
     def init(self, rng, example_batch) -> TrainState:
         shardings = self.state_shardings(example_batch)
@@ -177,10 +184,10 @@ class ShardedTrainer:
         return self._jit_step
 
     def step(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
-        if self._jit_step is None:
-            self._build_step(batch)
         if not isinstance(batch, dict):
             batch = {"input_ids": batch}
+        if self._jit_step is None:
+            self._build_step(batch)
         batch = {k: jax.device_put(v, self._batch_sharding)
                  for k, v in batch.items()}
         with self.mesh:

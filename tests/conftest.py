@@ -25,14 +25,27 @@ jax.config.update("jax_num_cpu_devices", 8)
 import pytest  # noqa: E402
 
 
-@pytest.fixture(scope="session")
+@pytest.fixture
 def shared_cluster():
-    """One session shared by tests that only need basic cluster services."""
+    """A cluster shared by tests that only need basic cluster services.
+
+    Function-scoped but lazy: re-initializes only if a fresh_cluster test (or
+    an explicit shutdown) tore the shared session down in between.
+    """
     import ray_tpu
 
-    session = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
-    yield session
-    ray_tpu.shutdown()
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield ray_tpu
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _shutdown_at_exit():
+    yield
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
 
 
 @pytest.fixture

@@ -1,0 +1,118 @@
+"""Flash-attention kernel parity vs the jnp reference (interpret mode on CPU).
+
+Mirrors how the reference project validates numerics-by-parity in its op
+tests; the kernel itself has no counterpart in the reference (it delegates
+attention to external engines, SURVEY.md §2.4).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.attention import reference_attention
+from ray_tpu.ops.flash_attention import flash_attention
+
+# tiny-but-unaligned shapes exercise the padding paths; interpret mode is slow
+B, D = 2, 32
+
+
+def _make(sq, sk, hq=4, hkv=2, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (B, sq, hq, D), dtype)
+    k = jax.random.normal(ks[1], (B, sk, hkv, D), dtype)
+    v = jax.random.normal(ks[2], (B, sk, hkv, D), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("sq,sk", [(128, 128), (64, 192), (200, 200)])
+def test_forward_parity(causal, sq, sk):
+    q, k, v = _make(sq, sk)
+    got = flash_attention(q, k, v, causal=causal, interpret=True)
+    want = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_forward_parity_mha_no_gqa():
+    q, k, v = _make(128, 128, hq=4, hkv=4)
+    got = flash_attention(q, k, v, causal=True, interpret=True)
+    want = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_forward_segment_ids_packed():
+    sq = 128
+    q, k, v = _make(sq, sq)
+    # two packed sequences per row
+    segs = jnp.concatenate(
+        [jnp.zeros((B, sq // 2), jnp.int32), jnp.ones((B, sq - sq // 2), jnp.int32)],
+        axis=1)
+    got = flash_attention(q, k, v, causal=True, segment_ids=segs,
+                          interpret=True)
+    want = reference_attention(q, k, v, causal=True, segment_ids=segs)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_forward_segment_ids_tuple_decode():
+    # chunked prefill: 32 query tokens attend to a 96-long kv axis
+    sq, sk = 32, 96
+    q, k, v = _make(sq, sk)
+    kv_seg = jnp.concatenate(
+        [jnp.zeros((B, 48), jnp.int32), jnp.ones((B, 48), jnp.int32)], axis=1)
+    q_seg = kv_seg[:, -sq:]
+    got = flash_attention(q, k, v, causal=True, segment_ids=(q_seg, kv_seg),
+                          interpret=True)
+    want = reference_attention(q, k, v, causal=True,
+                               segment_ids=(q_seg, kv_seg))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("sq,sk", [(128, 128), (64, 192)])
+def test_grad_parity(sq, sk):
+    q, k, v = _make(sq, sk)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=True, interpret=True)
+        return jnp.sum(jnp.sin(o))  # nontrivial cotangent
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(reference_attention(q, k, v, causal=True)))
+
+    g_got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in zip(g_got, g_want, "qkv"):
+        np.testing.assert_allclose(got, want, atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name}")
+
+
+def test_grad_parity_with_segments():
+    sq = 128
+    q, k, v = _make(sq, sq)
+    segs = jnp.tile(jnp.repeat(jnp.arange(4, dtype=jnp.int32), sq // 4)[None],
+                    (B, 1))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention(
+            q, k, v, causal=True, segment_ids=segs, interpret=True)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(reference_attention(
+            q, k, v, causal=True, segment_ids=segs)))
+
+    g_got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in zip(g_got, g_want, "qkv"):
+        np.testing.assert_allclose(got, want, atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name}")
+
+
+def test_jit_and_bf16():
+    q, k, v = _make(128, 128, dtype=jnp.bfloat16)
+    f = jax.jit(functools.partial(flash_attention, causal=True,
+                                  interpret=True))
+    got = f(q, k, v).astype(jnp.float32)
+    want = reference_attention(q, k, v, causal=True).astype(jnp.float32)
+    np.testing.assert_allclose(got, want, atol=3e-2, rtol=3e-2)

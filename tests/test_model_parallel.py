@@ -45,8 +45,8 @@ def test_reference_attention_gqa_matches_mha():
                                rtol=1e-5, atol=1e-6)
 
 
-def test_decode_with_cache_is_causal():
-    """Multi-token decode with a kv cache must mask future positions."""
+def test_causality():
+    """Logits at position t must not depend on tokens after t."""
     cfg = get_config("tiny")
     model = LlamaModel(cfg)
     ids = jnp.asarray(np.arange(16)[None, :], jnp.int32)
@@ -55,11 +55,49 @@ def test_decode_with_cache_is_causal():
 
     params = nn.meta.unbox(params)
     full = model.apply({"params": params}, ids)
-    # logits at position t must not depend on tokens after t
     ids2 = ids.at[0, -1].set(7)
     full2 = model.apply({"params": params}, ids2)
     np.testing.assert_allclose(np.asarray(full[0, :-1]),
                                np.asarray(full2[0, :-1]), atol=1e-5)
+
+
+@pytest.mark.parametrize("scan_layers", [True, False])
+def test_decode_with_cache_matches_full_forward(scan_layers):
+    """Prefill + cached decode must reproduce the full-sequence logits."""
+    import flax.linen as nn
+
+    cfg = get_config("tiny", scan_layers=scan_layers,
+                     dtype=jnp.float32)  # f32 for tight comparison
+    model = LlamaModel(cfg)
+    total = 12
+    prefill_len = 8
+    ids = jnp.asarray(np.arange(total)[None, :] % cfg.vocab_size, jnp.int32)
+    params = nn.meta.unbox(model.init(jax.random.PRNGKey(0), ids)["params"])
+
+    full = model.apply({"params": params}, ids)
+
+    # prefill with an empty cache to seed it
+    hd = cfg.head_dim_
+    if scan_layers:
+        empty = (jnp.zeros((cfg.num_layers, 1, 0, cfg.num_kv_heads, hd),
+                           cfg.dtype),) * 2
+    else:
+        empty = [(jnp.zeros((1, 0, cfg.num_kv_heads, hd), cfg.dtype),) * 2
+                 for _ in range(cfg.num_layers)]
+    positions = jnp.arange(prefill_len)[None, :]
+    logits_p, cache = model.apply({"params": params}, ids[:, :prefill_len],
+                                  positions=positions, kv_caches=empty)
+    np.testing.assert_allclose(np.asarray(logits_p),
+                               np.asarray(full[:, :prefill_len]), atol=2e-4)
+
+    # decode the rest one token at a time through the cache
+    for t in range(prefill_len, total):
+        pos = jnp.full((1, 1), t, jnp.int32)
+        logits_t, cache = model.apply({"params": params}, ids[:, t:t + 1],
+                                      positions=pos, kv_caches=cache)
+        np.testing.assert_allclose(np.asarray(logits_t[0, 0]),
+                                   np.asarray(full[0, t]), atol=2e-4,
+                                   err_msg=f"position {t}")
 
 
 def test_sharded_training_loss_decreases(cpu_mesh_devices):
