@@ -126,8 +126,11 @@ class Attention(nn.Module):
         # always causal: the kernels mask relative to the end of the kv axis
         # (tril k=sk-sq), which is correct for multi-token decode and
         # chunked prefill as well as plain training
+        impl = cfg.attention_impl
+        if kv_cache is not None and impl in ("ring", "ulysses"):
+            impl = None  # kv-cache decode is dense; sp applies to training
         out = attention(q, k, v, causal=True,
-                        segment_ids=segment_ids, impl=cfg.attention_impl)
+                        segment_ids=segment_ids, impl=impl)
         out = out.reshape(b, s, nq * hd)
         out = nn.DenseGeneral(
             features=cfg.hidden_size, use_bias=False, axis=-1,

@@ -79,15 +79,39 @@ def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
               causal: bool = True,
               segment_ids=None,
+              scale: Optional[float] = None,
               impl: Optional[str] = None) -> jax.Array:
     """Dispatch to the best backend for this platform.
 
     impl: None (auto) | "reference" | "flash" (Pallas TPU kernel, runs in
-    interpret mode off-TPU).
+    interpret mode off-TPU) | "ring" | "ulysses" (sequence-parallel
+    collectives over the ambient mesh's `sp` axis; fall back to the dense
+    path when no mesh is active or sp == 1).
 
     segment_ids: None | [B,S] array | (q_seg, kv_seg) tuple (see
     reference_attention).
     """
+    if impl in ("ring", "ulysses"):
+        from ..parallel.mesh import current_mesh
+
+        mesh = current_mesh()
+        if (mesh is not None and "sp" in mesh.axis_names
+                and mesh.shape["sp"] > 1):
+            if isinstance(segment_ids, tuple):
+                raise NotImplementedError(
+                    "sequence-parallel attention does not take a "
+                    "(q_seg, kv_seg) pair (kv-cache decode is dense)")
+            from .ring_attention import (ring_attention_sharded,
+                                         ulysses_attention_sharded)
+            fn = (ring_attention_sharded if impl == "ring"
+                  else ulysses_attention_sharded)
+            return fn(q, k, v, mesh, causal=causal, segment_ids=segment_ids,
+                      scale=scale)
+        _warn_flash_fallback(
+            f"impl={impl!r} requested but no active mesh with sp>1 "
+            "(wrap the call in ray_tpu.parallel.mesh.active_mesh); "
+            "running dense attention")
+        impl = None  # no sp axis active: fall through to dense auto-select
     auto = impl is None
     if auto:
         impl = "flash" if jax.default_backend() == "tpu" else "reference"
@@ -100,9 +124,9 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             _warn_flash_fallback("pallas kernel module unavailable")
         else:
             return flash_attention(q, k, v, causal=causal,
-                                   segment_ids=segment_ids)
+                                   segment_ids=segment_ids, scale=scale)
     return reference_attention(q, k, v, causal=causal,
-                               segment_ids=segment_ids)
+                               segment_ids=segment_ids, scale=scale)
 
 
 _warned = set()

@@ -74,6 +74,60 @@ def single_device_mesh() -> Mesh:
 
 
 # ---------------------------------------------------------------------------
+# Active-mesh context: lets ops (ring attention) find the mesh at trace time
+# without threading it through every model config.
+# ---------------------------------------------------------------------------
+import threading
+
+
+class _MeshStack(threading.local):
+    def __init__(self):
+        self.stack: List[Mesh] = []
+
+
+_ACTIVE_MESHES = _MeshStack()
+
+
+class active_mesh:
+    """Context manager marking `mesh` as the ambient mesh (and entering it).
+
+    The stack is thread-local: worker threads running concurrent trainers
+    each see only their own ambient mesh."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        self.mesh.__enter__()
+        _ACTIVE_MESHES.stack.append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc):
+        try:
+            self.mesh.__exit__(*exc)
+        finally:
+            _ACTIVE_MESHES.stack.pop()
+        return False
+
+
+def current_mesh() -> Optional[Mesh]:
+    """The innermost active_mesh, or the jax `with mesh:` context if any."""
+    if _ACTIVE_MESHES.stack:
+        return _ACTIVE_MESHES.stack[-1]
+    try:
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", DeprecationWarning)
+            m = jax.interpreters.pxla.thread_resources.env.physical_mesh
+        if m.axis_names:
+            return m
+    except Exception:
+        pass
+    return None
+
+
+# ---------------------------------------------------------------------------
 # PartitionSpec helpers
 # ---------------------------------------------------------------------------
 def batch_spec() -> P:

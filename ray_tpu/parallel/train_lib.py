@@ -21,7 +21,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import sharding as shd
-from .mesh import create_mesh, MeshConfig
+from .mesh import active_mesh, create_mesh, MeshConfig
 
 
 @dataclass
@@ -82,7 +82,10 @@ class ShardedTrainer:
         self.tx = optimizer or default_optimizer()
         self.rules = rules
         self.loss_fn = loss_fn or self._default_loss
-        self._batch_sharding = NamedSharding(self.mesh, P(("dp", "fsdp"), None))
+        seq_axis = ("sp" if "sp" in self.mesh.axis_names
+                    and self.mesh.shape.get("sp", 1) > 1 else None)
+        self._batch_sharding = NamedSharding(
+            self.mesh, P(("dp", "fsdp"), seq_axis))
         self._state_shardings = None
         self._jit_step = None
         self._jit_eval = None
@@ -90,8 +93,11 @@ class ShardedTrainer:
 
     # -------------------------------------------------------------- loss
     def _default_loss(self, params, batch):
+        # Forward over the FULL sequence (keeps seq length divisible by the
+        # sp axis for ring attention) and drop the final logit instead of
+        # slicing the input.
         input_ids = batch["input_ids"]
-        logits = self.model.apply({"params": params}, input_ids[:, :-1])
+        logits = self.model.apply({"params": params}, input_ids)[:, :-1]
         targets = input_ids[:, 1:]
         mask = batch.get("loss_mask")
         mask = mask[:, 1:] if mask is not None else None
@@ -102,10 +108,13 @@ class ShardedTrainer:
         if self._state_shardings is not None:
             return self._state_shardings
         ids = example_batch["input_ids"]
-        abstract = jax.eval_shape(
-            lambda: self.model.init(
-                jax.random.PRNGKey(0),
-                jnp.zeros((1,) + tuple(ids.shape[1:]), jnp.int32)))
+        # full example-batch shape (not batch 1): collective attention needs
+        # the batch/seq dims divisible by the mesh axes even under eval_shape
+        with active_mesh(self.mesh):
+            abstract = jax.eval_shape(
+                lambda: self.model.init(
+                    jax.random.PRNGKey(0),
+                    jnp.zeros(tuple(ids.shape), jnp.int32)))
         logical = nn.get_partition_spec(abstract)
         params_shardings = shd.logical_to_sharding(
             logical, self.mesh, self.rules)["params"]
@@ -147,13 +156,12 @@ class ShardedTrainer:
 
         def _init(rng):
             params = self.model.init(
-                rng, jnp.zeros_like(example_batch["input_ids"])[:, :-1]
-            )["params"]
+                rng, jnp.zeros_like(example_batch["input_ids"]))["params"]
             params = nn.meta.unbox(params)
             return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                               opt_state=self.tx.init(params))
 
-        with self.mesh:
+        with active_mesh(self.mesh):
             init_jit = jax.jit(_init, out_shardings=shardings)
             return init_jit(rng)
 
@@ -190,11 +198,11 @@ class ShardedTrainer:
             self._build_step(batch)
         batch = {k: jax.device_put(v, self._batch_sharding)
                  for k, v in batch.items()}
-        with self.mesh:
+        with active_mesh(self.mesh):
             return self._jit_step(state, batch)
 
     def eval_loss(self, state: TrainState, batch) -> jax.Array:
         if self._jit_eval is None:
             self._jit_eval = jax.jit(self.loss_fn)
-        with self.mesh:
+        with active_mesh(self.mesh):
             return self._jit_eval(state.params, batch)
