@@ -1,0 +1,356 @@
+"""Host-side collective communication between tasks/actors.
+
+Mirrors the reference's ``ray.util.collective`` API surface (ref:
+python/ray/util/collective/collective.py — GroupManager :40,
+init_collective_group :123, allreduce :268, broadcast :383, allgather :433,
+reducescatter :482, plus send/recv/barrier) with a TPU-native split:
+
+- **In-mesh device arrays** never go through this module: XLA collectives
+  (psum/all_gather/ppermute over ICI) inside jit/shard_map are the
+  accelerator tier (SURVEY.md §5 "Distributed communication backend").
+- **Host data** (numpy arrays, metrics, control tuples) between actors uses
+  a per-group rendezvous actor whose async methods park each rank on an
+  asyncio event until all contributions arrive — the gloo/DCN-equivalent
+  tier. Payloads ride the shared-memory object store, so intra-node
+  transfers are zero-copy.
+
+Collective calls must be issued in the same order on every rank of a group
+(the standard collective contract); a per-rank sequence number keys each
+operation.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda parts: _tree_reduce(np.add, parts),
+    ReduceOp.PRODUCT: lambda parts: _tree_reduce(np.multiply, parts),
+    ReduceOp.MIN: lambda parts: _tree_reduce(np.minimum, parts),
+    ReduceOp.MAX: lambda parts: _tree_reduce(np.maximum, parts),
+}
+
+
+def _tree_reduce(op, parts: List[Any]):
+    out = parts[0]
+    for p in parts[1:]:
+        out = op(out, p)
+    return out
+
+
+class _CollectiveGroupActor:
+    """Rendezvous + reduction state for one group. Async methods run
+    concurrently on the worker's user asyncio loop, so each rank's call
+    parks on an event until the op completes."""
+
+    def __init__(self, world_size: int):
+        import asyncio
+
+        self.world_size = world_size
+        self._asyncio = asyncio
+        self._ops: Dict[str, dict] = {}
+        self._mailbox: Dict[str, Any] = {}
+        self._mail_events: Dict[str, Any] = {}
+
+    def _op_state(self, key: str):
+        st = self._ops.get(key)
+        if st is None:
+            st = {"parts": {}, "event": self._asyncio.Event(), "result": None}
+            self._ops[key] = st
+        return st
+
+    async def _run_op(self, key: str, rank: int, payload, compute):
+        st = self._op_state(key)
+        if rank in st["parts"]:
+            raise RuntimeError(
+                f"rank {rank} already contributed to op {key} — collective "
+                "calls must be issued once per rank, in order")
+        st["parts"][rank] = payload
+        if len(st["parts"]) == self.world_size:
+            # a failing compute must still release the waiters: store the
+            # error and set the event so every rank sees it, not a timeout
+            try:
+                st["result"] = compute(st["parts"])
+            except Exception as e:  # noqa: BLE001
+                st["error"] = e
+            st["event"].set()
+        else:
+            await st["event"].wait()
+        err = st.get("error")
+        result = st["result"]
+        st["parts"][rank] = None  # drop the reference early
+        st.setdefault("done", set()).add(rank)
+        if len(st["done"]) == self.world_size:
+            del self._ops[key]
+        if err is not None:
+            raise RuntimeError(f"collective op {key} failed: {err!r}") from err
+        return result
+
+    async def allreduce(self, key: str, rank: int, data, op: str):
+        reducer = _REDUCERS[op]
+        return await self._run_op(
+            key, rank, data,
+            lambda parts: reducer([parts[r]
+                                   for r in range(self.world_size)]))
+
+    async def allgather(self, key: str, rank: int, data):
+        return await self._run_op(
+            key, rank, data,
+            lambda parts: [parts[r] for r in range(self.world_size)])
+
+    async def broadcast(self, key: str, rank: int, data, src_rank: int):
+        return await self._run_op(
+            key, rank, data, lambda parts: parts[src_rank])
+
+    async def reducescatter(self, key: str, rank: int, data, op: str):
+        reducer = _REDUCERS[op]
+
+        def compute(parts):
+            reduced = reducer([parts[r] for r in range(self.world_size)])
+            return np.array_split(np.asarray(reduced), self.world_size)
+
+        chunks = await self._run_op(key, rank, data, compute)
+        return chunks[rank]
+
+    async def barrier(self, key: str, rank: int):
+        return await self._run_op(key, rank, None, lambda parts: None)
+
+    async def send(self, key: str, data):
+        self._mailbox[key] = data
+        ev = self._mail_events.get(key)
+        if ev is None:
+            ev = self._mail_events[key] = self._asyncio.Event()
+        ev.set()
+
+    async def recv(self, key: str):
+        ev = self._mail_events.get(key)
+        if ev is None:
+            ev = self._mail_events[key] = self._asyncio.Event()
+        await ev.wait()
+        data = self._mailbox.pop(key)
+        del self._mail_events[key]
+        return data
+
+
+class GroupHandle:
+    def __init__(self, actor, world_size: int, rank: int, group_name: str):
+        self.actor = actor
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+        self._seq = 0
+        self._p2p_seq: Dict[tuple, int] = {}
+        self._lock = threading.Lock()
+
+    def next_key(self, kind: str) -> str:
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+        return f"{seq}:{kind}"
+
+    def p2p_key(self, src: int, dst: int) -> str:
+        with self._lock:
+            pair = (src, dst)
+            seq = self._p2p_seq.get(pair, 0)
+            self._p2p_seq[pair] = seq + 1
+        return f"p2p:{src}->{dst}:{seq}"
+
+
+class GroupManager:
+    """Process-local registry of joined groups (ref: collective.py:40)."""
+
+    def __init__(self):
+        self._groups: Dict[str, GroupHandle] = {}
+        self._lock = threading.Lock()
+
+    def create(self, world_size: int, rank: int, group_name: str):
+        from .. import remote as rt_remote
+
+        if not 0 <= rank < world_size:
+            raise ValueError(f"rank {rank} out of range for {world_size}")
+        with self._lock:
+            if group_name in self._groups:
+                raise RuntimeError(f"group {group_name!r} already joined "
+                                   "by this process")
+            # reserve the slot under the same lock hold so a concurrent
+            # create for the same name fails instead of overwriting
+            self._groups[group_name] = None
+        try:
+            actor_cls = rt_remote(_CollectiveGroupActor)
+            actor = actor_cls.options(
+                name=f"__collective_{group_name}", get_if_exists=True,
+                max_concurrency=max(world_size * 2, 8),
+            ).remote(world_size)
+            handle = GroupHandle(actor, world_size, rank, group_name)
+        except BaseException:
+            with self._lock:
+                self._groups.pop(group_name, None)
+            raise
+        with self._lock:
+            self._groups[group_name] = handle
+        return handle
+
+    def get(self, group_name: str) -> GroupHandle:
+        with self._lock:
+            g = self._groups.get(group_name)
+        if g is None:  # absent, or a reservation still being created
+            raise RuntimeError(
+                f"collective group {group_name!r} is not initialized in "
+                "this process; call init_collective_group first")
+        return g
+
+    def pop(self, group_name: str) -> Optional[GroupHandle]:
+        with self._lock:
+            return self._groups.pop(group_name, None)
+
+    def is_initialized(self, group_name: str) -> bool:
+        with self._lock:
+            return self._groups.get(group_name) is not None
+
+
+_manager = GroupManager()
+
+
+# ---------------------------------------------------------------------------
+# public API (mirrors the reference's function surface)
+# ---------------------------------------------------------------------------
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "shm",
+                          group_name: str = "default") -> None:
+    """Join this process to a collective group (ref: collective.py:123).
+
+    backend: "shm" (the object-store rendezvous) is the only host backend;
+    device arrays should use XLA collectives inside jit instead.
+    """
+    if backend not in ("shm", "dcn", "gloo"):
+        raise ValueError(f"unsupported backend {backend!r}")
+    _manager.create(world_size, rank, group_name)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    g = _manager.pop(group_name)
+    if g is None:
+        return
+    # quiesce: every rank reaches this barrier before rank 0 kills the
+    # rendezvous actor, so no peer's in-flight op races the kill
+    try:
+        _call(g, "barrier", g.next_key("destroy-barrier"), g.rank,
+              timeout=60.0)
+    except Exception:
+        pass  # peers may already be gone; best effort
+    if g.rank == 0:
+        from .. import kill
+
+        try:
+            kill(g.actor)
+        except Exception:
+            pass
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return _manager.is_initialized(group_name)
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _manager.get(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _manager.get(group_name).world_size
+
+
+def _call(g: GroupHandle, method: str, *args, timeout: float = 120.0):
+    from .. import get
+
+    return get(getattr(g.actor, method).remote(*args), timeout=timeout)
+
+
+def _to_host(tensor):
+    """Device arrays cross the host tier as numpy; everything else as-is."""
+    if hasattr(tensor, "__array__") and not isinstance(tensor, np.ndarray):
+        return np.asarray(tensor)
+    return tensor
+
+
+def _check_op(op: str):
+    if op not in _REDUCERS:
+        raise ValueError(f"unknown reduce op {op!r}; one of {list(_REDUCERS)}")
+
+
+def allreduce(tensor, group_name: str = "default",
+              op: str = ReduceOp.SUM, timeout: float = 120.0):
+    """All-reduce across the group (ref: collective.py:268)."""
+    _check_op(op)
+    g = _manager.get(group_name)
+    return _call(g, "allreduce", g.next_key("allreduce"), g.rank,
+                 _to_host(tensor), op, timeout=timeout)
+
+
+def allgather(tensor, group_name: str = "default",
+              timeout: float = 120.0) -> list:
+    """Gather every rank's tensor, ordered by rank (ref: collective.py:433)."""
+    g = _manager.get(group_name)
+    return _call(g, "allgather", g.next_key("allgather"), g.rank,
+                 _to_host(tensor), timeout=timeout)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
+              timeout: float = 120.0):
+    """Broadcast src_rank's tensor to all ranks (ref: collective.py:383).
+
+    Only the source's payload crosses the wire; other ranks contribute a
+    placeholder."""
+    g = _manager.get(group_name)
+    if not 0 <= src_rank < g.world_size:
+        raise ValueError(f"src_rank {src_rank} out of range "
+                         f"for world size {g.world_size}")
+    payload = _to_host(tensor) if g.rank == src_rank else None
+    return _call(g, "broadcast", g.next_key("broadcast"), g.rank,
+                 payload, src_rank, timeout=timeout)
+
+
+def reducescatter(tensor, group_name: str = "default",
+                  op: str = ReduceOp.SUM, timeout: float = 120.0):
+    """Reduce then scatter equal chunks; rank r gets chunk r
+    (ref: collective.py:482)."""
+    _check_op(op)
+    g = _manager.get(group_name)
+    return _call(g, "reducescatter", g.next_key("reducescatter"), g.rank,
+                 _to_host(tensor), op, timeout=timeout)
+
+
+def barrier(group_name: str = "default", timeout: float = 120.0) -> None:
+    g = _manager.get(group_name)
+    _call(g, "barrier", g.next_key("barrier"), g.rank, timeout=timeout)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default",
+         timeout: float = 120.0) -> None:
+    """Point-to-point send (ref: collective.py send/recv)."""
+    g = _manager.get(group_name)
+    if dst_rank == g.rank:
+        raise ValueError("cannot send to self")
+    key = g.p2p_key(g.rank, dst_rank)
+    _call(g, "send", key, _to_host(tensor), timeout=timeout)
+
+
+def recv(src_rank: int, group_name: str = "default", timeout: float = 120.0):
+    """Point-to-point receive, pairing with the src's send order."""
+    g = _manager.get(group_name)
+    if src_rank == g.rank:
+        raise ValueError("cannot recv from self")
+    key = g.p2p_key(src_rank, g.rank)
+    return _call(g, "recv", key, timeout=timeout)

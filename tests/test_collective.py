@@ -1,0 +1,92 @@
+"""Host-tier collective library (mirrors ref util/collective tests)."""
+
+import numpy as np
+import pytest
+
+
+def test_collective_ops(shared_cluster):
+    ray_tpu = shared_cluster
+    world = 3
+
+    def _run_rank(rank, world):
+        # executed inside a remote task: join the group, run the op set,
+        # return results for assertion on the driver
+        import numpy as np
+
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(world, rank, group_name="g")
+        out = {}
+        x = np.full((4,), float(rank + 1))
+        out["allreduce"] = col.allreduce(x, group_name="g")
+        out["allgather"] = col.allgather(np.array([rank]), group_name="g")
+        out["broadcast"] = col.broadcast(
+            np.array([42.0]) if rank == 1 else np.array([0.0]),
+            src_rank=1, group_name="g")
+        out["reducescatter"] = col.reducescatter(
+            np.arange(world * 2, dtype=np.float64), group_name="g",
+            op=col.ReduceOp.SUM)
+        col.barrier(group_name="g")
+        if rank == 0:
+            col.send(np.array([7.0]), dst_rank=1, group_name="g")
+        elif rank == 1:
+            out["recv"] = col.recv(src_rank=0, group_name="g")
+        out["rank"] = col.get_rank("g")
+        out["size"] = col.get_collective_group_size("g")
+        col.destroy_collective_group("g")
+        return out
+
+    run = ray_tpu.remote(_run_rank)
+    results = ray_tpu.get(
+        [run.remote(r, world) for r in range(world)], timeout=120)
+
+    expected_sum = np.full((4,), float(sum(range(1, world + 1))))
+    for r, out in enumerate(results):
+        np.testing.assert_allclose(out["allreduce"], expected_sum)
+        np.testing.assert_allclose(
+            np.concatenate(out["allgather"]), np.arange(world))
+        np.testing.assert_allclose(out["broadcast"], [42.0])
+        assert out["rank"] == r
+        assert out["size"] == world
+    # reducescatter: world ranks each reduce arange(world*2)*world then
+    # take their chunk
+    full = np.arange(world * 2, dtype=np.float64) * world
+    chunks = np.array_split(full, world)
+    for r, out in enumerate(results):
+        np.testing.assert_allclose(out["reducescatter"], chunks[r])
+    np.testing.assert_allclose(results[1]["recv"], [7.0])
+
+
+def test_group_errors(shared_cluster):
+    from ray_tpu.util import collective as col
+
+    with pytest.raises(RuntimeError):
+        col.get_rank("nope")
+    with pytest.raises(ValueError):
+        col.init_collective_group(2, 5, group_name="bad")
+    assert not col.is_group_initialized("bad")
+
+
+def test_collective_error_propagates_to_all_ranks(shared_cluster):
+    """A failing reduction (mismatched shapes) must raise on every rank
+    quickly, not hang the peers until timeout."""
+    ray_tpu = shared_cluster
+
+    def _bad(rank):
+        import numpy as np
+
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(2, rank, group_name="bad_shapes")
+        try:
+            col.allreduce(np.zeros(4 if rank == 0 else 5),
+                          group_name="bad_shapes", timeout=30)
+            return "no error"
+        except Exception as e:
+            return type(e).__name__
+        finally:
+            col.destroy_collective_group("bad_shapes")
+
+    run = ray_tpu.remote(_bad)
+    results = ray_tpu.get([run.remote(r) for r in range(2)], timeout=90)
+    assert all(r != "no error" for r in results), results
