@@ -1,0 +1,38 @@
+"""ray_tpu.train: distributed training orchestration (Train-v2 style).
+
+Public surface mirrors the reference (ref: python/ray/train/__init__.py):
+configs, Checkpoint, Result, the per-worker session API (report,
+get_context, get_checkpoint, get_dataset_shard), and JaxTrainer in place
+of Torch/TF trainers — parallelism is mesh axes, not wrapper classes.
+"""
+
+from .checkpoint import Checkpoint, CheckpointManager  # noqa: F401
+from .config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from .controller import (  # noqa: F401
+    ElasticScalingPolicy,
+    FailurePolicy,
+    FixedScalingPolicy,
+    ScalingPolicy,
+    TrainController,
+)
+from .session import (  # noqa: F401
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    report,
+)
+from .trainer import JaxTrainer, get_dataset_shard  # noqa: F401
+
+__all__ = [
+    "Checkpoint", "CheckpointConfig", "CheckpointManager", "FailureConfig",
+    "Result", "RunConfig", "ScalingConfig", "TrainContext", "TrainController",
+    "JaxTrainer", "ScalingPolicy", "FixedScalingPolicy",
+    "ElasticScalingPolicy", "FailurePolicy", "report", "get_context",
+    "get_checkpoint", "get_dataset_shard",
+]

@@ -1,0 +1,210 @@
+"""Checkpoints: directory handles + top-K retention + pytree persistence.
+
+ref: python/ray/train/_checkpoint.py (Checkpoint = directory handle),
+python/ray/train/_internal/checkpoint_manager.py (top-K retention),
+python/ray/train/_internal/storage.py (StorageContext). TPU-native twist:
+pytree persistence uses orbax (the JAX-ecosystem checkpointer) instead of
+torch.save, with a msgpack/pickle fallback for plain trees.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import threading
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class Checkpoint:
+    """A handle to a directory of checkpoint data (ref: _checkpoint.py).
+
+    The directory may live in the experiment's storage path (persisted) or
+    any local path (ephemeral until reported).
+    """
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @contextmanager
+    def as_directory(self):
+        yield self.path
+
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        dest = dest or tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    # ------------------------------------------------------------ pytrees
+    def save_pytree(self, tree: Any, name: str = "state") -> None:
+        save_pytree(tree, os.path.join(self.path, name))
+
+    def load_pytree(self, name: str = "state", target: Any = None) -> Any:
+        return load_pytree(os.path.join(self.path, name), target)
+
+    def update_metadata(self, metadata: Dict[str, Any]) -> None:
+        with open(os.path.join(self.path, "_metadata.json"), "w") as f:
+            json.dump(metadata, f)
+
+    def get_metadata(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, "_metadata.json")
+        if not os.path.exists(p):
+            return {}
+        with open(p) as f:
+            return json.load(f)
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path!r})"
+
+
+def save_pytree(tree: Any, path: str) -> None:
+    """Persist a JAX pytree. Orbax when available (sharded-array aware),
+    else pickle of fully-materialized numpy leaves."""
+    os.makedirs(path, exist_ok=True)
+    orbax_dir = os.path.join(path, "orbax")
+    try:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(orbax_dir, tree, force=True)
+        return
+    except Exception as e:
+        # a partial orbax dir must not shadow the pickle fallback on load
+        shutil.rmtree(orbax_dir, ignore_errors=True)
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "orbax save failed (%r); falling back to pickle", e)
+    import jax
+    import numpy as np
+
+    host_tree = jax.tree.map(lambda x: np.asarray(x)
+                             if hasattr(x, "__array__") else x, tree)
+    with open(os.path.join(path, "tree.pkl"), "wb") as f:
+        pickle.dump(host_tree, f)
+
+
+def load_pytree(path: str, target: Any = None) -> Any:
+    orbax_path = os.path.join(path, "orbax")
+    if os.path.exists(orbax_path):
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        restored = ckptr.restore(orbax_path)
+        if target is not None:
+            import jax
+
+            # restore flat dict into the target tree structure
+            return jax.tree.unflatten(jax.tree.structure(target),
+                                      jax.tree.leaves(restored))
+        return restored
+    with open(os.path.join(path, "tree.pkl"), "rb") as f:
+        restored = pickle.load(f)
+    if target is not None:
+        import jax
+
+        return jax.tree.unflatten(jax.tree.structure(target),
+                                  jax.tree.leaves(restored))
+    return restored
+
+
+class CheckpointManager:
+    """Top-K checkpoint retention (ref: _internal/checkpoint_manager.py)."""
+
+    def __init__(self, storage_dir: str, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None,
+                 score_order: str = "max"):
+        self.storage_dir = storage_dir
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._ckpts: List[Tuple[Optional[float], int, Checkpoint]] = []
+        self._seq = 0
+        self._lock = threading.Lock()
+        os.makedirs(storage_dir, exist_ok=True)
+
+    def register(self, local_ckpt: Checkpoint,
+                 metrics: Dict[str, Any]) -> Checkpoint:
+        """Move a reported checkpoint into storage, applying retention.
+        Returns the persisted checkpoint handle."""
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+        dest = os.path.join(self.storage_dir, f"checkpoint_{seq:06d}")
+        if os.path.abspath(local_ckpt.path) != dest:
+            shutil.copytree(local_ckpt.path, dest, dirs_exist_ok=True)
+        persisted = Checkpoint(dest)
+        persisted.update_metadata({"metrics": _json_safe(metrics),
+                                   "index": seq})
+        score = None
+        if self.score_attribute and self.score_attribute in metrics:
+            score = float(metrics[self.score_attribute])
+        with self._lock:
+            self._ckpts.append((score, seq, persisted))
+            self._apply_retention()
+        return persisted
+
+    def _apply_retention(self):
+        if self.num_to_keep is None or len(self._ckpts) <= self.num_to_keep:
+            return
+        # rank: by score if configured (worst first), else oldest first;
+        # the latest checkpoint is always kept (resume safety)
+        latest_seq = max(s for _, s, _ in self._ckpts)
+
+        def rank(entry):
+            score, seq, _ = entry
+            if score is None or self.score_attribute is None:
+                return seq
+            return score if self.score_order == "max" else -score
+
+        candidates = sorted(
+            [e for e in self._ckpts if e[1] != latest_seq], key=rank)
+        n_drop = len(self._ckpts) - self.num_to_keep
+        for entry in candidates[:n_drop]:
+            self._ckpts.remove(entry)
+            shutil.rmtree(entry[2].path, ignore_errors=True)
+
+    @property
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        with self._lock:
+            if not self._ckpts:
+                return None
+            scored = [e for e in self._ckpts if e[0] is not None]
+            if not scored:
+                return self._ckpts[-1][2]
+            key = (max if self.score_order == "max" else min)
+            return key(scored, key=lambda e: e[0])[2]
+
+    @property
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        with self._lock:
+            if not self._ckpts:
+                return None
+            return max(self._ckpts, key=lambda e: e[1])[2]
+
+    def list_checkpoints(self) -> List[Checkpoint]:
+        with self._lock:
+            return [c for _, _, c in sorted(self._ckpts, key=lambda e: e[1])]
+
+
+def _json_safe(obj):
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        if isinstance(obj, dict):
+            return {k: _json_safe(v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            return [_json_safe(v) for v in obj]
+        try:
+            return float(obj)
+        except (TypeError, ValueError):
+            return repr(obj)

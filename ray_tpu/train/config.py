@@ -1,0 +1,90 @@
+"""Train public config dataclasses.
+
+Mirrors the reference's config surface (ref: python/ray/air/config.py
+ScalingConfig/RunConfig/FailureConfig/CheckpointConfig; train/v2 uses the
+same shapes) with TPU-first fields: workers are HOSTS (one SPMD process per
+host, jax.distributed-style), and `topology` requests a TPU slice instead of
+a GPU count.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How many training workers (host processes) and what each reserves.
+
+    ref: python/ray/air/config.py ScalingConfig (num_workers,
+    use_gpu→use_tpu, resources_per_worker, placement_strategy).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    topology: Optional[str] = None       # e.g. "v5e-8" slice per worker
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        if "CPU" not in res:
+            res["CPU"] = 1.0
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = 1.0
+        return res
+
+
+@dataclass
+class FailureConfig:
+    """ref: python/ray/air/config.py FailureConfig(max_failures).
+
+    max_failures: retries of the whole worker group on worker failure.
+    0 = fail fast; -1 = unlimited.
+    """
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """ref: python/ray/air/config.py CheckpointConfig.
+
+    num_to_keep: top-K checkpoints kept (None = all);
+    checkpoint_score_attribute/order rank them.
+    """
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclass
+class RunConfig:
+    """ref: python/ray/air/config.py RunConfig."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(
+        default_factory=CheckpointConfig)
+
+
+@dataclass
+class Result:
+    """ref: python/ray/air/result.py Result."""
+
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Any]            # train.Checkpoint
+    error: Optional[BaseException]
+    path: Optional[str] = None           # experiment storage dir
+    metrics_dataframe: Optional[Any] = None
+
+    @property
+    def best_checkpoints(self):
+        return getattr(self, "_best_checkpoints", [])

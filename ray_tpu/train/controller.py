@@ -1,0 +1,236 @@
+"""Train controller: the v2-style control loop.
+
+ref: python/ray/train/v2/_internal/execution/controller/controller.py
+(TrainController.run :469, control loop :446), scaling policies at
+train/v2/_internal/execution/scaling_policy/, failure policies at
+train/v2/_internal/execution/failure_handling/. The loop: decide group
+size → (re)start worker group → poll worker status + drain reports →
+register checkpoints → on failure consult FailurePolicy → finish.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from .checkpoint import Checkpoint, CheckpointManager
+from .config import FailureConfig, Result, RunConfig, ScalingConfig
+from .worker_group import ERRORED, FINISHED, RUNNING, WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+
+# ------------------------------------------------------------------ policies
+@dataclass
+class ScalingDecision:
+    num_workers: int
+
+
+class ScalingPolicy:
+    """Decides the worker-group size at (re)start points."""
+
+    def __init__(self, scaling_config: ScalingConfig):
+        self.scaling_config = scaling_config
+
+    def initial_decision(self) -> ScalingDecision:
+        return ScalingDecision(self.scaling_config.num_workers)
+
+    def restart_decision(self, healthy_workers: int) -> ScalingDecision:
+        return ScalingDecision(self.scaling_config.num_workers)
+
+
+class FixedScalingPolicy(ScalingPolicy):
+    pass
+
+
+class ElasticScalingPolicy(ScalingPolicy):
+    """Shrink to available capacity on restart (ref: elastic scaling policy).
+
+    min_workers <= size <= num_workers; on a restart after failures the
+    group re-forms with what the cluster can place.
+    """
+
+    def __init__(self, scaling_config: ScalingConfig, min_workers: int = 1):
+        super().__init__(scaling_config)
+        self.min_workers = min_workers
+
+    def restart_decision(self, healthy_workers: int) -> ScalingDecision:
+        import ray_tpu
+
+        res = self.scaling_config.worker_resources()
+        avail = ray_tpu.available_resources()
+        fit = min(
+            int(avail.get(k, 0) // v) for k, v in res.items() if v > 0
+        ) if res else self.scaling_config.num_workers
+        n = max(self.min_workers,
+                min(self.scaling_config.num_workers, fit))
+        return ScalingDecision(n)
+
+
+class FailureDecision:
+    RETRY = "RETRY"
+    RAISE = "RAISE"
+
+
+class FailurePolicy:
+    """ref: train/v2 failure_handling: max_failures counting."""
+
+    def __init__(self, failure_config: FailureConfig):
+        self.failure_config = failure_config
+        self.failures = 0
+
+    def decide(self, error: str) -> str:
+        self.failures += 1
+        mf = self.failure_config.max_failures
+        if mf < 0 or self.failures <= mf:
+            return FailureDecision.RETRY
+        return FailureDecision.RAISE
+
+
+# ---------------------------------------------------------------- controller
+class TrainController:
+    """Runs one training job to completion (ref: controller.py:93)."""
+
+    def __init__(self, train_fn: Callable, train_loop_config: Dict[str, Any],
+                 scaling_config: ScalingConfig, run_config: RunConfig,
+                 scaling_policy: Optional[ScalingPolicy] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None,
+                 poll_interval: float = 0.1):
+        from ..runtime import serialization
+
+        self.train_fn_blob = serialization.dumps_inline(train_fn)
+        self.train_loop_config = train_loop_config or {}
+        self.scaling_config = scaling_config
+        self.run_config = run_config
+        self.scaling_policy = scaling_policy or FixedScalingPolicy(
+            scaling_config)
+        self.failure_policy = FailurePolicy(run_config.failure_config)
+        self.poll_interval = poll_interval
+
+        name = run_config.name or f"train_{int(time.time())}"
+        storage = run_config.storage_path or os.path.join(
+            os.path.expanduser("~"), "rtpu_results")
+        self.trial_dir = os.path.join(storage, name)
+        os.makedirs(self.trial_dir, exist_ok=True)
+        cc = run_config.checkpoint_config
+        self.checkpoint_manager = CheckpointManager(
+            os.path.join(self.trial_dir, "checkpoints"),
+            num_to_keep=cc.num_to_keep,
+            score_attribute=cc.checkpoint_score_attribute,
+            score_order=cc.checkpoint_score_order)
+        self._resume_checkpoint = resume_from_checkpoint
+        self.metrics_history: List[Dict[str, Any]] = []
+
+    # ------------------------------------------------------------------ run
+    def run(self) -> Result:
+        decision = self.scaling_policy.initial_decision()
+        attempt_error: Optional[str] = None
+        while True:
+            group = None
+            try:
+                group = self._start_group(decision.num_workers)
+                attempt_error = self._run_attempt(group)
+            except Exception as e:  # placement/start failures retry too
+                import traceback
+
+                attempt_error = (f"worker group start failed: {e!r}\n"
+                                 f"{traceback.format_exc()}")
+            finally:
+                if group is not None:
+                    group.shutdown()
+            if attempt_error is None:
+                break
+            action = self.failure_policy.decide(attempt_error)
+            logger.warning("training attempt failed (%s); policy=%s",
+                           attempt_error.splitlines()[-1] if attempt_error
+                           else "?", action)
+            if action == FailureDecision.RAISE:
+                err = RuntimeError(
+                    f"training failed after "
+                    f"{self.failure_policy.failures} failure(s):\n"
+                    f"{attempt_error}")
+                return self._build_result(err)
+            decision = self.scaling_policy.restart_decision(0)
+            # resume from the latest persisted checkpoint
+            self._resume_checkpoint = (
+                self.checkpoint_manager.latest_checkpoint
+                or self._resume_checkpoint)
+
+        return self._build_result(None)
+
+    def _build_result(self, error: Optional[BaseException]) -> Result:
+        result = Result(
+            metrics=self.metrics_history[-1] if self.metrics_history else {},
+            checkpoint=self.checkpoint_manager.best_checkpoint,
+            error=error, path=self.trial_dir)
+        result._best_checkpoints = [
+            (c, c.get_metadata().get("metrics", {}))
+            for c in self.checkpoint_manager.list_checkpoints()]
+        return result
+
+    # ------------------------------------------------------------- internals
+    def _backend_env(self, num_workers: int) -> Dict[str, str]:
+        """jax.distributed bootstrap env, derived from the ACTUAL group size
+        (elastic restarts may differ from scaling_config.num_workers).
+        Multi-host TPU workers use these to enter the same SPMD program
+        (the MASTER_ADDR-rendezvous equivalent of ref train/torch/config.py:66).
+        """
+        env: Dict[str, str] = {}
+        if self.scaling_config.use_tpu and num_workers > 1:
+            env["RTPU_JAX_DISTRIBUTED"] = "1"
+            env["RTPU_JAX_NUM_PROCESSES"] = str(num_workers)
+        return env
+
+    def _start_group(self, num_workers: int) -> WorkerGroup:
+        group = WorkerGroup(
+            num_workers=num_workers,
+            resources_per_worker=self.scaling_config.worker_resources(),
+            experiment_name=os.path.basename(self.trial_dir),
+            trial_dir=self.trial_dir,
+            placement_strategy=self.scaling_config.placement_strategy,
+            backend_env=self._backend_env(num_workers),
+        ).start()
+        ckpt_path = (self._resume_checkpoint.path
+                     if self._resume_checkpoint else None)
+        group.run("start_training", self.train_fn_blob,
+                  self.train_loop_config, ckpt_path, timeout=120)
+        return group
+
+    def _run_attempt(self, group: WorkerGroup) -> Optional[str]:
+        """Poll until all workers finish. Returns an error string or None."""
+        import ray_tpu
+
+        while True:
+            try:
+                polls = group.run("poll", timeout=120)
+            except Exception as e:  # worker/actor death surfaces here
+                return f"worker poll failed: {e!r}"
+            self._ingest_reports(polls)
+            states = [p["state"] for p in polls]
+            if ERRORED in states:
+                errs = [p["error"] for p in polls if p["error"]]
+                return errs[0] if errs else "unknown worker error"
+            if all(s == FINISHED for s in states):
+                return None
+            time.sleep(self.poll_interval)
+
+    def _ingest_reports(self, polls: List[Dict[str, Any]]):
+        """Group per-rank reports by report index; rank 0's metrics are
+        canonical, any rank's checkpoint is registered (rank 0 convention)."""
+        by_rank = {p["rank"]: p["reports"] for p in polls}
+        for rep in by_rank.get(0, []):
+            metrics = rep["metrics"]
+            self.metrics_history.append(metrics)
+            if rep["checkpoint_path"]:
+                self.checkpoint_manager.register(
+                    Checkpoint(rep["checkpoint_path"]), metrics)
+        for rank, reps in by_rank.items():
+            if rank == 0:
+                continue
+            for rep in reps:
+                if rep["checkpoint_path"]:
+                    self.checkpoint_manager.register(
+                        Checkpoint(rep["checkpoint_path"]), rep["metrics"])

@@ -1,0 +1,131 @@
+"""Per-worker training session: context, report(), checkpoint access.
+
+ref: python/ray/train/_internal/session.py (the session thread + report
+queue) and python/ray/train/context.py (TrainContext). The user's
+train_loop_per_worker runs on a thread inside the worker actor; report()
+enqueues (metrics, checkpoint) pairs that the controller drains via poll.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from .checkpoint import Checkpoint
+
+_session_lock = threading.Lock()
+_session: Optional["_TrainSession"] = None
+
+
+@dataclass
+class TrainContext:
+    """What a worker knows about its place in the run
+    (ref: train/context.py get_world_size/get_world_rank/...)."""
+
+    world_size: int
+    world_rank: int
+    local_rank: int
+    local_world_size: int
+    node_rank: int
+    experiment_name: str
+    trial_dir: str
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_local_world_size(self) -> int:
+        return self.local_world_size
+
+    def get_node_rank(self) -> int:
+        return self.node_rank
+
+    def get_experiment_name(self) -> str:
+        return self.experiment_name
+
+    def get_trial_dir(self) -> str:
+        return self.trial_dir
+
+
+class _TrainSession:
+    def __init__(self, context: TrainContext,
+                 checkpoint: Optional[Checkpoint] = None):
+        self.context = context
+        self.reports: "queue.Queue" = queue.Queue()
+        self.starting_checkpoint = checkpoint
+        self.stop_event = threading.Event()
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None):
+        if checkpoint is not None:
+            checkpoint = self._stage(checkpoint)
+        self.reports.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+        if self.stop_event.is_set():
+            raise SystemExit("training stopped by controller")
+
+    def _stage(self, checkpoint: Checkpoint) -> Checkpoint:
+        """Persist the worker-local checkpoint dir into the run's storage
+        (trial_dir must be on storage shared with the controller — the same
+        contract as the reference's fsspec StorageContext, ref:
+        train/_internal/storage.py). The controller then registers the
+        staged path without touching worker-local filesystems."""
+        import shutil
+        import uuid
+
+        staging_root = os.path.join(self.context.trial_dir, "staging")
+        os.makedirs(staging_root, exist_ok=True)
+        dest = os.path.join(
+            staging_root,
+            f"rank{self.context.world_rank}_{uuid.uuid4().hex[:8]}")
+        if os.path.abspath(checkpoint.path) != dest:
+            shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+        return Checkpoint(dest)
+
+
+def init_session(context: TrainContext,
+                 checkpoint: Optional[Checkpoint] = None) -> _TrainSession:
+    global _session
+    with _session_lock:
+        _session = _TrainSession(context, checkpoint)
+        return _session
+
+
+def shutdown_session():
+    global _session
+    with _session_lock:
+        _session = None
+
+
+def get_session() -> _TrainSession:
+    with _session_lock:
+        if _session is None:
+            raise RuntimeError(
+                "No training session active — this API must be called "
+                "inside train_loop_per_worker")
+        return _session
+
+
+# ------------------------------------------------------------------ public
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (and optionally a checkpoint) from a worker
+    (ref: python/ray/train/_internal/session.py report)."""
+    get_session().report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    """ref: python/ray/train/context.py get_context."""
+    return get_session().context
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """The checkpoint to resume from, if any (ref: session get_checkpoint)."""
+    return get_session().starting_checkpoint

@@ -1,0 +1,115 @@
+"""JaxTrainer: the user-facing trainer (ref BaseTrainer/DataParallelTrainer).
+
+ref: python/ray/train/base_trainer.py (BaseTrainer.fit :651),
+train/data_parallel_trainer.py (DataParallelTrainer :26),
+train/torch/config.py (_setup_torch_process_group :66 — replaced here by a
+jax.distributed bootstrap). Where the reference wires an NCCL process group
+per worker, the TPU-native trainer hands each worker host a coordinator
+address; inside the train loop all parallelism is mesh axes (pjit/GSPMD),
+so there is no DDP/FSDP wrapper to apply.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+from .config import Result, RunConfig, ScalingConfig
+from .controller import (ElasticScalingPolicy, FixedScalingPolicy,
+                         TrainController)
+
+
+class JaxTrainer:
+    """Data/model-parallel training of a JAX train loop over a gang of
+    host workers.
+
+    train_loop_per_worker runs once per worker host. Inside it:
+    - ray_tpu.train.get_context() for rank/world info
+    - ray_tpu.train.report(metrics, checkpoint=...) each step/epoch
+    - build a Mesh over jax.devices() and use ShardedTrainer (or raw pjit)
+      — on a multi-host slice, jax.distributed is initialized for you
+      before the loop starts (all hosts must enter the same program).
+    """
+
+    def __init__(self, train_loop_per_worker: Callable,
+                 *,
+                 train_loop_config: Optional[Dict[str, Any]] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 elastic: bool = False,
+                 min_workers: int = 1,
+                 resume_from_checkpoint=None,
+                 datasets: Optional[Dict[str, Any]] = None):
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.elastic = elastic
+        self.min_workers = min_workers
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.datasets = datasets or {}
+
+    def fit(self) -> Result:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+
+        sc = self.scaling_config
+        policy_cls = (ElasticScalingPolicy if self.elastic
+                      else FixedScalingPolicy)
+        policy = (policy_cls(sc, self.min_workers) if self.elastic
+                  else policy_cls(sc))
+
+        train_fn = self.train_loop_per_worker
+        if self.datasets:
+            train_fn = _wrap_with_datasets(train_fn, self.datasets)
+
+        controller = TrainController(
+            train_fn=train_fn,
+            train_loop_config=self.train_loop_config,
+            scaling_config=sc,
+            run_config=self.run_config,
+            scaling_policy=policy,
+            resume_from_checkpoint=self.resume_from_checkpoint,
+        )
+        return controller.run()
+
+
+def _wrap_with_datasets(train_fn: Callable,
+                        datasets: Dict[str, Any]) -> Callable:
+    """Give each worker its split of every dataset via
+    train.get_dataset_shard (ref: DataParallelTrainer dataset splitting).
+    Split counts come from the ACTUAL world size at run time, so elastic
+    restarts at a smaller size still cover the whole dataset."""
+
+    def wrapped(config):
+        from . import session as _session
+        from .session import get_context
+        from .worker_group import _accepts_config
+
+        ctx = get_context()
+        rank, num_workers = ctx.get_world_rank(), ctx.get_world_size()
+        shards = {}
+        for name, ds in datasets.items():
+            if hasattr(ds, "streaming_split"):
+                shards[name] = ds.streaming_split(num_workers)[rank]
+            elif hasattr(ds, "split"):
+                shards[name] = ds.split(num_workers)[rank]
+            else:
+                shards[name] = ds
+        _session.get_session().dataset_shards = shards
+        return train_fn(config) if _accepts_config(train_fn) else train_fn()
+
+    return wrapped
+
+
+def get_dataset_shard(name: str = "train"):
+    """ref: python/ray/train/_internal/session.py get_dataset_shard."""
+    from .session import get_session
+
+    shards = getattr(get_session(), "dataset_shards", None)
+    if shards is None or name not in shards:
+        raise KeyError(
+            f"no dataset shard named {name!r}; pass datasets= to JaxTrainer")
+    return shards[name]
