@@ -1,0 +1,102 @@
+"""ray_tpu.data: distributed datasets on the task runtime.
+
+ref: python/ray/data/__init__.py — the read_*/from_* factory surface plus
+Dataset. Lazy logical plans, fused per-block map stages, two-phase
+shuffles, streaming iteration for TPU ingest (iter_jax_batches).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from .block import Block, BlockAccessor  # noqa: F401
+from .dataset import DataIterator, Dataset, GroupedData  # noqa: F401
+from .plan import InputData, LogicalPlan, Read
+from .executor import StreamingExecutor
+
+
+def _from_read_tasks(tasks) -> Dataset:
+    return Dataset(LogicalPlan([Read(read_tasks=tasks)]))
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    """ref: data/read_api.py range — rows {'id': i}."""
+    from .datasource import range_read_tasks
+
+    return _from_read_tasks(range_read_tasks(n, parallelism))
+
+
+def range_tensor(n: int, *, shape: tuple = (1,),
+                 parallelism: int = -1) -> Dataset:
+    from .datasource import range_read_tasks
+
+    return _from_read_tasks(
+        range_read_tasks(n, parallelism, tensor_shape=tuple(shape)))
+
+
+def from_items(items: List[Any]) -> Dataset:
+    """ref: read_api.py from_items — python objects; dict rows become
+    tabular."""
+    from .dataset import from_items_internal
+
+    return from_items_internal(list(items))
+
+
+def from_numpy(arr, column: str = "data") -> Dataset:
+    import numpy as np
+
+    import ray_tpu
+
+    ref = ray_tpu.put({column: np.asarray(arr)})
+    return Dataset(LogicalPlan([InputData(blocks=[ref])]))
+
+
+def from_arrow(table) -> Dataset:
+    import ray_tpu
+
+    return Dataset(LogicalPlan([InputData(blocks=[ray_tpu.put(table)])]))
+
+
+def from_pandas(df) -> Dataset:
+    import pyarrow as pa
+
+    return from_arrow(pa.Table.from_pandas(df, preserve_index=False))
+
+
+def read_parquet(paths, *, parallelism: int = -1,
+                 columns: Optional[List[str]] = None) -> Dataset:
+    from .datasource import parquet_read_tasks
+
+    return _from_read_tasks(parquet_read_tasks(paths, parallelism, columns))
+
+
+def read_csv(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    from .datasource import csv_read_tasks
+
+    return _from_read_tasks(csv_read_tasks(paths, parallelism, **kwargs))
+
+
+def read_json(paths, *, parallelism: int = -1) -> Dataset:
+    from .datasource import json_read_tasks
+
+    return _from_read_tasks(json_read_tasks(paths, parallelism))
+
+
+def read_text(paths, *, parallelism: int = -1) -> Dataset:
+    from .datasource import text_read_tasks
+
+    return _from_read_tasks(text_read_tasks(paths, parallelism))
+
+
+def read_numpy(paths, *, parallelism: int = -1) -> Dataset:
+    from .datasource import numpy_read_tasks
+
+    return _from_read_tasks(numpy_read_tasks(paths, parallelism))
+
+
+__all__ = [
+    "Block", "BlockAccessor", "DataIterator", "Dataset", "GroupedData",
+    "StreamingExecutor", "range", "range_tensor", "from_items", "from_numpy",
+    "from_arrow", "from_pandas", "read_parquet", "read_csv", "read_json",
+    "read_text", "read_numpy",
+]

@@ -1,0 +1,219 @@
+"""Block model: the unit of distributed data.
+
+ref: python/ray/data/block.py (Block = Arrow table / pandas frame / simple
+list; BlockAccessor dispatches per layout, BlockMetadata). Here a block is
+one of three layouts:
+
+- ``pyarrow.Table``  — tabular data (the canonical interchange layout)
+- ``dict[str, np.ndarray]`` — tensor batches (any rank; the TPU ingest
+  layout: feeds jnp.asarray zero-copy from numpy)
+- ``list``           — simple rows (python objects)
+
+BlockAccessor gives a uniform interface: num_rows, slice, concat (via
+``BlockAccessor.merge``), iter_rows, conversion between layouts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+try:
+    import pyarrow as pa
+except ImportError:  # pragma: no cover - pyarrow is baked into the image
+    pa = None
+
+Block = Union["pa.Table", Dict[str, np.ndarray], List[Any]]
+
+
+def is_tabular(block: Block) -> bool:
+    return pa is not None and isinstance(block, pa.Table)
+
+
+class BlockAccessor:
+    """Uniform view over any block layout (ref: block.py BlockAccessor)."""
+
+    def __init__(self, block: Block):
+        self._block = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    @property
+    def block(self) -> Block:
+        return self._block
+
+    # ------------------------------------------------------------- shape
+    def num_rows(self) -> int:
+        b = self._block
+        if is_tabular(b):
+            return b.num_rows
+        if isinstance(b, dict):
+            if not b:
+                return 0
+            return len(next(iter(b.values())))
+        return len(b)
+
+    def size_bytes(self) -> int:
+        b = self._block
+        if is_tabular(b):
+            return b.nbytes
+        if isinstance(b, dict):
+            return int(sum(v.nbytes if hasattr(v, "nbytes") else 64
+                           for v in b.values()))
+        try:
+            import sys
+
+            return sum(sys.getsizeof(r) for r in b)
+        except Exception:
+            return 0
+
+    # ------------------------------------------------------------- slicing
+    def slice(self, start: int, end: int) -> Block:
+        b = self._block
+        if is_tabular(b):
+            return b.slice(start, end - start)
+        if isinstance(b, dict):
+            return {k: v[start:end] for k, v in b.items()}
+        return b[start:end]
+
+    @staticmethod
+    def merge(blocks: Sequence[Block]) -> Block:
+        """Concatenate same-layout blocks."""
+        blocks = [b for b in blocks if BlockAccessor(b).num_rows() > 0]
+        if not blocks:
+            return []
+        first = blocks[0]
+        if is_tabular(first):
+            return pa.concat_tables(blocks, promote_options="default")
+        if isinstance(first, dict):
+            keys = first.keys()
+            return {k: np.concatenate([blk[k] for blk in blocks])
+                    for k in keys}
+        out: List[Any] = []
+        for blk in blocks:
+            out.extend(blk)
+        return out
+
+    # ------------------------------------------------------------- rows
+    def iter_rows(self) -> Iterator[Any]:
+        b = self._block
+        if is_tabular(b):
+            for row in b.to_pylist():
+                yield row
+        elif isinstance(b, dict):
+            n = self.num_rows()
+            keys = list(b.keys())
+            for i in range(n):
+                yield {k: b[k][i] for k in keys}
+        else:
+            yield from b
+
+    # ------------------------------------------------------------- formats
+    def to_arrow(self) -> "pa.Table":
+        b = self._block
+        if is_tabular(b):
+            return b
+        if isinstance(b, dict):
+            cols = {}
+            for k, v in b.items():
+                v = np.asarray(v)
+                if v.ndim <= 1:
+                    cols[k] = pa.array(v)
+                else:
+                    # n-D tensors: fixed-shape tensor extension column
+                    cols[k] = pa.FixedShapeTensorArray.from_numpy_ndarray(v)
+            return pa.table(cols)
+        return rows_to_block(list(b), target="arrow")
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        b = self._block
+        if isinstance(b, dict):
+            return b
+        if is_tabular(b):
+            out = {}
+            for name in b.column_names:
+                col = b.column(name)
+                if isinstance(col.type, getattr(pa, "FixedShapeTensorType",
+                                                ())):
+                    combined = col.combine_chunks()
+                    out[name] = combined.to_numpy_ndarray()
+                else:
+                    out[name] = col.to_numpy(zero_copy_only=False)
+            return out
+        # simple rows of dicts -> columns; other objects -> "item" column
+        if b and isinstance(b[0], dict):
+            keys = b[0].keys()
+            return {k: np.asarray([r[k] for r in b]) for k in keys}
+        return {"item": np.asarray(b)}
+
+    def to_pandas(self):
+        import pandas as pd
+
+        b = self._block
+        if is_tabular(b):
+            return b.to_pandas()
+        if isinstance(b, dict):
+            return pd.DataFrame({k: list(v) if np.asarray(v).ndim > 1 else v
+                                 for k, v in b.items()})
+        if b and isinstance(b[0], dict):
+            return pd.DataFrame(b)
+        return pd.DataFrame({"item": b})
+
+    def to_batch(self, batch_format: Optional[str]):
+        if batch_format in (None, "default", "numpy"):
+            return self.to_numpy()
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format in ("arrow", "pyarrow"):
+            return self.to_arrow()
+        if batch_format == "dict":
+            return self.to_numpy()
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    # ------------------------------------------------------------- schema
+    def schema(self):
+        b = self._block
+        if is_tabular(b):
+            return b.schema
+        if isinstance(b, dict):
+            return {k: (np.asarray(v).dtype, np.asarray(v).shape[1:])
+                    for k, v in b.items()}
+        if b:
+            return type(b[0])
+        return None
+
+
+def batch_to_block(batch: Any) -> Block:
+    """Normalize a user-returned batch (dict/DataFrame/Table/list) to a
+    block."""
+    if batch is None:
+        return []
+    if is_tabular(batch):
+        return batch
+    if isinstance(batch, dict):
+        return {k: np.asarray(v) for k, v in batch.items()}
+    try:
+        import pandas as pd
+
+        if isinstance(batch, pd.DataFrame):
+            return pa.Table.from_pandas(batch, preserve_index=False)
+    except ImportError:
+        pass
+    if isinstance(batch, list):
+        return batch
+    raise TypeError(f"cannot interpret batch of type {type(batch)}")
+
+
+def rows_to_block(rows: List[Any], target: str = "auto") -> Block:
+    """Build a block from python rows. Dicts of scalars → arrow; anything
+    else stays a simple list."""
+    if target in ("auto", "arrow") and rows and all(
+            isinstance(r, dict) for r in rows):
+        try:
+            return pa.Table.from_pylist(rows)
+        except (pa.ArrowInvalid, pa.ArrowTypeError, pa.ArrowNotImplementedError):
+            pass
+    return list(rows)

@@ -1,0 +1,427 @@
+"""Dataset: the user-facing distributed data API.
+
+ref: python/ray/data/dataset.py (Dataset :160, 136 methods — the core
+surface is reproduced here: transforms, all-to-all ops, consumption,
+splits, iteration) on the block/plan/executor substrate. Datasets are lazy:
+ops append to a LogicalPlan; execution happens on consumption (the
+reference's streaming execution model).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
+                    Tuple, Union)
+
+import numpy as np
+
+from .block import Block, BlockAccessor, rows_to_block
+from .executor import StreamingExecutor
+from .plan import (AllToAll, Filter, FlatMap, InputData, Limit, LogicalPlan,
+                   MapBatches, MapRows, Read, Union as UnionOp, Zip,
+                   compile_plan)
+
+
+class Dataset:
+    def __init__(self, plan: LogicalPlan,
+                 executor: Optional[StreamingExecutor] = None):
+        self._plan = plan
+        self._executor = executor or StreamingExecutor()
+        self._cached_refs: Optional[List[Any]] = None
+
+    # ------------------------------------------------------------ transforms
+    def _append(self, op) -> "Dataset":
+        return Dataset(self._plan.with_op(op), self._executor)
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        """Row-wise transform (ref: dataset.py map)."""
+        return self._append(MapRows(fn=fn))
+
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
+                    batch_format: Optional[str] = None,
+                    fn_kwargs: Optional[Dict[str, Any]] = None) -> "Dataset":
+        """Batch-wise transform (ref: dataset.py map_batches). fn receives
+        a numpy dict / pandas frame / arrow table per batch_format."""
+        return self._append(MapBatches(
+            fn=fn, batch_size=batch_size, batch_format=batch_format,
+            fn_kwargs=fn_kwargs or {}))
+
+    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+        return self._append(Filter(fn=fn))
+
+    def flat_map(self, fn: Callable[[Any], Iterable[Any]]) -> "Dataset":
+        return self._append(FlatMap(fn=fn))
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def _add(batch):
+            batch[name] = fn(batch)
+            return batch
+
+        return self.map_batches(_add, batch_format="pandas")
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def _drop(batch):
+            for c in cols:
+                batch.pop(c, None)
+            return batch
+
+        return self.map_batches(_drop)
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(
+            lambda batch: {c: batch[c] for c in cols})
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        return self.map_batches(
+            lambda batch: {mapping.get(k, k): v for k, v in batch.items()})
+
+    # ------------------------------------------------------------ all-to-all
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._append(AllToAll(kind="repartition",
+                                     args={"num_blocks": num_blocks}))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._append(AllToAll(kind="random_shuffle",
+                                     args={"seed": seed}))
+
+    def sort(self, key: Union[str, List[str]],
+             descending: bool = False) -> "Dataset":
+        return self._append(AllToAll(
+            kind="sort", args={"key": key, "descending": descending}))
+
+    def groupby(self, key: Union[str, List[str]]) -> "GroupedData":
+        keys = [key] if isinstance(key, str) else list(key)
+        return GroupedData(self, keys)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._append(UnionOp(others=[o._plan for o in others]))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return self._append(Zip(other=other._plan))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._append(Limit(n=n))
+
+    def random_sample(self, fraction: float,
+                      *, seed: Optional[int] = None) -> "Dataset":
+        rng_seed = seed
+
+        def _sample(batch):
+            import zlib
+
+            import numpy as _np
+
+            n = len(next(iter(batch.values()))) if batch else 0
+            if rng_seed is None:
+                rng = _np.random.RandomState()
+            else:
+                # per-block stream: mix the seed with the block's content so
+                # every block draws a DIFFERENT (but deterministic) mask
+                h = zlib.crc32(_np.ascontiguousarray(
+                    next(iter(batch.values()))).tobytes())
+                rng = _np.random.RandomState((rng_seed + h) % (2 ** 32))
+            mask = rng.random_sample(n) < fraction
+            return {k: v[mask] for k, v in batch.items()}
+
+        return self.map_batches(_sample)
+
+    # ------------------------------------------------------------ execution
+    def _execute(self) -> List[Any]:
+        if self._cached_refs is None:
+            self._cached_refs = self._executor.execute(
+                compile_plan(self._plan))
+        return self._cached_refs
+
+    def materialize(self) -> "Dataset":
+        """Execute now; the result holds materialized blocks
+        (ref: dataset.py materialize -> MaterializedDataset)."""
+        refs = self._execute()
+        return Dataset(LogicalPlan([InputData(blocks=list(refs))]),
+                       self._executor)
+
+    def get_internal_block_refs(self) -> List[Any]:
+        return list(self._execute())
+
+    def num_blocks(self) -> int:
+        return len(self._execute())
+
+    def stats(self) -> str:
+        return f"plan: {self._plan.describe()}; blocks={self.num_blocks()}"
+
+    # ----------------------------------------------------------- consumption
+    def _iter_blocks(self) -> Iterator[Block]:
+        import ray_tpu
+
+        for ref in self._execute():
+            yield ray_tpu.get(ref, timeout=600)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self._iter_blocks():
+            yield from BlockAccessor(block).iter_rows()
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: Optional[str] = None,
+                     drop_last: bool = False) -> Iterator[Any]:
+        """Re-chunk blocks into fixed-size batches (ref: DataIterator
+        iter_batches)."""
+        pending: List[Block] = []
+        pending_rows = 0
+        for block in self._iter_blocks():
+            acc = BlockAccessor(block)
+            if acc.num_rows() == 0:
+                continue
+            pending.append(block)
+            pending_rows += acc.num_rows()
+            while pending_rows >= batch_size:
+                merged = BlockAccessor.merge(pending)
+                macc = BlockAccessor(merged)
+                batch = macc.slice(0, batch_size)
+                rest = macc.slice(batch_size, macc.num_rows())
+                yield BlockAccessor(batch).to_batch(batch_format)
+                pending = [rest]
+                pending_rows = BlockAccessor(rest).num_rows()
+        if pending_rows > 0 and not drop_last:
+            merged = BlockAccessor.merge(pending)
+            if BlockAccessor(merged).num_rows():
+                yield BlockAccessor(merged).to_batch(batch_format)
+
+    def iter_jax_batches(self, *, batch_size: int = 256,
+                         drop_last: bool = True,
+                         sharding=None) -> Iterator[Dict[str, Any]]:
+        """TPU ingest: numpy batches device_put onto `sharding` if given
+        (the reference's iter_torch_batches analogue, TPU-first)."""
+        import jax
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            if sharding is not None:
+                yield {k: jax.device_put(v, sharding)
+                       for k, v in batch.items()}
+            else:
+                yield {k: jax.numpy.asarray(v) for k, v in batch.items()}
+
+    def take(self, n: int = 20) -> List[Any]:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def take_batch(self, n: int = 20,
+                   batch_format: Optional[str] = None) -> Any:
+        rows_needed = n
+        pending = []
+        for block in self._iter_blocks():
+            pending.append(block)
+            if sum(BlockAccessor(b).num_rows() for b in pending) >= n:
+                break
+        merged = BlockAccessor.merge(pending)
+        acc = BlockAccessor(merged)
+        return BlockAccessor(
+            acc.slice(0, min(rows_needed, acc.num_rows()))
+        ).to_batch(batch_format)
+
+    def count(self) -> int:
+        import ray_tpu
+
+        count_fn = ray_tpu.remote(_count_block)
+        return sum(ray_tpu.get(
+            [count_fn.remote(r) for r in self._execute()], timeout=600))
+
+    def schema(self):
+        for block in self._iter_blocks():
+            acc = BlockAccessor(block)
+            if acc.num_rows():
+                return acc.schema()
+        return None
+
+    def columns(self) -> Optional[List[str]]:
+        s = self.schema()
+        if s is None:
+            return None
+        if hasattr(s, "names"):
+            return list(s.names)
+        if isinstance(s, dict):
+            return list(s.keys())
+        return None
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def sum(self, on: str):
+        return self._simple_agg("sum", on)
+
+    def min(self, on: str):
+        return self._simple_agg("min", on)
+
+    def max(self, on: str):
+        return self._simple_agg("max", on)
+
+    def mean(self, on: str):
+        return self._simple_agg("mean", on)
+
+    def std(self, on: str):
+        return self._simple_agg("std", on)
+
+    def _simple_agg(self, fn: str, on: str):
+        result = GroupedData(self, []).agg({on: fn}).take_all()
+        return result[0][f"{fn}({on})"] if result else None
+
+    # ---------------------------------------------------------------- splits
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        """Split into n datasets by block (ref: dataset.py split)."""
+        refs = self._execute()
+        if equal:
+            return self._split_equal(n)
+        out = []
+        for i in range(n):
+            chunk = refs[i::n]
+            out.append(Dataset(LogicalPlan([InputData(blocks=list(chunk))]),
+                               self._executor))
+        return out
+
+    def _split_equal(self, n: int) -> List["Dataset"]:
+        import ray_tpu
+
+        rows = self.count()
+        per = rows // n
+        datasets = []
+        it = self.iter_rows()
+        for i in range(n):
+            take = per
+            rows_i = list(itertools.islice(it, take))
+            block = rows_to_block(rows_i)
+            datasets.append(Dataset(
+                LogicalPlan([InputData(blocks=[ray_tpu.put(block)])]),
+                self._executor))
+        return datasets
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> List["DataIterator"]:
+        """n iterators over disjoint shards (ref: dataset.py
+        streaming_split for train ingest)."""
+        return [DataIterator(ds) for ds in self.split(n, equal=equal)]
+
+    def iterator(self) -> "DataIterator":
+        return DataIterator(self)
+
+    def train_test_split(self, test_size: float,
+                         *, shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> Tuple["Dataset", "Dataset"]:
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        total = ds.count()
+        n_test = int(total * test_size)
+        mat = ds.materialize()
+        rows = mat.take_all()
+        train_rows, test_rows = rows[: total - n_test], rows[total - n_test:]
+        return (from_items_internal(train_rows, self._executor),
+                from_items_internal(test_rows, self._executor))
+
+    # ----------------------------------------------------------------- write
+    def write_parquet(self, path: str) -> None:
+        from .datasource import write_blocks
+
+        write_blocks(self._iter_blocks(), path, "parquet")
+
+    def write_csv(self, path: str) -> None:
+        from .datasource import write_blocks
+
+        write_blocks(self._iter_blocks(), path, "csv")
+
+    def write_json(self, path: str) -> None:
+        from .datasource import write_blocks
+
+        write_blocks(self._iter_blocks(), path, "json")
+
+    def write_numpy(self, path: str, *, column: str) -> None:
+        from .datasource import write_blocks
+
+        write_blocks(self._iter_blocks(), path, "numpy", column=column)
+
+    def __repr__(self):
+        return f"Dataset(plan={self._plan.describe()})"
+
+
+def _count_block(block: Block) -> int:
+    return BlockAccessor(block).num_rows()
+
+
+class GroupedData:
+    """ref: python/ray/data/grouped_data.py GroupedData."""
+
+    def __init__(self, ds: Dataset, keys: List[str]):
+        self._ds = ds
+        self._keys = keys
+
+    def agg(self, aggs: Dict[str, Union[str, List[str]]]) -> Dataset:
+        """aggs: {column: fn | [fns]} with fn in sum/min/max/mean/std/count."""
+        spec = []
+        for on, fns in aggs.items():
+            for fn in ([fns] if isinstance(fns, str) else fns):
+                spec.append({"on": on, "fn": fn, "name": f"{fn}({on})"})
+        return self._ds._append(AllToAll(
+            kind="aggregate",
+            args={"keys": self._keys, "aggs": spec,
+                  "num_blocks": 1 if not self._keys else None}))
+
+    def count(self) -> Dataset:
+        first_col = "__count__"
+        ds = self._ds.map_batches(
+            lambda b: {**b, first_col: np.ones(
+                len(next(iter(b.values()))) if b else 0, np.int64)})
+        return GroupedData(ds, self._keys).agg({first_col: "sum"}).map_batches(
+            lambda b: {**{k: b[k] for k in self._keys},
+                       "count()": b[f"sum({first_col})"]})
+
+    def sum(self, on: str) -> Dataset:
+        return self.agg({on: "sum"})
+
+    def min(self, on: str) -> Dataset:
+        return self.agg({on: "min"})
+
+    def max(self, on: str) -> Dataset:
+        return self.agg({on: "max"})
+
+    def mean(self, on: str) -> Dataset:
+        return self.agg({on: "mean"})
+
+    def std(self, on: str) -> Dataset:
+        return self.agg({on: "std"})
+
+
+class DataIterator:
+    """Per-consumer iterator handle (ref: data/iterator.py DataIterator)."""
+
+    def __init__(self, ds: Dataset):
+        self._ds = ds
+
+    def iter_batches(self, **kwargs) -> Iterator[Any]:
+        return self._ds.iter_batches(**kwargs)
+
+    def iter_rows(self) -> Iterator[Any]:
+        return self._ds.iter_rows()
+
+    def iter_jax_batches(self, **kwargs) -> Iterator[Any]:
+        return self._ds.iter_jax_batches(**kwargs)
+
+    def materialize(self) -> Dataset:
+        return self._ds.materialize()
+
+    def count(self) -> int:
+        return self._ds.count()
+
+
+def from_items_internal(items: List[Any], executor=None) -> Dataset:
+    import ray_tpu
+
+    block = rows_to_block(list(items))
+    ref = ray_tpu.put(block)
+    return Dataset(LogicalPlan([InputData(blocks=[ref])]),
+                   executor or StreamingExecutor())

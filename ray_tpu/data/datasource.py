@@ -1,0 +1,175 @@
+"""Datasources: file readers/writers producing read tasks.
+
+ref: python/ray/data/datasource/ + _internal/datasource/ (parquet_datasource
+:parallel fragment reads, csv/json/numpy/text/images...). A read here is a
+list of zero-arg callables ("read tasks", same concept as ref ReadTask) that
+the executor schedules as remote tasks, one per file/fragment group.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .block import Block
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                f for f in glob.glob(os.path.join(p, "**"), recursive=True)
+                if os.path.isfile(f) and not os.path.basename(f).startswith(
+                    (".", "_"))))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths!r}")
+    return out
+
+
+def _group(files: List[str], parallelism: int) -> List[List[str]]:
+    n = min(max(parallelism, 1), len(files))
+    return [files[i::n] for i in range(n)]
+
+
+def parquet_read_tasks(paths, parallelism: int = -1,
+                       columns: Optional[List[str]] = None) -> List[Callable]:
+    files = _expand_paths(paths)
+    if parallelism == -1:
+        parallelism = len(files)
+
+    def make(group: List[str]):
+        def read() -> List[Block]:
+            import pyarrow.parquet as pq
+
+            return [pq.read_table(f, columns=columns) for f in group]
+
+        return read
+
+    return [make(g) for g in _group(files, parallelism)]
+
+
+def csv_read_tasks(paths, parallelism: int = -1, **csv_kwargs):
+    files = _expand_paths(paths)
+    if parallelism == -1:
+        parallelism = len(files)
+
+    def make(group):
+        def read() -> List[Block]:
+            import pyarrow.csv as pacsv
+
+            return [pacsv.read_csv(f, **csv_kwargs) for f in group]
+
+        return read
+
+    return [make(g) for g in _group(files, parallelism)]
+
+
+def json_read_tasks(paths, parallelism: int = -1):
+    files = _expand_paths(paths)
+    if parallelism == -1:
+        parallelism = len(files)
+
+    def make(group):
+        def read() -> List[Block]:
+            import pyarrow.json as pajson
+
+            return [pajson.read_json(f) for f in group]
+
+        return read
+
+    return [make(g) for g in _group(files, parallelism)]
+
+
+def text_read_tasks(paths, parallelism: int = -1):
+    files = _expand_paths(paths)
+    if parallelism == -1:
+        parallelism = len(files)
+
+    def make(group):
+        def read() -> List[Block]:
+            import pyarrow as pa
+
+            blocks = []
+            for f in group:
+                with open(f, encoding="utf-8") as fh:
+                    lines = [ln.rstrip("\n") for ln in fh]
+                blocks.append(pa.table({"text": lines}))
+            return blocks
+
+        return read
+
+    return [make(g) for g in _group(files, parallelism)]
+
+
+def numpy_read_tasks(paths, parallelism: int = -1):
+    files = _expand_paths(paths)
+    if parallelism == -1:
+        parallelism = len(files)
+
+    def make(group):
+        def read() -> List[Block]:
+            return [{"data": np.load(f)} for f in group]
+
+        return read
+
+    return [make(g) for g in _group(files, parallelism)]
+
+
+def range_read_tasks(n: int, parallelism: int = -1,
+                     tensor_shape: Optional[tuple] = None) -> List[Callable]:
+    if parallelism == -1:
+        parallelism = min(200, max(1, n // 1000)) or 1
+    parallelism = max(min(parallelism, n), 1) if n else 1
+    bounds = np.linspace(0, n, parallelism + 1, dtype=np.int64)
+
+    def make(lo: int, hi: int):
+        def read() -> List[Block]:
+            ids = np.arange(lo, hi)
+            if tensor_shape:
+                data = np.broadcast_to(
+                    ids.reshape((-1,) + (1,) * len(tensor_shape)),
+                    (hi - lo,) + tensor_shape).copy()
+                return [{"data": data}]
+            return [{"id": ids}]
+
+        return read
+
+    return [make(int(bounds[i]), int(bounds[i + 1]))
+            for i in range(parallelism) if bounds[i] < bounds[i + 1]]
+
+
+# ----------------------------------------------------------------- writers
+def write_blocks(blocks, path: str, fmt: str, column: str = None) -> None:
+    from .block import BlockAccessor
+
+    os.makedirs(path, exist_ok=True)
+    for i, block in enumerate(blocks):
+        acc = BlockAccessor(block)
+        if acc.num_rows() == 0:
+            continue
+        base = os.path.join(path, f"part-{i:05d}")
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+
+            pq.write_table(acc.to_arrow(), base + ".parquet")
+        elif fmt == "csv":
+            import pyarrow.csv as pacsv
+
+            pacsv.write_csv(acc.to_arrow(), base + ".csv")
+        elif fmt == "json":
+            acc.to_pandas().to_json(base + ".json", orient="records",
+                                    lines=True)
+        elif fmt == "numpy":
+            np.save(base + ".npy", acc.to_numpy()[column])
+        else:
+            raise ValueError(f"unknown format {fmt}")

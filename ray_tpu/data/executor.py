@@ -1,0 +1,305 @@
+"""Streaming executor: drives compiled stages over the task runtime.
+
+ref: python/ray/data/_internal/execution/streaming_executor.py (:52) and
+streaming_executor_state.py — there, a thread pumps a state machine with
+resource-aware backpressure. Here the same effects (bounded in-flight
+tasks, per-block pipelining, all-to-all barriers) come from:
+
+- fused map stages: ONE remote task per block for a whole chain of maps
+  (no intermediate materialization — the fusion IS the pipelining);
+- bounded submission: at most `max_in_flight` tasks outstanding, refilled
+  as results land (backpressure against object-store growth);
+- all-to-all stages as two-phase map/shuffle/reduce with `num_returns=n`
+  map tasks, so each reducer fetches only its partition.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from .block import Block, BlockAccessor, rows_to_block
+
+
+def _default_max_in_flight() -> int:
+    try:
+        import ray_tpu
+
+        cpus = int(ray_tpu.cluster_resources().get("CPU", 4))
+    except Exception:
+        cpus = 4
+    return max(2 * cpus, 8)
+
+
+# ---------------------------------------------------------- remote helpers
+def _apply_chain(fns: List[Callable[[Block], Block]], block: Block) -> Block:
+    for fn in fns:
+        block = fn(block)
+    return block
+
+
+def _read_task(task: Callable[[], List[Block]]) -> Block:
+    blocks = list(task())
+    return BlockAccessor.merge(blocks) if len(blocks) != 1 else blocks[0]
+
+
+def _partition_block(block: Block, n: int, kind: str, args: Dict[str, Any]):
+    """Map phase of an all-to-all: split one block into n partitions."""
+    acc = BlockAccessor(block)
+    rows = list(acc.iter_rows())
+    parts: List[List[Any]] = [[] for _ in range(n)]
+    if kind == "repartition":
+        # spread rows evenly, preserving order across partition index
+        for i, r in enumerate(rows):
+            parts[(i * n) // max(len(rows), 1)].append(r)
+    elif kind == "random_shuffle":
+        rng = np.random.RandomState(args.get("seed"))
+        for r in rows:
+            parts[int(rng.randint(n))].append(r)
+    elif kind == "sort":
+        key, bounds, desc = args["key"], args["bounds"], args["descending"]
+        for r in rows:
+            k = _sort_key(r, key)
+            idx = int(np.searchsorted(bounds, _orderable(k), side="right"))
+            parts[idx].append(r)
+    elif kind == "aggregate":
+        keys = args["keys"]
+        for r in rows:
+            h = hash(tuple(r[k] for k in keys)) % n
+            parts[h].append(r)
+    else:
+        raise ValueError(kind)
+    out = tuple(rows_to_block(p) for p in parts)
+    return out if n > 1 else out[0]
+
+
+def _reduce_partition(kind: str, args: Dict[str, Any], *parts: Block) -> Block:
+    """Reduce phase: merge the i-th partition from every map output."""
+    merged_rows: List[Any] = []
+    for p in parts:
+        merged_rows.extend(BlockAccessor(p).iter_rows())
+    if kind == "random_shuffle":
+        rng = np.random.RandomState(args.get("seed"))
+        rng.shuffle(merged_rows)
+    elif kind == "sort":
+        key, desc = args["key"], args["descending"]
+        merged_rows.sort(key=lambda r: _orderable(_sort_key(r, key)),
+                         reverse=desc)
+    elif kind == "aggregate":
+        return _aggregate_rows(merged_rows, args)
+    return rows_to_block(merged_rows)
+
+
+def _sort_key(row, key):
+    if isinstance(row, dict):
+        if isinstance(key, (list, tuple)):
+            return tuple(row[k] for k in key)
+        return row[key]
+    return row
+
+
+def _orderable(k):
+    return k
+
+
+def _aggregate_rows(rows: List[Any], args: Dict[str, Any]) -> Block:
+    import pandas as pd
+
+    keys: List[str] = args["keys"]
+    aggs: List[Dict[str, Any]] = args["aggs"]  # [{on, fn, name}]
+    if not rows:
+        return []
+    df = pd.DataFrame(rows)
+    if not keys:
+        out = {}
+        for a in aggs:
+            out[a["name"]] = _apply_agg(df, a)
+        return rows_to_block([out])
+    grouped = df.groupby(keys, sort=True)
+    result = {}
+    for a in aggs:
+        result[a["name"]] = _apply_agg(grouped, a)
+    out_df = pd.DataFrame(result).reset_index()
+    import pyarrow as pa
+
+    return pa.Table.from_pandas(out_df, preserve_index=False)
+
+
+def _apply_agg(df_or_grouped, agg: Dict[str, Any]):
+    fn, on = agg["fn"], agg["on"]
+    if fn == "count":
+        return df_or_grouped.size() if hasattr(df_or_grouped, "size") else \
+            len(df_or_grouped)
+    target = df_or_grouped[on]
+    return getattr(target, fn)()
+
+
+# ------------------------------------------------------------- the executor
+class StreamingExecutor:
+    """Executes compiled stages, returning the final block refs."""
+
+    def __init__(self, max_in_flight: Optional[int] = None):
+        self.max_in_flight = max_in_flight or _default_max_in_flight()
+
+    # -------------------------------------------------------------- public
+    def execute(self, stages: List[Any]) -> List[Any]:
+        """Run all stages; returns ObjectRefs of the final blocks."""
+        from .plan import (AllToAllStage, LimitStage, MapStage, SourceStage,
+                           UnionStage, ZipStage)
+        import ray_tpu
+
+        refs: List[Any] = []
+        for stage in stages:
+            if isinstance(stage, SourceStage):
+                refs = self._run_source(stage)
+            elif isinstance(stage, MapStage):
+                refs = self._run_map(stage, refs)
+            elif isinstance(stage, AllToAllStage):
+                refs = self._run_all_to_all(stage, refs)
+            elif isinstance(stage, UnionStage):
+                from .dataset import Dataset  # noqa: avoid cycle at import
+
+                for other in stage.others:
+                    refs = refs + self.execute(_compile(other))
+            elif isinstance(stage, ZipStage):
+                refs = self._run_zip(stage, refs)
+            elif isinstance(stage, LimitStage):
+                refs = self._run_limit(stage, refs)
+            else:
+                raise TypeError(f"unknown stage {stage}")
+        return refs
+
+    # ------------------------------------------------------------- sources
+    def _run_source(self, stage) -> List[Any]:
+        import ray_tpu
+
+        if stage.blocks is not None:
+            out = []
+            for b in stage.blocks:
+                out.append(b if isinstance(b, ray_tpu.ObjectRef)
+                           else ray_tpu.put(b))
+            return out
+        read = ray_tpu.remote(_read_task)
+        return self._bounded_submit(
+            [(read, (t,)) for t in stage.read_tasks])
+
+    def _run_map(self, stage, refs: List[Any]) -> List[Any]:
+        import ray_tpu
+
+        apply_ = ray_tpu.remote(_apply_chain)
+        return self._bounded_submit([(apply_, (stage.fns, r)) for r in refs])
+
+    def _bounded_submit(self, calls) -> List[Any]:
+        """Submit keeping at most max_in_flight outstanding."""
+        import ray_tpu
+
+        out: List[Any] = []
+        in_flight: List[Any] = []
+        for fn, args in calls:
+            if len(in_flight) >= self.max_in_flight:
+                ready, in_flight = ray_tpu.wait(
+                    in_flight, num_returns=1, timeout=300)
+            ref = fn.remote(*args)
+            out.append(ref)
+            in_flight.append(ref)
+        return out
+
+    # ---------------------------------------------------------- all-to-all
+    def _run_all_to_all(self, stage, refs: List[Any]) -> List[Any]:
+        import ray_tpu
+
+        kind, args = stage.kind, dict(stage.args)
+        n_out = args.pop("num_blocks", None) or max(len(refs), 1)
+        if kind == "sort" and "bounds" not in args:
+            args["bounds"] = self._sample_sort_bounds(refs, args, n_out)
+        if not refs:
+            return []
+        part = ray_tpu.remote(_partition_block).options(num_returns=n_out)
+        map_outs: List[List[Any]] = []
+        for r in refs:
+            res = part.remote(r, n_out, kind, args)
+            map_outs.append(res if isinstance(res, list) else [res])
+        reduce_ = ray_tpu.remote(_reduce_partition)
+        out = self._bounded_submit(
+            [(reduce_, (kind, args) + tuple(m[i] for m in map_outs))
+             for i in range(n_out)])
+        if kind == "sort" and args.get("descending"):
+            out.reverse()  # partitions ascend by range; rows descend within
+        return out
+
+    def _sample_sort_bounds(self, refs, args, n_out):
+        import ray_tpu
+
+        key = args["key"]
+        sample = ray_tpu.remote(_sample_keys)
+        samples = ray_tpu.get(
+            [sample.remote(r, key) for r in refs], timeout=300)
+        all_keys = sorted(k for s in samples for k in s)
+        if not all_keys or n_out <= 1:
+            return []
+        # n_out-1 boundaries at even quantiles
+        idx = [int(len(all_keys) * (i + 1) / n_out)
+               for i in range(n_out - 1)]
+        return [all_keys[min(i, len(all_keys) - 1)] for i in idx]
+
+    # ---------------------------------------------------------------- zip
+    def _run_zip(self, stage, refs: List[Any]) -> List[Any]:
+        import ray_tpu
+
+        other_refs = self.execute(_compile(stage.other))
+        # materialize row counts to align blocks; then zip row-wise
+        zip_ = ray_tpu.remote(_zip_blocks)
+        left = ray_tpu.get(refs, timeout=600)
+        right = ray_tpu.get(other_refs, timeout=600)
+        left_merged = BlockAccessor.merge(left)
+        right_merged = BlockAccessor.merge(right)
+        return [zip_.remote(left_merged, right_merged)]
+
+    def _run_limit(self, stage, refs: List[Any]) -> List[Any]:
+        import ray_tpu
+
+        out, taken = [], 0
+        for r in refs:
+            if taken >= stage.n:
+                break
+            block = ray_tpu.get(r, timeout=300)
+            acc = BlockAccessor(block)
+            rows = acc.num_rows()
+            if taken + rows <= stage.n:
+                out.append(r)
+                taken += rows
+            else:
+                out.append(ray_tpu.put(acc.slice(0, stage.n - taken)))
+                taken = stage.n
+        return out
+
+
+def _sample_keys(block: Block, key) -> List[Any]:
+    acc = BlockAccessor(block)
+    rows = list(acc.iter_rows())
+    step = max(len(rows) // 20, 1)
+    return [_orderable(_sort_key(r, key)) for r in rows[::step]]
+
+
+def _zip_blocks(left: Block, right: Block) -> Block:
+    la, ra = BlockAccessor(left), BlockAccessor(right)
+    if la.num_rows() != ra.num_rows():
+        raise ValueError(
+            f"zip requires equal row counts, got {la.num_rows()} "
+            f"vs {ra.num_rows()}")
+    ln, rn = la.to_numpy(), ra.to_numpy()
+    out = dict(ln)
+    for k, v in rn.items():
+        name = k
+        while name in out:
+            name = name + "_1"
+        out[name] = v
+    return out
+
+
+def _compile(plan) -> List[Any]:
+    from .plan import compile_plan
+
+    return compile_plan(plan)

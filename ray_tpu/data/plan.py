@@ -1,0 +1,248 @@
+"""Logical plan + operator fusion.
+
+ref: python/ray/data/_internal/logical/operators/ (map_operator,
+all_to_all_operator, read_operator...) and _internal/planner/. The plan is
+a linear chain of logical ops compiled into stages:
+
+- a **map stage** fuses every consecutive per-block op (map_batches, map,
+  filter, flat_map) into ONE task per block (ref fuses the same way —
+  fewer tasks, no intermediate materialization);
+- an **all-to-all stage** (repartition, random_shuffle, sort, groupby) is a
+  barrier implemented as two-phase map/shuffle/reduce over the runtime.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .block import Block, BlockAccessor, batch_to_block, rows_to_block
+
+
+# --------------------------------------------------------------- logical ops
+@dataclass
+class LogicalOp:
+    name: str = field(default="", init=False)
+
+
+@dataclass
+class InputData(LogicalOp):
+    blocks: List[Any] = field(default_factory=list)  # ObjectRefs or blocks
+
+    def __post_init__(self):
+        self.name = "InputData"
+
+
+@dataclass
+class Read(LogicalOp):
+    read_tasks: List[Callable[[], List[Block]]] = field(default_factory=list)
+
+    def __post_init__(self):
+        self.name = "Read"
+
+
+@dataclass
+class MapBatches(LogicalOp):
+    fn: Callable = None
+    batch_size: Optional[int] = None
+    batch_format: Optional[str] = None
+    fn_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        self.name = "MapBatches"
+
+
+@dataclass
+class MapRows(LogicalOp):
+    fn: Callable = None
+
+    def __post_init__(self):
+        self.name = "Map"
+
+
+@dataclass
+class Filter(LogicalOp):
+    fn: Callable = None
+
+    def __post_init__(self):
+        self.name = "Filter"
+
+
+@dataclass
+class FlatMap(LogicalOp):
+    fn: Callable = None
+
+    def __post_init__(self):
+        self.name = "FlatMap"
+
+
+@dataclass
+class AllToAll(LogicalOp):
+    kind: str = ""          # repartition | random_shuffle | sort | aggregate
+    args: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        self.name = f"AllToAll[{self.kind}]"
+
+
+@dataclass
+class Union(LogicalOp):
+    others: List["LogicalPlan"] = field(default_factory=list)
+
+    def __post_init__(self):
+        self.name = "Union"
+
+
+@dataclass
+class Zip(LogicalOp):
+    other: "LogicalPlan" = None
+
+    def __post_init__(self):
+        self.name = "Zip"
+
+
+@dataclass
+class Limit(LogicalOp):
+    n: int = 0
+
+    def __post_init__(self):
+        self.name = "Limit"
+
+
+class LogicalPlan:
+    def __init__(self, ops: List[LogicalOp]):
+        self.ops = ops
+
+    def with_op(self, op: LogicalOp) -> "LogicalPlan":
+        return LogicalPlan(self.ops + [op])
+
+    def describe(self) -> str:
+        return " -> ".join(op.name for op in self.ops)
+
+
+# ----------------------------------------------------------------- fusion
+def make_block_fn(op: LogicalOp) -> Callable[[Block], Block]:
+    """One logical per-block op -> a Block -> Block callable."""
+    if isinstance(op, MapBatches):
+        fmt, fn, kwargs = op.batch_format, op.fn, op.fn_kwargs
+        bs = op.batch_size
+
+        def apply_map_batches(block: Block) -> Block:
+            acc = BlockAccessor(block)
+            n = acc.num_rows()
+            if n == 0:
+                return block
+            size = bs or n
+            outs = []
+            for start in range(0, n, size):
+                piece = BlockAccessor(acc.slice(start, min(start + size, n)))
+                outs.append(batch_to_block(fn(piece.to_batch(fmt), **kwargs)))
+            return BlockAccessor.merge(outs)
+
+        return apply_map_batches
+    if isinstance(op, MapRows):
+        fn = op.fn
+
+        def apply_map(block: Block) -> Block:
+            return rows_to_block(
+                [fn(r) for r in BlockAccessor(block).iter_rows()])
+
+        return apply_map
+    if isinstance(op, Filter):
+        fn = op.fn
+
+        def apply_filter(block: Block) -> Block:
+            return rows_to_block(
+                [r for r in BlockAccessor(block).iter_rows() if fn(r)])
+
+        return apply_filter
+    if isinstance(op, FlatMap):
+        fn = op.fn
+
+        def apply_flat_map(block: Block) -> Block:
+            out = []
+            for r in BlockAccessor(block).iter_rows():
+                out.extend(fn(r))
+            return rows_to_block(out)
+
+        return apply_flat_map
+    raise TypeError(f"not a per-block op: {op}")
+
+
+FUSABLE = (MapBatches, MapRows, Filter, FlatMap)
+
+
+@dataclass
+class MapStage:
+    """A fused chain of per-block transforms: one task per block."""
+
+    fns: List[Callable[[Block], Block]]
+    name: str
+
+
+@dataclass
+class AllToAllStage:
+    kind: str
+    args: Dict[str, Any]
+
+
+@dataclass
+class UnionStage:
+    others: List["LogicalPlan"]
+
+
+@dataclass
+class ZipStage:
+    other: "LogicalPlan"
+
+
+@dataclass
+class LimitStage:
+    n: int
+
+
+@dataclass
+class SourceStage:
+    """Read tasks or pre-materialized input blocks."""
+
+    read_tasks: Optional[List[Callable]] = None
+    blocks: Optional[List[Any]] = None
+
+
+def compile_plan(plan: LogicalPlan) -> List[Any]:
+    """Compile the logical chain into executable stages, fusing maps."""
+    stages: List[Any] = []
+    i = 0
+    ops = plan.ops
+    if not ops:
+        return [SourceStage(blocks=[])]
+    first = ops[0]
+    if isinstance(first, Read):
+        stages.append(SourceStage(read_tasks=first.read_tasks))
+    elif isinstance(first, InputData):
+        stages.append(SourceStage(blocks=first.blocks))
+    else:
+        raise ValueError(f"plan must start with a source, got {first.name}")
+    i = 1
+    while i < len(ops):
+        op = ops[i]
+        if isinstance(op, FUSABLE):
+            fns, names = [], []
+            while i < len(ops) and isinstance(ops[i], FUSABLE):
+                fns.append(make_block_fn(ops[i]))
+                names.append(ops[i].name)
+                i += 1
+            stages.append(MapStage(fns=fns, name="+".join(names)))
+            continue
+        if isinstance(op, AllToAll):
+            stages.append(AllToAllStage(op.kind, op.args))
+        elif isinstance(op, Union):
+            stages.append(UnionStage(op.others))
+        elif isinstance(op, Zip):
+            stages.append(ZipStage(op.other))
+        elif isinstance(op, Limit):
+            stages.append(LimitStage(op.n))
+        else:
+            raise ValueError(f"unknown op {op}")
+        i += 1
+    return stages

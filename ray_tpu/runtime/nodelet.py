@@ -35,6 +35,19 @@ from .ids import NodeID, TaskID, WorkerID
 from .rpc import RpcClient, RpcServer, ServerConn
 
 
+async def _ensure_proc_dead(proc, grace: float = 2.0):
+    """SIGKILL a terminated worker that ignores SIGTERM."""
+    deadline = time.monotonic() + grace
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return
+        await asyncio.sleep(0.1)
+    try:
+        proc.kill()
+    except Exception:
+        pass
+
+
 class WorkerState:
     def __init__(self, worker_id: str, address: str, pid: int, proc=None):
         self.worker_id = worker_id
@@ -205,6 +218,20 @@ class Nodelet:
                 ws.proc.terminate()
             except Exception:
                 pass
+            # escalate to SIGKILL: user code may install SIGTERM handlers
+            # (jax.distributed's preemption notifier does) that keep the
+            # process alive past terminate()
+            try:
+                asyncio.get_running_loop().create_task(
+                    _ensure_proc_dead(ws.proc))
+            except RuntimeError:
+                try:
+                    ws.proc.wait(timeout=2)
+                except Exception:
+                    try:
+                        ws.proc.kill()
+                    except Exception:
+                        pass
 
     async def _on_worker_death(self, ws: WorkerState):
         self.workers.pop(ws.worker_id, None)

@@ -67,14 +67,28 @@ class Checkpoint:
 
 def save_pytree(tree: Any, path: str) -> None:
     """Persist a JAX pytree. Orbax when available (sharded-array aware),
-    else pickle of fully-materialized numpy leaves."""
+    else pickle of fully-materialized numpy leaves.
+
+    Leaves are stored POSITIONALLY (zero-padded index keys) with the
+    treedef alongside, so restore never depends on orbax's dict-key
+    ordering matching the target structure's flatten order (custom pytree
+    nodes flatten in field order, not sorted-key order)."""
+    import jax
+
     os.makedirs(path, exist_ok=True)
+    leaves, treedef = jax.tree.flatten(tree)
     orbax_dir = os.path.join(path, "orbax")
     try:
         import orbax.checkpoint as ocp
 
         ckptr = ocp.PyTreeCheckpointer()
-        ckptr.save(orbax_dir, tree, force=True)
+        ckptr.save(orbax_dir, {f"leaf_{i:06d}": leaf
+                               for i, leaf in enumerate(leaves)}, force=True)
+        try:
+            with open(os.path.join(path, "treedef.pkl"), "wb") as f:
+                pickle.dump(treedef, f)
+        except Exception:
+            pass  # structure only recoverable via `target=` then
         return
     except Exception as e:
         # a partial orbax dir must not shadow the pickle fallback on load
@@ -83,7 +97,6 @@ def save_pytree(tree: Any, path: str) -> None:
 
         logging.getLogger(__name__).warning(
             "orbax save failed (%r); falling back to pickle", e)
-    import jax
     import numpy as np
 
     host_tree = jax.tree.map(lambda x: np.asarray(x)
@@ -93,24 +106,29 @@ def save_pytree(tree: Any, path: str) -> None:
 
 
 def load_pytree(path: str, target: Any = None) -> Any:
+    """Restore a tree saved by save_pytree. With `target`, leaves are
+    re-assembled into the target's structure (positional, order-safe)."""
+    import jax
+
     orbax_path = os.path.join(path, "orbax")
     if os.path.exists(orbax_path):
         import orbax.checkpoint as ocp
 
         ckptr = ocp.PyTreeCheckpointer()
         restored = ckptr.restore(orbax_path)
+        leaves = [restored[k] for k in sorted(restored)]
         if target is not None:
-            import jax
-
-            # restore flat dict into the target tree structure
-            return jax.tree.unflatten(jax.tree.structure(target),
-                                      jax.tree.leaves(restored))
-        return restored
+            return jax.tree.unflatten(jax.tree.structure(target), leaves)
+        tdp = os.path.join(path, "treedef.pkl")
+        if os.path.exists(tdp):
+            with open(tdp, "rb") as f:
+                treedef = pickle.load(f)
+            return jax.tree.unflatten(treedef, leaves)
+        raise ValueError(
+            f"checkpoint at {path} has no stored treedef; pass target=")
     with open(os.path.join(path, "tree.pkl"), "rb") as f:
         restored = pickle.load(f)
     if target is not None:
-        import jax
-
         return jax.tree.unflatten(jax.tree.structure(target),
                                   jax.tree.leaves(restored))
     return restored
@@ -139,8 +157,14 @@ class CheckpointManager:
             seq = self._seq
             self._seq += 1
         dest = os.path.join(self.storage_dir, f"checkpoint_{seq:06d}")
-        if os.path.abspath(local_ckpt.path) != dest:
-            shutil.copytree(local_ckpt.path, dest, dirs_exist_ok=True)
+        src = os.path.abspath(local_ckpt.path)
+        if src != dest:
+            # session-staged checkpoints (under <trial>/staging/) are moved,
+            # not copied — staging must not accumulate a copy per report
+            if os.sep + "staging" + os.sep in src + os.sep:
+                shutil.move(src, dest)
+            else:
+                shutil.copytree(src, dest, dirs_exist_ok=True)
         persisted = Checkpoint(dest)
         persisted.update_metadata({"metrics": _json_safe(metrics),
                                    "index": seq})
@@ -155,15 +179,19 @@ class CheckpointManager:
     def _apply_retention(self):
         if self.num_to_keep is None or len(self._ckpts) <= self.num_to_keep:
             return
-        # rank: by score if configured (worst first), else oldest first;
-        # the latest checkpoint is always kept (resume safety)
+        # rank: worst first. With a score attribute, unscored checkpoints
+        # are worst of all (never outrank a scored one); among scored,
+        # lowest (max-order) / highest (min-order) score drops first.
+        # Without one, oldest drops first. Latest is always kept (resume).
         latest_seq = max(s for _, s, _ in self._ckpts)
 
         def rank(entry):
             score, seq, _ = entry
-            if score is None or self.score_attribute is None:
-                return seq
-            return score if self.score_order == "max" else -score
+            if self.score_attribute is None:
+                return (0, seq)
+            if score is None:
+                return (0, seq)
+            return (1, score if self.score_order == "max" else -score)
 
         candidates = sorted(
             [e for e in self._ckpts if e[1] != latest_seq], key=rank)

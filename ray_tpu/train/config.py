@@ -26,6 +26,11 @@ class ScalingConfig:
     topology: Optional[str] = None       # e.g. "v5e-8" slice per worker
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"
+    # jax.distributed bootstrap: None = auto (use_tpu and num_workers > 1),
+    # True/False forces. jax_platforms pins the workers' backend (e.g.
+    # "cpu" for multi-process CPU testing of the multi-host path).
+    jax_distributed: Optional[bool] = None
+    jax_platforms: Optional[str] = None
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})

@@ -179,9 +179,15 @@ class TrainController:
         (the MASTER_ADDR-rendezvous equivalent of ref train/torch/config.py:66).
         """
         env: Dict[str, str] = {}
-        if self.scaling_config.use_tpu and num_workers > 1:
+        sc = self.scaling_config
+        enable = sc.jax_distributed
+        if enable is None:
+            enable = sc.use_tpu and num_workers > 1
+        if enable:
             env["RTPU_JAX_DISTRIBUTED"] = "1"
             env["RTPU_JAX_NUM_PROCESSES"] = str(num_workers)
+        if sc.jax_platforms:
+            env["RTPU_JAX_PLATFORMS"] = sc.jax_platforms
         return env
 
     def _start_group(self, num_workers: int) -> WorkerGroup:

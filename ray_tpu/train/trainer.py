@@ -63,7 +63,13 @@ class JaxTrainer:
 
         train_fn = self.train_loop_per_worker
         if self.datasets:
-            train_fn = _wrap_with_datasets(train_fn, self.datasets)
+            # materialize ONCE on the driver: workers then split the same
+            # block refs, so nondeterministic plans (shuffles) can't give
+            # workers overlapping/disjoint-missing shards
+            materialized = {
+                name: (ds.materialize() if hasattr(ds, "materialize") else ds)
+                for name, ds in self.datasets.items()}
+            train_fn = _wrap_with_datasets(train_fn, materialized)
 
         controller = TrainController(
             train_fn=train_fn,

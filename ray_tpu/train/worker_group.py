@@ -66,6 +66,7 @@ class TrainWorker:
 
         def _run():
             try:
+                self._maybe_init_jax_distributed()
                 if _accepts_config(train_fn):
                     self.result = train_fn(config)
                 else:
@@ -80,6 +81,55 @@ class TrainWorker:
         self._thread = threading.Thread(target=_run, daemon=True,
                                         name=f"train-worker-{self.rank}")
         self._thread.start()
+
+    def _maybe_init_jax_distributed(self):
+        """Multi-host SPMD bootstrap: worker 0 publishes a coordinator
+        address in the cluster KV; everyone enters
+        jax.distributed.initialize (the MASTER_ADDR rendezvous of ref
+        train/torch/config.py:66, with the cluster KV as the store)."""
+        import os
+        import socket
+        import time
+
+        plat = os.environ.get("RTPU_JAX_PLATFORMS")
+        if plat:
+            import jax
+
+            jax.config.update("jax_platforms", plat)
+        if os.environ.get("RTPU_JAX_DISTRIBUTED") != "1":
+            return
+        num = int(os.environ.get("RTPU_JAX_NUM_PROCESSES",
+                                 str(self.world_size)))
+        from ..runtime.core import get_core
+
+        core = get_core()
+        ns = f"__train_coord:{self.experiment_name}"
+        key = f"coordinator:{num}"
+        if self.rank == 0:
+            s = socket.socket()
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+            s.close()
+            host = socket.gethostbyname(socket.gethostname())
+            addr = f"{host}:{port}"
+            core.controller.call("kv_put", ns=ns, key=key,
+                                 value=addr.encode(), overwrite=True)
+        else:
+            deadline = time.monotonic() + 120
+            addr = None
+            while time.monotonic() < deadline:
+                raw = core.controller.call("kv_get", ns=ns, key=key)
+                if raw:
+                    addr = raw.decode() if isinstance(raw, bytes) else raw
+                    break
+                time.sleep(0.2)
+            if addr is None:
+                raise TimeoutError("jax coordinator address never published")
+        import jax
+
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=num,
+                                   process_id=self.rank)
 
     def poll(self) -> Dict[str, Any]:
         """Drain queued reports + current state (controller heartbeat).
@@ -154,12 +204,12 @@ class WorkerGroup:
             # no capacity for a gang on this cluster shape — fall back to
             # plain resource scheduling. STRICT strategies must not degrade
             # silently: a multi-host jax gang mis-placed would deadlock.
+            self._remove_pg()  # never leak the half-reserved bundles
             if self.placement_strategy.startswith("STRICT"):
                 raise
             logging.getLogger(__name__).warning(
                 "placement group (%s) unavailable (%r); falling back to "
                 "unplaced scheduling", self.placement_strategy, e)
-            self._pg = None
             strategies = [None] * self.num_workers
 
         num_cpus = self.resources.get("CPU", 1)
@@ -200,6 +250,9 @@ class WorkerGroup:
             except Exception:
                 pass
         self.workers = []
+        self._remove_pg()
+
+    def _remove_pg(self):
         if self._pg is not None:
             try:
                 from ray_tpu.util.placement_group import (

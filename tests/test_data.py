@@ -1,0 +1,196 @@
+"""Data library tests (mirrors ref python/ray/data/tests test surface:
+transforms, all-to-all, groupby, reads/writes, iteration, splits)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu import data as rd
+
+
+@pytest.fixture(autouse=True)
+def _cluster(shared_cluster):
+    yield shared_cluster
+
+
+def test_range_count_schema_take():
+    ds = rd.range(100, parallelism=4)
+    assert ds.count() == 100
+    assert ds.num_blocks() == 4
+    rows = ds.take(3)
+    assert rows == [{"id": 0}, {"id": 1}, {"id": 2}]
+    assert "id" in ds.columns()
+
+
+def test_map_filter_flatmap_chain_fuses():
+    from ray_tpu.data.plan import MapStage, compile_plan
+
+    ds = (rd.range(50, parallelism=2)
+          .map(lambda r: {"id": r["id"] * 2})
+          .filter(lambda r: r["id"] % 4 == 0)
+          .flat_map(lambda r: [r, r]))
+    stages = compile_plan(ds._plan)
+    # source + ONE fused map stage
+    assert len(stages) == 2
+    assert isinstance(stages[1], MapStage) and len(stages[1].fns) == 3
+    rows = ds.take_all()
+    assert len(rows) == 50  # 25 survive filter, duplicated
+    assert all(r["id"] % 4 == 0 for r in rows)
+
+
+def test_map_batches_formats():
+    ds = rd.range(32, parallelism=2)
+    out = ds.map_batches(lambda b: {"x": b["id"] + 1},
+                         batch_format="numpy").take(2)
+    assert out == [{"x": 1}, {"x": 2}]
+
+    def pdf(df):
+        df["y"] = df["id"] * 10
+        return df
+
+    out = ds.map_batches(pdf, batch_format="pandas").take(2)
+    assert out[1]["y"] == 10
+
+    out = ds.map_batches(lambda b: {"n": [len(b["id"])]},
+                         batch_size=8).take_all()
+    assert [r["n"] for r in out] == [8, 8, 8, 8]
+
+
+def test_repartition_and_shuffle():
+    ds = rd.range(100, parallelism=3).repartition(5)
+    assert ds.num_blocks() == 5
+    assert ds.count() == 100
+
+    shuffled = rd.range(100, parallelism=4).random_shuffle(seed=7)
+    ids = [r["id"] for r in shuffled.take_all()]
+    assert sorted(ids) == list(range(100))
+    assert ids != list(range(100))
+
+
+def test_sort():
+    rng = np.random.RandomState(0)
+    vals = rng.permutation(200)
+    ds = rd.from_items([{"v": int(v)} for v in vals])
+    ds = ds.repartition(4).sort("v")
+    out = [r["v"] for r in ds.take_all()]
+    assert out == sorted(vals.tolist())
+    out_desc = [r["v"] for r in
+                rd.from_items([{"v": int(v)} for v in vals])
+                .repartition(4).sort("v", descending=True).take_all()]
+    assert out_desc == sorted(vals.tolist(), reverse=True)
+
+
+def test_groupby_agg():
+    rows = [{"k": i % 3, "v": float(i)} for i in range(30)]
+    ds = rd.from_items(rows).repartition(4)
+    out = ds.groupby("k").agg({"v": ["sum", "mean"]}).take_all()
+    assert len(out) == 3
+    by_k = {r["k"]: r for r in out}
+    expect_sum = {k: sum(r["v"] for r in rows if r["k"] == k)
+                  for k in range(3)}
+    for k in range(3):
+        assert by_k[k]["sum(v)"] == expect_sum[k]
+        assert by_k[k]["mean(v)"] == expect_sum[k] / 10
+
+    counted = ds.groupby("k").count().take_all()
+    assert {r["k"]: r["count()"] for r in counted} == {0: 10, 1: 10, 2: 10}
+
+
+def test_global_aggregates():
+    ds = rd.from_items([{"x": float(i)} for i in range(10)])
+    assert ds.sum("x") == 45.0
+    assert ds.min("x") == 0.0
+    assert ds.max("x") == 9.0
+    assert ds.mean("x") == 4.5
+
+
+def test_union_zip_limit():
+    a = rd.range(10, parallelism=2)
+    b = rd.range(10, parallelism=2).map(lambda r: {"id": r["id"] + 10})
+    u = a.union(b)
+    assert u.count() == 20
+
+    z = rd.range(5).zip(rd.range(5).map(lambda r: {"sq": r["id"] ** 2}))
+    rows = z.take_all()
+    assert rows[3] == {"id": 3, "sq": 9}
+
+    assert rd.range(100, parallelism=4).limit(13).count() == 13
+
+
+def test_iter_batches_and_jax():
+    ds = rd.range(50, parallelism=3)
+    batches = list(ds.iter_batches(batch_size=16, batch_format="numpy"))
+    sizes = [len(b["id"]) for b in batches]
+    assert sizes == [16, 16, 16, 2]
+    all_ids = np.concatenate([b["id"] for b in batches])
+    assert sorted(all_ids.tolist()) == list(range(50))
+
+    jb = list(ds.iter_jax_batches(batch_size=25))
+    assert len(jb) == 2
+    import jax.numpy as jnp
+
+    assert isinstance(jb[0]["id"], jnp.ndarray)
+
+
+def test_split_and_streaming_split():
+    ds = rd.range(60, parallelism=6)
+    parts = ds.split(3)
+    assert sum(p.count() for p in parts) == 60
+    its = ds.streaming_split(2)
+    ids = []
+    for it in its:
+        for b in it.iter_batches(batch_size=100, batch_format="numpy"):
+            ids.extend(b["id"].tolist())
+    assert sorted(ids) == list(range(60))
+
+
+def test_read_write_parquet_csv_json(tmp_path):
+    ds = rd.from_items([{"a": i, "b": f"s{i}"} for i in range(20)])
+    pq_dir = str(tmp_path / "pq")
+    ds.write_parquet(pq_dir)
+    back = rd.read_parquet(pq_dir)
+    assert back.count() == 20
+    assert sorted(r["a"] for r in back.take_all()) == list(range(20))
+
+    csv_dir = str(tmp_path / "csv")
+    ds.write_csv(csv_dir)
+    assert rd.read_csv(csv_dir).count() == 20
+
+    json_dir = str(tmp_path / "json")
+    ds.write_json(json_dir)
+    assert rd.read_json(json_dir).count() == 20
+
+
+def test_tensor_blocks_roundtrip():
+    ds = rd.range_tensor(16, shape=(2, 3), parallelism=2)
+    batch = ds.take_batch(4, batch_format="numpy")
+    assert batch["data"].shape == (4, 2, 3)
+    # tensors should survive an arrow conversion (FixedShapeTensor)
+    mapped = ds.map_batches(lambda b: {"data": b["data"] * 2.0})
+    out = mapped.take_batch(16, batch_format="numpy")
+    assert out["data"].shape == (16, 2, 3)
+    np.testing.assert_allclose(out["data"][3], np.full((2, 3), 6.0))
+
+
+def test_column_ops_and_sample():
+    ds = rd.from_items([{"a": i, "b": i * 2} for i in range(10)])
+    assert ds.select_columns(["a"]).columns() == ["a"]
+    assert "c" in (ds.rename_columns({"b": "c"}).columns())
+    dropped = ds.drop_columns(["b"]).take(1)
+    assert dropped == [{"a": 0}]
+
+    s = rd.range(1000, parallelism=2).random_sample(0.1, seed=3).count()
+    assert 50 < s < 200
+
+
+def test_materialize_caches():
+    calls = []
+
+    def f(b):
+        calls.append(1)
+        return b
+
+    ds = rd.range(10, parallelism=2).map_batches(f).materialize()
+    ds.count()
+    ds.count()
+    # map ran once per block during materialize only
+    assert ds._plan.ops[0].__class__.__name__ == "InputData"
