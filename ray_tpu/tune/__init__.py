@@ -1,0 +1,182 @@
+"""ray_tpu.tune: hyperparameter search (ref: python/ray/tune).
+
+Surface: Tuner.fit (ref tune/tuner.py:43,:312), TuneConfig, search-space
+ctors (uniform/loguniform/choice/grid_search/...), schedulers (ASHA,
+median-stopping, PBT), ResultGrid. Trial reporting reuses the train
+session: ``tune.report(metrics, checkpoint=...)`` inside the trainable.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..train.checkpoint import Checkpoint  # noqa: F401
+from ..train.config import Result, RunConfig
+from ..train.session import get_checkpoint, get_context, report  # noqa: F401
+from .controller import TERMINATED, Trial, TuneController
+from .schedulers import (  # noqa: F401
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from .search import (  # noqa: F401
+    BasicVariantGenerator,
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+
+
+@dataclass
+class TuneConfig:
+    """ref: tune/tune_config.py TuneConfig."""
+
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Optional[TrialScheduler] = None
+    search_seed: Optional[int] = None
+
+
+class ResultGrid:
+    """ref: tune/result_grid.py ResultGrid."""
+
+    def __init__(self, trials: List[Trial], metric: Optional[str],
+                 mode: str, experiment_dir: str):
+        self._trials = trials
+        self._metric = metric
+        self._mode = mode
+        self.experiment_path = experiment_dir
+
+    def __len__(self):
+        return len(self._trials)
+
+    def __getitem__(self, i: int) -> Result:
+        return self._to_result(self._trials[i])
+
+    def _to_result(self, t: Trial) -> Result:
+        err = RuntimeError(t.error) if t.error else None
+        ckpt = (t.checkpoint_manager.latest_checkpoint
+                if t.checkpoint_manager else None)
+        r = Result(metrics=t.last_metrics, checkpoint=ckpt, error=err,
+                   path=os.path.join(self.experiment_path, t.trial_id))
+        r.config = dict(t.config)
+        return r
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (set in TuneConfig or here)")
+        best, best_v = None, None
+        for t in self._trials:
+            # best over the trial's whole history (a scheduler may stop a
+            # trial after its peak)
+            for m in t.metrics_history:
+                if metric not in m:
+                    continue
+                v = float(m[metric])
+                better = (best_v is None or
+                          (v > best_v if mode == "max" else v < best_v))
+                if better:
+                    best, best_v = t, v
+        if best is None:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        return self._to_result(best)
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for t in self._trials:
+            row = dict(t.last_metrics)
+            row["trial_id"] = t.trial_id
+            row["status"] = t.status
+            for k, v in t.config.items():
+                row[f"config/{k}"] = v
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+    @property
+    def errors(self) -> List[str]:
+        return [t.error for t in self._trials if t.error]
+
+    def num_terminated(self) -> int:
+        return sum(t.status in (TERMINATED, "FINISHED")
+                   for t in self._trials)
+
+
+class Tuner:
+    """ref: tune/tuner.py Tuner(trainable, param_space=..., tune_config=...,
+    run_config=...)."""
+
+    def __init__(self, trainable: Callable, *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resources_per_trial: Optional[Dict[str, float]] = None):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self.resources_per_trial = resources_per_trial
+
+    def fit(self) -> ResultGrid:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        tc = self.tune_config
+        gen = BasicVariantGenerator(seed=tc.search_seed)
+        configs = list(gen.generate(self.param_space, tc.num_samples))
+        name = self.run_config.name or f"tune_{int(time.time())}"
+        storage = self.run_config.storage_path or os.path.join(
+            os.path.expanduser("~"), "rtpu_results")
+        experiment_dir = os.path.join(storage, name)
+        scheduler = tc.scheduler
+        if scheduler is not None and scheduler.metric is None:
+            scheduler.metric = tc.metric
+            scheduler.mode = tc.mode
+        controller = TuneController(
+            self.trainable, configs,
+            experiment_dir=experiment_dir,
+            scheduler=scheduler,
+            max_concurrent=tc.max_concurrent_trials,
+            max_failures=self.run_config.failure_config.max_failures,
+            resources_per_trial=self.resources_per_trial,
+        )
+        trials = controller.run()
+        return ResultGrid(trials, tc.metric, tc.mode, experiment_dir)
+
+
+def with_parameters(fn: Callable, **kwargs) -> Callable:
+    """ref: tune/trainable/util.py with_parameters — bind large objects
+    once (here: captured in the closure, shipped via the object store on
+    task submission)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(config):
+        return fn(config, **kwargs)
+
+    return wrapped
+
+
+__all__ = [
+    "ASHAScheduler", "BasicVariantGenerator", "Checkpoint", "FIFOScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining", "ResultGrid",
+    "TuneConfig", "Tuner", "choice", "get_checkpoint", "grid_search",
+    "loguniform", "quniform", "randint", "report", "sample_from", "uniform",
+    "with_parameters",
+]

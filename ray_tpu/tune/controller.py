@@ -1,0 +1,200 @@
+"""Tune controller: the trial event loop.
+
+ref: python/ray/tune/execution/tune_controller.py (TuneController :68 — an
+actor event loop over Trainables). Trials here are TrainWorker actors
+(world_size=1) reusing the train session/report plumbing; the controller
+polls them, feeds results to the scheduler/searcher, applies STOP
+decisions, PBT exploits, retries, and assembles the ResultGrid.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..train.checkpoint import Checkpoint, CheckpointManager
+from ..train.config import Result
+from ..train.worker_group import ERRORED, FINISHED, RUNNING, TrainWorker
+from .schedulers import (CONTINUE, STOP, FIFOScheduler,
+                         PopulationBasedTraining, TrialScheduler)
+
+logger = logging.getLogger(__name__)
+
+PENDING = "PENDING"
+TERMINATED = "TERMINATED"
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    status: str = PENDING
+    actor: Any = None
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+    error: Optional[str] = None
+    checkpoint_manager: Optional[CheckpointManager] = None
+    num_failures: int = 0
+    stopped_by_scheduler: bool = False
+    resume_checkpoint: Optional[Checkpoint] = None
+
+    @property
+    def last_metrics(self) -> Dict[str, Any]:
+        return self.metrics_history[-1] if self.metrics_history else {}
+
+
+class TuneController:
+    def __init__(self, trainable: Callable, configs: List[Dict[str, Any]],
+                 *, experiment_dir: str,
+                 scheduler: Optional[TrialScheduler] = None,
+                 max_concurrent: Optional[int] = None,
+                 max_failures: int = 0,
+                 resources_per_trial: Optional[Dict[str, float]] = None,
+                 poll_interval: float = 0.1):
+        from ..runtime import serialization
+
+        self.trainable_blob = serialization.dumps_inline(trainable)
+        self.scheduler = scheduler or FIFOScheduler()
+        self.experiment_dir = experiment_dir
+        self.max_concurrent = max_concurrent or _default_concurrency()
+        self.max_failures = max_failures
+        self.resources = resources_per_trial or {"CPU": 1.0}
+        self.poll_interval = poll_interval
+        os.makedirs(experiment_dir, exist_ok=True)
+        self.trials = [
+            Trial(trial_id=f"trial_{i:05d}", config=cfg,
+                  checkpoint_manager=CheckpointManager(
+                      os.path.join(experiment_dir, f"trial_{i:05d}",
+                                   "checkpoints")))
+            for i, cfg in enumerate(configs)]
+        if isinstance(self.scheduler, PopulationBasedTraining):
+            for t in self.trials:
+                self.scheduler.register(t.trial_id, t.config)
+
+    # ------------------------------------------------------------------ run
+    def run(self) -> List[Trial]:
+        pending = list(self.trials)
+        running: List[Trial] = []
+        while pending or running:
+            while pending and len(running) < self.max_concurrent:
+                trial = pending.pop(0)
+                self._start_trial(trial)
+                running.append(trial)
+            time.sleep(self.poll_interval)
+            for trial in list(running):
+                done = self._poll_trial(trial)
+                if done:
+                    running.remove(trial)
+                    if (trial.status == ERRORED
+                            and trial.num_failures <= self.max_failures):
+                        trial.status = PENDING
+                        trial.error = None
+                        trial.resume_checkpoint = (
+                            trial.checkpoint_manager.latest_checkpoint)
+                        pending.append(trial)
+        return self.trials
+
+    # ------------------------------------------------------------ internals
+    def _start_trial(self, trial: Trial):
+        import ray_tpu
+
+        trial_dir = os.path.join(self.experiment_dir, trial.trial_id)
+        os.makedirs(trial_dir, exist_ok=True)
+        actor_cls = ray_tpu.remote(TrainWorker)
+        num_cpus = self.resources.get("CPU", 1)
+        res = {k: v for k, v in self.resources.items() if k != "CPU"}
+        trial.actor = actor_cls.options(
+            num_cpus=num_cpus, resources=res or None,
+        ).remote(0, 1, trial.trial_id, trial_dir, None)
+        ckpt = trial.resume_checkpoint
+        trial.actor.start_training.remote(
+            self.trainable_blob, trial.config,
+            ckpt.path if ckpt else None)
+        trial.status = RUNNING
+
+    def _poll_trial(self, trial: Trial) -> bool:
+        """Returns True when the trial left the running set."""
+        import ray_tpu
+
+        try:
+            poll = ray_tpu.get(trial.actor.poll.remote(), timeout=60)
+        except Exception as e:
+            trial.status = ERRORED
+            trial.error = f"poll failed: {e!r}"
+            trial.num_failures += 1
+            self._stop_actor(trial)
+            self.scheduler.on_complete(trial.trial_id)
+            return True
+        decision = CONTINUE
+        for rep in poll["reports"]:
+            metrics = dict(rep["metrics"])
+            metrics.setdefault("training_iteration",
+                               len(trial.metrics_history) + 1)
+            trial.metrics_history.append(metrics)
+            if rep["checkpoint_path"]:
+                trial.checkpoint_manager.register(
+                    Checkpoint(rep["checkpoint_path"]), metrics)
+            d = self.scheduler.on_result(trial.trial_id, metrics)
+            if d == STOP:
+                decision = STOP
+        self._apply_pbt(trial)
+        if decision == STOP and poll["state"] == RUNNING:
+            trial.stopped_by_scheduler = True
+            try:
+                trial.actor.stop.remote()
+            except Exception:
+                pass
+            self._stop_actor(trial)
+            trial.status = TERMINATED
+            self.scheduler.on_complete(trial.trial_id)
+            return True
+        if poll["state"] in (FINISHED, ERRORED):
+            trial.status = poll["state"]
+            if poll["state"] == ERRORED:
+                trial.error = poll["error"]
+                trial.num_failures += 1
+            self._stop_actor(trial)
+            self.scheduler.on_complete(trial.trial_id)
+            return True
+        return False
+
+    def _apply_pbt(self, trial: Trial):
+        sched = self.scheduler
+        if not isinstance(sched, PopulationBasedTraining):
+            return
+        exploit = sched.pending_exploits.pop(trial.trial_id, None)
+        if exploit is None:
+            return
+        donor_id, new_cfg = exploit
+        donor = next(t for t in self.trials if t.trial_id == donor_id)
+        donor_ckpt = (donor.checkpoint_manager.latest_checkpoint
+                      if donor.checkpoint_manager else None)
+        logger.info("PBT exploit: %s <- %s (cfg %s)", trial.trial_id,
+                    donor_id, new_cfg)
+        self._stop_actor(trial)
+        trial.config = new_cfg
+        sched.register(trial.trial_id, new_cfg)
+        trial.resume_checkpoint = donor_ckpt
+        self._start_trial(trial)
+
+    def _stop_actor(self, trial: Trial):
+        import ray_tpu
+
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+
+
+def _default_concurrency() -> int:
+    try:
+        import ray_tpu
+
+        return max(int(ray_tpu.cluster_resources().get("CPU", 2)), 1)
+    except Exception:
+        return 2

@@ -1,0 +1,188 @@
+"""Trial schedulers: FIFO, ASHA, median stopping, PBT.
+
+ref: python/ray/tune/schedulers/ (FIFOScheduler, AsyncHyperBandScheduler
+a.k.a. ASHA in async_hyperband.py, MedianStoppingRule in
+median_stopping_rule.py, PopulationBasedTraining in pbt.py). Decisions are
+made per reported result: CONTINUE or STOP; PBT additionally mutates
+low-quantile trials from high-quantile donors at perturbation intervals.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def _score(self, metrics: Dict[str, Any]) -> Optional[float]:
+        if self.metric is None or self.metric not in metrics:
+            return None
+        v = float(metrics[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_result(self, trial_id: str, metrics: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_complete(self, trial_id: str) -> None:
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    """Async successive halving (ref: schedulers/async_hyperband.py).
+
+    Rungs at time_attr = grace_period * reduction_factor^k; at each rung a
+    trial stops unless it is in the top 1/reduction_factor of completed
+    results at that rung.
+    """
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, reduction_factor: int = 4,
+                 max_t: int = 100):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(int(t))
+            t *= reduction_factor
+        self.rung_scores: Dict[int, List[float]] = defaultdict(list)
+        self._trial_rung: Dict[str, int] = {}
+
+    def on_result(self, trial_id: str, metrics: Dict[str, Any]) -> str:
+        t = metrics.get(self.time_attr)
+        score = self._score(metrics)
+        if t is None or score is None:
+            return CONTINUE
+        next_rung_idx = self._trial_rung.get(trial_id, 0)
+        while (next_rung_idx < len(self.rungs)
+               and t >= self.rungs[next_rung_idx]):
+            rung = self.rungs[next_rung_idx]
+            scores = self.rung_scores[rung]
+            scores.append(score)
+            next_rung_idx += 1
+            self._trial_rung[trial_id] = next_rung_idx
+            if len(scores) >= self.rf:
+                # survive only in the top 1/rf fraction of this rung
+                k = max(int(math.ceil(len(scores) / self.rf)), 1)
+                cutoff = sorted(scores, reverse=True)[k - 1]
+                if score < cutoff:
+                    return STOP
+        return CONTINUE
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running mean falls below the median of other
+    trials' running means at the same step (ref:
+    schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self._history: Dict[str, List[float]] = defaultdict(list)
+
+    def on_result(self, trial_id: str, metrics: Dict[str, Any]) -> str:
+        t = metrics.get(self.time_attr, 0)
+        score = self._score(metrics)
+        if score is None:
+            return CONTINUE
+        self._history[trial_id].append(score)
+        if t < self.grace or len(self._history) < self.min_samples:
+            return CONTINUE
+        means = {tid: sum(h) / len(h) for tid, h in self._history.items()
+                 if h}
+        others = [m for tid, m in means.items() if tid != trial_id]
+        if not others:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        if means[trial_id] < median:
+            return STOP
+        return CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (ref: schedulers/pbt.py): at each perturbation_interval, bottom-
+    quantile trials exploit (clone config+checkpoint of a top-quantile
+    donor) and explore (perturb hyperparams). The controller performs the
+    actual restart; this class decides and rewrites configs."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 perturbation_factors=(0.8, 1.2),
+                 seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.factors = perturbation_factors
+        self.rng = random.Random(seed)
+        self.last_scores: Dict[str, float] = {}
+        self._last_perturb: Dict[str, int] = {}
+        # controller reads + clears: trial_id -> (donor_id, new_config)
+        self.pending_exploits: Dict[str, Any] = {}
+        self.trial_configs: Dict[str, Dict[str, Any]] = {}
+
+    def register(self, trial_id: str, config: Dict[str, Any]):
+        self.trial_configs[trial_id] = dict(config)
+
+    def on_result(self, trial_id: str, metrics: Dict[str, Any]) -> str:
+        score = self._score(metrics)
+        t = metrics.get(self.time_attr, 0)
+        if score is None:
+            return CONTINUE
+        self.last_scores[trial_id] = score
+        last = self._last_perturb.get(trial_id, 0)
+        if t - last < self.interval or len(self.last_scores) < 2:
+            return CONTINUE
+        self._last_perturb[trial_id] = t
+        ranked = sorted(self.last_scores.items(), key=lambda kv: kv[1])
+        n = len(ranked)
+        k = max(1, int(n * self.quantile))
+        bottom = {tid for tid, _ in ranked[:k]}
+        top = [tid for tid, _ in ranked[-k:]]
+        if trial_id in bottom and top:
+            donor = self.rng.choice(top)
+            if donor != trial_id:
+                new_cfg = self._explore(self.trial_configs.get(donor, {}))
+                self.pending_exploits[trial_id] = (donor, new_cfg)
+        return CONTINUE
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        import copy
+
+        cfg = copy.deepcopy(config)
+        for key, spec in self.mutations.items():
+            if key not in cfg:
+                continue
+            if isinstance(spec, list):
+                cfg[key] = self.rng.choice(spec)
+            elif callable(spec):
+                cfg[key] = spec()
+            else:  # numeric perturbation
+                cfg[key] = cfg[key] * self.rng.choice(self.factors)
+        return cfg

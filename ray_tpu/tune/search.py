@@ -1,0 +1,143 @@
+"""Search spaces and the basic variant generator.
+
+ref: python/ray/tune/search/sample.py (Domain/Float/Integer/Categorical),
+search/basic_variant.py (BasicVariantGenerator: grid expansion x random
+sampling), search/variant_generator.py.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+class Domain:
+    def sample(self, rng: np.random.RandomState) -> Any:
+        raise NotImplementedError
+
+
+class Float(Domain):
+    def __init__(self, lower: float, upper: float, log: bool = False,
+                 q: Optional[float] = None):
+        self.lower, self.upper, self.log, self.q = lower, upper, log, q
+
+    def sample(self, rng):
+        if self.log:
+            v = float(np.exp(rng.uniform(np.log(self.lower),
+                                         np.log(self.upper))))
+        else:
+            v = float(rng.uniform(self.lower, self.upper))
+        if self.q:
+            v = float(np.round(v / self.q) * self.q)
+        return v
+
+
+class Integer(Domain):
+    def __init__(self, lower: int, upper: int):
+        self.lower, self.upper = lower, upper  # upper exclusive (ref randint)
+
+    def sample(self, rng):
+        return int(rng.randint(self.lower, self.upper))
+
+
+class Categorical(Domain):
+    def __init__(self, categories: List[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return self.categories[int(rng.randint(len(self.categories)))]
+
+
+class Function(Domain):
+    def __init__(self, fn: Callable[[], Any]):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn()
+
+
+class GridSearch:
+    """Marker for exhaustive expansion (ref: tune.grid_search)."""
+
+    def __init__(self, values: List[Any]):
+        self.values = list(values)
+
+
+# ------------------------------------------------------------- public ctors
+def uniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper)
+
+
+def quniform(lower: float, upper: float, q: float) -> Float:
+    return Float(lower, upper, q=q)
+
+
+def loguniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def randint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper)
+
+
+def choice(categories: List[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def sample_from(fn: Callable[[], Any]) -> Function:
+    return Function(fn)
+
+
+def grid_search(values: List[Any]) -> GridSearch:
+    return GridSearch(values)
+
+
+# ----------------------------------------------------------------- expansion
+def _find_grid(space: Dict[str, Any], prefix=()) -> List[tuple]:
+    out = []
+    for k, v in space.items():
+        if isinstance(v, GridSearch):
+            out.append((prefix + (k,), v))
+        elif isinstance(v, dict):
+            out.extend(_find_grid(v, prefix + (k,)))
+    return out
+
+
+def _set_path(d: Dict[str, Any], path: tuple, value: Any):
+    for k in path[:-1]:
+        d = d[k]
+    d[path[-1]] = value
+
+
+def _resolve(space: Any, rng: np.random.RandomState) -> Any:
+    if isinstance(space, Domain):
+        return space.sample(rng)
+    if isinstance(space, dict):
+        return {k: _resolve(v, rng) for k, v in space.items()}
+    return space
+
+
+class BasicVariantGenerator:
+    """Grid axes expand exhaustively; sampled axes draw num_samples times
+    (ref: search/basic_variant.py — same semantics: num_samples multiplies
+    the grid)."""
+
+    def __init__(self, seed: Optional[int] = None):
+        self.rng = np.random.RandomState(seed)
+
+    def generate(self, param_space: Dict[str, Any],
+                 num_samples: int = 1) -> Iterator[Dict[str, Any]]:
+        import copy
+
+        grid_axes = _find_grid(param_space)
+        grid_values = [axis.values for _, axis in grid_axes]
+        combos = list(itertools.product(*grid_values)) if grid_axes else [()]
+        for _ in range(num_samples):
+            for combo in combos:
+                cfg = copy.deepcopy(param_space)
+                for (path, _), val in zip(grid_axes, combo):
+                    _set_path(cfg, path, val)
+                yield _resolve(cfg, self.rng)

@@ -1,0 +1,166 @@
+"""Tune library tests (mirrors ref tune/tests: search spaces, Tuner.fit,
+schedulers' stopping behavior, PBT exploit, best-result selection)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu import tune
+
+
+def test_search_space_generation():
+    space = {
+        "lr": tune.loguniform(1e-5, 1e-1),
+        "bs": tune.choice([16, 32]),
+        "depth": tune.grid_search([2, 4, 6]),
+        "nested": {"dropout": tune.uniform(0.0, 0.5)},
+    }
+    gen = tune.BasicVariantGenerator(seed=0)
+    cfgs = list(gen.generate(space, num_samples=2))
+    assert len(cfgs) == 6  # 3 grid x 2 samples
+    assert sorted({c["depth"] for c in cfgs}) == [2, 4, 6]
+    for c in cfgs:
+        assert 1e-5 <= c["lr"] <= 1e-1
+        assert c["bs"] in (16, 32)
+        assert 0.0 <= c["nested"]["dropout"] <= 0.5
+    # determinism
+    cfgs2 = list(tune.BasicVariantGenerator(seed=0).generate(space, 2))
+    assert [c["lr"] for c in cfgs] == [c["lr"] for c in cfgs2]
+
+
+def test_tuner_fit_grid(shared_cluster, tmp_path):
+    def objective(config):
+        from ray_tpu import tune
+
+        score = -(config["x"] - 3) ** 2
+        tune.report({"score": score, "x": config["x"]})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0, 1, 2, 3, 4])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=tune.RunConfig(name="grid",
+                                  storage_path=str(tmp_path)),
+    ).fit()
+    assert len(grid) == 5
+    best = grid.get_best_result()
+    assert best.metrics["x"] == 3
+    assert best.config["x"] == 3
+    df = grid.get_dataframe()
+    assert len(df) == 5 and "config/x" in df.columns
+
+
+def test_asha_stops_bad_trials(shared_cluster, tmp_path):
+    """Bad trials (low asymptote) must be stopped before finishing all
+    iterations; the best trial must survive to the end."""
+
+    def objective(config):
+        import time
+
+        from ray_tpu import tune
+
+        for i in range(1, 17):
+            tune.report({"acc": config["cap"] * i / 16.0,
+                         "training_iteration": i})
+            time.sleep(0.05)  # let the controller poll mid-run
+
+    grid = tune.Tuner(
+        objective,
+        # strong trials first: they establish the rung records that the
+        # later, weak trials get measured (and stopped) against
+        param_space={"cap": tune.grid_search([1.0, 0.9, 0.3, 0.2, 0.1])},
+        tune_config=tune.TuneConfig(
+            metric="acc", mode="max",
+            scheduler=tune.ASHAScheduler(
+                metric="acc", mode="max", grace_period=2,
+                reduction_factor=2, max_t=16),
+            max_concurrent_trials=2),
+        run_config=tune.RunConfig(name="asha", storage_path=str(tmp_path)),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.config["cap"] == 1.0
+    # at least one weak trial was stopped early by the scheduler
+    stopped = [t for t in grid._trials if t.stopped_by_scheduler]
+    assert stopped, "ASHA never stopped a trial"
+    finished_iters = {t.config["cap"]: len(t.metrics_history)
+                      for t in grid._trials}
+    assert finished_iters[1.0] == 16
+
+
+def test_median_stopping(shared_cluster, tmp_path):
+    def objective(config):
+        from ray_tpu import tune
+
+        for i in range(1, 11):
+            tune.report({"loss_neg": -config["level"],
+                         "training_iteration": i})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"level": tune.grid_search([1.0, 2.0, 3.0, 10.0])},
+        tune_config=tune.TuneConfig(
+            metric="loss_neg", mode="max",
+            scheduler=tune.MedianStoppingRule(
+                metric="loss_neg", mode="max", grace_period=2),
+            max_concurrent_trials=4),
+        run_config=tune.RunConfig(name="median", storage_path=str(tmp_path)),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.config["level"] == 1.0
+
+
+def test_pbt_exploit(shared_cluster, tmp_path):
+    """A low-performing trial must adopt (approximately) the donor's
+    config via exploit/explore."""
+
+    def objective(config):
+        import time
+
+        from ray_tpu import tune
+
+        for i in range(1, 13):
+            # lr=good -> high score; the bad trial should converge to good
+            tune.report({"score": -abs(config["lr"] - 1.0),
+                         "training_iteration": i, "lr": config["lr"]})
+            time.sleep(0.02)
+
+    pbt = tune.PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=3,
+        hyperparam_mutations={"lr": lambda: 1.0}, seed=0)
+    grid = tune.Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([1.0, 100.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=pbt,
+                                    max_concurrent_trials=2),
+        run_config=tune.RunConfig(name="pbt", storage_path=str(tmp_path)),
+    ).fit()
+    # the bad trial (lr=100) must have been exploited at least once
+    bad = next(t for t in grid._trials if t.trial_id == "trial_00001")
+    final_lrs = [m["lr"] for m in bad.metrics_history[-3:]]
+    assert any(lr == 1.0 for lr in final_lrs), final_lrs
+
+
+def test_trial_failure_and_retry(shared_cluster, tmp_path):
+    def objective(config):
+        import os
+
+        from ray_tpu import tune
+
+        if not os.path.exists(config["marker"]):
+            open(config["marker"], "w").close()
+            raise RuntimeError("flaky")
+        tune.report({"ok": 1})
+
+    from ray_tpu.train.config import FailureConfig
+
+    marker = str(tmp_path / "m")
+    grid = tune.Tuner(
+        objective,
+        param_space={"marker": marker},
+        tune_config=tune.TuneConfig(metric="ok", mode="max"),
+        run_config=tune.RunConfig(
+            name="retry", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1)),
+    ).fit()
+    assert grid.get_best_result().metrics["ok"] == 1
+    assert not grid.errors
