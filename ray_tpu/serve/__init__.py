@@ -1,0 +1,30 @@
+"""ray_tpu.serve: scalable model serving.
+
+TPU-native re-design of the reference's Serve library (ref:
+python/ray/serve/): controller-reconciled deployments backed by replica
+actors, power-of-two-choices routing, queue-depth autoscaling, an aiohttp
+ingress proxy, and (in `ray_tpu.serve.llm`) a JAX paged-KV continuous-
+batching LLM engine replacing the reference's external vLLM dependency.
+"""
+
+from .api import (  # noqa: F401
+    delete,
+    get_app_handle,
+    get_deployment_handle,
+    get_proxy_url,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions  # noqa: F401
+from .deployment import Application, Deployment, deployment  # noqa: F401
+from .handle import DeploymentHandle, DeploymentResponse  # noqa: F401
+from .replica import Request  # noqa: F401
+
+__all__ = [
+    "deployment", "Deployment", "Application", "run", "start", "status",
+    "delete", "shutdown", "get_app_handle", "get_deployment_handle",
+    "get_proxy_url", "DeploymentHandle", "DeploymentResponse",
+    "AutoscalingConfig", "DeploymentConfig", "HTTPOptions", "Request",
+]

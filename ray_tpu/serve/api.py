@@ -1,0 +1,179 @@
+"""Public Serve API.
+
+Parity with the reference (ref: python/ray/serve/api.py — serve.run :687,
+serve.start, serve.status, serve.delete, serve.shutdown,
+serve.get_app_handle / get_deployment_handle; client ref:
+serve/_private/client.py deploy_apps :328).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from .config import (CONTROLLER_NAME, DEFAULT_APP_NAME, DEFAULT_HTTP_PORT,
+                     PROXY_NAME, HTTPOptions)
+from .deployment import Application, flatten_app
+from .handle import DeploymentHandle, _Router
+
+
+def _get_controller(create: bool = True):
+    """Get a LIVE controller handle, creating one if needed. A freshly
+    killed controller can linger in the name registry until its death
+    notification lands, so ping-validate and retry (ref: the reference
+    avoids this by making the controller detached + lifetime-owned)."""
+    import ray_tpu
+    from ..actor import ActorClass
+    from .controller import ServeControllerActor
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            handle = ray_tpu.get_actor(CONTROLLER_NAME)
+        except Exception:
+            handle = None
+        if handle is None:
+            if not create:
+                raise ValueError("Serve is not running")
+            handle = ActorClass(ServeControllerActor, name=CONTROLLER_NAME,
+                                get_if_exists=True,
+                                max_concurrency=64).remote()
+        try:
+            ray_tpu.get(handle.ping.remote(), timeout=10)
+        except Exception:
+            time.sleep(0.1)  # dying controller still registered; wait
+            continue
+        handle.run_control_loop.remote()  # idempotent fire-and-forget
+        return handle
+    raise RuntimeError("could not obtain a live Serve controller")
+
+
+def start(http_options: Optional[HTTPOptions] = None, **_ignored) -> None:
+    """Start the Serve control plane + HTTP proxy (ref: api.py serve.start)."""
+    import ray_tpu
+    from ..actor import ActorClass
+    from .proxy import ProxyActor
+
+    _get_controller()
+    opts = http_options or HTTPOptions(port=DEFAULT_HTTP_PORT)
+    try:
+        ray_tpu.get_actor(PROXY_NAME)
+    except Exception:
+        proxy = ActorClass(ProxyActor, name=PROXY_NAME, get_if_exists=True,
+                           max_concurrency=256).remote(opts.host, opts.port)
+        proxy.run.remote()  # fire-and-forget server loop
+        ray_tpu.get(proxy.get_port.remote())  # wait until listening
+
+
+def get_proxy_url() -> str:
+    import ray_tpu
+
+    proxy = ray_tpu.get_actor(PROXY_NAME)
+    port = ray_tpu.get(proxy.get_port.remote())
+    return f"http://127.0.0.1:{port}"
+
+
+def run(app: Application, *, name: str = DEFAULT_APP_NAME,
+        route_prefix: str = "/", blocking: bool = False,
+        _start_http: bool = False, wait_timeout_s: float = 60.0,
+        ) -> DeploymentHandle:
+    """Deploy an application and wait for it to be RUNNING
+    (ref: serve/api.py:687)."""
+    from ..runtime import serialization
+
+    controller = _get_controller()
+    if _start_http:
+        start()
+    specs = flatten_app(app, name)
+    payload = []
+    for spec in specs:
+        cfg_blob = serialization.dumps_inline(spec.config)
+        payload.append({
+            "name": spec.name,
+            "spec_blob": serialization.dumps_inline(spec),
+            "config_blob": cfg_blob,
+            "is_ingress": spec.is_ingress,
+        })
+    import ray_tpu
+
+    ray_tpu.get(controller.deploy_app.remote(name, route_prefix, payload))
+    _Router.reset_all()  # old routing tables may reference dead replicas
+    # Wait for the app to become RUNNING (reuse the live controller handle
+    # rather than re-running the _get_controller handshake per poll).
+    deadline = time.time() + wait_timeout_s
+    st = None
+    while time.time() < deadline:
+        st = ray_tpu.get(controller.status.remote())["applications"].get(name)
+        if st and st["status"] == "RUNNING":
+            break
+        time.sleep(0.05)
+    else:
+        raise RuntimeError(
+            f"app {name!r} did not become RUNNING within {wait_timeout_s}s; "
+            f"status: {st}")
+    ingress = ray_tpu.get(controller.get_ingress.remote(name))
+    handle = DeploymentHandle(name, ingress)
+    if blocking:
+        while True:
+            time.sleep(1)
+    return handle
+
+
+def status() -> Dict[str, Any]:
+    import ray_tpu
+
+    controller = _get_controller()
+    return ray_tpu.get(controller.status.remote())
+
+
+def get_app_handle(name: str = DEFAULT_APP_NAME) -> DeploymentHandle:
+    import ray_tpu
+
+    controller = _get_controller(create=False)
+    ingress = ray_tpu.get(controller.get_ingress.remote(name))
+    if ingress is None:
+        raise ValueError(f"no application named {name!r}")
+    return DeploymentHandle(name, ingress)
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = DEFAULT_APP_NAME,
+                          ) -> DeploymentHandle:
+    return DeploymentHandle(app_name, deployment_name)
+
+
+def delete(name: str) -> None:
+    import ray_tpu
+
+    controller = _get_controller(create=False)
+    ray_tpu.get(controller.delete_app.remote(name))
+    _Router.reset_all()
+
+
+def shutdown() -> None:
+    import ray_tpu
+
+    try:
+        controller = _get_controller(create=False)
+    except Exception:
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote(), timeout=30)
+    except Exception:
+        pass
+    for actor_name in (PROXY_NAME, CONTROLLER_NAME):
+        try:
+            ray_tpu.kill(ray_tpu.get_actor(actor_name))
+        except Exception:
+            pass
+    # Wait for the names to clear so a subsequent serve.start() is clean.
+    deadline = time.time() + 15
+    for actor_name in (PROXY_NAME, CONTROLLER_NAME):
+        while time.time() < deadline:
+            try:
+                if ray_tpu.get_actor(actor_name) is None:
+                    break
+            except Exception:
+                break
+            time.sleep(0.05)
+    _Router.reset_all()

@@ -1,0 +1,76 @@
+"""Serve configuration schemas.
+
+Parity with the reference's deployment/autoscaling config surface
+(ref: python/ray/serve/config.py AutoscalingConfig/DeploymentConfig and
+python/ray/serve/_private/config.py), reduced to the fields the rest of the
+stack consumes.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+DEFAULT_MAX_ONGOING_REQUESTS = 5
+DEFAULT_APP_NAME = "default"
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+PROXY_NAME = "SERVE_PROXY"
+DEFAULT_HTTP_PORT = 8800
+
+
+@dataclass
+class AutoscalingConfig:
+    """Queue-depth driven autoscaling (ref: serve/config.py AutoscalingConfig;
+    decision logic ref: serve/_private/autoscaling_state.py)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 3.0
+    downscale_delay_s: float = 30.0
+    # Smoothing applied to the raw desired-replica estimate.
+    upscaling_factor: float = 1.0
+    downscaling_factor: float = 1.0
+
+    def desired_replicas(self, total_ongoing: float, current: int) -> int:
+        if current == 0:
+            return max(self.min_replicas, 1 if total_ongoing > 0 else 0)
+        error = total_ongoing / (current * self.target_ongoing_requests)
+        if error > 1:
+            raw = current * (1 + (error - 1) * self.upscaling_factor)
+            desired = math.ceil(raw)
+        else:
+            raw = current * (1 - (1 - error) * self.downscaling_factor)
+            desired = max(math.floor(raw), 0) if total_ongoing == 0 else max(
+                math.ceil(raw), 1)
+        return max(self.min_replicas, min(self.max_replicas, desired))
+
+
+@dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = DEFAULT_MAX_ONGOING_REQUESTS
+    user_config: Optional[Any] = None
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    health_check_period_s: float = 10.0
+    health_check_timeout_s: float = 30.0
+    graceful_shutdown_timeout_s: float = 20.0
+    max_concurrency: int = 100
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+
+    def initial_replicas(self) -> int:
+        if self.autoscaling_config is not None:
+            return max(self.autoscaling_config.min_replicas, 1)
+        return self.num_replicas
+
+
+@dataclass
+class HTTPOptions:
+    host: str = "127.0.0.1"
+    port: int = DEFAULT_HTTP_PORT
+
+
+def replica_actor_name(app: str, deployment: str, replica_id: str) -> str:
+    return f"SERVE_REPLICA::{app}#{deployment}#{replica_id}"

@@ -1,0 +1,336 @@
+"""Serve controller actor: owns app/deployment state and reconciles replicas.
+
+Parity with the reference's control plane (ref:
+python/ray/serve/_private/controller.py ServeController :87, control loop
+:373; application state ref: serve/_private/application_state.py;
+replica reconciliation ref: serve/_private/deployment_state.py — scaled down
+to a single reconcile loop per controller). Autoscaling decisions poll
+replica metrics (ref: serve/_private/autoscaling_state.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import time
+from typing import Any, Dict, List, Optional
+
+from .config import replica_actor_name
+
+
+class _ReplicaState:
+    def __init__(self, replica_id: str, handle):
+        self.replica_id = replica_id
+        self.handle = handle
+        self.started_at = time.time()
+        self.healthy = True
+        # A replica is "ready" after its first successful health check
+        # (i.e. its constructor finished). Unready replicas are exempt
+        # from health-check kills until REPLICA_STARTUP_TIMEOUT_S — the
+        # reference models this as the STARTING replica state
+        # (ref: deployment_state.py ReplicaState.STARTING).
+        self.ready = False
+        self.last_health_check = 0.0
+        self.ongoing = 0
+
+
+REPLICA_STARTUP_TIMEOUT_S = 600.0
+
+
+class _DeploymentState:
+    def __init__(self, app_name: str, spec_blob: bytes, config):
+        self.app_name = app_name
+        self.spec_blob = spec_blob
+        self.config = config
+        self.replicas: Dict[str, _ReplicaState] = {}
+        self.target_replicas = config.initial_replicas()
+        self.version = 0
+        self.is_ingress = False
+        self.name = ""
+        # autoscaling smoothing state
+        self._scale_up_since: Optional[float] = None
+        self._scale_down_since: Optional[float] = None
+
+
+class ServeControllerActor:
+    """Named actor `SERVE_CONTROLLER`. Runs `run_control_loop` fire-and-
+    forget after creation (the reference does the same, controller.py:373)."""
+
+    def __init__(self, http_host: str = "127.0.0.1", http_port: int = 0):
+        self._apps: Dict[str, Dict[str, _DeploymentState]] = {}
+        self._ingress: Dict[str, str] = {}  # app -> ingress deployment name
+        self._route_prefixes: Dict[str, str] = {}  # app -> route prefix
+        self._id_counter = itertools.count()
+        self._running = True
+        self._http = (http_host, http_port)
+        self._reconcile_wakeup = asyncio.Event()
+
+    # ------------------------------------------------------------- deploy
+
+    async def deploy_app(self, app_name: str, route_prefix: str,
+                         deployments: List[dict]) -> None:
+        """deployments: [{name, spec_blob, config_blob, is_ingress}]"""
+        from ..runtime import serialization
+
+        old = self._apps.get(app_name, {})
+        new_states: Dict[str, _DeploymentState] = {}
+        for item in deployments:
+            config = serialization.loads_inline(item["config_blob"])
+            state = old.get(item["name"])
+            if state is None:
+                state = _DeploymentState(app_name, item["spec_blob"], config)
+            else:
+                # Redeploy. Code/init-arg changes replace every replica;
+                # config-only changes apply in place (num_replicas adjusts
+                # target, user_config reconfigures live replicas) — the
+                # reference's lightweight-update path (ref:
+                # deployment_state.py deployment version diffing).
+                old_blob = state.spec_blob
+                old_cfg = state.config
+                state.spec_blob = item["spec_blob"]
+                state.config = config
+                state.target_replicas = config.initial_replicas()
+                if not _same_code(old_blob, item["spec_blob"]):
+                    await self._stop_all_replicas(state)
+                elif old_cfg.user_config != config.user_config:
+                    for rep in state.replicas.values():
+                        rep.handle.reconfigure.remote(config.user_config)
+                state.version += 1
+            state.name = item["name"]
+            state.is_ingress = item["is_ingress"]
+            if item["is_ingress"]:
+                self._ingress[app_name] = item["name"]
+            new_states[item["name"]] = state
+        # Tear down deployments dropped from the app.
+        for name, state in old.items():
+            if name not in new_states:
+                await self._stop_all_replicas(state)
+        self._apps[app_name] = new_states
+        self._route_prefixes[app_name] = route_prefix
+        self._reconcile_wakeup.set()
+
+    async def delete_app(self, app_name: str) -> None:
+        states = self._apps.pop(app_name, {})
+        self._ingress.pop(app_name, None)
+        self._route_prefixes.pop(app_name, None)
+        for state in states.values():
+            await self._stop_all_replicas(state)
+
+    async def shutdown(self) -> None:
+        self._running = False
+        for app in list(self._apps):
+            await self.delete_app(app)
+
+    # ---------------------------------------------------------- reconcile
+
+    async def run_control_loop(self) -> None:
+        if getattr(self, "_loop_started", False):
+            return  # idempotent: every _get_controller() call fires this
+        self._loop_started = True
+        while self._running:
+            try:
+                await self._reconcile_once()
+            except Exception:  # keep the loop alive (ref: controller.py:373)
+                import traceback
+
+                traceback.print_exc()
+            try:
+                await asyncio.wait_for(self._reconcile_wakeup.wait(),
+                                       timeout=0.25)
+            except asyncio.TimeoutError:
+                pass
+            self._reconcile_wakeup.clear()
+
+    async def _reconcile_once(self) -> None:
+        for app_name, states in list(self._apps.items()):
+            for state in list(states.values()):
+                await self._autoscale(state)
+                await self._health_check(state)
+                # Scale up
+                while len(state.replicas) < state.target_replicas:
+                    self._start_replica(state)
+                # Scale down (newest first, like the reference's default)
+                while len(state.replicas) > state.target_replicas:
+                    replica_id = max(state.replicas,
+                                     key=lambda r: state.replicas[r].started_at)
+                    await self._stop_replica(state, replica_id)
+
+    def _start_replica(self, state: _DeploymentState) -> None:
+        from ..actor import ActorClass
+        from .replica import ReplicaActor
+
+        replica_id = f"r{next(self._id_counter)}"
+        name = replica_actor_name(state.app_name, state.name, replica_id)
+        opts = dict(state.config.ray_actor_options)
+        handle = ActorClass(ReplicaActor, name=name,
+                            max_concurrency=state.config.max_concurrency,
+                            max_restarts=0, **opts).remote(
+            state.app_name, state.name, replica_id, state.spec_blob)
+        state.replicas[replica_id] = _ReplicaState(replica_id, handle)
+        state.version += 1
+
+    async def _stop_replica(self, state: _DeploymentState,
+                            replica_id: str) -> None:
+        import ray_tpu
+
+        rep = state.replicas.pop(replica_id)
+        state.version += 1
+        try:
+            await asyncio.wait_for(
+                asyncio.wrap_future(
+                    rep.handle.prepare_for_shutdown.remote().future()),
+                timeout=state.config.graceful_shutdown_timeout_s + 1)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(rep.handle)
+        except Exception:
+            pass
+
+    async def _stop_all_replicas(self, state: _DeploymentState) -> None:
+        for replica_id in list(state.replicas):
+            await self._stop_replica(state, replica_id)
+
+    async def _health_check(self, state: _DeploymentState) -> None:
+        now = time.time()
+        for replica_id, rep in list(state.replicas.items()):
+            # Unready (starting) replicas are probed every tick so readiness
+            # is noticed quickly; ready ones on the configured period.
+            period = (0.0 if not rep.ready
+                      else state.config.health_check_period_s)
+            if now - rep.last_health_check < period:
+                continue
+            rep.last_health_check = now
+            try:
+                await asyncio.wait_for(
+                    asyncio.wrap_future(
+                        rep.handle.check_health.remote().future()),
+                    timeout=state.config.health_check_timeout_s)
+                rep.healthy = True
+                if not rep.ready:
+                    rep.ready = True
+                    state.version += 1  # newly routable replica
+            except Exception:
+                if (not rep.ready and now - rep.started_at
+                        < REPLICA_STARTUP_TIMEOUT_S):
+                    continue  # constructor may still be running
+                rep.healthy = False
+                # Replace the dead replica (ref: deployment_state.py replica
+                # recovery path).
+                state.replicas.pop(replica_id, None)
+                state.version += 1
+                try:
+                    import ray_tpu
+
+                    ray_tpu.kill(rep.handle)
+                except Exception:
+                    pass
+
+    async def _autoscale(self, state: _DeploymentState) -> None:
+        cfg = state.config.autoscaling_config
+        if cfg is None or not state.replicas:
+            # Zero-replica deployments are woken by get_routing_table's
+            # scale-from-zero path; nothing to measure here.
+            return
+        total = 0.0
+        for rep in state.replicas.values():
+            try:
+                metrics = await asyncio.wait_for(
+                    asyncio.wrap_future(rep.handle.get_metrics.remote()
+                                        .future()), timeout=2.0)
+                rep.ongoing = metrics["ongoing"]
+            except Exception:
+                pass
+            total += rep.ongoing
+        desired = cfg.desired_replicas(total, len(state.replicas))
+        now = time.time()
+        if desired > state.target_replicas:
+            state._scale_down_since = None
+            if state._scale_up_since is None:
+                state._scale_up_since = now
+            if now - state._scale_up_since >= cfg.upscale_delay_s:
+                state.target_replicas = desired
+                state._scale_up_since = None
+        elif desired < state.target_replicas:
+            state._scale_up_since = None
+            if state._scale_down_since is None:
+                state._scale_down_since = now
+            if now - state._scale_down_since >= cfg.downscale_delay_s:
+                state.target_replicas = desired
+                state._scale_down_since = None
+        else:
+            state._scale_up_since = None
+            state._scale_down_since = None
+
+    # ------------------------------------------------------------ queries
+
+    def get_routing_table(self, app_name: str, deployment_name: str,
+                          for_request: bool = False) -> Optional[dict]:
+        state = self._apps.get(app_name, {}).get(deployment_name)
+        if state is None:
+            return None
+        if for_request and state.target_replicas == 0:
+            # Scale-from-zero: a router asked on behalf of a live request
+            # (ref: autoscaling wakes on handle queue metrics).
+            state.target_replicas = 1
+            self._reconcile_wakeup.set()
+        return {
+            "version": state.version,
+            "max_ongoing_requests": state.config.max_ongoing_requests,
+            "replicas": [rep.handle.actor_id
+                         for rep in state.replicas.values()
+                         if rep.healthy and rep.ready],
+        }
+
+    def get_ingress(self, app_name: str) -> Optional[str]:
+        return self._ingress.get(app_name)
+
+    def list_routes(self) -> Dict[str, dict]:
+        """route_prefix -> {app, ingress}, for the HTTP proxy (carrying the
+        ingress deployment lets the proxy route with zero extra controller
+        round-trips)."""
+        return {prefix: {"app": app, "ingress": self._ingress.get(app)}
+                for app, prefix in self._route_prefixes.items()}
+
+    def status(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"applications": {}}
+        for app_name, states in self._apps.items():
+            deployments = {}
+            for name, state in states.items():
+                n_ready = sum(1 for rep in state.replicas.values()
+                              if rep.ready)
+                deployments[name] = {
+                    "status": ("HEALTHY" if n_ready >= state.target_replicas
+                               else "UPDATING"),
+                    "replicas": n_ready,
+                    "target_replicas": state.target_replicas,
+                }
+            app_ok = all(d["status"] == "HEALTHY"
+                         for d in deployments.values())
+            out["applications"][app_name] = {
+                "status": "RUNNING" if app_ok else "DEPLOYING",
+                "route_prefix": self._route_prefixes.get(app_name, "/"),
+                "deployments": deployments,
+            }
+        return out
+
+    def ping(self) -> str:
+        return "pong"
+
+
+def _same_code(blob_a: bytes, blob_b: bytes) -> bool:
+    """True when two deployment specs carry the same callable code and init
+    args (cloudpickle captures class bodies, so code edits change the
+    bytes). False on any doubt — the safe direction is a full replica
+    replacement."""
+    from ..runtime import serialization
+
+    try:
+        a = serialization.loads_inline(blob_a)
+        b = serialization.loads_inline(blob_b)
+        return (serialization.dumps_inline((a.func_or_class, a.init_args,
+                                            a.init_kwargs))
+                == serialization.dumps_inline((b.func_or_class, b.init_args,
+                                               b.init_kwargs)))
+    except Exception:
+        return False

@@ -1,0 +1,175 @@
+"""Deployment decorator and application graph.
+
+Parity with the reference's deployment API (ref: python/ray/serve/api.py
+`@serve.deployment` :339, Deployment.bind → Application; app graph build
+ref: serve/_private/build_app.py): `bind()` produces a DAG of deployments;
+at deploy time each bound node becomes a named deployment and nested bound
+nodes in its constructor args are replaced with DeploymentHandles.
+"""
+
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .config import AutoscalingConfig, DeploymentConfig
+
+
+@dataclass
+class Application:
+    """A bound deployment node (possibly with bound children in its args)."""
+
+    deployment: "Deployment"
+    args: Tuple[Any, ...] = ()
+    kwargs: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return self.deployment.name
+
+
+class Deployment:
+    def __init__(self, func_or_class: Callable, name: str,
+                 config: DeploymentConfig):
+        if inspect.isfunction(func_or_class):
+            # Wrap bare functions in a callable class (the reference does the
+            # same so every replica is an actor with a __call__).
+            func = func_or_class
+
+            class _FuncWrapper:
+                async def __call__(self, *args, **kwargs):
+                    out = func(*args, **kwargs)
+                    if inspect.isawaitable(out):
+                        out = await out
+                    return out
+
+            _FuncWrapper.__name__ = getattr(func, "__name__", "func")
+            self.func_or_class = _FuncWrapper
+            self._is_function = True
+        else:
+            self.func_or_class = func_or_class
+            self._is_function = False
+        self.name = name
+        self.config = config
+
+    def options(self, *, name: Optional[str] = None,
+                num_replicas: Optional[int] = None,
+                max_ongoing_requests: Optional[int] = None,
+                user_config: Optional[Any] = None,
+                autoscaling_config: Optional[Any] = None,
+                health_check_period_s: Optional[float] = None,
+                graceful_shutdown_timeout_s: Optional[float] = None,
+                ray_actor_options: Optional[dict] = None,
+                **_ignored) -> "Deployment":
+        cfg = DeploymentConfig(**vars(self.config))
+        if num_replicas is not None:
+            cfg.num_replicas = num_replicas
+        if max_ongoing_requests is not None:
+            cfg.max_ongoing_requests = max_ongoing_requests
+        if user_config is not None:
+            cfg.user_config = user_config
+        if autoscaling_config is not None:
+            cfg.autoscaling_config = _coerce_autoscaling(autoscaling_config)
+        if health_check_period_s is not None:
+            cfg.health_check_period_s = health_check_period_s
+        if graceful_shutdown_timeout_s is not None:
+            cfg.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = dict(ray_actor_options)
+        return Deployment(self.func_or_class, name or self.name, cfg)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def __repr__(self):
+        return f"Deployment({self.name})"
+
+
+def _coerce_autoscaling(value) -> AutoscalingConfig:
+    if isinstance(value, AutoscalingConfig):
+        return value
+    if isinstance(value, dict):
+        return AutoscalingConfig(**value)
+    raise TypeError(f"bad autoscaling_config: {value!r}")
+
+
+def deployment(func_or_class=None, *, name: Optional[str] = None,
+               num_replicas: Optional[int] = None,
+               max_ongoing_requests: Optional[int] = None,
+               user_config: Optional[Any] = None,
+               autoscaling_config: Optional[Any] = None,
+               health_check_period_s: Optional[float] = None,
+               graceful_shutdown_timeout_s: Optional[float] = None,
+               ray_actor_options: Optional[dict] = None,
+               **_ignored):
+    """`@serve.deployment` (ref: serve/api.py:339)."""
+
+    def wrap(fc):
+        cfg = DeploymentConfig()
+        if num_replicas is not None:
+            cfg.num_replicas = num_replicas
+        if max_ongoing_requests is not None:
+            cfg.max_ongoing_requests = max_ongoing_requests
+        if user_config is not None:
+            cfg.user_config = user_config
+        if autoscaling_config is not None:
+            cfg.autoscaling_config = _coerce_autoscaling(autoscaling_config)
+        if health_check_period_s is not None:
+            cfg.health_check_period_s = health_check_period_s
+        if graceful_shutdown_timeout_s is not None:
+            cfg.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = dict(ray_actor_options)
+        return Deployment(fc, name or fc.__name__, cfg)
+
+    if func_or_class is not None:
+        return wrap(func_or_class)
+    return wrap
+
+
+@dataclass
+class DeploymentSpec:
+    """Flattened, serializable form of one deployment in an app, produced by
+    `flatten_app` and shipped to the controller."""
+
+    name: str
+    func_or_class: Any
+    init_args: Tuple[Any, ...]
+    init_kwargs: Dict[str, Any]
+    config: DeploymentConfig
+    is_ingress: bool = False
+
+
+def flatten_app(app: Application, app_name: str) -> List[DeploymentSpec]:
+    """Walk the bound-deployment DAG; replace nested Application args with
+    handle placeholders (resolved to DeploymentHandles at replica init)."""
+    from .handle import DeploymentHandle
+
+    specs: Dict[str, DeploymentSpec] = {}
+    name_to_node: Dict[str, int] = {}
+
+    def visit(node: Application) -> DeploymentHandle:
+        name = node.deployment.name
+        if name_to_node.get(name, id(node)) != id(node):
+            raise ValueError(
+                f"two distinct bindings share the deployment name {name!r}; "
+                f"rename one with .options(name=...)")
+        if name not in specs:
+            name_to_node[name] = id(node)
+            args = tuple(_sub(a) for a in node.args)
+            kwargs = {k: _sub(v) for k, v in node.kwargs.items()}
+            specs[name] = DeploymentSpec(
+                name=name, func_or_class=node.deployment.func_or_class,
+                init_args=args, init_kwargs=kwargs,
+                config=node.deployment.config)
+        return DeploymentHandle(app_name, name)
+
+    def _sub(value):
+        if isinstance(value, Application):
+            return visit(value)
+        return value
+
+    ingress = visit(app)
+    specs[ingress.deployment_name].is_ingress = True
+    return list(specs.values())

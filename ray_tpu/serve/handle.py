@@ -1,0 +1,227 @@
+"""DeploymentHandle + router.
+
+Parity with the reference's handle/router layer (ref:
+python/ray/serve/handle.py DeploymentHandle/DeploymentResponse;
+serve/_private/router.py Router :341; pow-2 routing ref:
+serve/_private/request_router/pow_2_router.py:27): each handle owns a router
+that keeps a cached replica set (version-polled from the controller) and
+picks the less-loaded of two random replicas, capped by
+max_ongoing_requests with queueing.
+
+Routing (which may block while replicas are saturated or still starting)
+runs on a dedicated submission thread pool, never on the caller's thread or
+event loop — a replica awaiting a downstream handle must keep its own
+asyncio loop free for health checks (the reference's router is fully async
+for the same reason).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import random
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .config import CONTROLLER_NAME
+
+# Shared pool driving request submission; sized generously since entries
+# block only while every replica of the target deployment is saturated.
+_SUBMIT_POOL = concurrent.futures.ThreadPoolExecutor(
+    max_workers=64, thread_name_prefix="serve-submit")
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote() (ref: serve/handle.py
+    DeploymentResponse). Resolution never blocks the calling thread."""
+
+    def __init__(self, submit_fn):
+        self._result_fut: concurrent.futures.Future = concurrent.futures.Future()
+        self._ref_fut: concurrent.futures.Future = concurrent.futures.Future()
+        _SUBMIT_POOL.submit(self._drive, submit_fn)
+
+    def _drive(self, submit_fn):
+        try:
+            ref, on_done = submit_fn()
+        except Exception as e:
+            self._ref_fut.set_exception(e)
+            self._result_fut.set_exception(e)
+            return
+        self._ref_fut.set_result(ref)
+
+        def _done(fut):
+            on_done()
+            err = fut.exception()
+            if err is not None:
+                self._result_fut.set_exception(err)
+            else:
+                self._result_fut.set_result(fut.result())
+
+        ref.future().add_done_callback(_done)
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        return self._result_fut.result(timeout=timeout_s)
+
+    def _to_object_ref(self):
+        """ObjectRef of the underlying actor call (blocks until routed)."""
+        return self._ref_fut.result()
+
+    def __await__(self):
+        return asyncio.wrap_future(self._result_fut).__await__()
+
+
+class _Router:
+    """Per-(app, deployment) router state, shared across handles in one
+    process."""
+
+    _routers: Dict[tuple, "_Router"] = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def get(cls, app: str, deployment: str) -> "_Router":
+        with cls._lock:
+            key = (app, deployment)
+            router = cls._routers.get(key)
+            if router is None:
+                router = cls._routers[key] = _Router(app, deployment)
+            return router
+
+    @classmethod
+    def reset_all(cls):
+        with cls._lock:
+            cls._routers.clear()
+
+    def __init__(self, app: str, deployment: str):
+        self.app = app
+        self.deployment = deployment
+        self.version = -1
+        self.replicas: list = []  # ActorHandles
+        self.max_ongoing = 0
+        self.inflight: Dict[str, int] = {}  # actor_id -> count
+        self.cond = threading.Condition()
+        self._last_refresh = 0.0
+
+    def _controller(self):
+        from ..actor import get_actor
+
+        return get_actor(CONTROLLER_NAME)
+
+    def refresh(self, block_until_nonempty: bool = True,
+                timeout_s: float = 30.0):
+        """Pull the routing table when stale (the reference long-polls;
+        we poll with a version check, at most every 0.5 s). Passing
+        for_request=True lets the controller scale a zero-replica
+        autoscaled deployment back up."""
+        import ray_tpu
+
+        deadline = time.time() + timeout_s
+        while True:
+            now = time.time()
+            if self.replicas and now - self._last_refresh < 0.5:
+                return
+            table = ray_tpu.get(self._controller().get_routing_table.remote(
+                self.app, self.deployment, True))
+            with self.cond:
+                self._last_refresh = time.time()
+                if table is not None:
+                    self.version = table["version"]
+                    self.max_ongoing = table["max_ongoing_requests"]
+                    from ..actor import ActorHandle
+
+                    self.replicas = [ActorHandle(aid)
+                                     for aid in table["replicas"]]
+                    live = set(table["replicas"])
+                    self.inflight = {k: v for k, v in self.inflight.items()
+                                     if k in live}
+            if self.replicas or not block_until_nonempty:
+                return
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"no replicas for {self.app}#{self.deployment} "
+                    f"after {timeout_s}s")
+            time.sleep(0.1)
+
+    def pick(self) -> "Any":
+        """Power-of-two-choices over in-flight counts
+        (ref: pow_2_router.py:27)."""
+        deadline = time.time() + 120.0
+        while True:
+            self.refresh()
+            with self.cond:
+                candidates = self.replicas
+                if not candidates:
+                    # A concurrent refresh may have published an empty
+                    # (all-unhealthy) table after ours; wait and re-poll.
+                    self.cond.wait(timeout=0.2)
+                    self._last_refresh = 0.0
+                    continue
+                if len(candidates) > 2:
+                    candidates = random.sample(candidates, 2)
+                best = min(candidates,
+                           key=lambda h: self.inflight.get(h.actor_id, 0))
+                if (self.max_ongoing <= 0
+                        or self.inflight.get(best.actor_id, 0)
+                        < self.max_ongoing):
+                    self.inflight[best.actor_id] = (
+                        self.inflight.get(best.actor_id, 0) + 1)
+                    return best
+                # All replicas saturated: wait for a completion, then retry.
+                self.cond.wait(timeout=0.2)
+            self._last_refresh = 0.0  # force a table re-pull while queued
+            if time.time() > deadline:
+                raise TimeoutError("all replicas saturated for 120s")
+
+    def release(self, actor_id: str):
+        with self.cond:
+            if actor_id in self.inflight:
+                self.inflight[actor_id] = max(0, self.inflight[actor_id] - 1)
+            self.cond.notify()
+
+
+class DeploymentHandle:
+    """Serializable handle to a deployment (ref: serve/handle.py);
+    routing state is rebuilt lazily in each process."""
+
+    def __init__(self, app_name: str, deployment_name: str,
+                 method_name: str = "__call__"):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self._method_name = method_name
+
+    def options(self, *, method_name: Optional[str] = None,
+                **_ignored) -> "DeploymentHandle":
+        return DeploymentHandle(self.app_name, self.deployment_name,
+                                method_name or self._method_name)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self.app_name, self.deployment_name, name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        app, deployment = self.app_name, self.deployment_name
+        method_name = self._method_name
+
+        def submit():
+            resolved = tuple(
+                a._to_object_ref() if isinstance(a, DeploymentResponse)
+                else a for a in args)
+            resolved_kw = {
+                k: (v._to_object_ref() if isinstance(v, DeploymentResponse)
+                    else v) for k, v in kwargs.items()}
+            router = _Router.get(app, deployment)
+            replica = router.pick()
+            ref = replica.handle_request.remote(method_name, resolved,
+                                                resolved_kw)
+            return ref, lambda: router.release(replica.actor_id)
+
+        return DeploymentResponse(submit)
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.app_name, self.deployment_name, self._method_name))
+
+    def __repr__(self):
+        return (f"DeploymentHandle({self.app_name}#{self.deployment_name}"
+                f".{self._method_name})")

@@ -1,0 +1,102 @@
+"""HTTP ingress proxy actor.
+
+Parity with the reference's per-node proxy (ref:
+python/ray/serve/_private/proxy.py ProxyActor, proxy_request :417 — uvicorn
+there, aiohttp here since that's what this image ships). Routes by longest
+matching route prefix, converts the HTTP request into a `Request`, calls the
+app's ingress deployment through a DeploymentHandle, and serializes the
+result (dict/list → JSON, str → text, bytes → raw).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Dict, Optional
+
+from .config import CONTROLLER_NAME
+from .replica import Request
+
+
+class ProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._port = port
+        self._actual_port: Optional[int] = None
+        self._routes: Dict[str, str] = {}
+        self._routes_fetched_at = 0.0
+        self._started = asyncio.Event()
+
+    async def run(self) -> None:
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, self._host, self._port)
+        await site.start()
+        self._actual_port = site._server.sockets[0].getsockname()[1]
+        self._started.set()
+        while True:  # serve forever; killed with the actor
+            await asyncio.sleep(3600)
+
+    async def get_port(self) -> int:
+        await asyncio.wait_for(self._started.wait(), timeout=30)
+        return self._actual_port
+
+    async def _refresh_routes(self) -> None:
+        import time
+
+        if time.time() - self._routes_fetched_at < 0.5:  # staleness cap
+            return
+        from ..actor import get_actor
+
+        controller = get_actor(CONTROLLER_NAME)
+        loop = asyncio.get_running_loop()
+        ref = controller.list_routes.remote()
+        self._routes = await loop.run_in_executor(
+            None, lambda: ref.future().result(timeout=10))
+        self._routes_fetched_at = time.time()
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        await self._refresh_routes()
+        path = "/" + request.match_info["tail"]
+        match = None
+        for prefix in sorted(self._routes, key=len, reverse=True):
+            norm = prefix.rstrip("/") or "/"
+            if path == norm or path.startswith(norm + "/") or norm == "/":
+                match = prefix
+                break
+        if match is None:
+            return web.Response(status=404, text="no route")
+        route = self._routes[match]
+        body = await request.read()
+        sub_path = path[len(match.rstrip("/")):] or "/"
+        req = Request(method=request.method, path=sub_path,
+                      query_params=dict(request.query),
+                      headers=dict(request.headers), body=body)
+
+        from .handle import DeploymentHandle
+
+        handle = DeploymentHandle(route["app"], route["ingress"])
+        loop = asyncio.get_running_loop()
+
+        def call():
+            return handle.remote(req).result(timeout_s=120)
+
+        try:
+            result = await loop.run_in_executor(None, call)
+        except Exception as e:  # surface user errors as 500s
+            return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+        if isinstance(result, web.Response):
+            return result
+        if isinstance(result, bytes):
+            return web.Response(body=result,
+                                content_type="application/octet-stream")
+        if isinstance(result, str):
+            return web.Response(text=result)
+        return web.Response(text=json.dumps(result),
+                            content_type="application/json")

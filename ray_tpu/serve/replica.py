@@ -1,0 +1,104 @@
+"""Replica actor: hosts one instance of a deployment's user callable.
+
+Parity with the reference's replica runtime (ref:
+python/ray/serve/_private/replica.py — UserCallableWrapper, request metric
+tracking, reconfigure, health checks), minus the ASGI machinery: HTTP
+requests arrive as plain `Request` objects from the proxy.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+from typing import Any, Dict, Optional
+
+
+class Request:
+    """Minimal HTTP request view handed to deployments by the proxy
+    (stand-in for the reference's starlette.Request)."""
+
+    def __init__(self, method: str = "GET", path: str = "/",
+                 query_params: Optional[Dict[str, str]] = None,
+                 headers: Optional[Dict[str, str]] = None,
+                 body: bytes = b""):
+        self.method = method
+        self.path = path
+        self.query_params = query_params or {}
+        self.headers = headers or {}
+        self.body = body
+
+    def json(self):
+        import json
+
+        return json.loads(self.body or b"null")
+
+    def text(self) -> str:
+        return self.body.decode()
+
+
+class ReplicaActor:
+    """One replica. Created by the controller; called by routers/handles.
+
+    Tracks in-flight request count for autoscaling (ref: replica.py request
+    metrics pushed to controller; here the controller polls get_metrics)."""
+
+    def __init__(self, app_name: str, deployment_name: str, replica_id: str,
+                 spec_blob: bytes):
+        from ..runtime import serialization
+
+        spec = serialization.loads_inline(spec_blob)
+        self._app = app_name
+        self._deployment = deployment_name
+        self._replica_id = replica_id
+        self._config = spec.config
+        self._user_callable = spec.func_or_class(*spec.init_args,
+                                                 **spec.init_kwargs)
+        self._ongoing = 0
+        self._total = 0
+        self._started_at = time.time()
+        if (spec.config.user_config is not None
+                and hasattr(self._user_callable, "reconfigure")):
+            self._user_callable.reconfigure(spec.config.user_config)
+
+    async def handle_request(self, method_name: str, args: tuple,
+                             kwargs: dict) -> Any:
+        self._ongoing += 1
+        self._total += 1
+        try:
+            if method_name in ("__call__", ""):
+                target = self._user_callable
+            else:
+                target = getattr(self._user_callable, method_name)
+            out = target(*args, **kwargs)
+            if inspect.isawaitable(out):
+                out = await out
+            if inspect.isgenerator(out):
+                out = list(out)  # streaming is materialized at the replica
+            return out
+        finally:
+            self._ongoing -= 1
+
+    def reconfigure(self, user_config: Any) -> None:
+        self._config.user_config = user_config
+        if hasattr(self._user_callable, "reconfigure"):
+            self._user_callable.reconfigure(user_config)
+
+    def get_metrics(self) -> Dict[str, Any]:
+        return {"ongoing": self._ongoing, "total": self._total,
+                "uptime_s": time.time() - self._started_at}
+
+    async def check_health(self) -> bool:
+        fn = getattr(self._user_callable, "check_health", None)
+        if fn is not None:
+            out = fn()
+            if inspect.isawaitable(out):
+                out = await out
+        return True
+
+    async def prepare_for_shutdown(self) -> None:
+        """Drain: wait (bounded) for in-flight requests to finish
+        (ref: replica.py graceful shutdown)."""
+        deadline = time.time() + self._config.graceful_shutdown_timeout_s
+        while self._ongoing > 0 and time.time() < deadline:
+            await asyncio.sleep(0.02)

@@ -140,7 +140,6 @@ class TuneController:
             d = self.scheduler.on_result(trial.trial_id, metrics)
             if d == STOP:
                 decision = STOP
-        self._apply_pbt(trial)
         if decision == STOP and poll["state"] == RUNNING:
             trial.stopped_by_scheduler = True
             try:
@@ -150,6 +149,7 @@ class TuneController:
             self._stop_actor(trial)
             trial.status = TERMINATED
             self.scheduler.on_complete(trial.trial_id)
+            self._discard_pending_exploit(trial)
             return True
         if poll["state"] in (FINISHED, ERRORED):
             trial.status = poll["state"]
@@ -158,8 +158,18 @@ class TuneController:
                 trial.num_failures += 1
             self._stop_actor(trial)
             self.scheduler.on_complete(trial.trial_id)
+            self._discard_pending_exploit(trial)
             return True
+        # Exploit only trials that are still running — a perturbation that
+        # landed on the trial's final report must not restart it (and must
+        # not rewrite its config after the fact).
+        self._apply_pbt(trial)
         return False
+
+    def _discard_pending_exploit(self, trial: Trial):
+        sched = self.scheduler
+        if isinstance(sched, PopulationBasedTraining):
+            sched.pending_exploits.pop(trial.trial_id, None)
 
     def _apply_pbt(self, trial: Trial):
         sched = self.scheduler
