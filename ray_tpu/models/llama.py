@@ -22,8 +22,10 @@ from typing import Any, Optional, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from flax import struct
 
 from ..ops.attention import attention
+from ..ops.paged_attention import paged_attention, paged_write
 
 A = nn.with_logical_partitioning  # annotate param init with logical axes
 
@@ -87,6 +89,23 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+@struct.dataclass
+class PagedCache:
+    """Per-layer paged KV state threaded through the model as `kv_caches`.
+
+    The serving engine owns page allocation (ray_tpu/serve/llm/cache.py);
+    the model writes new tokens into pages and attends through block tables
+    (ops/paged_attention.py). When scan_layers, every leaf carries a leading
+    [L] axis (block_tables/total_lens are tiled per layer so they can ride
+    the scan's xs axis).
+    """
+
+    k_pages: jax.Array      # [P, page, Hkv, D]
+    v_pages: jax.Array      # [P, page, Hkv, D]
+    block_tables: jax.Array  # [B, MP] int32 page ids
+    total_lens: jax.Array    # [B] int32, length INCLUDING new tokens
+
+
 class Attention(nn.Module):
     config: LlamaConfig
 
@@ -108,36 +127,47 @@ class Attention(nn.Module):
         v = v.reshape(b, s, nkv, hd)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        if kv_cache is not None:
-            # decode path: append to cache (serving engine manages layout)
-            k = jnp.concatenate([kv_cache[0], k], axis=1)
-            v = jnp.concatenate([kv_cache[1], v], axis=1)
-            if segment_ids is not None:
-                if not isinstance(segment_ids, tuple):
-                    # a single array must cover the FULL kv axis (cache +
-                    # new tokens); the query part is its suffix
-                    segment_ids = (segment_ids[:, -s:], segment_ids)
-                q_seg, kv_seg = segment_ids
-                if kv_seg.shape[1] != k.shape[1]:
-                    raise ValueError(
-                        f"kv segment_ids length {kv_seg.shape[1]} must "
-                        f"equal cache+input length {k.shape[1]}")
-                segment_ids = (q_seg, kv_seg)
-        # always causal: the kernels mask relative to the end of the kv axis
-        # (tril k=sk-sq), which is correct for multi-token decode and
-        # chunked prefill as well as plain training
-        impl = cfg.attention_impl
-        if kv_cache is not None and impl in ("ring", "ulysses"):
-            impl = None  # kv-cache decode is dense; sp applies to training
-        out = attention(q, k, v, causal=True,
-                        segment_ids=segment_ids, impl=impl)
+        if isinstance(kv_cache, PagedCache):
+            # Serving path: scatter new K/V into pages, attend via block
+            # tables (write-then-attend so new tokens see themselves).
+            pc = kv_cache
+            k_pages, v_pages = paged_write(
+                pc.k_pages, pc.v_pages, k, v, pc.block_tables, positions,
+                pc.total_lens)
+            out = paged_attention(q, k_pages, v_pages, pc.block_tables,
+                                  positions)
+            new_cache = pc.replace(k_pages=k_pages, v_pages=v_pages)
+        else:
+            if kv_cache is not None:
+                # decode path: append to cache (serving engine manages layout)
+                k = jnp.concatenate([kv_cache[0], k], axis=1)
+                v = jnp.concatenate([kv_cache[1], v], axis=1)
+                if segment_ids is not None:
+                    if not isinstance(segment_ids, tuple):
+                        # a single array must cover the FULL kv axis (cache +
+                        # new tokens); the query part is its suffix
+                        segment_ids = (segment_ids[:, -s:], segment_ids)
+                    q_seg, kv_seg = segment_ids
+                    if kv_seg.shape[1] != k.shape[1]:
+                        raise ValueError(
+                            f"kv segment_ids length {kv_seg.shape[1]} must "
+                            f"equal cache+input length {k.shape[1]}")
+                    segment_ids = (q_seg, kv_seg)
+            # always causal: the kernels mask relative to the end of the kv
+            # axis (tril k=sk-sq), which is correct for multi-token decode
+            # and chunked prefill as well as plain training
+            impl = cfg.attention_impl
+            if kv_cache is not None and impl in ("ring", "ulysses"):
+                impl = None  # kv-cache decode is dense; sp is for training
+            out = attention(q, k, v, causal=True,
+                            segment_ids=segment_ids, impl=impl)
+            new_cache = (k, v) if kv_cache is not None else None
         out = out.reshape(b, s, nq * hd)
         out = nn.DenseGeneral(
             features=cfg.hidden_size, use_bias=False, axis=-1,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             kernel_init=A(nn.initializers.lecun_normal(), ("heads", "embed")),
             name="o_proj")(out)
-        new_cache = (k, v) if kv_cache is not None else None
         return out, new_cache
 
 

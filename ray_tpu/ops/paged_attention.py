@@ -1,0 +1,76 @@
+"""Paged KV-cache attention ops (the serving engine's compute core).
+
+The reference delegates paged attention entirely to vLLM's CUDA kernels
+(ref: python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_engine.py:181
+wraps the external engine; no kernels in-repo). Here it is TPU-native: KV
+lives in fixed-size pages ([num_pages, page_size, Hkv, D] per layer), each
+sequence owns a block table of page indices, and both the page write
+(scatter) and the attention gather are pure jnp with static shapes so XLA
+can fuse and tile them; everything jits once per (batch, bucket) shape.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def paged_write(k_pages: jax.Array, v_pages: jax.Array,
+                k_new: jax.Array, v_new: jax.Array,
+                block_tables: jax.Array, positions: jax.Array,
+                total_lens: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Scatter new tokens' K/V into their sequences' pages.
+
+    k_pages/v_pages: [P, page, Hkv, D]; k_new/v_new: [B, S, Hkv, D];
+    block_tables: [B, MP] page ids; positions: [B, S] absolute positions of
+    the new tokens; total_lens: [B] sequence length INCLUDING the new
+    tokens. Writes for padding rows (positions >= total_lens) are dropped.
+    """
+    num_pages, page_size = k_pages.shape[:2]
+    valid = positions < total_lens[:, None]
+    page_ix = jnp.take_along_axis(block_tables, positions // page_size,
+                                  axis=1)
+    page_ix = jnp.where(valid, page_ix, num_pages)  # OOB -> mode="drop"
+    offset = positions % page_size
+    k_pages = k_pages.at[page_ix, offset].set(
+        k_new.astype(k_pages.dtype), mode="drop")
+    v_pages = v_pages.at[page_ix, offset].set(
+        v_new.astype(v_pages.dtype), mode="drop")
+    return k_pages, v_pages
+
+
+def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                    block_tables: jax.Array, positions: jax.Array,
+                    *, scale: Optional[float] = None) -> jax.Array:
+    """Attention over paged KV. Causal by absolute position: query at
+    position p attends to kv positions <= p within its own block table.
+
+    q: [B, S, Hq, D]; k_pages/v_pages: [P, page, Hkv, D];
+    block_tables: [B, MP]; positions: [B, S]. Returns [B, S, Hq, D].
+    """
+    b, s, hq, d = q.shape
+    page = k_pages.shape[1]
+    mp = block_tables.shape[1]
+    hkv = k_pages.shape[2]
+    k = k_pages[block_tables].reshape(b, mp * page, hkv, d)
+    v = v_pages[block_tables].reshape(b, mp * page, hkv, d)
+    if hq != hkv:
+        rep = hq // hkv
+        k = jnp.broadcast_to(k[:, :, :, None, :],
+                             (b, mp * page, hkv, rep, d)
+                             ).reshape(b, mp * page, hq, d)
+        v = jnp.broadcast_to(v[:, :, :, None, :],
+                             (b, mp * page, hkv, rep, d)
+                             ).reshape(b, mp * page, hq, d)
+    scale = scale if scale is not None else d ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    kv_pos = jnp.arange(mp * page)
+    mask = kv_pos[None, None, None, :] <= positions[:, None, :, None]
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -155,12 +155,9 @@ def shutdown() -> None:
 
     try:
         controller = _get_controller(create=False)
-    except Exception:
-        return
-    try:
         ray_tpu.get(controller.shutdown.remote(), timeout=30)
     except Exception:
-        pass
+        pass  # controller already gone; still clean up proxy below
     for actor_name in (PROXY_NAME, CONTROLLER_NAME):
         try:
             ray_tpu.kill(ray_tpu.get_actor(actor_name))

@@ -32,6 +32,9 @@ class _ReplicaState:
         self.ready = False
         self.last_health_check = 0.0
         self.ongoing = 0
+        # In-flight health probe (checks never block the reconcile loop).
+        self.check_task = None
+        self.check_started = 0.0
 
 
 REPLICA_STARTUP_TIMEOUT_S = 600.0
@@ -64,6 +67,7 @@ class ServeControllerActor:
         self._running = True
         self._http = (http_host, http_port)
         self._reconcile_wakeup = asyncio.Event()
+        self._stop_tasks: set = set()
 
     # ------------------------------------------------------------- deploy
 
@@ -91,7 +95,7 @@ class ServeControllerActor:
                 state.config = config
                 state.target_replicas = config.initial_replicas()
                 if not _same_code(old_blob, item["spec_blob"]):
-                    await self._stop_all_replicas(state)
+                    self._stop_all_replicas(state)
                 elif old_cfg.user_config != config.user_config:
                     for rep in state.replicas.values():
                         rep.handle.reconfigure.remote(config.user_config)
@@ -104,7 +108,7 @@ class ServeControllerActor:
         # Tear down deployments dropped from the app.
         for name, state in old.items():
             if name not in new_states:
-                await self._stop_all_replicas(state)
+                self._stop_all_replicas(state)
         self._apps[app_name] = new_states
         self._route_prefixes[app_name] = route_prefix
         self._reconcile_wakeup.set()
@@ -114,12 +118,14 @@ class ServeControllerActor:
         self._ingress.pop(app_name, None)
         self._route_prefixes.pop(app_name, None)
         for state in states.values():
-            await self._stop_all_replicas(state)
+            self._stop_all_replicas(state)
 
     async def shutdown(self) -> None:
         self._running = False
         for app in list(self._apps):
             await self.delete_app(app)
+        if self._stop_tasks:  # let graceful drains finish before we die
+            await asyncio.wait(self._stop_tasks, timeout=30)
 
     # ---------------------------------------------------------- reconcile
 
@@ -169,17 +175,25 @@ class ServeControllerActor:
         state.replicas[replica_id] = _ReplicaState(replica_id, handle)
         state.version += 1
 
-    async def _stop_replica(self, state: _DeploymentState,
-                            replica_id: str) -> None:
-        import ray_tpu
-
+    def _stop_replica(self, state: _DeploymentState,
+                      replica_id: str) -> None:
+        """Remove the replica from routing now; drain + kill in the
+        background so one slow drain can't stall reconciliation."""
         rep = state.replicas.pop(replica_id)
         state.version += 1
+        task = asyncio.ensure_future(
+            self._drain_and_kill(rep, state.config))
+        self._stop_tasks.add(task)
+        task.add_done_callback(self._stop_tasks.discard)
+
+    async def _drain_and_kill(self, rep: _ReplicaState, config) -> None:
+        import ray_tpu
+
         try:
             await asyncio.wait_for(
                 asyncio.wrap_future(
                     rep.handle.prepare_for_shutdown.remote().future()),
-                timeout=state.config.graceful_shutdown_timeout_s + 1)
+                timeout=config.graceful_shutdown_timeout_s + 1)
         except Exception:
             pass
         try:
@@ -187,44 +201,62 @@ class ServeControllerActor:
         except Exception:
             pass
 
-    async def _stop_all_replicas(self, state: _DeploymentState) -> None:
+    def _stop_all_replicas(self, state: _DeploymentState) -> None:
         for replica_id in list(state.replicas):
-            await self._stop_replica(state, replica_id)
+            self._stop_replica(state, replica_id)
 
     async def _health_check(self, state: _DeploymentState) -> None:
+        """Fully non-blocking: probes run as background tasks and results
+        are consumed on later ticks, so a hung/slow-starting replica never
+        stalls reconciliation of other replicas or apps."""
         now = time.time()
         for replica_id, rep in list(state.replicas.items()):
-            # Unready (starting) replicas are probed every tick so readiness
-            # is noticed quickly; ready ones on the configured period.
-            period = (0.0 if not rep.ready
+            if rep.check_task is not None:
+                if rep.check_task.done():
+                    failed = (rep.check_task.cancelled()
+                              or rep.check_task.exception() is not None)
+                    rep.check_task = None
+                    if not failed:
+                        rep.healthy = True
+                        if not rep.ready:
+                            rep.ready = True
+                            state.version += 1  # newly routable replica
+                    else:
+                        self._on_check_failure(state, replica_id, rep, now)
+                elif (now - rep.check_started
+                        > state.config.health_check_timeout_s):
+                    rep.check_task.cancel()
+                    rep.check_task = None
+                    self._on_check_failure(state, replica_id, rep, now)
+                continue
+            # Unready (starting) replicas are probed aggressively so
+            # readiness is noticed quickly; ready ones on the period.
+            period = (0.1 if not rep.ready
                       else state.config.health_check_period_s)
             if now - rep.last_health_check < period:
                 continue
             rep.last_health_check = now
-            try:
-                await asyncio.wait_for(
-                    asyncio.wrap_future(
-                        rep.handle.check_health.remote().future()),
-                    timeout=state.config.health_check_timeout_s)
-                rep.healthy = True
-                if not rep.ready:
-                    rep.ready = True
-                    state.version += 1  # newly routable replica
-            except Exception:
-                if (not rep.ready and now - rep.started_at
-                        < REPLICA_STARTUP_TIMEOUT_S):
-                    continue  # constructor may still be running
-                rep.healthy = False
-                # Replace the dead replica (ref: deployment_state.py replica
-                # recovery path).
-                state.replicas.pop(replica_id, None)
-                state.version += 1
-                try:
-                    import ray_tpu
+            rep.check_started = now
+            rep.check_task = asyncio.ensure_future(
+                asyncio.wrap_future(
+                    rep.handle.check_health.remote().future()))
 
-                    ray_tpu.kill(rep.handle)
-                except Exception:
-                    pass
+    def _on_check_failure(self, state: _DeploymentState, replica_id: str,
+                          rep: _ReplicaState, now: float) -> None:
+        if (not rep.ready
+                and now - rep.started_at < REPLICA_STARTUP_TIMEOUT_S):
+            return  # constructor may still be running
+        rep.healthy = False
+        # Replace the dead replica (ref: deployment_state.py replica
+        # recovery path).
+        state.replicas.pop(replica_id, None)
+        state.version += 1
+        try:
+            import ray_tpu
+
+            ray_tpu.kill(rep.handle)
+        except Exception:
+            pass
 
     async def _autoscale(self, state: _DeploymentState) -> None:
         cfg = state.config.autoscaling_config
@@ -232,15 +264,18 @@ class ServeControllerActor:
             # Zero-replica deployments are woken by get_routing_table's
             # scale-from-zero path; nothing to measure here.
             return
+        futs = {rep.replica_id: asyncio.wrap_future(
+            rep.handle.get_metrics.remote().future())
+            for rep in state.replicas.values()}
+        if futs:  # poll all replicas concurrently, bounded wait
+            await asyncio.wait(futs.values(), timeout=2.0)
         total = 0.0
         for rep in state.replicas.values():
-            try:
-                metrics = await asyncio.wait_for(
-                    asyncio.wrap_future(rep.handle.get_metrics.remote()
-                                        .future()), timeout=2.0)
-                rep.ongoing = metrics["ongoing"]
-            except Exception:
-                pass
+            fut = futs.get(rep.replica_id)
+            if fut is not None and fut.done() and fut.exception() is None:
+                rep.ongoing = fut.result()["ongoing"]
+            elif fut is not None and not fut.done():
+                fut.cancel()
             total += rep.ongoing
         desired = cfg.desired_replicas(total, len(state.replicas))
         now = time.time()

@@ -212,8 +212,14 @@ class DeploymentHandle:
                     else v) for k, v in kwargs.items()}
             router = _Router.get(app, deployment)
             replica = router.pick()
-            ref = replica.handle_request.remote(method_name, resolved,
-                                                resolved_kw)
+            try:
+                ref = replica.handle_request.remote(method_name, resolved,
+                                                    resolved_kw)
+            except BaseException:
+                # pick() incremented the in-flight slot; give it back or the
+                # replica looks saturated forever.
+                router.release(replica.actor_id)
+                raise
             return ref, lambda: router.release(replica.actor_id)
 
         return DeploymentResponse(submit)

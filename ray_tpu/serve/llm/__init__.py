@@ -1,0 +1,28 @@
+"""ray_tpu.serve.llm: native paged-KV continuous-batching LLM serving.
+
+The reference's Serve-LLM wraps external vLLM (ref: python/ray/llm/); here
+the engine is in-repo and TPU-native: paged attention in jnp/Pallas over
+block tables, bucketed jit shapes, prefix caching, continuous batching.
+"""
+
+from .cache import OutOfPages, PageAllocator  # noqa: F401
+from .engine import (  # noqa: F401
+    EngineConfig,
+    LLMEngine,
+    OutputDelta,
+    Request,
+    SamplingParams,
+)
+from .server import (  # noqa: F401
+    LLMConfig,
+    LLMServer,
+    OpenAIIngress,
+    build_openai_app,
+)
+from .tokenizer import ByteTokenizer, get_tokenizer  # noqa: F401
+
+__all__ = [
+    "EngineConfig", "LLMEngine", "SamplingParams", "OutputDelta", "Request",
+    "PageAllocator", "OutOfPages", "LLMConfig", "LLMServer", "OpenAIIngress",
+    "build_openai_app", "ByteTokenizer", "get_tokenizer",
+]

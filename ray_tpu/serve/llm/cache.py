@@ -1,0 +1,127 @@
+"""Host-side paged KV cache management: allocator + prefix cache.
+
+The reference delegates this to vLLM's BlockSpaceManager/prefix pool (no
+in-repo implementation; ref: llm/_internal/serve/deployments/llm/vllm/
+vllm_engine.py wraps the external engine). Design here follows the same
+contract: fixed pool of pages, per-sequence block tables, refcounted
+sharing of FULL pages keyed by a rolling content hash, LRU eviction of
+unreferenced cached pages. Only full pages are ever shared, so a sequence's
+writable tail page is always exclusively owned.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class OutOfPages(Exception):
+    pass
+
+
+class PageAllocator:
+    """Page 0 is reserved as the dummy page (padding block-table slots)."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        assert num_pages >= 2
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._free: List[int] = list(range(1, num_pages))
+        self._refcount: Dict[int, int] = {}
+        # prefix cache: chain_hash -> page id; pages with refcount 0 that
+        # remain cached sit in _evictable (LRU order) until reused/evicted
+        self._hash_to_page: Dict[int, int] = {}
+        self._page_to_hash: Dict[int, int] = {}
+        self._evictable: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+        self.stats = {"allocated": 0, "cache_hits": 0, "evictions": 0}
+
+    # ------------------------------------------------------------ queries
+
+    def num_free(self) -> int:
+        return len(self._free) + len(self._evictable)
+
+    @staticmethod
+    def chain_hash(prev_hash: Optional[int],
+                   tokens: Sequence[int]) -> int:
+        return hash((prev_hash, tuple(tokens)))
+
+    def match_prefix(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest cached prefix of `tokens` in FULL pages. Returns
+        (page_ids, n_cached_tokens); the pages are ref-bumped."""
+        pages: List[int] = []
+        prev_hash: Optional[int] = None
+        n = 0
+        # Never match the *entire* prompt: at least one token must be
+        # computed so prefill has a query position to sample from.
+        limit = (len(tokens) - 1) // self.page_size
+        for i in range(limit):
+            chunk = tokens[i * self.page_size:(i + 1) * self.page_size]
+            h = self.chain_hash(prev_hash, chunk)
+            page = self._hash_to_page.get(h)
+            if page is None:
+                break
+            self._ref(page)
+            pages.append(page)
+            prev_hash = h
+            n += self.page_size
+        self.stats["cache_hits"] += len(pages)
+        return pages, n
+
+    # ---------------------------------------------------------- lifecycle
+
+    def allocate(self, count: int) -> List[int]:
+        if self.num_free() < count:
+            raise OutOfPages(f"need {count} pages, {self.num_free()} free")
+        out = []
+        for _ in range(count):
+            if self._free:
+                page = self._free.pop()
+            else:  # evict the LRU cached page
+                page, _ = self._evictable.popitem(last=False)
+                self._uncache(page)
+                self.stats["evictions"] += 1
+            self._refcount[page] = 1
+            out.append(page)
+        self.stats["allocated"] += count
+        return out
+
+    def _ref(self, page: int) -> None:
+        if self._refcount.get(page, 0) == 0:
+            self._evictable.pop(page, None)
+        self._refcount[page] = self._refcount.get(page, 0) + 1
+
+    def release(self, pages: Sequence[int]) -> None:
+        """Drop one reference per page. Cached (hashed) pages become
+        evictable; uncached pages return to the free list."""
+        for page in pages:
+            rc = self._refcount.get(page, 0) - 1
+            if rc > 0:
+                self._refcount[page] = rc
+                continue
+            self._refcount.pop(page, None)
+            if page in self._page_to_hash:
+                self._evictable[page] = None
+                self._evictable.move_to_end(page)
+            else:
+                self._free.append(page)
+
+    def register_full_page(self, page: int, prev_hash: Optional[int],
+                           tokens: Sequence[int]) -> int:
+        """Enter a now-full page into the prefix cache; returns its chain
+        hash (feed into the next page's registration)."""
+        assert len(tokens) == self.page_size
+        h = self.chain_hash(prev_hash, tokens)
+        existing = self._hash_to_page.get(h)
+        if existing is not None and existing != page:
+            # Duplicate content; keep the existing mapping (this page stays
+            # uncached and will be freed on release).
+            return h
+        self._hash_to_page[h] = page
+        self._page_to_hash[page] = h
+        return h
+
+    def _uncache(self, page: int) -> None:
+        h = self._page_to_hash.pop(page, None)
+        if h is not None and self._hash_to_page.get(h) == page:
+            del self._hash_to_page[h]

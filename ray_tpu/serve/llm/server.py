@@ -1,0 +1,184 @@
+"""LLM serving deployments: engine host + OpenAI-compatible ingress.
+
+Parity with the reference's Serve-LLM surface (ref: llm/_internal/serve/
+deployments/llm/llm_server.py:410 LLMServer.chat; OpenAI ingress builders
+ref: llm/_internal/serve/builders/application_builders.py:19,55
+build_openai_app) with the external vLLM engine replaced by the native
+paged-KV engine (engine.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import itertools
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import deployment
+from .engine import EngineConfig, LLMEngine, SamplingParams
+from .tokenizer import get_tokenizer
+
+
+@dataclasses.dataclass
+class LLMConfig:
+    """User-facing config (ref: llm/_internal/serve/configs/
+    server_models.py:160 LLMConfig — model id + engine kwargs +
+    deployment sizing)."""
+
+    model_id: str = "default-llm"
+    engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
+    tokenizer: Any = None
+    num_replicas: int = 1
+    max_ongoing_requests: int = 64
+
+
+@deployment
+class LLMServer:
+    """Hosts one engine. A single driver coroutine pulls engine steps on an
+    executor thread while requests are pending, so the replica's event loop
+    stays free (ref: llm_server.py engine loop task)."""
+
+    def __init__(self, llm_config: LLMConfig):
+        self.config = llm_config
+        self.tokenizer = get_tokenizer(llm_config.tokenizer)
+        engine_cfg = llm_config.engine
+        if engine_cfg.eos_token_id is None:
+            engine_cfg.eos_token_id = getattr(
+                self.tokenizer, "eos_token_id", None)
+        self.engine = LLMEngine(engine_cfg)
+        self._ids = itertools.count()
+        self._waiters: Dict[str, asyncio.Queue] = {}
+        self._driver_task: Optional[asyncio.Task] = None
+
+    async def _ensure_driver(self):
+        if self._driver_task is None or self._driver_task.done():
+            self._driver_task = asyncio.get_running_loop().create_task(
+                self._drive())
+
+    async def _drive(self):
+        loop = asyncio.get_running_loop()
+        while self.engine.has_work():
+            deltas = await loop.run_in_executor(None, self.engine.step)
+            for delta in deltas:
+                queue = self._waiters.get(delta.request_id)
+                if queue is not None:
+                    queue.put_nowait(delta)
+            if not deltas:
+                await asyncio.sleep(0.005)
+
+    async def generate(self, prompt: str = None, *,
+                       prompt_ids: Optional[List[int]] = None,
+                       max_tokens: int = 64, temperature: float = 0.0,
+                       top_k: int = 0, seed: int = 0) -> Dict[str, Any]:
+        """Generate to completion; returns text + token ids + usage."""
+        if prompt_ids is None:
+            prompt_ids = self.tokenizer.encode(prompt)
+        request_id = f"req-{next(self._ids)}"
+        queue: asyncio.Queue = asyncio.Queue()
+        self._waiters[request_id] = queue
+        sampling = SamplingParams(max_tokens=max_tokens,
+                                  temperature=temperature, top_k=top_k,
+                                  seed=seed)
+        t0 = time.time()
+        self.engine.add_request(request_id, prompt_ids, sampling)
+        await self._ensure_driver()
+        out_ids: List[int] = []
+        finish_reason = None
+        ttft = None
+        try:
+            while True:
+                delta = await queue.get()
+                if ttft is None and delta.new_token_ids:
+                    ttft = time.time() - t0
+                out_ids.extend(delta.new_token_ids)
+                if delta.finished:
+                    finish_reason = delta.finish_reason
+                    break
+        finally:
+            self._waiters.pop(request_id, None)
+        return {
+            "request_id": request_id,
+            "text": self.tokenizer.decode(out_ids),
+            "token_ids": out_ids,
+            "finish_reason": finish_reason,
+            "usage": {"prompt_tokens": len(prompt_ids),
+                      "completion_tokens": len(out_ids),
+                      "total_tokens": len(prompt_ids) + len(out_ids)},
+            "ttft_s": ttft,
+        }
+
+    def engine_stats(self) -> Dict[str, Any]:
+        return self.engine.stats()
+
+    async def check_health(self) -> bool:
+        return True
+
+
+def _render_chat(messages: List[dict]) -> str:
+    """Minimal chat template (no model-specific template without a real
+    tokenizer)."""
+    parts = [f"<|{m.get('role', 'user')}|>\n{m.get('content', '')}"
+             for m in messages]
+    return "\n".join(parts) + "\n<|assistant|>\n"
+
+
+@deployment
+class OpenAIIngress:
+    """OpenAI-compatible HTTP surface: /v1/chat/completions,
+    /v1/completions, /v1/models (ref: llm/_internal/serve/deployments/
+    routers/router.py)."""
+
+    def __init__(self, llm_handle, model_id: str = "default-llm"):
+        self.llm = llm_handle
+        self.model_id = model_id
+        self._ids = itertools.count()
+
+    async def __call__(self, request):
+        path = request.path
+        if path.endswith("/v1/models") or path == "/v1/models":
+            return {"object": "list",
+                    "data": [{"id": self.model_id, "object": "model"}]}
+        body = request.json()
+        if "chat/completions" in path:
+            prompt = _render_chat(body.get("messages", []))
+            kind = "chat.completion"
+        elif "completions" in path:
+            prompt = body.get("prompt", "")
+            kind = "text_completion"
+        else:
+            return {"error": {"message": f"unknown path {path}",
+                              "type": "invalid_request_error"}}
+        out = await self.llm.generate.remote(
+            prompt,
+            max_tokens=int(body.get("max_tokens", 64)),
+            temperature=float(body.get("temperature", 0.0)),
+            seed=int(body.get("seed", 0)))
+        created = int(time.time())
+        if kind == "chat.completion":
+            choice = {"index": 0, "finish_reason": out["finish_reason"],
+                      "message": {"role": "assistant",
+                                  "content": out["text"]}}
+        else:
+            choice = {"index": 0, "finish_reason": out["finish_reason"],
+                      "text": out["text"]}
+        return {
+            "id": f"cmpl-{next(self._ids)}",
+            "object": kind,
+            "created": created,
+            "model": body.get("model", self.model_id),
+            "choices": [choice],
+            "usage": out["usage"],
+        }
+
+
+def build_openai_app(llm_config: LLMConfig):
+    """Application: OpenAI ingress -> LLMServer replicas (ref:
+    application_builders.py:55 build_openai_app)."""
+    server = LLMServer.options(
+        name=f"LLMServer:{llm_config.model_id}",
+        num_replicas=llm_config.num_replicas,
+        max_ongoing_requests=llm_config.max_ongoing_requests,
+    ).bind(llm_config)
+    return OpenAIIngress.options(name="OpenAIIngress").bind(
+        server, llm_config.model_id)
