@@ -1,0 +1,22 @@
+"""ray_tpu.rllib: reinforcement learning with JAX/Flax learners.
+
+Re-design of the reference's RLlib new API stack (ref: rllib/ — the
+reference ships torch/tf2 learners and NO jax backend, SURVEY.md §2.3):
+RLModule (Flax policy/value nets), Learner (jitted optax updates),
+LearnerGroup (data-parallel learner actors with host-collective gradient
+sync), SingleAgentEnvRunner actors (vectorized gymnasium envs), and
+Algorithms (PPO, DQN) driving the sample → update → sync-weights loop as
+Tune-compatible trainables.
+"""
+
+from .algorithms.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
+from .algorithms.dqn import DQN, DQNConfig  # noqa: F401
+from .algorithms.ppo import PPO, PPOConfig  # noqa: F401
+from .core.learner import Learner  # noqa: F401
+from .core.rl_module import DiscreteMLPModule, RLModuleSpec  # noqa: F401
+from .env.env_runner import SingleAgentEnvRunner  # noqa: F401
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
+    "Learner", "RLModuleSpec", "DiscreteMLPModule", "SingleAgentEnvRunner",
+]

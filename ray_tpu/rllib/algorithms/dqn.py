@@ -1,0 +1,131 @@
+"""DQN (ref: rllib/algorithms/dqn/dqn.py — replay buffer + target network;
+loss ref: rllib/algorithms/dqn/torch/dqn_torch_learner.py TD error, with
+double-Q action selection)."""
+
+from __future__ import annotations
+
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.learner import Learner
+from ..core.rl_module import QMLPModule, RLModuleSpec
+from ..utils.replay_buffers import UniformReplayBuffer
+from .algorithm import Algorithm, AlgorithmConfig
+
+
+class DQNLearner(Learner):
+    def __init__(self, module, config, seed: int = 0):
+        super().__init__(module, config, seed=seed)
+        self.target_params = jax.device_get(self.params)
+        self._updates = 0
+
+    def loss(self, params, batch):
+        # `batch["target"]` carries the target-net params as part of the
+        # input pytree (NOT a trace-time closure, which jit would bake in
+        # as a constant and never refresh).
+        gamma = self.config.get("gamma", 0.99)
+        q_all = self.module.forward_train(params, batch["obs"])["q"]
+        q = jnp.take_along_axis(q_all, batch["actions"][..., None],
+                                axis=-1)[..., 0]
+        q_next_online = self.module.forward_train(
+            params, batch["next_obs"])["q"]
+        q_next_target = self.module.forward_train(
+            batch["target"], batch["next_obs"])["q"]
+        # double-Q: online net picks the action, target net evaluates it
+        best = q_next_online.argmax(-1)
+        q_next = jnp.take_along_axis(q_next_target, best[..., None],
+                                     axis=-1)[..., 0]
+        target = batch["rewards"] + gamma * (1 - batch["dones"]) * \
+            jax.lax.stop_gradient(q_next)
+        td = q - target
+        loss = jnp.square(td).mean()
+        return loss, {"td_error_mean": jnp.abs(td).mean(),
+                      "q_mean": q.mean()}
+
+    def prepare_batch(self, batch):
+        return {**batch, "target": self.target_params}
+
+    def after_update(self):
+        self._updates += 1
+        if self._updates % self.config.get("target_update_freq", 50) == 0:
+            self.target_params = jax.device_get(self.params)
+
+    def set_weights(self, weights):
+        super().set_weights(weights)
+        # A restored checkpoint's online net is the source of truth; the
+        # target must follow or TD targets come from a random init.
+        self.target_params = jax.device_get(self.params)
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = DQN
+        self.module_spec = RLModuleSpec(module_class=QMLPModule)
+        self.buffer_size = 50_000
+        self.learning_starts = 1000
+        self.rollout_fragment_length = 200
+        self.update_batch_size = 64
+        self.updates_per_iteration = 50
+        self.target_update_freq = 50
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_decay_timesteps = 10_000
+
+    def learner_config(self) -> Dict[str, Any]:
+        cfg = super().learner_config()
+        cfg.update(target_update_freq=self.target_update_freq)
+        return cfg
+
+
+class DQN(Algorithm):
+    learner_class = DQNLearner
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.buffer = UniformReplayBuffer(config.buffer_size,
+                                          seed=config.seed)
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._timesteps_total
+                   / max(1, cfg.epsilon_decay_timesteps))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end
+                                           - cfg.epsilon_start)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        weights = self.learner_group.get_weights()
+        episodes = self.env_runner_group.sample(
+            cfg.rollout_fragment_length, weights=weights, explore=True,
+            epsilon=self._epsilon())
+        self._record_episodes(episodes)
+        for episode in episodes:
+            batch = episode.to_batch()
+            obs = batch["obs"]
+            if len(obs) < 2 and not episode.terminated:
+                continue
+            next_obs = np.concatenate([obs[1:], obs[-1:]], axis=0)
+            dones = np.zeros(len(obs), np.float32)
+            if episode.terminated:
+                # final next_obs is unused when done=1
+                dones[-1] = 1.0
+                keep = len(obs)
+            else:
+                # truncated/cut fragment: the true next_obs of the final
+                # transition is unknown here, so drop that transition
+                keep = len(obs) - 1
+            self.buffer.add_batch({
+                "obs": obs[:keep], "actions": batch["actions"][:keep],
+                "rewards": batch["rewards"][:keep],
+                "next_obs": next_obs[:keep], "dones": dones[:keep]})
+        metrics: Dict[str, float] = {"epsilon": self._epsilon()}
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iteration):
+                metrics.update(self.learner_group.update(
+                    self.buffer.sample(cfg.update_batch_size)))
+        return metrics

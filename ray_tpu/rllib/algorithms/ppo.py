@@ -1,0 +1,93 @@
+"""PPO (ref: rllib/algorithms/ppo/ppo.py:388 training_step; loss ref:
+rllib/algorithms/ppo/torch/ppo_torch_learner.py — clipped surrogate +
+clipped value loss + entropy bonus, here as one jitted optax update)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.learner import Learner
+from ..core.rl_module import (categorical_entropy, categorical_logp)
+from ..env.episodes import compute_gae
+from .algorithm import Algorithm, AlgorithmConfig
+
+
+class PPOLearner(Learner):
+    def loss(self, params, batch):
+        cfg = self.config
+        fwd = self.module.forward_train(params, batch["obs"])
+        logp = categorical_logp(fwd["logits"], batch["actions"])
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["advantages"]
+        clip = cfg.get("clip_param", 0.3)
+        surrogate = jnp.minimum(
+            ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+        vf = fwd["vf"]
+        vf_loss = jnp.square(vf - batch["value_targets"])
+        vf_loss = jnp.minimum(vf_loss, cfg.get("vf_clip_param", 10.0))
+        entropy = categorical_entropy(fwd["logits"])
+        total = (-surrogate.mean()
+                 + cfg.get("vf_loss_coeff", 1.0) * vf_loss.mean()
+                 - cfg.get("entropy_coeff", 0.0) * entropy.mean())
+        return total, {
+            "policy_loss": -surrogate.mean(),
+            "vf_loss": vf_loss.mean(),
+            "entropy": entropy.mean(),
+            "mean_kl": (batch["logp"] - logp).mean(),
+        }
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = PPO
+        self.lam = 0.95
+        self.clip_param = 0.3
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 1.0
+        self.entropy_coeff = 0.0
+        self.num_epochs = 6
+        self.minibatch_size = 128
+
+    def learner_config(self) -> Dict[str, Any]:
+        cfg = super().learner_config()
+        cfg.update(clip_param=self.clip_param,
+                   vf_clip_param=self.vf_clip_param,
+                   vf_loss_coeff=self.vf_loss_coeff,
+                   entropy_coeff=self.entropy_coeff)
+        return cfg
+
+
+class PPO(Algorithm):
+    learner_class = PPOLearner
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        weights = self.learner_group.get_weights()
+        episodes = self.env_runner_group.sample(
+            cfg.train_batch_size, weights=weights, explore=True)
+        if not episodes:
+            # e.g. every remote runner died this round and was respawned;
+            # skip the update rather than crash — next iteration resamples.
+            return {"num_env_runner_restarts": 1.0}
+        self._record_episodes(episodes)
+        batches = [compute_gae(ep, cfg.gamma, cfg.lam) for ep in episodes]
+        batch = {key: np.concatenate([b[key] for b in batches])
+                 for key in batches[0]}
+        adv = batch["advantages"]
+        batch["advantages"] = ((adv - adv.mean())
+                               / np.maximum(adv.std(), 1e-4))
+        n = len(adv)
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        metrics: Dict[str, float] = {}
+        mb = min(cfg.minibatch_size, n)
+        for _ in range(cfg.num_epochs):
+            perm = rng.permutation(n)
+            for start in range(0, n - mb + 1, mb):
+                idx = perm[start:start + mb]
+                metrics = self.learner_group.update(
+                    {key: val[idx] for key, val in batch.items()})
+        return metrics

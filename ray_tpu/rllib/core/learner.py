@@ -1,0 +1,86 @@
+"""Learner: owns module params + optimizer state, runs jitted updates.
+
+Parity with the reference's Learner (ref: rllib/core/learner/learner.py:107
+— update :977, compute_gradients :464, apply_gradients :607; torch there,
+optax/jit here). Subclasses define `loss(params, batch)`; the whole
+grad+clip+apply step compiles to one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+class Learner:
+    def __init__(self, module, config: Dict[str, Any], seed: int = 0):
+        self.module = module
+        self.config = config
+        self.params = module.init(jax.random.PRNGKey(seed))
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(config.get("grad_clip", 10.0)),
+            optax.adam(config.get("lr", 3e-4)),
+        )
+        self.opt_state = self.tx.init(self.params)
+        self._jit_update = jax.jit(self._update_impl, donate_argnums=(0, 1))
+        self._jit_grads = jax.jit(self._grads_impl)
+
+    # ------------------------------------------------------------- loss
+
+    def loss(self, params, batch) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        raise NotImplementedError
+
+    def prepare_batch(self, batch) -> Any:
+        """Hook to enrich the batch before grads (e.g. DQN injects target-
+        net params here so BOTH update() and compute_gradients() — the
+        data-parallel path — see them)."""
+        return batch
+
+    # ----------------------------------------------------------- update
+
+    def _grads_impl(self, params, batch):
+        (loss_val, metrics), grads = jax.value_and_grad(
+            self.loss, has_aux=True)(params, batch)
+        metrics["total_loss"] = loss_val
+        return grads, metrics
+
+    def _update_impl(self, params, opt_state, batch):
+        grads, metrics = self._grads_impl(params, batch)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return params, opt_state, metrics
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """One full update step (grads + clip + apply), jit-compiled
+        (ref: learner.py:977 update)."""
+        self.params, self.opt_state, metrics = self._jit_update(
+            self.params, self.opt_state, self.prepare_batch(batch))
+        return {k: float(v) for k, v in metrics.items()}
+
+    def compute_gradients(self, batch) -> Tuple[Any, Dict[str, float]]:
+        """(ref: learner.py:464)"""
+        grads, metrics = self._jit_grads(self.params,
+                                         self.prepare_batch(batch))
+        return grads, {k: float(v) for k, v in metrics.items()}
+
+    def apply_gradients(self, grads) -> None:
+        """(ref: learner.py:607)"""
+        updates, self.opt_state = self.tx.update(grads, self.opt_state,
+                                                 self.params)
+        self.params = optax.apply_updates(self.params, updates)
+
+    # ---------------------------------------------------------- weights
+
+    def get_weights(self) -> Any:
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.device_put(weights)
+
+    def after_update(self) -> None:
+        """Hook (e.g. DQN target-net sync)."""

@@ -1,0 +1,131 @@
+"""RLModule: the neural-network component of an RL algorithm.
+
+Parity with the reference's RLModule abstraction (ref:
+rllib/core/rl_module/rl_module.py — forward_inference/forward_exploration/
+forward_train return dists or dist inputs) with Flax as the network library
+and explicit functional params (the JAX idiom: modules are stateless, the
+Learner owns params).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class RLModuleSpec:
+    """Builds an RLModule for a given env's spaces (ref:
+    rllib/core/rl_module/rl_module.py RLModuleSpec)."""
+
+    module_class: Any = None
+    hidden: Tuple[int, ...] = (64, 64)
+    dueling: bool = False  # DQN: separate value/advantage streams
+
+    def build(self, obs_space, act_space) -> "RLModule":
+        cls = self.module_class or DiscreteMLPModule
+        return cls(obs_space, act_space, self)
+
+
+class _MLPNet(nn.Module):
+    hidden: Sequence[int]
+    out: int
+
+    @nn.compact
+    def __call__(self, x):
+        for width in self.hidden:
+            x = nn.tanh(nn.Dense(width)(x))
+        return nn.Dense(self.out, kernel_init=nn.initializers.normal(0.01))(x)
+
+
+class RLModule:
+    """Base: wraps a flax net; params are created by `init` and owned by the
+    caller (Learner / EnvRunner)."""
+
+    def __init__(self, obs_space, act_space, spec: RLModuleSpec):
+        self.obs_space = obs_space
+        self.act_space = act_space
+        self.spec = spec
+        self.obs_dim = int(np.prod(obs_space.shape))
+
+    def init(self, rng) -> Any:
+        raise NotImplementedError
+
+    def forward_train(self, params, obs) -> Dict[str, jax.Array]:
+        raise NotImplementedError
+
+    # exploration/inference default to the train forward
+    def forward_inference(self, params, obs) -> Dict[str, jax.Array]:
+        return self.forward_train(params, obs)
+
+
+class DiscreteMLPModule(RLModule):
+    """Categorical policy + value head for Discrete action spaces (the
+    default module, ref: rllib default MLP catalog)."""
+
+    def __init__(self, obs_space, act_space, spec):
+        super().__init__(obs_space, act_space, spec)
+        self.n_actions = int(act_space.n)
+        self.pi = _MLPNet(spec.hidden, self.n_actions)
+        self.vf = _MLPNet(spec.hidden, 1)
+
+    def init(self, rng):
+        obs = jnp.zeros((1, self.obs_dim), jnp.float32)
+        r1, r2 = jax.random.split(rng)
+        return {"pi": self.pi.init(r1, obs)["params"],
+                "vf": self.vf.init(r2, obs)["params"]}
+
+    def forward_train(self, params, obs):
+        logits = self.pi.apply({"params": params["pi"]}, obs)
+        value = self.vf.apply({"params": params["vf"]}, obs)[..., 0]
+        return {"logits": logits, "vf": value}
+
+
+class QMLPModule(RLModule):
+    """Q-network for DQN (optionally dueling)."""
+
+    def __init__(self, obs_space, act_space, spec):
+        super().__init__(obs_space, act_space, spec)
+        self.n_actions = int(act_space.n)
+        if spec.dueling:
+            self.adv = _MLPNet(spec.hidden, self.n_actions)
+            self.val = _MLPNet(spec.hidden, 1)
+        else:
+            self.q = _MLPNet(spec.hidden, self.n_actions)
+
+    def init(self, rng):
+        obs = jnp.zeros((1, self.obs_dim), jnp.float32)
+        if self.spec.dueling:
+            r1, r2 = jax.random.split(rng)
+            return {"adv": self.adv.init(r1, obs)["params"],
+                    "val": self.val.init(r2, obs)["params"]}
+        return {"q": self.q.init(rng, obs)["params"]}
+
+    def forward_train(self, params, obs):
+        if self.spec.dueling:
+            adv = self.adv.apply({"params": params["adv"]}, obs)
+            val = self.val.apply({"params": params["val"]}, obs)
+            q = val + adv - adv.mean(axis=-1, keepdims=True)
+        else:
+            q = self.q.apply({"params": params["q"]}, obs)
+        return {"q": q}
+
+
+def categorical_sample(rng, logits):
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def categorical_logp(logits, actions):
+    logp_all = jax.nn.log_softmax(logits)
+    return jnp.take_along_axis(logp_all, actions[..., None],
+                               axis=-1)[..., 0]
+
+
+def categorical_entropy(logits):
+    logp = jax.nn.log_softmax(logits)
+    return -(jnp.exp(logp) * logp).sum(-1)

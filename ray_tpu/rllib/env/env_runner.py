@@ -1,0 +1,212 @@
+"""SingleAgentEnvRunner: samples episodes from vectorized gymnasium envs.
+
+Parity with the reference (ref: rllib/env/single_agent_env_runner.py:68 —
+vectorized gym envs + RLModule forward_exploration; EnvRunnerGroup ref:
+rllib/env/env_runner_group.py:71 with fault-tolerant actor management).
+Runs as a plain class (local mode) or behind `ray_tpu.remote` actors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .episodes import Episode
+
+
+def _apply_platform(platform: Optional[str]) -> None:
+    """Pin this process's JAX backend BEFORE first use. RL env stepping and
+    small policy nets belong on CPU even when an accelerator is visible —
+    per-step forwards on a remote-tunneled device pay a round-trip each.
+    No-op if a backend is already initialized (e.g. driver-local mode)."""
+    if not platform or platform == "default":
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", platform)
+    except RuntimeError:
+        pass
+
+
+def _make_env(env_spec, seed: int):
+    if callable(env_spec):
+        env = env_spec()
+    else:
+        import gymnasium as gym
+
+        env = gym.make(env_spec)
+    env.reset(seed=seed)
+    return env
+
+
+class SingleAgentEnvRunner:
+    def __init__(self, env_spec, module_spec, config: Dict[str, Any],
+                 seed: int = 0, worker_index: int = 0):
+        import jax
+
+        _apply_platform(config.get("jax_platform", "cpu"))
+        self.config = config
+        self.num_envs = config.get("num_envs_per_env_runner", 1)
+        base_seed = seed + worker_index * 10_000
+        self.envs = [_make_env(env_spec, base_seed + i)
+                     for i in range(self.num_envs)]
+        self.obs_space = self.envs[0].observation_space
+        self.act_space = self.envs[0].action_space
+        self.module = module_spec.build(self.obs_space, self.act_space)
+        self.params = self.module.init(jax.random.PRNGKey(base_seed))
+        self._rng = jax.random.PRNGKey(base_seed + 1)
+        self._np_rng = np.random.default_rng(base_seed + 2)
+        self._jit_fwd = jax.jit(self.module.forward_train)
+        self._cur_obs: List[np.ndarray] = []
+        self._episodes: List[Episode] = []
+        self._reset_all()
+
+    def _reset_all(self):
+        self._cur_obs = []
+        self._episodes = []
+        for env in self.envs:
+            obs, _ = env.reset()
+            self._cur_obs.append(np.asarray(obs, np.float32))
+            self._episodes.append(Episode())
+
+    def set_weights(self, weights) -> None:
+        self.params = weights
+
+    def get_spaces(self) -> Tuple[Any, Any]:
+        return self.obs_space, self.act_space
+
+    def sample(self, num_timesteps: int, explore: bool = True,
+               epsilon: float = 0.0, weights=None) -> List[Episode]:
+        """Collect ~num_timesteps env steps (across the vector); returns
+        finished + truncated episode fragments, each with GAE bootstrap
+        values filled in."""
+        import jax
+
+        if weights is not None:
+            self.params = weights
+        out: List[Episode] = []
+        steps = 0
+        while steps < num_timesteps:
+            obs = np.stack(self._cur_obs)
+            fwd = self._jit_fwd(self.params, obs)
+            if "logits" in fwd:
+                logits = np.asarray(fwd["logits"], np.float32)
+                vf = np.asarray(fwd.get("vf", np.zeros(len(logits))),
+                                np.float32)
+                if explore:
+                    self._rng, sub = jax.random.split(self._rng)
+                    actions = np.asarray(jax.random.categorical(
+                        sub, fwd["logits"], axis=-1))
+                else:
+                    actions = logits.argmax(-1)
+                logp_all = logits - _logsumexp(logits)
+                logps = logp_all[np.arange(len(actions)), actions]
+            else:  # Q-values: epsilon-greedy
+                q = np.asarray(fwd["q"], np.float32)
+                actions = q.argmax(-1)
+                rand = self._np_rng.random(len(actions)) < epsilon
+                actions = np.where(
+                    rand,
+                    self._np_rng.integers(0, q.shape[-1], len(actions)),
+                    actions)
+                vf = np.zeros(len(actions), np.float32)
+                logps = np.zeros(len(actions), np.float32)
+            for i, env in enumerate(self.envs):
+                episode = self._episodes[i]
+                episode.obs.append(self._cur_obs[i])
+                action = int(actions[i])
+                next_obs, reward, terminated, truncated, _ = env.step(action)
+                episode.actions.append(action)
+                episode.rewards.append(float(reward))
+                episode.logp.append(float(logps[i]))
+                episode.vf_preds.append(float(vf[i]))
+                steps += 1
+                if terminated or truncated:
+                    episode.terminated = bool(terminated)
+                    episode.truncated = bool(truncated)
+                    if truncated:
+                        episode.last_value = self._value_of(next_obs)
+                    out.append(episode)
+                    next_obs, _ = env.reset()
+                    self._episodes[i] = Episode()
+                self._cur_obs[i] = np.asarray(next_obs, np.float32)
+        # Truncate in-flight fragments into the batch (bootstrapped).
+        for i in range(self.num_envs):
+            episode = self._episodes[i]
+            if len(episode) > 0:
+                episode.truncated = True
+                episode.cut = True
+                episode.last_value = self._value_of(self._cur_obs[i])
+                out.append(episode)
+                self._episodes[i] = Episode()
+        return out
+
+    def _value_of(self, obs) -> float:
+        fwd = self._jit_fwd(self.params,
+                            np.asarray(obs, np.float32)[None])
+        if "vf" in fwd:
+            return float(np.asarray(fwd["vf"])[0])
+        return 0.0
+
+    def ping(self) -> str:
+        return "pong"
+
+
+def _logsumexp(logits: np.ndarray) -> np.ndarray:
+    m = logits.max(-1, keepdims=True)
+    return m + np.log(np.exp(logits - m).sum(-1, keepdims=True))
+
+
+class EnvRunnerGroup:
+    """Local runner or N remote runner actors with restart-on-failure
+    (ref: rllib/env/env_runner_group.py:71 + utils/actor_manager.py
+    FaultTolerantActorManager)."""
+
+    def __init__(self, env_spec, module_spec, config: Dict[str, Any],
+                 num_env_runners: int = 0, seed: int = 0):
+        self._args = (env_spec, module_spec, dict(config), seed)
+        self.num_env_runners = num_env_runners
+        if num_env_runners == 0:
+            self._local = SingleAgentEnvRunner(env_spec, module_spec,
+                                              config, seed)
+            self._remote = None
+        else:
+            self._local = None
+            self._remote = [self._spawn(i) for i in range(num_env_runners)]
+
+    def _spawn(self, index: int):
+        import ray_tpu
+
+        env_spec, module_spec, config, seed = self._args
+        cls = ray_tpu.remote(SingleAgentEnvRunner)
+        return cls.remote(env_spec, module_spec, config, seed,
+                          worker_index=index + 1)
+
+    def get_spaces(self):
+        if self._local is not None:
+            return self._local.get_spaces()
+        import ray_tpu
+
+        return ray_tpu.get(self._remote[0].get_spaces.remote())
+
+    def sample(self, num_timesteps: int, weights=None, explore: bool = True,
+               epsilon: float = 0.0) -> List[Episode]:
+        if self._local is not None:
+            return self._local.sample(num_timesteps, explore=explore,
+                                      epsilon=epsilon, weights=weights)
+        import ray_tpu
+
+        share = -(-num_timesteps // len(self._remote))
+        refs = [runner.sample.remote(share, explore=explore,
+                                     epsilon=epsilon, weights=weights)
+                for runner in self._remote]
+        episodes: List[Episode] = []
+        for i, ref in enumerate(refs):
+            try:
+                episodes.extend(ray_tpu.get(ref, timeout=120))
+            except Exception:
+                # Restart the failed runner (fault-tolerant manager).
+                self._remote[i] = self._spawn(i)
+        return episodes

@@ -1,0 +1,63 @@
+"""Episode containers (ref: rllib/env/single_agent_episode.py, reduced to
+the fields the default connectors consume)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Episode:
+    """One (possibly truncated) episode fragment collected by an EnvRunner."""
+
+    obs: List[np.ndarray] = dataclasses.field(default_factory=list)
+    actions: List[int] = dataclasses.field(default_factory=list)
+    rewards: List[float] = dataclasses.field(default_factory=list)
+    logp: List[float] = dataclasses.field(default_factory=list)
+    vf_preds: List[float] = dataclasses.field(default_factory=list)
+    terminated: bool = False
+    truncated: bool = False
+    # fragment cut by the sampler mid-episode (not a real episode end)
+    cut: bool = False
+    # bootstrap value for truncated fragments (GAE tail)
+    last_value: float = 0.0
+
+    def __len__(self) -> int:
+        return len(self.actions)
+
+    @property
+    def total_reward(self) -> float:
+        return float(sum(self.rewards))
+
+    def to_batch(self) -> Dict[str, np.ndarray]:
+        return {
+            "obs": np.stack(self.obs).astype(np.float32),
+            "actions": np.asarray(self.actions, np.int32),
+            "rewards": np.asarray(self.rewards, np.float32),
+            "logp": np.asarray(self.logp, np.float32),
+            "vf_preds": np.asarray(self.vf_preds, np.float32),
+        }
+
+
+def compute_gae(episode: Episode, gamma: float, lam: float
+                ) -> Dict[str, np.ndarray]:
+    """Generalized advantage estimation over one episode fragment (ref:
+    rllib/connectors/learner/general_advantage_estimation.py)."""
+    batch = episode.to_batch()
+    rewards = batch["rewards"]
+    values = batch["vf_preds"]
+    n = len(rewards)
+    next_values = np.append(values[1:],
+                            0.0 if episode.terminated else episode.last_value)
+    deltas = rewards + gamma * next_values - values
+    adv = np.zeros(n, np.float32)
+    acc = 0.0
+    for t in range(n - 1, -1, -1):
+        acc = deltas[t] + gamma * lam * acc
+        adv[t] = acc
+    batch["advantages"] = adv
+    batch["value_targets"] = adv + values
+    return batch

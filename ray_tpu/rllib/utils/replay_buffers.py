@@ -1,0 +1,46 @@
+"""Replay buffers (ref: rllib/utils/replay_buffers/ — uniform ring buffer,
+the EpisodeReplayBuffer used by the new-stack DQN)."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+class UniformReplayBuffer:
+    """Ring buffer over transitions with uniform sampling."""
+
+    def __init__(self, capacity: int, seed: int = 0):
+        self.capacity = capacity
+        self._storage: Dict[str, np.ndarray] = {}
+        self._next = 0
+        self._size = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_batch(self, batch: Dict[str, np.ndarray]) -> None:
+        n = len(next(iter(batch.values())))
+        if n == 0:
+            return
+        if not self._storage:
+            for key, arr in batch.items():
+                self._storage[key] = np.zeros(
+                    (self.capacity,) + arr.shape[1:], arr.dtype)
+        if n >= self.capacity:  # only the newest `capacity` rows survive
+            batch = {k: v[-self.capacity:] for k, v in batch.items()}
+            n = self.capacity
+        # vectorized ring insert: at most two slice assignments per key
+        first = min(n, self.capacity - self._next)
+        for key, arr in batch.items():
+            self._storage[key][self._next:self._next + first] = arr[:first]
+            if first < n:
+                self._storage[key][:n - first] = arr[first:]
+        self._next = (self._next + n) % self.capacity
+        self._size = min(self._size + n, self.capacity)
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, self._size, batch_size)
+        return {key: arr[idx] for key, arr in self._storage.items()}

@@ -78,6 +78,10 @@ class RunConfig:
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(
         default_factory=CheckpointConfig)
+    # Stop criteria for tune trials: {metric: threshold}; a trial stops once
+    # any reported metric reaches its threshold (training_iteration counts
+    # reports). ref: air/config.py RunConfig.stop.
+    stop: Optional[Dict[str, Any]] = None
 
 
 @dataclass

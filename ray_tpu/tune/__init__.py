@@ -155,6 +155,7 @@ class Tuner:
             max_concurrent=tc.max_concurrent_trials,
             max_failures=self.run_config.failure_config.max_failures,
             resources_per_trial=self.resources_per_trial,
+            stop=self.run_config.stop,
         )
         trials = controller.run()
         return ResultGrid(trials, tc.metric, tc.mode, experiment_dir)

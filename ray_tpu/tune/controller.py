@@ -39,6 +39,7 @@ class Trial:
     checkpoint_manager: Optional[CheckpointManager] = None
     num_failures: int = 0
     stopped_by_scheduler: bool = False
+    stop_reason: Optional[str] = None
     resume_checkpoint: Optional[Checkpoint] = None
 
     @property
@@ -53,10 +54,12 @@ class TuneController:
                  max_concurrent: Optional[int] = None,
                  max_failures: int = 0,
                  resources_per_trial: Optional[Dict[str, float]] = None,
+                 stop: Optional[Dict[str, Any]] = None,
                  poll_interval: float = 0.1):
         from ..runtime import serialization
 
         self.trainable_blob = serialization.dumps_inline(trainable)
+        self.stop_criteria = stop or {}
         self.scheduler = scheduler or FIFOScheduler()
         self.experiment_dir = experiment_dir
         self.max_concurrent = max_concurrent or _default_concurrency()
@@ -128,7 +131,7 @@ class TuneController:
             self._stop_actor(trial)
             self.scheduler.on_complete(trial.trial_id)
             return True
-        decision = CONTINUE
+        sched_stop = criteria_stop = False
         for rep in poll["reports"]:
             metrics = dict(rep["metrics"])
             metrics.setdefault("training_iteration",
@@ -137,11 +140,16 @@ class TuneController:
             if rep["checkpoint_path"]:
                 trial.checkpoint_manager.register(
                     Checkpoint(rep["checkpoint_path"]), metrics)
-            d = self.scheduler.on_result(trial.trial_id, metrics)
-            if d == STOP:
-                decision = STOP
+            if self.scheduler.on_result(trial.trial_id, metrics) == STOP:
+                sched_stop = True
+            if self._meets_stop_criteria(metrics):
+                criteria_stop = True
+        decision = STOP if (sched_stop or criteria_stop) else CONTINUE
         if decision == STOP and poll["state"] == RUNNING:
-            trial.stopped_by_scheduler = True
+            # keep scheduler stops distinct from RunConfig.stop criteria
+            trial.stopped_by_scheduler = sched_stop
+            trial.stop_reason = ("scheduler" if sched_stop
+                                 else "stop_criteria")
             try:
                 trial.actor.stop.remote()
             except Exception:
@@ -164,6 +172,15 @@ class TuneController:
         # landed on the trial's final report must not restart it (and must
         # not rewrite its config after the fact).
         self._apply_pbt(trial)
+        return False
+
+    def _meets_stop_criteria(self, metrics: Dict[str, Any]) -> bool:
+        """RunConfig.stop: {metric: threshold} — stop once any metric
+        reaches its threshold (ref: air RunConfig.stop dict form)."""
+        for key, threshold in self.stop_criteria.items():
+            value = metrics.get(key)
+            if value is not None and value >= threshold:
+                return True
         return False
 
     def _discard_pending_exploit(self, trial: Trial):

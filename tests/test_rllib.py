@@ -1,0 +1,154 @@
+"""RL library tests.
+
+Mirrors the reference's RLlib test strategy (ref: rllib/**/tests + CI
+learning-regression via tuned_examples — short training runs to a target
+reward): PPO must learn CartPole, DQN must improve, plus unit tests for
+GAE, replay, learner determinism, and remote env runners.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import DQNConfig, PPOConfig
+from ray_tpu.rllib.env.episodes import Episode, compute_gae
+from ray_tpu.rllib.utils.replay_buffers import UniformReplayBuffer
+
+
+def test_gae_simple():
+    ep = Episode(obs=[np.zeros(2)] * 3, actions=[0, 1, 0],
+                 rewards=[1.0, 1.0, 1.0], logp=[0.0] * 3,
+                 vf_preds=[0.5, 0.5, 0.5], terminated=True)
+    batch = compute_gae(ep, gamma=1.0, lam=1.0)
+    # terminal: returns are 3-t; advantage = return - value
+    np.testing.assert_allclose(batch["value_targets"], [3.0, 2.0, 1.0],
+                               rtol=1e-6)
+    np.testing.assert_allclose(batch["advantages"], [2.5, 1.5, 0.5],
+                               rtol=1e-6)
+
+
+def test_replay_buffer_wraps():
+    buf = UniformReplayBuffer(capacity=10)
+    buf.add_batch({"x": np.arange(7, dtype=np.float32)})
+    assert len(buf) == 7
+    buf.add_batch({"x": np.arange(7, 14, dtype=np.float32)})
+    assert len(buf) == 10
+    sample = buf.sample(32)
+    assert sample["x"].shape == (32,)
+    assert set(np.unique(sample["x"])) <= set(range(4, 14))
+
+
+def test_ppo_learns_cartpole():
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_envs_per_env_runner=4)
+              .training(train_batch_size=2048, lr=3e-4, num_epochs=8,
+                        minibatch_size=256, entropy_coeff=0.01)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    best = 0.0
+    for _ in range(15):
+        result = algo.train()
+        best = max(best, result["episode_return_mean"])
+        if best >= 120.0:
+            break
+    assert best >= 120.0, f"PPO failed to learn CartPole: best={best}"
+    algo.stop()
+
+
+def test_dqn_improves_cartpole(tmp_path):
+    config = (DQNConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_envs_per_env_runner=4)
+              .training(lr=1e-3, learning_starts=500,
+                        rollout_fragment_length=800,
+                        updates_per_iteration=200,
+                        epsilon_decay_timesteps=6000,
+                        target_update_freq=100)
+              .rl_module(hidden=(128, 128))
+              .debugging(seed=0))
+    algo = config.build_algo()
+    first = None
+    best = 0.0
+    for _ in range(40):
+        result = algo.train()
+        if first is None and result["num_episodes"] > 0:
+            first = result["episode_return_mean"]
+        best = max(best, result["episode_return_mean"])
+        if best >= 80.0:
+            break
+    assert best >= 80.0, f"DQN did not improve: first={first} best={best}"
+    # checkpoint roundtrip
+    path = algo.save_to_path(str(tmp_path / "ckpt"))
+    algo2 = config.build_algo()
+    algo2.restore_from_path(path)
+    w1 = algo.get_weights()
+    w2 = algo2.get_weights()
+    import jax
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(a, b), w1, w2)
+    algo.stop()
+
+
+def test_remote_env_runners(shared_cluster):
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=2, num_envs_per_env_runner=2)
+              .training(train_batch_size=512, num_epochs=2,
+                        minibatch_size=128)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    result = algo.train()
+    assert result["timesteps_total"] >= 512
+    assert np.isfinite(result["total_loss"])
+    algo.stop()
+
+
+def test_multi_learner_dqn_data_parallel(shared_cluster):
+    """DQN across 2 learner actors: gradients allreduced, target nets sync,
+    params stay identical on both ranks."""
+    from ray_tpu.rllib.core.learner_group import LearnerGroup  # noqa: F401
+
+    config = (DQNConfig()
+              .environment("CartPole-v1")
+              .learners(num_learners=2)
+              .training(learning_starts=64, rollout_fragment_length=200,
+                        updates_per_iteration=4, update_batch_size=64,
+                        target_update_freq=2)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    result = algo.train()
+    assert np.isfinite(result["total_loss"])
+    # both learner replicas must hold identical params after DDP updates
+    import ray_tpu
+
+    group = algo.learner_group
+    w0, w1 = ray_tpu.get([w.get_weights.remote() for w in group._workers])
+    import jax
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6), w0, w1)
+    algo.stop()
+
+
+def test_ppo_with_tune(shared_cluster, tmp_path):
+    from ray_tpu import tune
+    from ray_tpu.rllib.algorithms.algorithm import as_trainable
+
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .training(train_batch_size=256, num_epochs=2,
+                        minibatch_size=64)
+              .debugging(seed=0))
+    trainable = as_trainable(config)
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([3e-4, 1e-3])},
+        tune_config=tune.TuneConfig(metric="episode_return_mean",
+                                    mode="max"),
+        run_config=tune.RunConfig(storage_path=str(tmp_path),
+                                  stop={"training_iteration": 2}),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 2
+    assert grid.get_best_result() is not None
