@@ -51,6 +51,15 @@ class ActorMethod:
             return refs[0]
         return refs
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node from this bound method (ref: actor.py
+        ActorMethod.bind → dag ClassMethodNode)."""
+        if kwargs:
+            raise NotImplementedError("kwargs are not supported in .bind()")
+        from .dag.dag_node import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._method_name, args)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"actor method {self._method_name} cannot be called directly; "

@@ -1,0 +1,23 @@
+"""ray_tpu.dag: compiled graphs (the aDAG-equivalent accelerated dataplane).
+
+Parity with the reference's Compiled Graphs (ref: python/ray/dag/ —
+DAGNode/ClassMethodNode/InputNode/MultiOutputNode in dag_node.py /
+class_node.py; CompiledDAG compiled_dag_node.py:808, execute :2547): a DAG
+of bound actor methods compiles into pre-provisioned per-actor execution
+loops connected by shared-memory channels (runtime/channel.py), bypassing
+per-call task submission entirely. Where the reference moves GPU tensors
+over NCCL channels, colocated TPU actors hand off arrays through the same
+shm channels (host round-trip) — cross-chip device-to-device transfer
+rides the mesh inside jit, not the actor dataplane.
+"""
+
+from .dag_node import (  # noqa: F401
+    ClassMethodNode,
+    DAGNode,
+    InputNode,
+    MultiOutputNode,
+)
+from .compiled_dag import CompiledDAG, CompiledDAGRef  # noqa: F401
+
+__all__ = ["InputNode", "MultiOutputNode", "DAGNode", "ClassMethodNode",
+           "CompiledDAG", "CompiledDAGRef"]

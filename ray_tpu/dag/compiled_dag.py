@@ -1,0 +1,213 @@
+"""CompiledDAG: pre-provisioned actor loops over shm channels.
+
+Parity with the reference's CompiledDAG (ref: python/ray/dag/
+compiled_dag_node.py:808; execute :2547): compilation walks the bound DAG,
+allocates one SPSC channel per cross-process edge, ships each actor an
+ordered op list, and starts a long-running loop in each actor that reads
+inputs, runs the bound methods, and writes outputs — no per-call task
+submission, no control plane on the hot path.
+"""
+
+from __future__ import annotations
+
+import itertools
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..runtime.channel import Channel, ChannelClosed
+from .dag_node import ClassMethodNode, DAGNode, InputNode, MultiOutputNode
+
+_dag_counter = itertools.count()
+
+
+class CompiledDAGRef:
+    """Result handle for one execute() (ref: compiled_dag_node.py
+    CompiledDAGRef). Results arrive in execution order; get() may be
+    called out of order (buffered)."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+        self._got = False
+
+    def get(self, timeout: Optional[float] = 120.0):
+        if self._got:
+            raise ValueError("CompiledDAGRef.get() called twice")
+        self._got = True
+        return self._dag._fetch(self._seq, timeout)
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, buffer_size_bytes: int = 4 << 20,
+                 max_inflight_executions: int = 4):
+        import ray_tpu
+        from ..runtime.core import get_core
+
+        self._root = root
+        self._session = get_core().session_name
+        self._dag_id = f"{next(_dag_counter)}-{uuid.uuid4().hex[:6]}"
+        self._buffer = buffer_size_bytes
+        # Channel slot count == the in-flight bound, so execute() never
+        # parks on a full ring (a blocked single-threaded driver that has
+        # not read its outputs would deadlock otherwise; the reference
+        # bounds this the same way via _max_inflight_executions).
+        self._max_inflight = max_inflight_executions
+        self._torn_down = False
+        self._seq = 0
+        self._next_fetch = 0
+        self._fetched: Dict[int, Any] = {}
+
+        nodes = root.topo()
+        self._input: Optional[InputNode] = None
+        outputs: List[ClassMethodNode] = []
+        compute_nodes: List[ClassMethodNode] = []
+        for node in nodes:
+            if isinstance(node, InputNode):
+                if self._input is not None and node is not self._input:
+                    raise ValueError("a DAG may have only one InputNode")
+                self._input = node
+            elif isinstance(node, ClassMethodNode):
+                compute_nodes.append(node)
+            elif isinstance(node, MultiOutputNode):
+                if node is not root:
+                    raise ValueError("MultiOutputNode must be the DAG root")
+        if isinstance(root, MultiOutputNode):
+            for arg in root.args:
+                if not isinstance(arg, ClassMethodNode):
+                    raise ValueError("MultiOutputNode accepts bound "
+                                     "actor-method nodes only")
+                outputs.append(arg)
+            self._multi_output = True
+        elif isinstance(root, ClassMethodNode):
+            outputs = [root]
+            self._multi_output = False
+        else:
+            raise ValueError(f"cannot compile DAG rooted at {root!r}")
+        if self._input is None:
+            raise ValueError("compiled DAGs require an InputNode")
+
+        # ----------------------------------------------- channel planning
+        def edge_channel(producer_uid: int, consumer_uid) -> Channel:
+            return Channel(self._session,
+                           f"dag{self._dag_id}-{producer_uid}-{consumer_uid}",
+                           item_size=self._buffer,
+                           num_slots=self._max_inflight)
+
+        self._input_channels: List[Channel] = []
+        # per-actor ordered ops
+        actor_ops: Dict[str, List[dict]] = {}
+        actor_handles: Dict[str, Any] = {}
+        consumers: Dict[int, List[Tuple[str, int]]] = {}  # producer uid
+
+        for node in compute_nodes:
+            actor_id = node.actor.actor_id
+            actor_handles[actor_id] = node.actor
+            arg_specs = []
+            for arg in node.args:
+                if isinstance(arg, InputNode):
+                    ch = edge_channel(arg.uid, node.uid)
+                    self._input_channels.append(ch)
+                    arg_specs.append(("chan", ch))
+                elif isinstance(arg, ClassMethodNode):
+                    if arg.actor.actor_id == actor_id:
+                        arg_specs.append(("local", arg.uid))
+                    else:
+                        ch = edge_channel(arg.uid, node.uid)
+                        consumers.setdefault(arg.uid, []).append(ch)
+                        arg_specs.append(("chan", ch))
+                elif isinstance(arg, DAGNode):
+                    raise ValueError(f"unsupported upstream {arg!r}")
+                else:
+                    arg_specs.append(("const", arg))
+            actor_ops.setdefault(actor_id, []).append({
+                "uid": node.uid, "method": node.method_name,
+                "args": arg_specs, "out": []})
+
+        self._output_channels: List[Channel] = []
+        for out_node in outputs:
+            ch = edge_channel(out_node.uid, "driver")
+            consumers.setdefault(out_node.uid, []).append(ch)
+            self._output_channels.append(ch)
+
+        # attach output channels to the producing ops
+        for ops in actor_ops.values():
+            for op in ops:
+                op["out"] = consumers.get(op["uid"], [])
+
+        self._all_channels = list(self._input_channels) + [
+            ch for chans in consumers.values() for ch in chans]
+
+        # ------------------------------------------------- start the loops
+        self._loop_refs = []
+        for actor_id, ops in actor_ops.items():
+            handle = actor_handles[actor_id]
+            # dunder name bypasses ActorHandle.__getattr__'s privacy filter
+            ref = handle._actor_method("__rtpu_dag_loop__").remote(ops)
+            self._loop_refs.append(ref)
+        ray_tpu.get(self._loop_refs)  # loops confirmed started
+
+    # --------------------------------------------------------------- run
+
+    def execute(self, *args) -> CompiledDAGRef:
+        if self._torn_down:
+            raise RuntimeError("DAG was torn down")
+        if self._seq - self._next_fetch >= self._max_inflight:
+            raise RuntimeError(
+                f"{self._max_inflight} executions already in flight; call "
+                f".get() on earlier refs first (raise "
+                f"max_inflight_executions at compile time to pipeline "
+                f"deeper)")
+        value = args[0] if len(args) == 1 else args
+        for ch in self._input_channels:
+            ch.write(value)
+        ref = CompiledDAGRef(self, self._seq)
+        self._seq += 1
+        return ref
+
+    def _fetch(self, seq: int, timeout: Optional[float]):
+        while seq not in self._fetched:
+            if self._next_fetch > seq:
+                raise RuntimeError("result already consumed")
+            values = [ch.read(timeout=timeout)
+                      for ch in self._output_channels]
+            out = values if self._multi_output else values[0]
+            self._fetched[self._next_fetch] = out
+            self._next_fetch += 1
+        out = self._fetched.pop(seq)
+        from .loop_runner import _DagLoopError
+
+        for value in (out if self._multi_output else [out]):
+            if isinstance(value, _DagLoopError):
+                raise RuntimeError(
+                    f"compiled DAG op failed:\n{value.tb}")
+        return out
+
+    # ----------------------------------------------------------- teardown
+
+    def teardown(self) -> None:
+        if self._torn_down:
+            return
+        self._torn_down = True
+        for ch in self._input_channels:
+            try:
+                ch.write(None, sentinel=True, timeout=5)
+            except Exception:
+                ch.close()
+        # Drain each output until its sentinel propagates through.
+        for ch in self._output_channels:
+            for _ in range(64):
+                try:
+                    ch.read(timeout=10)
+                except (ChannelClosed, TimeoutError):
+                    break
+                except Exception:
+                    break
+        for ch in self._all_channels:
+            ch.close()
+            ch.unlink()
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass

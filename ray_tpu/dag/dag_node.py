@@ -1,0 +1,109 @@
+"""DAG IR nodes (ref: python/ray/dag/dag_node.py, class_node.py,
+input_node.py, output_node.py).
+
+`actor.method.bind(upstream)` builds the graph; `.execute(x)` runs it
+uncompiled through normal actor calls; `.experimental_compile()` returns a
+CompiledDAG running over shm channels.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional
+
+_node_counter = itertools.count()
+
+
+class DAGNode:
+    def __init__(self, args: tuple = ()):
+        self.uid = next(_node_counter)
+        self.args = args  # mix of DAGNode and constants
+
+    # ---------------------------------------------------------- traversal
+
+    def upstreams(self) -> List["DAGNode"]:
+        return [a for a in self.args if isinstance(a, DAGNode)]
+
+    def topo(self) -> List["DAGNode"]:
+        order: List[DAGNode] = []
+        seen = set()
+
+        def visit(node: "DAGNode"):
+            if node.uid in seen:
+                return
+            seen.add(node.uid)
+            for up in node.upstreams():
+                visit(up)
+            order.append(node)
+
+        visit(self)
+        return order
+
+    # ---------------------------------------------------------- execution
+
+    def execute(self, *input_args):
+        """Uncompiled execution through normal actor calls (ref:
+        dag_node.py execute). Returns ObjectRef(s)."""
+        results: Dict[int, Any] = {}
+        for node in self.topo():
+            node._execute_uncompiled(results, input_args)
+        return results[self.uid]
+
+    def _execute_uncompiled(self, results, input_args):
+        raise NotImplementedError
+
+    def experimental_compile(self, buffer_size_bytes: int = 4 << 20,
+                             ) -> "Any":
+        from .compiled_dag import CompiledDAG
+
+        return CompiledDAG(self, buffer_size_bytes=buffer_size_bytes)
+
+
+class InputNode(DAGNode):
+    """The driver-supplied input (ref: dag/input_node.py). Context-manager
+    form matches the reference:  `with InputNode() as inp: ...`"""
+
+    def __init__(self):
+        super().__init__(())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _execute_uncompiled(self, results, input_args):
+        results[self.uid] = (input_args[0] if len(input_args) == 1
+                             else input_args)
+
+
+class ClassMethodNode(DAGNode):
+    """One bound actor-method call (ref: dag/class_node.py)."""
+
+    def __init__(self, actor_handle, method_name: str, args: tuple):
+        super().__init__(args)
+        self.actor = actor_handle
+        self.method_name = method_name
+
+    def _execute_uncompiled(self, results, input_args):
+        resolved = [results[a.uid] if isinstance(a, DAGNode) else a
+                    for a in self.args]
+        method = getattr(self.actor, self.method_name)
+        results[self.uid] = method.remote(*resolved)
+
+    def __repr__(self):
+        return f"ClassMethodNode({self.method_name}@{self.actor.actor_id[:8]})"
+
+
+class MultiOutputNode(DAGNode):
+    """Marks multiple DAG leaves as the output (ref: dag/output_node.py)."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__(tuple(outputs))
+
+    def _execute_uncompiled(self, results, input_args):
+        import ray_tpu
+
+        refs = [results[a.uid] if isinstance(a, DAGNode) else a
+                for a in self.args]
+        results[self.uid] = refs

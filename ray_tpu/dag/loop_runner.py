@@ -1,0 +1,117 @@
+"""Actor-side compiled-DAG execution loop.
+
+Runs inside the actor's worker process on a dedicated thread (ref: the
+reference provisions per-actor executables the same way,
+compiled_dag_node.py _get_or_compile → actor loop tasks). Invariant: every
+iteration consumes EXACTLY ONE item from each input channel and produces
+exactly one item (value or error marker) on each output channel, so
+channels across the whole DAG stay in lockstep. A sentinel anywhere
+propagates to all outputs and ends the loop; a user exception travels
+downstream as a _DagLoopError so the driver raises it, and later
+executions still run (per-execution error semantics, like the reference).
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Any, Dict, List
+
+from ..runtime.channel import ChannelClosed
+
+
+class _DagLoopError:
+    """Marker carrying a remote traceback through the output channels."""
+
+    def __init__(self, tb: str):
+        self.tb = tb
+
+
+class _Abort(Exception):
+    def __init__(self, err: _DagLoopError):
+        self.err = err
+
+
+def run_dag_loop(instance: Any, ops: List[dict]) -> None:
+    while True:
+        local: Dict[int, Any] = {}
+        written: set = set()  # channel names written this iteration
+        closed = False
+        try:
+            for op_i, op in enumerate(ops):
+                args = []
+                for arg_i, (kind, spec) in enumerate(op["args"]):
+                    if kind == "const":
+                        args.append(spec)
+                    elif kind == "local":
+                        args.append(local[spec])
+                    else:
+                        value = spec.read()
+                        if isinstance(value, _DagLoopError):
+                            closed = _drain_rest(ops, op_i, arg_i)
+                            raise _Abort(value)
+                        args.append(value)
+                try:
+                    result = getattr(instance, op["method"])(*args)
+                except Exception:
+                    err = _DagLoopError(traceback.format_exc())
+                    raise _Abort(err)
+                local[op["uid"]] = result
+                try:
+                    for ch in op["out"]:
+                        ch.write(result)
+                        written.add(ch.name)
+                except ChannelClosed:
+                    raise
+                except Exception:
+                    # e.g. result too large for the channel buffer: turn it
+                    # into a per-execution error (the marker is small, so
+                    # the unwritten channels still get their one item)
+                    raise _Abort(_DagLoopError(traceback.format_exc()))
+        except ChannelClosed:
+            _propagate_sentinel(ops)
+            return
+        except _Abort as abort:
+            # Keep the one-item-per-iteration invariant: error goes to
+            # every output channel not already written this iteration.
+            for op in ops:
+                for ch in op["out"]:
+                    if ch.name not in written:
+                        try:
+                            ch.write(abort.err)
+                        except Exception:
+                            pass
+            if closed:
+                _propagate_sentinel(ops)
+                return
+
+
+def _drain_rest(ops: List[dict], op_i: int, arg_i: int) -> bool:
+    """After an upstream error, consume this iteration's remaining input
+    items so the next iteration starts aligned. Returns True if a sentinel
+    was hit (the DAG is shutting down)."""
+    closed = False
+    for later_op_i, op in enumerate(ops[op_i:], start=op_i):
+        for later_arg_i, (kind, spec) in enumerate(op["args"]):
+            if kind != "chan":
+                continue
+            if later_op_i == op_i and later_arg_i <= arg_i:
+                continue
+            try:
+                spec.read(timeout=10)
+            except ChannelClosed:
+                closed = True
+            except Exception:
+                pass
+    return closed
+
+
+def _propagate_sentinel(ops: List[dict]) -> None:
+    for op in ops:
+        for ch in op["out"]:
+            try:
+                ch.write(None, sentinel=True, timeout=5)
+            except Exception:
+                try:
+                    ch.close()
+                except Exception:
+                    pass

@@ -155,9 +155,10 @@ class Algorithm:
         for episode in episodes:
             self._timesteps_total += len(episode)
             # Sampler-cut fragments are partial; only real episode ends
-            # (env terminated or env-truncated at horizon) count as returns.
+            # (env terminated or env-truncated at horizon) count, and they
+            # report the FULL return including pre-cut fragments.
             if not episode.cut:
-                self._episode_returns.append(episode.total_reward)
+                self._episode_returns.append(episode.full_return)
 
     # ----------------------------------------------------- checkpointing
 

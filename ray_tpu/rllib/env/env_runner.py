@@ -8,19 +8,28 @@ Runs as a plain class (local mode) or behind `ray_tpu.remote` actors.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from .episodes import Episode
 
+logger = logging.getLogger(__name__)
+
 
 def _apply_platform(platform: Optional[str]) -> None:
-    """Pin this process's JAX backend BEFORE first use. RL env stepping and
-    small policy nets belong on CPU even when an accelerator is visible —
-    per-step forwards on a remote-tunneled device pay a round-trip each.
-    No-op if a backend is already initialized (e.g. driver-local mode)."""
+    """Pin this WORKER process's JAX backend before first use. RL env
+    stepping and small policy nets belong on CPU even when an accelerator
+    is visible — per-step forwards on a remote-tunneled device pay a
+    round-trip each. Never touches the driver process (local mode): that
+    would silently hide the TPU from the user's own JAX code."""
     if not platform or platform == "default":
+        return
+    from ...runtime.core import get_core
+
+    core = get_core(required=False)
+    if core is None or getattr(core, "mode", "driver") != "worker":
         return
     import jax
 
@@ -140,7 +149,10 @@ class SingleAgentEnvRunner:
                 episode.cut = True
                 episode.last_value = self._value_of(self._cur_obs[i])
                 out.append(episode)
-                self._episodes[i] = Episode()
+                # the continuation fragment carries the running return so
+                # the eventual terminal fragment reports the FULL episode
+                self._episodes[i] = Episode(
+                    prior_reward=episode.full_return)
         return out
 
     def _value_of(self, obs) -> float:
@@ -203,10 +215,24 @@ class EnvRunnerGroup:
                                      epsilon=epsilon, weights=weights)
                 for runner in self._remote]
         episodes: List[Episode] = []
+        last_error: Optional[Exception] = None
         for i, ref in enumerate(refs):
             try:
                 episodes.extend(ray_tpu.get(ref, timeout=120))
-            except Exception:
-                # Restart the failed runner (fault-tolerant manager).
+            except Exception as e:
+                # Restart the failed runner (fault-tolerant manager) —
+                # loudly, and escalate if NO runner produced data for
+                # several consecutive rounds (deterministic failures like a
+                # bad env spec must not silently spin forever).
+                logger.exception("env runner %d failed; restarting", i)
+                last_error = e
                 self._remote[i] = self._spawn(i)
+        if episodes:
+            self._empty_rounds = 0
+        else:
+            self._empty_rounds = getattr(self, "_empty_rounds", 0) + 1
+            if self._empty_rounds >= 3:
+                raise RuntimeError(
+                    "all env runners failed for 3 consecutive sample "
+                    "rounds; last error below") from last_error
         return episodes

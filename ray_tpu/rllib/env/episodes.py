@@ -22,6 +22,9 @@ class Episode:
     truncated: bool = False
     # fragment cut by the sampler mid-episode (not a real episode end)
     cut: bool = False
+    # reward accumulated by earlier fragments of the same env episode
+    # (carried across sample() boundaries so full returns are reported)
+    prior_reward: float = 0.0
     # bootstrap value for truncated fragments (GAE tail)
     last_value: float = 0.0
 
@@ -31,6 +34,11 @@ class Episode:
     @property
     def total_reward(self) -> float:
         return float(sum(self.rewards))
+
+    @property
+    def full_return(self) -> float:
+        """Whole-episode return including pre-cut fragments."""
+        return self.prior_reward + self.total_reward
 
     def to_batch(self) -> Dict[str, np.ndarray]:
         return {

@@ -1,0 +1,153 @@
+"""Shared-memory channels: the compiled-graph data plane.
+
+TPU-native equivalent of the reference's mutable plasma objects +
+SharedMemoryChannel (ref: src/ray/core_worker/
+experimental_mutable_object_manager.h:44 WriteAcquire/ReadAcquire;
+python/ray/experimental/channel/shared_memory_channel.py): a single-writer
+single-reader ring over an mmap'd file in the session dir. Writers park
+when the ring is full, readers when it is empty — no RPC, no control-plane
+hop, just mapped memory and counters (Linux mmap MAP_SHARED gives
+cross-process visibility; the GIL orders the counter writes after payload
+writes within each process).
+
+Layout: [write_count u64][read_count u64][closed u8][pad..64] then
+`num_slots` slots of [flag u8][len u32][payload item_size bytes].
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import pickle
+import struct
+import time
+from typing import Any, Optional
+
+_HEADER = 64
+_SLOT_META = 5  # flag u8 + len u32
+FLAG_DATA = 0
+FLAG_SENTINEL = 1
+
+DEFAULT_ITEM_SIZE = 4 << 20
+DEFAULT_SLOTS = 2
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class ChannelFull(Exception):
+    pass
+
+
+def _channel_dir(session_name: str) -> str:
+    base = ("/dev/shm" if os.path.isdir("/dev/shm") else "/tmp")
+    # same root as the object store's segments (object_store.py _shm_dir)
+    return os.path.join(base, f"rtpu_{session_name}", "channels")
+
+
+class Channel:
+    """One direction, one writer process, one reader process. Both ends
+    are constructed from the same (session, name); the first one creates
+    the backing file. Pickles to its coordinates."""
+
+    def __init__(self, session_name: str, name: str,
+                 item_size: int = DEFAULT_ITEM_SIZE,
+                 num_slots: int = DEFAULT_SLOTS):
+        self.session_name = session_name
+        self.name = name
+        self.item_size = item_size
+        self.num_slots = num_slots
+        self._slot_stride = _SLOT_META + item_size
+        self._size = _HEADER + num_slots * self._slot_stride
+        path = os.path.join(_channel_dir(session_name), name + ".ch")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        # O_CREAT without O_EXCL: both ends race-safely map the same file.
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+        try:
+            if os.fstat(fd).st_size < self._size:
+                os.ftruncate(fd, self._size)
+            self._mm = mmap.mmap(fd, self._size)
+        finally:
+            os.close(fd)
+        self._path = path
+
+    # ------------------------------------------------------------ counters
+
+    def _get_counts(self):
+        return struct.unpack_from("<QQ", self._mm, 0)
+
+    def _closed(self) -> bool:
+        return self._mm[16] == 1
+
+    def close(self) -> None:
+        """Mark closed: pending/parked readers and writers raise."""
+        self._mm[16] = 1
+
+    def unlink(self) -> None:
+        try:
+            os.unlink(self._path)
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------- write
+
+    def write(self, value: Any, timeout: Optional[float] = None,
+              sentinel: bool = False) -> None:
+        payload = b"" if sentinel else pickle.dumps(value, protocol=5)
+        if len(payload) > self.item_size:
+            raise ChannelFull(
+                f"serialized value of {len(payload)} bytes exceeds channel "
+                f"item_size {self.item_size}; pass a larger "
+                f"buffer_size_bytes at compile time")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spin = 0
+        while True:
+            write_count, read_count = self._get_counts()
+            if write_count - read_count < self.num_slots:
+                break
+            if self._closed():
+                raise ChannelClosed(self.name)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel {self.name} write timeout")
+            spin += 1
+            time.sleep(0 if spin < 100 else 0.0002)
+        slot = (write_count % self.num_slots) * self._slot_stride + _HEADER
+        flag = FLAG_SENTINEL if sentinel else FLAG_DATA
+        struct.pack_into("<BI", self._mm, slot, flag, len(payload))
+        self._mm[slot + _SLOT_META:slot + _SLOT_META + len(payload)] = payload
+        # publish AFTER the payload is in place
+        struct.pack_into("<Q", self._mm, 0, write_count + 1)
+
+    # -------------------------------------------------------------- read
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        """Returns the value; raises ChannelClosed on sentinel/close."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spin = 0
+        while True:
+            write_count, read_count = self._get_counts()
+            if read_count < write_count:
+                break
+            if self._closed():
+                raise ChannelClosed(self.name)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel {self.name} read timeout")
+            spin += 1
+            time.sleep(0 if spin < 100 else 0.0002)
+        slot = (read_count % self.num_slots) * self._slot_stride + _HEADER
+        flag, length = struct.unpack_from("<BI", self._mm, slot)
+        if flag == FLAG_SENTINEL:
+            struct.pack_into("<Q", self._mm, 8, read_count + 1)
+            raise ChannelClosed(self.name)
+        payload = bytes(
+            self._mm[slot + _SLOT_META:slot + _SLOT_META + length])
+        struct.pack_into("<Q", self._mm, 8, read_count + 1)
+        return pickle.loads(payload)
+
+    def __reduce__(self):
+        return (Channel, (self.session_name, self.name, self.item_size,
+                          self.num_slots))
+
+    def __repr__(self):
+        return f"Channel({self.name})"

@@ -205,6 +205,12 @@ class Executor:
 
     def _start_actor_task(self, spec: dict):
         method_name = spec["method"]
+        if method_name == "__rtpu_dag_loop__":
+            # Compiled-graph loop (ray_tpu/dag): runs on its own daemon
+            # thread for the DAG's lifetime; the call itself returns as
+            # soon as the loop is up so compile() can confirm startup.
+            self.exec_pool.submit(self._start_dag_loop, spec)
+            return
         method = getattr(type(self.actor_instance), method_name, None) \
             if self.actor_instance is not None else None
         if method is not None and inspect.iscoroutinefunction(method):
@@ -235,6 +241,27 @@ class Executor:
                     None, lambda: self._send_results(spec, result))
             except Exception as e:
                 self._send_error(spec, e)
+
+    def _start_dag_loop(self, spec: dict):
+        try:
+            from ..dag.loop_runner import run_dag_loop
+
+            (ops,), _ = self._unpack_args(spec)  # attaches the channels
+
+            def loop():
+                try:
+                    run_dag_loop(self.actor_instance, ops)
+                except BaseException:
+                    # A loop death outside run_dag_loop's own handling
+                    # would otherwise vanish with the daemon thread.
+                    traceback.print_exc()
+
+            thread = threading.Thread(target=loop, name="rtpu-dag-loop",
+                                      daemon=True)
+            thread.start()
+            self._send_results(spec, True)
+        except Exception as e:
+            self._send_error(spec, e)
 
     def _run_actor_sync(self, spec: dict):
         try:
