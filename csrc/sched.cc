@@ -1,0 +1,112 @@
+// Native cluster-scheduling core: feasibility + scoring over node
+// resource matrices.
+//
+// Equivalent of the reference's scheduling policy hot loop (ref:
+// src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.h:50 — prefer
+// the local node below a utilization threshold, otherwise top-k by score;
+// spread ref: spread_scheduling_policy.h; scorer ref:
+// cluster_resource_scheduler.cc). The Python control plane flattens node
+// resources into dense matrices once per decision batch and calls in —
+// the O(nodes x resources) scan runs native.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr double kEps = 1e-9;
+
+inline bool feasible(const double* avail, const double* req, int k) {
+  for (int j = 0; j < k; j++) {
+    if (req[j] > 0 && avail[j] < req[j] - kEps) return false;
+  }
+  return true;
+}
+
+// Max post-placement utilization across resources (lower = emptier).
+inline double score(const double* avail, const double* total,
+                    const double* req, int k) {
+  double s = 0.0;
+  for (int j = 0; j < k; j++) {
+    if (total[j] <= 0) continue;
+    double used = total[j] - avail[j] + req[j];
+    double u = used / total[j];
+    if (u > s) s = u;
+  }
+  return s;
+}
+
+inline uint32_t next_rand(uint32_t* state) {
+  uint32_t x = *state;
+  x ^= x << 13;
+  x ^= x >> 17;
+  x ^= x << 5;
+  return *state = x;
+}
+
+}  // namespace
+
+extern "C" {
+
+// avail/total: n*k row-major matrices; req: k.
+// strategy: 0 = HYBRID (prefer local under threshold, else best score),
+//           1 = SPREAD (feasible node with lowest current utilization),
+//           2 = RANDOM (uniform over feasible).
+// local_index: index of the caller's node, or -1.
+// Returns the chosen node index, or -1 if no feasible node.
+int rtpu_sched_pick(const double* avail, const double* total, int n, int k,
+                    const double* req, int strategy, int local_index,
+                    double hybrid_threshold, uint32_t seed) {
+  uint32_t rng = seed | 1;
+  if (strategy == 0 && local_index >= 0 && local_index < n) {
+    const double* la = avail + static_cast<int64_t>(local_index) * k;
+    const double* lt = total + static_cast<int64_t>(local_index) * k;
+    if (feasible(la, req, k) &&
+        score(la, lt, req, k) <= hybrid_threshold + kEps) {
+      return local_index;
+    }
+  }
+  if (strategy == 2) {
+    int count = 0, pick = -1;
+    for (int i = 0; i < n; i++) {
+      if (feasible(avail + static_cast<int64_t>(i) * k, req, k)) {
+        count++;
+        if (next_rand(&rng) % count == 0) pick = i;  // reservoir sample
+      }
+    }
+    return pick;
+  }
+  int best = -1;
+  double best_score = 1e300;
+  for (int i = 0; i < n; i++) {
+    const double* a = avail + static_cast<int64_t>(i) * k;
+    const double* t = total + static_cast<int64_t>(i) * k;
+    if (!feasible(a, req, k)) continue;
+    // Both policies score by POST-placement utilization (matching the
+    // Python implementation they accelerate; scheduling.py
+    // _utilization_after); SPREAD is deterministic, HYBRID randomizes
+    // among near-equal nodes so they share load.
+    double s = score(a, t, req, k);
+    if (best < 0 || s < best_score - kEps) {
+      best_score = s;
+      best = i;
+    } else if (strategy != 1 && s < best_score + kEps &&
+               (next_rand(&rng) & 1)) {
+      best = i;  // near-tie: randomize (HYBRID only)
+    }
+  }
+  return best;
+}
+
+// Batch feasibility: out[i] = 1 if node i can host req. Returns count.
+int rtpu_sched_feasible_mask(const double* avail, int n, int k,
+                             const double* req, uint8_t* out) {
+  int count = 0;
+  for (int i = 0; i < n; i++) {
+    out[i] = feasible(avail + static_cast<int64_t>(i) * k, req, k) ? 1 : 0;
+    count += out[i];
+  }
+  return count;
+}
+
+}  // extern "C"

@@ -1,0 +1,476 @@
+// Native shared-memory object store pool.
+//
+// TPU-native equivalent of the reference's plasma store core (ref:
+// src/ray/object_manager/plasma/store.h:55 PlasmaStore; allocator ref:
+// plasma/dlmalloc.cc; eviction ref: plasma/eviction_policy.cc LRU): one
+// mmap'd pool per session shared by every process on the host, a
+// boundary-tag first-fit allocator with coalescing, a keyed object table
+// (open hashing), refcounts, seal semantics and LRU eviction of sealed
+// unreferenced objects. Unlike the reference there is no store server
+// process: clients mutate the pool directly under a process-shared robust
+// mutex (crashed holders are recovered via EOWNERDEAD), which removes the
+// client<->server IPC round-trip from every create/get.
+//
+// All offsets are relative to the pool base so every process can map the
+// pool at a different address. Offset 0 means "null".
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52545055504f4f4cULL;  // "RTPUPOOL"
+constexpr uint64_t kAlign = 64;
+constexpr uint64_t kKeyLen = 20;
+constexpr uint64_t kFooter = 8;
+// payload begins at this offset within a block so that buffers stay
+// 64-byte aligned (blocks themselves sit at 64-aligned offsets)
+constexpr uint64_t kPayloadOff = 128;
+
+struct PoolHeader {
+  uint64_t magic;
+  uint64_t pool_size;
+  uint64_t heap_start;
+  uint64_t nbuckets;
+  pthread_mutex_t mutex;
+  uint64_t free_head;
+  uint64_t lru_head;  // most recently used
+  uint64_t lru_tail;  // eviction candidate
+  uint64_t used_bytes;
+  uint64_t num_objects;
+  uint64_t evictions;
+  uint64_t reserved[8];
+  // uint64_t buckets[nbuckets] follows
+};
+
+struct Block {
+  uint64_t total;      // whole block size incl. header+footer
+  uint64_t data_size;  // payload bytes requested
+  uint8_t key[kKeyLen];
+  uint32_t refcount;
+  uint8_t sealed;
+  uint8_t is_free;
+  uint8_t pending_delete;
+  uint8_t pad;
+  uint64_t fnext, fprev;  // free list links
+  uint64_t lnext, lprev;  // LRU links (allocated+sealed only)
+  uint64_t bnext;         // hash bucket chain
+};
+
+struct Pool {
+  uint8_t* base;
+  uint64_t size;
+  int fd;
+};
+
+inline PoolHeader* H(Pool* p) { return reinterpret_cast<PoolHeader*>(p->base); }
+inline uint64_t* buckets(Pool* p) {
+  return reinterpret_cast<uint64_t*>(p->base + sizeof(PoolHeader));
+}
+inline Block* B(Pool* p, uint64_t off) {
+  return off ? reinterpret_cast<Block*>(p->base + off) : nullptr;
+}
+inline uint64_t off_of(Pool* p, Block* b) {
+  return reinterpret_cast<uint8_t*>(b) - p->base;
+}
+inline void set_footer(Pool* p, Block* b) {
+  uint64_t off = off_of(p, b);
+  *reinterpret_cast<uint64_t*>(p->base + off + b->total - kFooter) =
+      (b->total << 1) | (b->is_free ? 1 : 0);
+}
+inline uint64_t hash_key(const uint8_t* key) {
+  uint64_t h = 1469598103934665603ULL;
+  for (uint64_t i = 0; i < kKeyLen; i++) h = (h ^ key[i]) * 1099511628211ULL;
+  return h;
+}
+
+void lock(Pool* p) {
+  int rc = pthread_mutex_lock(&H(p)->mutex);
+  if (rc == EOWNERDEAD) pthread_mutex_consistent(&H(p)->mutex);
+}
+void unlock(Pool* p) { pthread_mutex_unlock(&H(p)->mutex); }
+
+// ------------------------------------------------------------- free list
+
+void free_list_push(Pool* p, Block* b) {
+  PoolHeader* h = H(p);
+  b->is_free = 1;
+  b->fprev = 0;
+  b->fnext = h->free_head;
+  if (h->free_head) B(p, h->free_head)->fprev = off_of(p, b);
+  h->free_head = off_of(p, b);
+  set_footer(p, b);
+}
+
+void free_list_remove(Pool* p, Block* b) {
+  PoolHeader* h = H(p);
+  if (b->fprev)
+    B(p, b->fprev)->fnext = b->fnext;
+  else
+    h->free_head = b->fnext;
+  if (b->fnext) B(p, b->fnext)->fprev = b->fprev;
+  b->is_free = 0;
+}
+
+// Coalesce b with free neighbours; b must already be free + unlinked.
+Block* coalesce(Pool* p, Block* b) {
+  PoolHeader* h = H(p);
+  uint64_t off = off_of(p, b);
+  // next neighbour
+  uint64_t next_off = off + b->total;
+  if (next_off < h->pool_size) {
+    Block* next = B(p, next_off);
+    if (next->is_free) {
+      free_list_remove(p, next);
+      b->total += next->total;
+    }
+  }
+  // previous neighbour via its footer
+  if (off > h->heap_start) {
+    uint64_t tag = *reinterpret_cast<uint64_t*>(p->base + off - kFooter);
+    if (tag & 1) {
+      uint64_t prev_total = tag >> 1;
+      Block* prev = B(p, off - prev_total);
+      free_list_remove(p, prev);
+      prev->total += b->total;
+      b = prev;
+    }
+  }
+  b->is_free = 1;
+  set_footer(p, b);
+  return b;
+}
+
+// ------------------------------------------------------------------ LRU
+
+void lru_push_front(Pool* p, Block* b) {
+  PoolHeader* h = H(p);
+  b->lprev = 0;
+  b->lnext = h->lru_head;
+  if (h->lru_head) B(p, h->lru_head)->lprev = off_of(p, b);
+  h->lru_head = off_of(p, b);
+  if (!h->lru_tail) h->lru_tail = off_of(p, b);
+}
+
+void lru_remove(Pool* p, Block* b) {
+  PoolHeader* h = H(p);
+  if (b->lprev)
+    B(p, b->lprev)->lnext = b->lnext;
+  else if (h->lru_head == off_of(p, b))
+    h->lru_head = b->lnext;
+  if (b->lnext)
+    B(p, b->lnext)->lprev = b->lprev;
+  else if (h->lru_tail == off_of(p, b))
+    h->lru_tail = b->lprev;
+  b->lnext = b->lprev = 0;
+}
+
+// ---------------------------------------------------------------- table
+
+Block* table_find_any(Pool* p, const uint8_t* key, bool pending) {
+  uint64_t idx = hash_key(key) % H(p)->nbuckets;
+  for (uint64_t off = buckets(p)[idx]; off; off = B(p, off)->bnext) {
+    Block* b = B(p, off);
+    if (memcmp(b->key, key, kKeyLen) == 0 &&
+        (pending || !b->pending_delete))
+      return b;
+  }
+  return nullptr;
+}
+
+// Active (non-pending) entry only — what create/get/contains see.
+Block* table_find(Pool* p, const uint8_t* key) {
+  return table_find_any(p, key, false);
+}
+
+void table_insert(Pool* p, Block* b) {
+  uint64_t idx = hash_key(b->key) % H(p)->nbuckets;
+  b->bnext = buckets(p)[idx];
+  buckets(p)[idx] = off_of(p, b);
+}
+
+void table_remove(Pool* p, Block* b) {
+  uint64_t idx = hash_key(b->key) % H(p)->nbuckets;
+  uint64_t* slot = &buckets(p)[idx];
+  for (uint64_t off = *slot; off; off = B(p, off)->bnext) {
+    if (off == off_of(p, b)) {
+      *slot = b->bnext;
+      return;
+    }
+    slot = &B(p, off)->bnext;
+  }
+}
+
+void destroy_object(Pool* p, Block* b) {
+  PoolHeader* h = H(p);
+  table_remove(p, b);
+  if (b->sealed && !b->pending_delete) lru_remove(p, b);
+  h->used_bytes -= b->total;
+  h->num_objects--;
+  b = coalesce(p, b);
+  free_list_push(p, b);
+}
+
+// returns bytes freed
+uint64_t evict_lru(Pool* p, uint64_t needed) {
+  PoolHeader* h = H(p);
+  uint64_t freed = 0;
+  uint64_t off = h->lru_tail;
+  while (off && freed < needed) {
+    Block* b = B(p, off);
+    uint64_t prev = b->lprev;
+    if (b->refcount == 0 && b->sealed) {
+      freed += b->total;
+      destroy_object(p, b);
+      h->evictions++;
+    }
+    off = prev;
+  }
+  return freed;
+}
+
+int64_t alloc_block(Pool* p, uint64_t need_total) {
+  // first fit
+  for (uint64_t off = H(p)->free_head; off; off = B(p, off)->fnext) {
+    Block* b = B(p, off);
+    if (b->total >= need_total) {
+      free_list_remove(p, b);
+      uint64_t remainder = b->total - need_total;
+      if (remainder >= sizeof(Block) + kFooter + kAlign) {
+        b->total = need_total;
+        Block* rest = B(p, off + need_total);
+        memset(rest, 0, sizeof(Block));
+        rest->total = remainder;
+        free_list_push(p, rest);
+        set_footer(p, rest);
+      }
+      b->is_free = 0;
+      set_footer(p, b);
+      return static_cast<int64_t>(off);
+    }
+  }
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (idempotent) + initialize the pool file. Returns 0 on success.
+int rtpu_pool_create(const char* path, uint64_t pool_size,
+                     uint64_t nbuckets) {
+  int fd = open(path, O_RDWR | O_CREAT | O_EXCL, 0600);
+  if (fd < 0) {
+    if (errno == EEXIST) return 0;  // another process initialized it
+    return -errno;
+  }
+  if (ftruncate(fd, static_cast<off_t>(pool_size)) != 0) {
+    int e = errno;
+    close(fd);
+    unlink(path);
+    return -e;
+  }
+  void* mem =
+      mmap(nullptr, pool_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return -errno;
+  Pool pool{static_cast<uint8_t*>(mem), pool_size, -1};
+  Pool* p = &pool;
+  PoolHeader* h = H(p);
+  memset(h, 0, sizeof(PoolHeader));
+  h->pool_size = pool_size;
+  h->nbuckets = nbuckets;
+  memset(buckets(p), 0, nbuckets * sizeof(uint64_t));
+  uint64_t heap = sizeof(PoolHeader) + nbuckets * sizeof(uint64_t);
+  heap = (heap + kAlign - 1) & ~(kAlign - 1);
+  h->heap_start = heap;
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &attr);
+  pthread_mutexattr_destroy(&attr);
+  Block* first = B(p, heap);
+  memset(first, 0, sizeof(Block));
+  first->total = pool_size - heap;
+  free_list_push(p, first);
+  __atomic_store_n(&h->magic, kMagic, __ATOMIC_RELEASE);
+  munmap(mem, pool_size);
+  return 0;
+}
+
+void* rtpu_pool_open(const char* path) {
+  for (int attempt = 0; attempt < 2000; attempt++) {
+    int fd = open(path, O_RDWR);
+    if (fd < 0) return nullptr;
+    struct stat st;
+    if (fstat(fd, &st) != 0 || st.st_size < (off_t)sizeof(PoolHeader)) {
+      close(fd);
+      usleep(1000);
+      continue;
+    }
+    uint64_t size = static_cast<uint64_t>(st.st_size);
+    void* mem = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    close(fd);
+    if (mem == MAP_FAILED) return nullptr;
+    PoolHeader* h = static_cast<PoolHeader*>(mem);
+    if (__atomic_load_n(&h->magic, __ATOMIC_ACQUIRE) == kMagic) {
+      Pool* p = new Pool{static_cast<uint8_t*>(mem), size, -1};
+      return p;
+    }
+    munmap(mem, size);  // not initialized yet; retry
+    usleep(1000);
+  }
+  return nullptr;
+}
+
+void rtpu_pool_close(void* handle) {
+  Pool* p = static_cast<Pool*>(handle);
+  if (!p) return;
+  munmap(p->base, p->size);
+  delete p;
+}
+
+// Returns payload offset (>0), or -1 exists, -2 out of memory.
+int64_t rtpu_store_create(void* handle, const uint8_t* key,
+                          uint64_t data_size) {
+  Pool* p = static_cast<Pool*>(handle);
+  lock(p);
+  if (table_find(p, key)) {
+    unlock(p);
+    return -1;
+  }
+  uint64_t need = kPayloadOff + data_size + kFooter;
+  need = (need + kAlign - 1) & ~(kAlign - 1);
+  int64_t off = alloc_block(p, need);
+  if (off < 0) {
+    evict_lru(p, need);
+    off = alloc_block(p, need);
+  }
+  if (off < 0) {
+    unlock(p);
+    return -2;
+  }
+  Block* b = B(p, static_cast<uint64_t>(off));
+  memcpy(b->key, key, kKeyLen);
+  b->data_size = data_size;
+  b->refcount = 1;
+  b->sealed = 0;
+  b->pending_delete = 0;  // recycled blocks may carry a stale flag
+  b->lnext = b->lprev = b->bnext = 0;
+  table_insert(p, b);
+  PoolHeader* h = H(p);
+  h->used_bytes += b->total;
+  h->num_objects++;
+  unlock(p);
+  return off + static_cast<int64_t>(kPayloadOff);
+}
+
+int rtpu_store_seal(void* handle, const uint8_t* key) {
+  Pool* p = static_cast<Pool*>(handle);
+  lock(p);
+  Block* b = table_find(p, key);
+  if (!b) {
+    unlock(p);
+    return -3;
+  }
+  if (!b->sealed) {
+    b->sealed = 1;
+    lru_push_front(p, b);
+  }
+  // The creator's ref stays as the owner pin: distributed refcounting
+  // (core.py) frees owned objects via delete; only objects whose every
+  // ref (incl. the pin) was released become LRU-evictable.
+  unlock(p);
+  return 0;
+}
+
+// Returns payload offset (>0) with refcount bumped; -3 missing, -4 unsealed.
+int64_t rtpu_store_get(void* handle, const uint8_t* key, uint64_t* size_out) {
+  Pool* p = static_cast<Pool*>(handle);
+  lock(p);
+  Block* b = table_find(p, key);
+  if (!b) {
+    unlock(p);
+    return -3;
+  }
+  if (!b->sealed) {
+    unlock(p);
+    return -4;
+  }
+  b->refcount++;
+  lru_remove(p, b);
+  lru_push_front(p, b);
+  *size_out = b->data_size;
+  int64_t off = static_cast<int64_t>(off_of(p, b) + kPayloadOff);
+  unlock(p);
+  return off;
+}
+
+int rtpu_store_release(void* handle, const uint8_t* key) {
+  Pool* p = static_cast<Pool*>(handle);
+  lock(p);
+  Block* b = table_find(p, key);
+  if (!b) b = table_find_any(p, key, true);  // pending-deleted entry
+  if (b && b->refcount > 0) b->refcount--;
+  if (b && b->pending_delete && b->refcount == 0) destroy_object(p, b);
+  unlock(p);
+  return b ? 0 : -3;
+}
+
+int rtpu_store_delete(void* handle, const uint8_t* key) {
+  Pool* p = static_cast<Pool*>(handle);
+  lock(p);
+  Block* b = table_find(p, key);
+  if (!b) {
+    unlock(p);
+    return -3;
+  }
+  // Drop the owner pin taken at create/seal time.
+  if (b->refcount > 0) b->refcount--;
+  if (b->refcount == 0) {
+    destroy_object(p, b);
+  } else {
+    // Live readers (zero-copy views, other processes) still hold refs:
+    // hide the entry and reclaim when the last ref releases (plasma
+    // defers deletion the same way).
+    if (b->sealed) lru_remove(p, b);
+    b->pending_delete = 1;
+  }
+  unlock(p);
+  return 0;
+}
+
+int rtpu_store_contains(void* handle, const uint8_t* key) {
+  Pool* p = static_cast<Pool*>(handle);
+  lock(p);
+  Block* b = table_find(p, key);
+  int ok = (b && b->sealed) ? 1 : 0;
+  unlock(p);
+  return ok;
+}
+
+// out: [used_bytes, pool_size, num_objects, evictions]
+void rtpu_store_stats(void* handle, uint64_t* out) {
+  Pool* p = static_cast<Pool*>(handle);
+  lock(p);
+  PoolHeader* h = H(p);
+  out[0] = h->used_bytes;
+  out[1] = h->pool_size;
+  out[2] = h->num_objects;
+  out[3] = h->evictions;
+  unlock(p);
+}
+
+uint8_t* rtpu_pool_base(void* handle) {
+  return static_cast<Pool*>(handle)->base;
+}
+
+}  // extern "C"

@@ -1,0 +1,221 @@
+"""ctypes bindings for the native runtime core (csrc/).
+
+The reference's native layer binds through Cython (ref:
+python/ray/_raylet.pyx); this image has no pybind11, so the C ABI +
+ctypes is the binding (zero build-time Python deps). `ensure_built()`
+compiles csrc/ on first use when a toolchain is present; every native
+feature has a pure-Python fallback, so the framework still works where
+there is no compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "librtpu.so")
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "csrc")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _stale() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    so_mtime = os.path.getmtime(_SO)
+    for name in os.listdir(_CSRC):
+        if name.endswith(".cc"):
+            if os.path.getmtime(os.path.join(_CSRC, name)) > so_mtime:
+                return True
+    return False
+
+
+def ensure_built() -> bool:
+    """Build librtpu.so if missing/stale. Returns availability."""
+    global _build_failed
+    with _lock:
+        if os.path.exists(_SO) and not _stale():
+            return True
+        if _build_failed:
+            return False
+        try:
+            subprocess.run(["make", "-C", _CSRC], check=True,
+                           capture_output=True, timeout=120)
+            return True
+        except Exception:
+            _build_failed = True
+            return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded library, or None when unavailable (no toolchain)."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if os.environ.get("RTPU_NATIVE", "1") == "0":
+        return None
+    if not ensure_built():
+        return None
+    with _lock:
+        if _lib is None:
+            lib = ctypes.CDLL(_SO)
+            lib.rtpu_pool_create.restype = ctypes.c_int
+            lib.rtpu_pool_create.argtypes = [ctypes.c_char_p,
+                                             ctypes.c_uint64,
+                                             ctypes.c_uint64]
+            lib.rtpu_pool_open.restype = ctypes.c_void_p
+            lib.rtpu_pool_open.argtypes = [ctypes.c_char_p]
+            lib.rtpu_pool_close.argtypes = [ctypes.c_void_p]
+            lib.rtpu_pool_base.restype = ctypes.POINTER(ctypes.c_ubyte)
+            lib.rtpu_pool_base.argtypes = [ctypes.c_void_p]
+            lib.rtpu_store_create.restype = ctypes.c_int64
+            lib.rtpu_store_create.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_char_p,
+                                              ctypes.c_uint64]
+            lib.rtpu_store_seal.restype = ctypes.c_int
+            lib.rtpu_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.rtpu_store_get.restype = ctypes.c_int64
+            lib.rtpu_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                           ctypes.POINTER(ctypes.c_uint64)]
+            lib.rtpu_store_release.restype = ctypes.c_int
+            lib.rtpu_store_release.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_char_p]
+            lib.rtpu_store_delete.restype = ctypes.c_int
+            lib.rtpu_store_delete.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_char_p]
+            lib.rtpu_store_contains.restype = ctypes.c_int
+            lib.rtpu_store_contains.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_char_p]
+            lib.rtpu_store_stats.argtypes = [ctypes.c_void_p,
+                                             ctypes.POINTER(
+                                                 ctypes.c_uint64 * 4)]
+            lib.rtpu_sched_pick.restype = ctypes.c_int
+            lib.rtpu_sched_pick.argtypes = [
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_double), ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_double), ctypes.c_int, ctypes.c_int,
+                ctypes.c_double, ctypes.c_uint32]
+            _lib = lib
+    return _lib
+
+
+class OutOfMemory(Exception):
+    pass
+
+
+class NativePool:
+    """One mmap'd object pool shared by all processes of a session
+    (plasma-store equivalent; see csrc/store.cc)."""
+
+    KEY_LEN = 20
+
+    def __init__(self, path: str, capacity: int = 256 << 20,
+                 nbuckets: int = 4096):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._path = path
+        rc = lib.rtpu_pool_create(path.encode(), capacity, nbuckets)
+        if rc != 0:
+            raise OSError(f"pool create failed: {rc}")
+        self._handle = lib.rtpu_pool_open(path.encode())
+        if not self._handle:
+            raise OSError("pool open failed")
+        base = lib.rtpu_pool_base(self._handle)
+        # view over the whole pool for zero-copy reads
+        stats = (ctypes.c_uint64 * 4)()
+        lib.rtpu_store_stats(self._handle, ctypes.byref(stats))
+        self._pool_size = stats[1]
+        arr = (ctypes.c_ubyte * self._pool_size).from_address(
+            ctypes.addressof(base.contents))
+        self._mem = memoryview(arr).cast("B")
+
+    def _key(self, key: bytes) -> bytes:
+        assert len(key) == self.KEY_LEN, key
+        return key
+
+    def create(self, key: bytes, size: int) -> memoryview:
+        off = self._lib.rtpu_store_create(self._handle, self._key(key), size)
+        if off == -1:
+            raise FileExistsError(key.hex())
+        if off == -2:
+            raise OutOfMemory(f"pool full allocating {size} bytes")
+        return self._mem[off:off + size]
+
+    def seal(self, key: bytes) -> None:
+        self._lib.rtpu_store_seal(self._handle, self._key(key))
+
+    def get(self, key: bytes) -> Optional[memoryview]:
+        """Zero-copy view; pairs with release()."""
+        raw = self.get_raw(key)
+        if raw is None:
+            return None
+        off, size = raw
+        return self._mem[off:off + size]
+
+    def get_raw(self, key: bytes):
+        """(file_offset, size) with the refcount bumped, or None. Callers
+        that hand out zero-copy views should map their own window over the
+        pool file at this offset so alias liveness is detectable at
+        close() time (buffer exports root at the mmap object)."""
+        size = ctypes.c_uint64()
+        off = self._lib.rtpu_store_get(self._handle, self._key(key),
+                                       ctypes.byref(size))
+        if off < 0:
+            return None
+        return int(off), int(size.value)
+
+    def release(self, key: bytes) -> None:
+        self._lib.rtpu_store_release(self._handle, self._key(key))
+
+    def delete(self, key: bytes) -> None:
+        self._lib.rtpu_store_delete(self._handle, self._key(key))
+
+    def contains(self, key: bytes) -> bool:
+        return bool(self._lib.rtpu_store_contains(self._handle,
+                                                  self._key(key)))
+
+    def stats(self) -> dict:
+        raw = (ctypes.c_uint64 * 4)()
+        self._lib.rtpu_store_stats(self._handle, ctypes.byref(raw))
+        return {"used_bytes": raw[0], "capacity": raw[1],
+                "num_objects": raw[2], "evictions": raw[3]}
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.rtpu_pool_close(self._handle)
+            self._handle = None
+
+
+STRATEGY_CODES = {"HYBRID": 0, "SPREAD": 1, "RANDOM": 2}
+
+
+def native_pick(avail, total, req, strategy: str, local_index: int = -1,
+                hybrid_threshold: float = 0.5, seed: int = 1):
+    """avail/total: list of per-node resource lists (n x k); req: k floats.
+    Returns node index or None. Falls back to None when unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(avail)
+    k = len(req)
+    if n == 0:
+        return -1
+    import numpy as np
+
+    flat_a = np.ascontiguousarray(avail, dtype=np.float64)
+    flat_t = np.ascontiguousarray(total, dtype=np.float64)
+    flat_r = np.ascontiguousarray(req, dtype=np.float64)
+    dptr = ctypes.POINTER(ctypes.c_double)
+    idx = lib.rtpu_sched_pick(
+        flat_a.ctypes.data_as(dptr), flat_t.ctypes.data_as(dptr), n, k,
+        flat_r.ctypes.data_as(dptr),
+        STRATEGY_CODES.get(strategy, 0), local_index, hybrid_threshold,
+        seed)
+    return idx

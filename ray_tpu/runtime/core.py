@@ -29,7 +29,7 @@ from .. import exceptions
 from . import serialization
 from .config import get_config
 from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
-from .object_store import ObjectStoreClient
+from .object_store import make_store_client
 from .rpc import EventLoopThread, RpcClient, RpcServer, ConnectionLost, RemoteHandlerError
 
 _core_lock = threading.Lock()
@@ -157,7 +157,7 @@ class CoreWorker:
                                     notify_handlers={"pubsub": self._on_pubsub,
                                                      "shutdown": self._on_shutdown_ntf})
         self.nodelet = RpcClient(nodelet_addr)
-        self.store = ObjectStoreClient(session_name)
+        self.store = make_store_client(session_name)
 
         self.memory_store: Dict[ObjectID, Any] = {}
         self._events: Dict[ObjectID, asyncio.Event] = {}

@@ -165,6 +165,155 @@ class ObjectStoreClient:
             return None
 
 
+class NativeObjectStoreClient:
+    """ObjectStoreClient backed by the native C++ pool (csrc/store.cc):
+    one mmap'd slab for the whole session instead of a file per object, a
+    native boundary-tag allocator, and LRU eviction of sealed unreferenced
+    objects — the plasma-store architecture (ref: plasma/store.h:55) minus
+    the store server process. Same interface + pinning semantics as the
+    pure-Python client above."""
+
+    _KEY_PAD = b"\x00" * 4  # ObjectID is 16 bytes; pool keys are 20
+
+    def __init__(self, session_name: str, pool):
+        self.session_name = session_name
+        self._pool = pool
+        # reads map their own window over the pool file: buffer exports
+        # (numpy zero-copy arrays, pickle out-of-band buffers) root at the
+        # mmap object, so close() raising BufferError is the alias-liveness
+        # signal (plasma's client works the same way; ref: plasma/client.cc
+        # mmap-per-object + Release)
+        self._fd = os.open(pool._path, os.O_RDWR)
+        self._pinned: Dict[ObjectID, List[mmap.mmap]] = {}
+        # release() was requested but zero-copy aliases were still alive;
+        # swept opportunistically until the aliases die
+        self._zombies: Dict[ObjectID, List[mmap.mmap]] = {}
+
+    def _key(self, oid: ObjectID) -> bytes:
+        return oid.binary() + self._KEY_PAD
+
+    # ---- write path ----
+    def put_serialized(self, oid: ObjectID,
+                       sv: serialization.SerializedValue) -> int:
+        meta = sv.meta
+        offsets: List[Tuple[int, int]] = []
+        cursor = _aligned(
+            _HDR.size + len(meta) + 8 * (1 + 2 * len(sv.buffers)))
+        header_tail = struct.pack(">Q", len(sv.buffers))
+        raws = [b.raw() for b in sv.buffers]
+        for raw in raws:
+            offsets.append((cursor, len(raw)))
+            header_tail += struct.pack(">QQ", cursor, len(raw))
+            cursor = _aligned(cursor + len(raw))
+        total = cursor
+        key = self._key(oid)
+        try:
+            mv = self._pool.create(key, max(total, 1))
+        except FileExistsError:
+            return total  # idempotent double-put
+        pos = 0
+        mv[pos:pos + _HDR.size] = _HDR.pack(len(meta)); pos += _HDR.size
+        mv[pos:pos + len(meta)] = meta; pos += len(meta)
+        mv[pos:pos + len(header_tail)] = header_tail
+        for (off, ln), raw in zip(offsets, raws):
+            mv[off:off + ln] = raw
+        mv.release()
+        self._pool.seal(key)
+        return total
+
+    def put(self, oid: ObjectID, value: Any) -> int:
+        return self.put_serialized(oid, serialization.serialize(value))
+
+    # ---- read path ----
+    def contains(self, oid: ObjectID) -> bool:
+        return self._pool.contains(self._key(oid))
+
+    def get(self, oid: ObjectID) -> Any:
+        self._sweep_zombies()
+        raw = self._pool.get_raw(self._key(oid))
+        if raw is None:
+            raise FileNotFoundError(oid.hex())
+        file_off, size = raw
+        page = file_off & ~(mmap.ALLOCATIONGRANULARITY - 1)
+        mm = mmap.mmap(self._fd, (file_off - page) + size, offset=page)
+        mv = memoryview(mm)[file_off - page:file_off - page + size]
+        (meta_len,) = _HDR.unpack_from(mv, 0)
+        pos = _HDR.size
+        meta = bytes(mv[pos:pos + meta_len]); pos += meta_len
+        (nbuf,) = struct.unpack_from(">Q", mv, pos); pos += 8
+        buffers = []
+        for _ in range(nbuf):
+            off, ln = struct.unpack_from(">QQ", mv, pos); pos += 16
+            buffers.append(mv[off:off + ln])
+        value = serialization.deserialize(meta, buffers)
+        del buffers, mv
+        # pool refcount stays bumped until release(); mm pins this process
+        self._pinned.setdefault(oid, []).append(mm)
+        return value
+
+    def release(self, oid: ObjectID):
+        self._sweep_zombies()
+        entries = self._pinned.pop(oid, None)
+        if entries is None:
+            return
+        for mm in entries:
+            try:
+                mm.close()
+                self._pool.release(self._key(oid))
+            except BufferError:
+                # zero-copy aliases still alive; retry on later sweeps
+                self._zombies.setdefault(oid, []).append(mm)
+
+    def _sweep_zombies(self):
+        if not self._zombies:
+            return
+        for oid in list(self._zombies):
+            remaining = []
+            for mm in self._zombies[oid]:
+                try:
+                    mm.close()
+                    self._pool.release(self._key(oid))
+                except BufferError:
+                    remaining.append(mm)
+            if remaining:
+                self._zombies[oid] = remaining
+            else:
+                del self._zombies[oid]
+
+    def delete(self, oid: ObjectID):
+        self.release(oid)
+        self._pool.delete(self._key(oid))
+
+    def size_of(self, oid: ObjectID) -> Optional[int]:
+        mv = self._pool.get(self._key(oid))
+        if mv is None:
+            return None
+        size = len(mv)
+        mv.release()
+        self._pool.release(self._key(oid))
+        return size
+
+    def stats(self) -> dict:
+        return self._pool.stats()
+
+
+def make_store_client(session_name: str):
+    """Native pool when the toolchain/lib is available (default),
+    pure-Python file-per-object store otherwise or with RTPU_NATIVE=0."""
+    if os.environ.get("RTPU_NATIVE", "1") != "0":
+        try:
+            from .._native import NativePool
+
+            capacity = int(os.environ.get("RTPU_POOL_SIZE", 256 << 20))
+            os.makedirs(_shm_dir(session_name), exist_ok=True)
+            pool = NativePool(os.path.join(_shm_dir(session_name), "pool"),
+                              capacity=capacity)
+            return NativeObjectStoreClient(session_name, pool)
+        except Exception:
+            pass
+    return ObjectStoreClient(session_name)
+
+
 def cleanup_session(session_name: str):
     d = _shm_dir(session_name)
     if os.path.isdir(d):

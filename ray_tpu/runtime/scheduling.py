@@ -67,6 +67,11 @@ def pick_node_for(nodes: Sequence, resources: Dict[str, float],
         if not soft:
             return None
         strategy = "HYBRID"
+    native = _native_pick(alive, resources, strategy)
+    if native is _NO_NODE:
+        return None
+    if native is not None:
+        return native
     feasible = [n for n in alive if _feasible(n.available_resources, resources)]
     if not feasible:
         return None
@@ -80,6 +85,47 @@ def pick_node_for(nodes: Sequence, resources: Dict[str, float],
     top = [n for n in scored if _utilization_after(n, resources)
            <= _utilization_after(scored[0], resources) + 1e-9]
     return random.choice(top)
+
+
+def _native_pick(alive, resources, strategy):
+    """O(nodes x resources) scan in the C++ core (csrc/sched.cc) when the
+    native lib is present; returns None to fall back (also for strategies
+    the native core does not model). A -1 pick means 'infeasible', mapped
+    to the sentinel _NO_NODE so callers see None."""
+    if strategy not in ("HYBRID", "SPREAD"):
+        return None
+    if len(alive) < 8:
+        # marshalling n x k floats through ctypes costs more than the
+        # Python scan saves on small clusters; native pays off at scale
+        return None
+    try:
+        from .._native import native_pick
+    except Exception:
+        return None
+    keys = sorted(set(resources) | {key for n in alive
+                                    for key in n.total_resources})
+    if not keys:
+        return None
+    avail = [[n.available_resources.get(key, 0.0) for key in keys]
+             for n in alive]
+    total = [[n.total_resources.get(key, 0.0) for key in keys]
+             for n in alive]
+    req = [resources.get(key, 0.0) for key in keys]
+    idx = native_pick(avail, total, req, strategy,
+                      seed=random.getrandbits(31) or 1)
+    if idx is None:
+        return None
+    if idx < 0:
+        return _NO_NODE
+    return alive[idx]
+
+
+class _NoNode:
+    """Sentinel: native core answered 'infeasible' (distinct from 'native
+    unavailable', which is None and falls back to Python)."""
+
+
+_NO_NODE = _NoNode()
 
 
 def place_bundles(nodes: Sequence, bundles: List[Dict[str, float]],

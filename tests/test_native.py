@@ -1,0 +1,182 @@
+"""Native (C++) component tests: shm pool store + scheduling core.
+
+Mirrors the reference's colocated C++ unit tests (ref:
+src/ray/object_manager/plasma/ store tests;
+src/ray/raylet/scheduling/cluster_resource_scheduler_test.cc) through the
+ctypes surface, plus integration through the Python object-store client.
+"""
+
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ray_tpu._native import get_lib
+
+pytestmark = pytest.mark.skipif(get_lib() is None,
+                                reason="native toolchain unavailable")
+
+
+@pytest.fixture
+def pool(tmp_path):
+    from ray_tpu._native import NativePool
+
+    path = "/dev/shm/rtpu_test_%d" % os.getpid()
+    if os.path.exists(path):
+        os.unlink(path)
+    pool = NativePool(path, capacity=1 << 20)
+    yield pool
+    pool.close()
+    os.unlink(path)
+
+
+def _key(i: int) -> bytes:
+    return struct.pack(">I", i) + b"k" * 16
+
+
+def test_create_seal_get_roundtrip(pool):
+    buf = pool.create(_key(1), 11)
+    buf[:] = b"hello world"
+    buf.release()
+    assert not pool.contains(_key(1))  # unsealed objects are invisible
+    pool.seal(_key(1))
+    assert pool.contains(_key(1))
+    view = pool.get(_key(1))
+    assert bytes(view) == b"hello world"
+    view.release()
+    pool.release(_key(1))
+
+
+def test_create_duplicate_raises(pool):
+    pool.create(_key(2), 8)
+    pool.seal(_key(2))
+    with pytest.raises(FileExistsError):
+        pool.create(_key(2), 8)
+
+
+def test_delete_frees_space(pool):
+    before = pool.stats()["used_bytes"]
+    pool.create(_key(3), 100_000)
+    pool.seal(_key(3))
+    pool.release(_key(3))
+    assert pool.stats()["used_bytes"] > before
+    pool.delete(_key(3))
+    assert pool.stats()["used_bytes"] == before
+    assert not pool.contains(_key(3))
+
+
+def test_lru_eviction_under_pressure(pool):
+    for i in range(40):  # 40 x 50KB >> 1MB pool
+        pool.create(_key(100 + i), 50_000)
+        pool.seal(_key(100 + i))
+        pool.release(_key(100 + i))
+    stats = pool.stats()
+    assert stats["evictions"] > 0
+    assert stats["used_bytes"] <= stats["capacity"]
+    # oldest evicted, newest survives
+    assert not pool.contains(_key(100))
+    assert pool.contains(_key(139))
+
+
+def test_referenced_objects_never_evicted(pool):
+    pool.create(_key(500), 200_000)
+    pool.seal(_key(500))
+    view = pool.get(_key(500))  # hold a reference
+    for i in range(40):
+        try:
+            pool.create(_key(600 + i), 50_000)
+            pool.seal(_key(600 + i))
+            pool.release(_key(600 + i))
+        except Exception:
+            break
+    assert pool.contains(_key(500))
+    view.release()
+    pool.release(_key(500))
+    pool.release(_key(500))  # from get
+
+
+def test_cross_process_visibility(pool):
+    buf = pool.create(_key(7), 4)
+    buf[:] = b"ping"
+    buf.release()
+    pool.seal(_key(7))
+    code = f"""
+import struct
+from ray_tpu._native import NativePool
+pool = NativePool({pool._path!r})
+key = struct.pack(">I", 7) + b"k" * 16
+view = pool.get(key)
+assert bytes(view) == b"ping", bytes(view)
+view[:] = b"pong"
+view.release(); pool.release(key); pool.close()
+print("CHILD_OK")
+"""
+    result = subprocess.run([sys.executable, "-c", code],
+                            capture_output=True, text=True)
+    assert "CHILD_OK" in result.stdout, result.stderr[-500:]
+    view = pool.get(_key(7))
+    assert bytes(view) == b"pong"  # child's write visible here
+    view.release()
+    pool.release(_key(7))
+
+
+def test_native_store_client_numpy_roundtrip(tmp_path):
+    from ray_tpu.runtime.ids import ObjectID
+    from ray_tpu.runtime.object_store import (NativeObjectStoreClient,
+                                              make_store_client)
+    from ray_tpu._native import NativePool
+
+    path = "/dev/shm/rtpu_test_client_%d" % os.getpid()
+    if os.path.exists(path):
+        os.unlink(path)
+    client = NativeObjectStoreClient("t", NativePool(path, capacity=1 << 22))
+    oid = ObjectID.from_random()
+    arr = np.arange(1000, dtype=np.float64)
+    client.put(oid, {"x": arr, "tag": "native"})
+    out = client.get(oid)
+    np.testing.assert_array_equal(out["x"], arr)
+    assert out["tag"] == "native"
+    # zero-copy: the returned array aliases pool memory
+    del out
+    client.release(oid)
+    client.delete(oid)
+    assert not client.contains(oid)
+    os.unlink(path)
+
+
+def test_native_sched_matches_semantics():
+    from ray_tpu._native import native_pick
+
+    avail = [[8, 0], [4, 4], [0, 8]]
+    total = [[8, 8], [8, 8], [8, 8]]
+    # needs 2 of resource 1 -> nodes 1,2 feasible; HYBRID picks min
+    # post-placement utilization -> node 2 (util 0.25+... ) check:
+    idx = native_pick(avail, total, [0, 2], "HYBRID")
+    assert idx in (1, 2)
+    # infeasible
+    assert native_pick(avail, total, [100, 0], "HYBRID") == -1
+    # spread prefers the emptiest node
+    idx = native_pick([[8, 8], [1, 1]], [[8, 8], [8, 8]], [1, 0], "SPREAD")
+    assert idx == 0
+
+
+def test_cluster_uses_native_store(fresh_cluster):
+    """End-to-end: put/get through the session store (native by default)."""
+    import ray_tpu
+    from ray_tpu.runtime.core import get_core
+    from ray_tpu.runtime.object_store import NativeObjectStoreClient
+
+    core = get_core()
+    assert isinstance(core.store, NativeObjectStoreClient)
+    arr = np.random.rand(256, 256)
+    ref = ray_tpu.put(arr)
+    np.testing.assert_array_equal(ray_tpu.get(ref), arr)
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    np.testing.assert_array_equal(ray_tpu.get(double.remote(arr)), arr * 2)
