@@ -1,0 +1,55 @@
+"""Dashboard-lite: an HTTP window onto cluster state.
+
+The reference ships a React dashboard + aiohttp head with subprocess module
+runners (ref: python/ray/dashboard/head.py:48, agent.py:22, 34k lines + TS
+frontend). The TPU-native equivalent keeps the same observation points —
+cluster status, nodes, actors, tasks, jobs, Prometheus metrics — as a
+single JSON-over-HTTP server plus a minimal HTML overview page.
+"""
+
+from __future__ import annotations
+
+import json
+
+from typing import Optional, Tuple
+
+_PAGE = """<!doctype html><html><head><title>ray_tpu dashboard</title>
+<style>body{font-family:monospace;margin:2em}pre{background:#f5f5f5;
+padding:1em;overflow:auto}</style></head><body>
+<h2>ray_tpu dashboard</h2>
+<p>endpoints: <a href="/api/cluster">/api/cluster</a> ·
+<a href="/api/nodes">/api/nodes</a> · <a href="/api/actors">/api/actors</a> ·
+<a href="/api/tasks">/api/tasks</a> · <a href="/api/jobs">/api/jobs</a> ·
+<a href="/metrics">/metrics</a></p>
+<pre id="out">loading…</pre>
+<script>fetch('/api/cluster').then(r=>r.json()).then(d=>{
+document.getElementById('out').textContent=JSON.stringify(d,null,2)})
+</script></body></html>"""
+
+
+def start_dashboard(port: int = 8265,
+                    host: str = "127.0.0.1") -> Tuple[int, object]:
+    """Serve the dashboard over the CURRENT session; returns (port, server).
+    Runs on a daemon thread (no event-loop coupling)."""
+    from .util import metrics as metrics_mod
+    from .util import state
+    from .util.httpserve import start_http
+
+    def _json(fn):
+        return lambda: (json.dumps(fn(), default=str).encode(),
+                        "application/json")
+
+    routes = {
+        "/": lambda: (_PAGE.encode(), "text/html"),
+        "/index.html": lambda: (_PAGE.encode(), "text/html"),
+        "/metrics": lambda: (metrics_mod.prometheus_text().encode(),
+                             "text/plain; version=0.0.4"),
+        "/api/cluster": _json(state.cluster_status),
+        "/api/nodes": _json(state.list_nodes),
+        "/api/actors": _json(state.list_actors),
+        "/api/tasks": _json(state.list_tasks),
+        "/api/jobs": _json(state.list_jobs),
+        "/api/summary/tasks": _json(state.summarize_tasks),
+        "/api/summary/actors": _json(state.summarize_actors),
+    }
+    return start_http(routes, port=port, host=host)

@@ -189,8 +189,40 @@ class CoreWorker:
             handlers.update(extra_handlers)
         self._server = RpcServer(self.address, handlers)
         EventLoopThread.get().run(self._server.start())
+        EventLoopThread.get().spawn(self._metrics_flush_loop())
+
+    async def _metrics_flush_loop(self):
+        """Ship this process's metric registry to the controller every few
+        seconds (the node-metrics-agent channel; ref: stats/metric.h
+        exporter → metrics agent). Keyed by worker so per-process series
+        stay distinct in `cluster_metrics()`."""
+        from ..util import metrics as metrics_mod
+
+        while not self._shutting_down:
+            await asyncio.sleep(5.0)
+            snap = metrics_mod.snapshot()
+            if not snap:
+                continue
+            try:
+                await self.controller.call_async(
+                    "report_metrics",
+                    node_id=f"{self.node_id}/{self.worker_id.hex()[:8]}",
+                    metrics=snap)
+            except Exception:
+                pass
 
     def shutdown(self):
+        from ..util import metrics as metrics_mod
+
+        snap = metrics_mod.snapshot()
+        if snap:  # final flush so short-lived drivers still report
+            try:
+                self.controller.call(
+                    "report_metrics",
+                    node_id=f"{self.node_id}/{self.worker_id.hex()[:8]}",
+                    metrics=snap)
+            except Exception:
+                pass
         self._shutting_down = True
         try:
             if self._server is not None:

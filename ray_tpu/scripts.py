@@ -1,0 +1,131 @@
+"""Command-line interface: `python -m ray_tpu <command>`.
+
+Parity with the reference's CLI surface (ref: python/ray/scripts/scripts.py
+cli :94 — `ray status`, `ray list`, `ray summary`, `ray timeline`; state
+CLI ref: util/state/state_cli.py). Attaches to a running session by
+scanning /tmp/ray_tpu/*/sock/controller.sock (newest first) or an explicit
+--address.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Optional
+
+
+def _discover_address(explicit: Optional[str]) -> str:
+    if explicit:
+        return explicit
+    socks = glob.glob("/tmp/ray_tpu/*/sock/controller.sock")
+    if not socks:
+        print("no running ray_tpu session found", file=sys.stderr)
+        sys.exit(1)
+    # newest first, but ping: a crashed session can leave a stale socket
+    # that would otherwise shadow a live one
+    for sock in sorted(socks, key=os.path.getmtime, reverse=True):
+        address = f"unix:{sock}"
+        try:
+            from .runtime.rpc import RpcClient
+
+            client = RpcClient(address)
+            client.call("ping", _timeout=5)
+            client.close()
+            return address
+        except Exception:
+            continue
+    print("found session socket(s) but none are live", file=sys.stderr)
+    sys.exit(1)
+
+
+def _connect(args):
+    import ray_tpu
+
+    ray_tpu.init(address=_discover_address(args.address))
+
+
+def cmd_status(args):
+    _connect(args)
+    from .util import state
+
+    status = state.cluster_status()
+    print(json.dumps(status, indent=2, default=str))
+
+
+def cmd_list(args):
+    _connect(args)
+    from .util import state
+
+    fetchers = {
+        "nodes": state.list_nodes,
+        "actors": state.list_actors,
+        "tasks": lambda: state.list_tasks(args.limit),
+        "placement-groups": state.list_placement_groups,
+        "jobs": state.list_jobs,
+    }
+    rows = fetchers[args.kind]()
+    print(json.dumps(rows, indent=2, default=str))
+
+
+def cmd_summary(args):
+    _connect(args)
+    from .util import state
+
+    if args.kind == "tasks":
+        print(json.dumps(state.summarize_tasks(), indent=2))
+    else:
+        print(json.dumps(state.summarize_actors(), indent=2))
+
+
+def cmd_timeline(args):
+    _connect(args)
+    from .util import state
+
+    path = state.dump_timeline(args.output)
+    print(f"wrote chrome trace to {path} "
+          f"(open in chrome://tracing or https://ui.perfetto.dev)")
+
+
+def cmd_metrics(args):
+    _connect(args)
+    from .util import state
+
+    print(json.dumps(state.cluster_metrics(), indent=2, default=str))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="ray_tpu", description="TPU-native distributed runtime CLI")
+    parser.add_argument("--address", help="controller address "
+                        "(default: newest local session)")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("status", help="cluster resource status"
+                   ).set_defaults(func=cmd_status)
+
+    p_list = sub.add_parser("list", help="list cluster entities")
+    p_list.add_argument("kind", choices=["nodes", "actors", "tasks",
+                                         "placement-groups", "jobs"])
+    p_list.add_argument("--limit", type=int, default=100)
+    p_list.set_defaults(func=cmd_list)
+
+    p_summary = sub.add_parser("summary", help="state summaries")
+    p_summary.add_argument("kind", choices=["tasks", "actors"])
+    p_summary.set_defaults(func=cmd_summary)
+
+    p_timeline = sub.add_parser("timeline", help="dump chrome trace")
+    p_timeline.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
+    p_timeline.set_defaults(func=cmd_timeline)
+
+    sub.add_parser("metrics", help="per-node metric snapshots"
+                   ).set_defaults(func=cmd_metrics)
+
+    args = parser.parse_args(argv)
+    args.func(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Tiny threaded HTTP server helper shared by the metrics endpoint and the
+dashboard (routes: path -> () -> (body_bytes, content_type))."""
+
+from __future__ import annotations
+
+import http.server
+import socketserver
+import threading
+from typing import Callable, Dict, Tuple
+
+
+def start_http(routes: Dict[str, Callable[[], Tuple[bytes, str]]],
+               port: int = 0, host: str = "127.0.0.1"):
+    """Returns (bound_port, server); server runs on a daemon thread."""
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            handler = routes.get(self.path)
+            if handler is None:
+                self._send(404, b"not found", "text/plain")
+                return
+            try:
+                body, ctype = handler()
+                self._send(200, body, ctype)
+            except Exception as e:
+                self._send(500, repr(e).encode(), "text/plain")
+
+        def _send(self, code, body, ctype):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    server = socketserver.ThreadingTCPServer((host, port), Handler)
+    server.daemon_threads = True
+    threading.Thread(target=server.serve_forever, name="rtpu-http",
+                     daemon=True).start()
+    return server.server_address[1], server

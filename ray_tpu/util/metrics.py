@@ -1,0 +1,207 @@
+"""User-facing metrics API + Prometheus exposition.
+
+Parity with the reference's metrics surface (ref: python/ray/util/metrics.py
+Counter/Gauge/Histogram; C++ pipeline ref: src/ray/stats/metric.h:110 →
+node metrics agent → Prometheus exposition _private/prometheus_exporter.py).
+Here metrics live in an in-process registry; each worker flushes its
+snapshot to the controller with its heartbeat metrics channel, and
+`prometheus_text()` / `serve_prometheus()` expose the standard text format.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, "Metric"] = {}
+
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                   5.0, 10.0, 30.0, 60.0)
+
+
+def _tag_key(tags: Optional[Dict[str, str]]) -> Tuple:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    metric_type = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            existing = _registry.get(name)
+            if existing is not None and type(existing) is not type(self):
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{existing.metric_type}")
+            self._existing = existing
+            _registry[name] = self
+
+    def _share_state(self, attrs):
+        """Re-registering an existing metric name shares its storage, so
+        every instance of e.g. Counter("requests_total") feeds ONE series
+        (standard Prometheus-client semantics)."""
+        if getattr(self, "_existing", None) is not None:
+            for attr in attrs:
+                setattr(self, attr, getattr(self._existing, attr))
+            self._lock = self._existing._lock
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _merge(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        return merged
+
+    def _samples(self) -> List[Tuple[str, Dict[str, str], float]]:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    metric_type = "counter"
+
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+        self._share_state(("_values",))
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        key = _tag_key(self._merge(tags))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def _samples(self):
+        with self._lock:
+            return [(self.name, dict(k), v)
+                    for k, v in self._values.items()]
+
+
+class Gauge(Metric):
+    metric_type = "gauge"
+
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+        self._share_state(("_values",))
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[_tag_key(self._merge(tags))] = float(value)
+
+    def inc(self, value: float = 1.0, tags=None):
+        key = _tag_key(self._merge(tags))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def dec(self, value: float = 1.0, tags=None):
+        self.inc(-value, tags)
+
+    def _samples(self):
+        with self._lock:
+            return [(self.name, dict(k), v)
+                    for k, v in self._values.items()]
+
+
+class Histogram(Metric):
+    metric_type = "histogram"
+
+    def __init__(self, name, description="", boundaries=DEFAULT_BUCKETS,
+                 tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = tuple(sorted(boundaries))
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._totals: Dict[Tuple, int] = {}
+        self._share_state(("_counts", "_sums", "_totals", "boundaries"))
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None):
+        key = _tag_key(self._merge(tags))
+        idx = bisect.bisect_left(self.boundaries, value)
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.boundaries) + 1))
+            counts[idx] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def _samples(self):
+        out = []
+        with self._lock:
+            for key, counts in self._counts.items():
+                tags = dict(key)
+                cumulative = 0
+                for boundary, count in zip(self.boundaries, counts):
+                    cumulative += count
+                    out.append((f"{self.name}_bucket",
+                                {**tags, "le": str(boundary)}, cumulative))
+                out.append((f"{self.name}_bucket",
+                            {**tags, "le": "+Inf"}, self._totals[key]))
+                out.append((f"{self.name}_sum", tags, self._sums[key]))
+                out.append((f"{self.name}_count", tags, self._totals[key]))
+        return out
+
+
+def snapshot() -> Dict[str, float]:
+    """Flat snapshot {name{tags}: value} for the controller channel."""
+    out: Dict[str, float] = {}
+    with _registry_lock:
+        metrics = list(_registry.values())
+    for metric in metrics:
+        for name, tags, value in metric._samples():
+            tag_str = ",".join(f"{k}={v}" for k, v in sorted(tags.items()))
+            out[f"{name}{{{tag_str}}}" if tag_str else name] = value
+    return out
+
+
+def prometheus_text() -> str:
+    """Standard Prometheus exposition format over the local registry."""
+    lines: List[str] = []
+    with _registry_lock:
+        metrics = list(_registry.values())
+    for metric in metrics:
+        if metric.description:
+            lines.append(f"# HELP {metric.name} {metric.description}")
+        lines.append(f"# TYPE {metric.name} {metric.metric_type}")
+        for name, tags, value in metric._samples():
+            if tags:
+                tag_str = ",".join(
+                    f'{k}="{_escape(v)}"' for k, v in sorted(tags.items()))
+                lines.append(f"{name}{{{tag_str}}} {value}")
+            else:
+                lines.append(f"{name} {value}")
+    return "\n".join(lines) + "\n"
+
+
+def _escape(value) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def serve_prometheus(port: int = 0, host: str = "127.0.0.1"):
+    """Expose /metrics on an HTTP endpoint; returns (port, server)."""
+    from .httpserve import start_http
+
+    return start_http(
+        {"/metrics": lambda: (prometheus_text().encode(),
+                              "text/plain; version=0.0.4")},
+        port=port, host=host)
+
+
+def _reset_for_tests():
+    with _registry_lock:
+        _registry.clear()

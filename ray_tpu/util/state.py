@@ -1,0 +1,108 @@
+"""Cluster state API.
+
+Parity with the reference's state API (ref: python/ray/util/state/api.py —
+StateApiClient :110, list_actors/list_tasks/list_nodes/... :783,:1010;
+summaries ref: util/state/common.py; chrome-tracing dump ref:
+python/ray/_private/state.py:438). Queries go straight to the controller's
+tables (the GCS equivalent).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+from typing import Any, Dict, List, Optional
+
+
+def _controller():
+    from ..runtime.core import get_core
+
+    return get_core().controller
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    return list(_controller().call("list_nodes").values())
+
+
+def list_actors() -> List[Dict[str, Any]]:
+    return _controller().call("list_actors")
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    return _controller().call("list_placement_groups")
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    return _controller().call("list_jobs")
+
+
+def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Task state events (submitted/running/finished/failed)."""
+    from ..runtime.core import get_core
+
+    get_core().flush_events()
+    return _controller().call("list_task_events", limit=limit)
+
+
+def cluster_metrics() -> Dict[str, Any]:
+    return _controller().call("get_metrics")
+
+
+def summarize_tasks(limit: int = 10000) -> Dict[str, Dict[str, int]]:
+    """Per-function LATEST-state counts — one tally per task, not per
+    state transition (ref: `ray summary tasks`)."""
+    latest: Dict[str, Dict[str, Any]] = {}
+    for event in list_tasks(limit):  # events arrive in time order
+        latest[event.get("task_id")] = event
+    summary: Dict[str, Dict[str, int]] = collections.defaultdict(
+        lambda: collections.defaultdict(int))
+    for event in latest.values():
+        summary[event.get("name", "?")][event.get("state", "?")] += 1
+    return {name: dict(states) for name, states in summary.items()}
+
+
+def summarize_actors() -> Dict[str, int]:
+    counts: Dict[str, int] = collections.defaultdict(int)
+    for actor in list_actors():
+        counts[actor.get("state", "?")] += 1
+    return dict(counts)
+
+
+def cluster_status() -> Dict[str, Any]:
+    return _controller().call("cluster_status")
+
+
+# ------------------------------------------------------------- timeline
+
+def timeline_chrome_trace(limit: int = 100000) -> List[Dict[str, Any]]:
+    """Chrome-tracing (about://tracing, Perfetto) events from task state
+    transitions (ref: _private/state.py:438 chrome_tracing_dump)."""
+    events = list_tasks(limit)
+    # pair SUBMITTED -> FINISHED/FAILED per task into complete ("X") slices
+    starts: Dict[str, Dict[str, Any]] = {}
+    trace: List[Dict[str, Any]] = []
+    for event in events:
+        task_id = event.get("task_id")
+        state = event.get("state")
+        if state == "SUBMITTED":
+            starts[task_id] = event
+        elif state in ("FINISHED", "FAILED") and task_id in starts:
+            start = starts.pop(task_id)
+            t0 = start.get("ts", 0.0)
+            trace.append({
+                "ph": "X",
+                "name": event.get("name", "task"),
+                "cat": "task",
+                "pid": event.get("node_id", "node")[:8],
+                "tid": event.get("worker_id", "worker")[:8],
+                "ts": t0 * 1e6,
+                "dur": max(event.get("ts", t0) - t0, 0.0) * 1e6,
+                "args": {"task_id": task_id, "state": state},
+            })
+    return trace
+
+
+def dump_timeline(path: str, limit: int = 100000) -> str:
+    with open(path, "w") as f:
+        json.dump(timeline_chrome_trace(limit), f)
+    return path
