@@ -1,0 +1,16 @@
+"""ray_tpu.autoscaler: declarative cluster scaling.
+
+v2-style design (ref: python/ray/autoscaler/v2/autoscaler.py — reconcile
+against the control plane's reported demand rather than imperative node
+bookkeeping; demand source ref: gcs_autoscaler_state_manager.cc): the
+controller reports pending actors + recently-unschedulable requests, the
+Autoscaler matches them to node types, and a NodeProvider launches or
+terminates nodes. TPU twist: node types carry slice labels so scaled-up
+hosts join gang-schedulable slices (scheduling.py SLICE_PACK).
+"""
+
+from .autoscaler import Autoscaler, NodeTypeConfig  # noqa: F401
+from .node_provider import LocalNodeProvider, NodeProvider  # noqa: F401
+
+__all__ = ["Autoscaler", "NodeTypeConfig", "NodeProvider",
+           "LocalNodeProvider"]

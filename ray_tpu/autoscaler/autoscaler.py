@@ -1,0 +1,161 @@
+"""The autoscaler reconcile loop.
+
+ref: python/ray/autoscaler/v2/autoscaler.py (declarative reconcile) +
+_private/resource_demand_scheduler.py (demand → node-type bin packing),
+reduced to the decision core: match unmet demand to node types under
+min/max bounds, scale idle autoscaled nodes down after a timeout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class NodeTypeConfig:
+    name: str
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def fits(self, demand: Dict[str, float]) -> bool:
+        return all(self.resources.get(k, 0.0) >= v
+                   for k, v in demand.items() if v > 0)
+
+
+class Autoscaler:
+    def __init__(self, node_types: List[NodeTypeConfig],
+                 provider=None, idle_timeout_s: float = 60.0,
+                 interval_s: float = 2.0, launch_cooldown_s: float = 10.0):
+        from .node_provider import LocalNodeProvider
+
+        self.node_types = {t.name: t for t in node_types}
+        self.provider = provider or LocalNodeProvider()
+        self.idle_timeout_s = idle_timeout_s
+        self.interval_s = interval_s
+        # debounce: a just-launched node takes time to register, during
+        # which the same demand still reads as unmet
+        self.launch_cooldown_s = launch_cooldown_s
+        self._last_launch: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {t: 0 for t in self.node_types}
+        self._node_type: Dict[str, str] = {}  # node_id -> type
+        self._idle_since: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- loop
+
+    def start(self) -> "Autoscaler":
+        self._thread = threading.Thread(target=self._loop,
+                                        name="rtpu-autoscaler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.run_once()
+            except Exception:
+                logger.exception("autoscaler reconcile failed")
+            self._stop.wait(self.interval_s)
+
+    # -------------------------------------------------------- reconcile
+
+    def run_once(self) -> Dict[str, int]:
+        """One reconcile pass; returns actions {launched: n, terminated: n}."""
+        from ..runtime.core import get_core
+
+        status = get_core().controller.call("cluster_status")
+        actions = {"launched": 0, "terminated": 0}
+
+        # 1. min_workers floors
+        for cfg in self.node_types.values():
+            while self._counts[cfg.name] < cfg.min_workers:
+                self._launch(cfg)
+                actions["launched"] += 1
+
+        # 2. unmet demand -> smallest fitting node type under max_workers
+        demands = [d["resources"] for d in status.get(
+            "recent_unschedulable", [])]
+        demands += [p["resources"] for p in status.get("pending_actors", [])]
+        unmet = self._dedupe(demands)
+        now = time.time()
+        for demand in unmet:
+            if not any(v > 0 for v in demand.values()):
+                continue  # zero-resource requests fit anywhere already
+            cfg = self._pick_type(demand)
+            if (cfg is not None
+                    and self._counts[cfg.name] < cfg.max_workers
+                    and now - self._last_launch.get(cfg.name, 0.0)
+                    >= self.launch_cooldown_s):
+                self._launch(cfg)
+                actions["launched"] += 1
+
+        # 3. idle autoscaled nodes above min -> terminate after timeout
+        now = time.time()
+        for node_id, info in status.get("nodes", {}).items():
+            if node_id not in self._node_type or not info.get("alive", True):
+                continue
+            if self._is_idle(info):
+                self._idle_since.setdefault(node_id, now)
+                if now - self._idle_since[node_id] >= self.idle_timeout_s:
+                    type_name = self._node_type[node_id]
+                    cfg = self.node_types[type_name]
+                    if self._counts[type_name] > cfg.min_workers:
+                        if self.provider.terminate_node(node_id):
+                            self._counts[type_name] -= 1
+                            del self._node_type[node_id]
+                            self._idle_since.pop(node_id, None)
+                            actions["terminated"] += 1
+            else:
+                self._idle_since.pop(node_id, None)
+        return actions
+
+    # ---------------------------------------------------------- helpers
+
+    def _launch(self, cfg: NodeTypeConfig) -> None:
+        node_id = self.provider.create_node(cfg.name, cfg.resources,
+                                            cfg.labels)
+        self._counts[cfg.name] += 1
+        self._last_launch[cfg.name] = time.time()
+        self._node_type[node_id] = cfg.name
+        logger.info("autoscaler launched %s node %s", cfg.name, node_id[:8])
+
+    def _pick_type(self, demand: Dict[str, float]
+                   ) -> Optional[NodeTypeConfig]:
+        fitting = [c for c in self.node_types.values() if c.fits(demand)]
+        if not fitting:
+            return None
+        # smallest fitting type (by total resource volume) packs best
+        return min(fitting, key=lambda c: sum(c.resources.values()))
+
+    @staticmethod
+    def _is_idle(info: Dict) -> bool:
+        avail = info.get("available_resources", {})
+        # the controller's node snapshot calls the totals "resources"
+        total = info.get("resources", {})
+        return bool(total) and all(abs(avail.get(k, 0.0) - v) < 1e-9
+                                   for k, v in total.items())
+
+    @staticmethod
+    def _dedupe(demands: List[Dict[str, float]]) -> List[Dict[str, float]]:
+        seen = set()
+        out = []
+        for demand in demands:
+            key = tuple(sorted(demand.items()))
+            if key not in seen:
+                seen.add(key)
+                out.append(demand)
+        return out

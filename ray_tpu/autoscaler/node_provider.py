@@ -1,0 +1,65 @@
+"""NodeProvider SPI + the local (fake multi-node) provider.
+
+Parity with the reference's provider interface (ref:
+python/ray/autoscaler/node_provider.py NodeProvider SPI; local fake ref:
+autoscaler/_private/fake_multi_node/node_provider.py — 'launches' extra
+raylet processes on this host so autoscaling is testable without a cloud).
+Cloud providers (GKE/TPU-pod REST) implement the same three methods.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class NodeProvider:
+    def create_node(self, node_type: str, resources: Dict[str, float],
+                    labels: Dict[str, str]) -> str:
+        """Launch one node; returns provider node id."""
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> bool:
+        """True on success; False keeps the node under management for a
+        retry on a later reconcile."""
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Scales by starting/stopping extra nodelet processes in the current
+    session (Session.add_node / controller drain)."""
+
+    def __init__(self, session=None):
+        from ..runtime import node as node_mod
+
+        self._session = session or node_mod.current_session()
+        assert self._session is not None, "requires a running session"
+        self._managed: Dict[str, Any] = {}
+
+    def create_node(self, node_type: str, resources: Dict[str, float],
+                    labels: Dict[str, str]) -> str:
+        cpus = resources.get("CPU", 1)
+        tpus = resources.get("TPU") or None
+        extra = {k: v for k, v in resources.items()
+                 if k not in ("CPU", "TPU")}
+        node_id = self._session.add_node(
+            num_cpus=cpus, num_tpus=tpus, resources=extra or None,
+            labels={**labels, "node_type": node_type,
+                    "autoscaled": "1"})
+        self._managed[node_id] = node_type
+        return node_id
+
+    def terminate_node(self, node_id: str) -> bool:
+        from ..runtime.core import get_core
+
+        try:
+            get_core().controller.call("drain_node", node_id=node_id)
+        except Exception:
+            return False  # stays managed; retried next reconcile
+        self._managed.pop(node_id, None)
+        return True
+
+    def non_terminated_nodes(self) -> List[str]:
+        return list(self._managed)

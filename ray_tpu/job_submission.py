@@ -1,0 +1,201 @@
+"""Job submission: run entrypoint commands as supervised cluster jobs.
+
+Parity with the reference's job API (ref: python/ray/dashboard/modules/job/
+— JobSubmissionClient sdk.py:36, JobManager→JobSupervisor actor
+job_manager.py/job_supervisor.py; REST surface omitted — the client talks
+to the supervisor actors directly). The entrypoint subprocess gets
+RAY_TPU_ADDRESS so `ray_tpu.init()` inside the script attaches to the
+submitting cluster.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+STOPPED = "STOPPED"
+
+
+class JobSupervisorActor:
+    """Supervises one entrypoint subprocess (ref: job_supervisor.py)."""
+
+    def __init__(self, submission_id: str, entrypoint: str,
+                 controller_addr: str, log_path: str,
+                 env: Optional[Dict[str, str]] = None,
+                 metadata: Optional[Dict[str, str]] = None):
+        self.submission_id = submission_id
+        self.entrypoint = entrypoint
+        self.metadata = metadata or {}
+        self.log_path = log_path
+        self.status = PENDING
+        self.message = ""
+        self.start_time = time.time()
+        self.end_time: Optional[float] = None
+        self._proc = None
+        self._stop_requested = False
+        self._env = dict(os.environ)
+        self._env.update(env or {})
+        self._env["RAY_TPU_ADDRESS"] = controller_addr
+
+    async def run(self) -> str:
+        """Fire-and-forget: runs the subprocess to completion."""
+        import asyncio
+
+        if self._stop_requested:  # stopped before the subprocess spawned
+            self.status = STOPPED
+            self.end_time = time.time()
+            return self.status
+        self.status = RUNNING
+        with open(self.log_path, "ab") as log:
+            self._proc = await asyncio.create_subprocess_shell(
+                self.entrypoint, stdout=log, stderr=log, env=self._env,
+                start_new_session=True)
+            if self._stop_requested:  # raced with spawn
+                self._kill()
+            code = await self._proc.wait()
+        self.end_time = time.time()
+        if self.status != STOPPED:
+            self.status = SUCCEEDED if code == 0 else FAILED
+            self.message = f"exit code {code}"
+        self._mark_finished()
+        return self.status
+
+    def _mark_finished(self):
+        try:
+            from .runtime.core import get_core
+
+            get_core().controller.call("mark_job_finished",
+                                       job_id=self.submission_id, _timeout=5)
+        except Exception:
+            pass
+
+    def _kill(self):
+        try:
+            import signal
+
+            os.killpg(os.getpgid(self._proc.pid), signal.SIGTERM)
+        except Exception:
+            pass
+
+    def stop(self) -> bool:
+        self._stop_requested = True
+        if self._proc is not None and self._proc.returncode is None:
+            self.status = STOPPED
+            self._kill()
+            return True
+        if self.status in (PENDING, RUNNING):
+            # not spawned yet; run() observes the flag and never launches
+            self.status = STOPPED
+            self.end_time = time.time()
+            return True
+        return False
+
+    def info(self) -> Dict[str, Any]:
+        return {
+            "submission_id": self.submission_id,
+            "entrypoint": self.entrypoint,
+            "status": self.status,
+            "message": self.message,
+            "metadata": self.metadata,
+            "start_time": self.start_time,
+            "end_time": self.end_time,
+            "log_path": self.log_path,
+        }
+
+
+def _supervisor_name(submission_id: str) -> str:
+    return f"JOB_SUPERVISOR:{submission_id}"
+
+
+class JobSubmissionClient:
+    """ref: dashboard/modules/job/sdk.py:36 JobSubmissionClient — same
+    verbs (submit/status/logs/stop/list), addressed at a running session
+    instead of the REST head."""
+
+    def __init__(self, address: Optional[str] = None):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address, ignore_reinit_error=True)
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[Dict[str, Any]] = None,
+                   metadata: Optional[Dict[str, str]] = None) -> str:
+        import ray_tpu
+        from .actor import ActorClass
+        from .runtime import node as node_mod
+        from .runtime.core import get_core
+
+        session = node_mod.current_session()
+        submission_id = submission_id or f"job-{uuid.uuid4().hex[:10]}"
+        log_path = os.path.join(session.session_dir, "logs",
+                                f"{submission_id}.log")
+        env = dict((runtime_env or {}).get("env_vars", {}))
+        if runtime_env and runtime_env.get("working_dir"):
+            import shlex
+
+            work_dir = runtime_env["working_dir"]
+            env["PWD"] = work_dir
+            entrypoint = f"cd {shlex.quote(work_dir)} && {entrypoint}"
+        supervisor = ActorClass(
+            JobSupervisorActor, name=_supervisor_name(submission_id),
+            max_concurrency=4).remote(
+            submission_id, entrypoint, session.controller_addr, log_path,
+            env, metadata)
+        supervisor.run.remote()  # fire-and-forget
+        get_core().controller.call(
+            "register_job", job_id=submission_id,
+            info={"entrypoint": entrypoint, "type": "submission"})
+        return submission_id
+
+    def _supervisor(self, submission_id: str):
+        import ray_tpu
+
+        return ray_tpu.get_actor(_supervisor_name(submission_id))
+
+    def get_job_status(self, submission_id: str) -> str:
+        import ray_tpu
+
+        return ray_tpu.get(
+            self._supervisor(submission_id).info.remote())["status"]
+
+    def get_job_info(self, submission_id: str) -> Dict[str, Any]:
+        import ray_tpu
+
+        return ray_tpu.get(self._supervisor(submission_id).info.remote())
+
+    def get_job_logs(self, submission_id: str) -> str:
+        info = self.get_job_info(submission_id)
+        try:
+            with open(info["log_path"]) as f:
+                return f.read()
+        except FileNotFoundError:
+            return ""
+
+    def stop_job(self, submission_id: str) -> bool:
+        import ray_tpu
+
+        return ray_tpu.get(self._supervisor(submission_id).stop.remote())
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        from .runtime.core import get_core
+
+        return get_core().controller.call("list_jobs")
+
+    def wait_until_finished(self, submission_id: str,
+                            timeout_s: float = 300.0) -> str:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            status = self.get_job_status(submission_id)
+            if status in (SUCCEEDED, FAILED, STOPPED):
+                return status
+            time.sleep(0.25)
+        raise TimeoutError(f"job {submission_id} still "
+                           f"{self.get_job_status(submission_id)}")

@@ -94,6 +94,7 @@ class Controller:
         self.subscribers: Dict[str, List[ServerConn]] = collections.defaultdict(list)
         self.placement_groups: Dict[str, Dict[str, Any]] = {}
         self.jobs: Dict[str, Dict[str, Any]] = {}
+        self.unschedulable: collections.deque = collections.deque(maxlen=1000)
         self.task_events: collections.deque = collections.deque(maxlen=100000)
         self.metrics: Dict[str, Any] = {}
         self._server = RpcServer(address, self._handlers(), on_disconnect=self._on_disconnect)
@@ -185,8 +186,16 @@ class Controller:
 
     async def drain_node(self, node_id: str):
         node = self.nodes.get(node_id)
-        if node is not None and node.client is not None:
+        if node is None:
+            return True
+        # Unschedulable FIRST: between the shutdown notify and the health
+        # sweep noticing the death, the scheduler must not place new work
+        # on the draining node (ref: node drain protocol in
+        # gcs_node_manager.cc HandleDrainNode).
+        node.alive = False
+        if node.client is not None:
             await node.client.notify_async("shutdown")
+        await self._handle_node_death(node)
         return True
 
     async def _health_loop(self):
@@ -275,6 +284,9 @@ class Controller:
                 if ok:
                     info.node_id = node.node_id
                     return
+            else:
+                self.unschedulable.append(
+                    {"resources": dict(resources), "ts": time.time()})
             await asyncio.sleep(min(delay, 2.0))
             delay *= 2
 
@@ -351,6 +363,11 @@ class Controller:
             bundle_index=bundle_index,
         )
         if node is None:
+            # Record unmet demand for the autoscaler (ref: the reference's
+            # GcsAutoscalerStateManager aggregates pending resource demand;
+            # gcs_autoscaler_state_manager.cc).
+            self.unschedulable.append(
+                {"resources": dict(resources), "ts": time.time()})
             return None
         return {"node_id": node.node_id, "address": node.address}
 
@@ -506,6 +523,14 @@ class Controller:
             "nodes": {nid: n.snapshot() for nid, n in self.nodes.items()},
             "num_actors": len(self.actors),
             "num_placement_groups": len(self.placement_groups),
+            "pending_actors": [
+                {"actor_id": a.actor_id,
+                 "resources": a.spec.get("resources", {})}
+                for a in self.actors.values()
+                if a.state in (ACTOR_PENDING, ACTOR_RESTARTING)],
+            "recent_unschedulable": [
+                d for d in self.unschedulable
+                if time.time() - d["ts"] < 30.0],
         }
 
     async def ping(self):

@@ -215,18 +215,23 @@ class CoreWorker:
         from ..util import metrics as metrics_mod
 
         snap = metrics_mod.snapshot()
-        if snap:  # final flush so short-lived drivers still report
+        # final flush so short-lived drivers still report — but only over
+        # an ALREADY-connected client: the connect path retries for ~10s
+        # when the controller is gone, which would stall teardown
+        if snap and getattr(self.controller, "_writer", None) is not None:
             try:
                 self.controller.call(
                     "report_metrics",
                     node_id=f"{self.node_id}/{self.worker_id.hex()[:8]}",
-                    metrics=snap)
+                    metrics=snap, _timeout=2)
             except Exception:
                 pass
         self._shutting_down = True
         try:
             if self._server is not None:
-                EventLoopThread.get().run(self._server.stop())
+                # bounded: peers (e.g. live workers on other nodes) may
+                # still hold connections open
+                EventLoopThread.get().run(self._server.stop(), timeout=5)
         except Exception:
             pass
         for c in self._clients.values():
