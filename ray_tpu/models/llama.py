@@ -24,8 +24,20 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from jax.ad_checkpoint import checkpoint_name
+
 from ..ops.attention import attention
 from ..ops.paged_attention import paged_attention, paged_write
+
+
+def _remat_policy(name: str):
+    """Checkpoint policy by config key (HBM <-> recompute dial)."""
+    if name == "names":
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "mlp_out")
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    return jax.checkpoint_policies.nothing_saveable
 
 A = nn.with_logical_partitioning  # annotate param init with logical axes
 
@@ -45,6 +57,13 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # remat policy: "nothing" = recompute everything (min memory),
+    # "names" = save per-layer attention/MLP outputs (skips the expensive
+    # recomputes in backward, ~1GB per saved tensor set at bs8 seq2048),
+    # "dots" = save all matmul outputs (max memory)
+    remat_policy: str = "nothing"
+    # sequence chunk for the fused cross-entropy (targets= path)
+    loss_chunk: int = 512
     scan_layers: bool = True
     attention_impl: Optional[str] = None  # None = auto (flash on TPU)
 
@@ -201,9 +220,11 @@ class DecoderLayer(nn.Module):
         h, new_cache = Attention(cfg, name="attn")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="attn_norm")(x),
             positions, kv_cache=kv_cache, segment_ids=segment_ids)
+        h = checkpoint_name(h, "attn_out")
         x = x + h
         h = MLP(cfg, name="mlp")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="mlp_norm")(x))
+        h = checkpoint_name(h, "mlp_out")
         return x + h, new_cache
 
 
@@ -225,10 +246,12 @@ class ScannedLayer(nn.Module):
 
 class LlamaModel(nn.Module):
     config: LlamaConfig
+    # train_lib feature-detects the fused chunked-CE `targets=` path
+    supports_fused_loss = True
 
     @nn.compact
     def __call__(self, input_ids, positions=None, segment_ids=None,
-                 kv_caches=None):
+                 kv_caches=None, targets=None):
         """Forward pass.
 
         kv_caches: None (training / full prefill), or a (k, v) pair stacked
@@ -252,7 +275,7 @@ class LlamaModel(nn.Module):
             if cfg.remat:
                 layer_cls = nn.remat(
                     ScannedLayer, prevent_cse=False,
-                    policy=jax.checkpoint_policies.nothing_saveable)
+                    policy=_remat_policy(cfg.remat_policy))
             (x, _, _), new_caches = nn.scan(
                 layer_cls,
                 variable_axes={"params": 0},
@@ -263,7 +286,8 @@ class LlamaModel(nn.Module):
         else:
             layer_cls = DecoderLayer
             if cfg.remat:
-                layer_cls = nn.remat(DecoderLayer, prevent_cse=False)
+                layer_cls = nn.remat(DecoderLayer, prevent_cse=False,
+                                     policy=_remat_policy(cfg.remat_policy))
             new_caches = [] if kv_caches is not None else None
             for i in range(cfg.num_layers):
                 cache_i = kv_caches[i] if kv_caches is not None else None
@@ -273,11 +297,40 @@ class LlamaModel(nn.Module):
                     new_caches.append(new_cache)
 
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="final_norm")(x)
-        logits = nn.DenseGeneral(
+        head = nn.DenseGeneral(
             features=cfg.vocab_size, use_bias=False, axis=-1,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             kernel_init=A(nn.initializers.lecun_normal(), ("embed", "vocab")),
-            name="lm_head")(x)
+            name="lm_head")
+        if targets is not None:
+            # Fused chunked cross-entropy: the [B,S,V] logits (fp32!) never
+            # materialize — each sequence chunk projects + reduces inside a
+            # scan, bounding loss memory to [B,chunk,V]. This is what makes
+            # long-sequence training fit in HBM (the full-logit buffer at
+            # S=8192, V=32k would be 8 GB fp32 per example-batch).
+            chunk = min(cfg.loss_chunk, x.shape[1])
+            b, s, e = x.shape
+            n_chunks = -(-s // chunk)
+            pad = n_chunks * chunk - s
+            x_p = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+            t_p = jnp.pad(targets, ((0, 0), (0, pad)))
+            x_c = x_p.reshape(b, n_chunks, chunk, e).swapaxes(0, 1)
+            t_c = t_p.reshape(b, n_chunks, chunk).swapaxes(0, 1)
+
+            def one_chunk(carry, xt):
+                xc, tc = xt
+                logits = head(xc).astype(jnp.float32)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                nll = -jnp.take_along_axis(
+                    logp, tc[..., None], axis=-1)[..., 0]
+                return carry, nll
+
+            _, nll = jax.lax.scan(one_chunk, 0.0, (x_c, t_c))
+            nll = nll.swapaxes(0, 1).reshape(b, n_chunks * chunk)[:, :s]
+            if kv_caches is not None:
+                return nll, new_caches
+            return nll
+        logits = head(x)
         if kv_caches is not None:
             return logits, new_caches
         return logits

@@ -5,7 +5,7 @@ external engines (torch SDPA / vLLM; see SURVEY.md §2.4 "sequence parallel:
 ABSENT").  Here the hot op is owned natively: a blocked online-softmax
 (FlashAttention-2 style) kernel laid out for the TPU MXU/VMEM:
 
-- blocks of 128 on both query and key axes (MXU-native tiling),
+- blocked tiling on both query and key axes (512 default, 128 minimum),
 - K/V for one (batch, kv-head) kept resident in VMEM; the inner k-loop is a
   `fori_loop` of MXU matmuls with f32 accumulation,
 - GQA handled in the BlockSpec index map (q-head h reads kv-head h // n_rep),
@@ -33,7 +33,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
-BLOCK = 128  # MXU-native tile edge
+BLOCK = 512  # default tile edge: benches fastest fwd+bwd on v5e
+GRAN = 128   # MXU-minimal granularity: short sequences round up to this,
+             # not to BLOCK, so small prefills don't pad 4-8x
 
 
 def _round_up(x: int, m: int) -> int:
@@ -41,8 +43,8 @@ def _round_up(x: int, m: int) -> int:
 
 
 def _pick_blocks(sq: int, sk: int, block_q: int, block_k: int):
-    bq = min(block_q, _round_up(sq, BLOCK))
-    bk = min(block_k, _round_up(sk, BLOCK))
+    bq = min(block_q, _round_up(sq, GRAN))
+    bk = min(block_k, _round_up(sk, GRAN))
     return bq, bk
 
 

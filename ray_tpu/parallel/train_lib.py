@@ -42,14 +42,19 @@ jax.tree_util.register_pytree_node(
     TrainState, TrainState.tree_flatten, TrainState.tree_unflatten)
 
 
+def masked_mean(values: jax.Array, mask) -> jax.Array:
+    if mask is None:
+        return values.mean()
+    mask = mask.astype(values.dtype)
+    return jnp.sum(values * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
                        mask: Optional[jax.Array] = None) -> jax.Array:
     logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    if mask is not None:
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
-    return jnp.mean(nll)
+    return masked_mean(nll, mask)
 
 
 def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
@@ -94,14 +99,21 @@ class ShardedTrainer:
     # -------------------------------------------------------------- loss
     def _default_loss(self, params, batch):
         # Forward over the FULL sequence (keeps seq length divisible by the
-        # sp axis for ring attention) and drop the final logit instead of
-        # slicing the input.
+        # sp axis for ring attention); targets are the input shifted left.
         input_ids = batch["input_ids"]
-        logits = self.model.apply({"params": params}, input_ids)[:, :-1]
-        targets = input_ids[:, 1:]
+        targets = jnp.concatenate(
+            [input_ids[:, 1:], input_ids[:, :1]], axis=1)
         mask = batch.get("loss_mask")
         mask = mask[:, 1:] if mask is not None else None
-        return cross_entropy_loss(logits, targets, mask)
+        if getattr(self.model, "supports_fused_loss", False):
+            # fused chunked CE: [B,S,V] fp32 logits never materialize
+            nll = self.model.apply({"params": params}, input_ids,
+                                   targets=targets)
+            nll = nll[:, :-1]  # final position has no next token
+            return masked_mean(nll, mask)
+        # model without a fused-loss path: dense logits + CE
+        logits = self.model.apply({"params": params}, input_ids)[:, :-1]
+        return cross_entropy_loss(logits, input_ids[:, 1:], mask)
 
     # -------------------------------------------------------------- init
     def state_shardings(self, example_batch):
