@@ -1,0 +1,105 @@
+"""Core-runtime microbenchmarks with golden JSON output.
+
+Parity with the reference's microbenchmark harness (ref:
+python/ray/_private/ray_perf.py — tasks/s, actor calls/s, put throughput;
+golden numbers ref: release/perf_metrics/microbenchmark.json, duplicated in
+BASELINE.md). Run: `python benchmarks/ray_perf.py [--out golden.json]`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # runnable from anywhere
+
+
+def timeit(fn, n: int, warmup: int = 5) -> float:
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return n / (time.perf_counter() - t0)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=None)
+    parser.add_argument("--scale", type=float, default=1.0,
+                        help="multiply iteration counts")
+    args = parser.parse_args()
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    results = {}
+
+    # ---- tasks/s (ref: ray_perf.py "multi client tasks async")
+    @ray_tpu.remote
+    def nop():
+        return 0
+
+    ray_tpu.get(nop.remote())
+    batch = max(1, int(100 * args.scale))
+
+    def submit_batch():
+        ray_tpu.get([nop.remote() for _ in range(batch)])
+
+    per_s = timeit(submit_batch, max(1, int(10 * args.scale))) * batch
+    results["tasks_per_s"] = round(per_s, 1)
+
+    # ---- sync actor calls/s (ref: "1_1_actor_calls_sync")
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    counter = Counter.remote()
+    ray_tpu.get(counter.inc.remote())
+    results["actor_calls_sync_per_s"] = round(
+        timeit(lambda: ray_tpu.get(counter.inc.remote()),
+               max(1, int(300 * args.scale))), 1)
+
+    # ---- pipelined actor calls/s (ref: "1_1_actor_calls_async")
+    def pipelined():
+        ray_tpu.get([counter.inc.remote() for _ in range(batch)])
+
+    results["actor_calls_async_per_s"] = round(
+        timeit(pipelined, max(1, int(10 * args.scale))) * batch, 1)
+
+    # ---- object store put throughput (ref: "multi_client_put_gigabytes")
+    payload = np.random.bytes(8 << 20)  # 8 MB
+    refs = []
+
+    def put_big():
+        refs.append(ray_tpu.put(payload))
+
+    per_s = timeit(put_big, max(1, int(20 * args.scale)))
+    results["put_gigabytes_per_s"] = round(per_s * len(payload) / 1e9, 3)
+    del refs
+
+    # ---- put/get roundtrip latency small objects
+    results["put_get_small_per_s"] = round(
+        timeit(lambda: ray_tpu.get(ray_tpu.put(1)),
+               max(1, int(200 * args.scale))), 1)
+
+    print(json.dumps(results))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=2)
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

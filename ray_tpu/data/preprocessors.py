@@ -1,0 +1,194 @@
+"""Dataset preprocessors: fit statistics over a Dataset, transform blocks.
+
+Parity with the reference's preprocessor suite (ref:
+python/ray/data/preprocessors/ — scaler.py StandardScaler/MinMaxScaler,
+encoder.py LabelEncoder/OneHotEncoder, concatenator.py Concatenator;
+base ref: preprocessor.py Preprocessor.fit/transform/fit_transform).
+Fitting aggregates per-block partial statistics through the lazy plan;
+transforms run as map_batches stages.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class Preprocessor:
+    _fitted = False
+
+    def fit(self, ds) -> "Preprocessor":
+        self._fit(ds)
+        self._fitted = True
+        return self
+
+    def transform(self, ds):
+        if not self._fitted and self._needs_fit():
+            raise RuntimeError(f"{type(self).__name__} must be fit first")
+        return ds.map_batches(self._transform_batch)
+
+    def fit_transform(self, ds):
+        return self.fit(ds).transform(ds)
+
+    def _needs_fit(self) -> bool:
+        return True
+
+    def _fit(self, ds) -> None:
+        raise NotImplementedError
+
+    def _transform_batch(self, batch: Dict[str, np.ndarray]):
+        raise NotImplementedError
+
+
+def _column_arrays(ds, columns: List[str]):
+    """Iterate per-batch numpy arrays for the requested columns."""
+    for batch in ds.iter_batches(batch_size=4096, batch_format="numpy"):
+        yield {col: np.asarray(batch[col]) for col in columns}
+
+
+class StandardScaler(Preprocessor):
+    """(x - mean) / std per column (ref: preprocessors/scaler.py)."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+        self.stats_: Dict[str, tuple] = {}
+
+    def _fit(self, ds) -> None:
+        count = 0
+        sums = {c: 0.0 for c in self.columns}
+        sq_sums = {c: 0.0 for c in self.columns}
+        for arrays in _column_arrays(ds, self.columns):
+            first = arrays[self.columns[0]]
+            count += len(first)
+            for col, arr in arrays.items():
+                sums[col] += float(arr.sum())
+                sq_sums[col] += float((arr.astype(np.float64) ** 2).sum())
+        for col in self.columns:
+            mean = sums[col] / max(count, 1)
+            var = sq_sums[col] / max(count, 1) - mean ** 2
+            self.stats_[col] = (mean, float(np.sqrt(max(var, 0.0))))
+
+    def _transform_batch(self, batch):
+        out = dict(batch)
+        for col, (mean, std) in self.stats_.items():
+            out[col] = (np.asarray(batch[col]) - mean) / (std or 1.0)
+        return out
+
+
+class MinMaxScaler(Preprocessor):
+    """(x - min) / (max - min) per column."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+        self.stats_: Dict[str, tuple] = {}
+
+    def _fit(self, ds) -> None:
+        lows = {c: np.inf for c in self.columns}
+        highs = {c: -np.inf for c in self.columns}
+        for arrays in _column_arrays(ds, self.columns):
+            for col, arr in arrays.items():
+                lows[col] = min(lows[col], float(arr.min()))
+                highs[col] = max(highs[col], float(arr.max()))
+        for col in self.columns:
+            self.stats_[col] = (lows[col], highs[col])
+
+    def _transform_batch(self, batch):
+        out = dict(batch)
+        for col, (low, high) in self.stats_.items():
+            span = (high - low) or 1.0
+            out[col] = (np.asarray(batch[col]) - low) / span
+        return out
+
+
+class LabelEncoder(Preprocessor):
+    """Categorical column -> dense int codes (ref: encoder.py)."""
+
+    def __init__(self, label_column: str):
+        self.label_column = label_column
+        self.mapping_: Dict[Any, int] = {}
+
+    def _fit(self, ds) -> None:
+        values = set()
+        for arrays in _column_arrays(ds, [self.label_column]):
+            values.update(arrays[self.label_column].tolist())
+        self.mapping_ = {v: i for i, v in enumerate(sorted(values))}
+
+    def _transform_batch(self, batch):
+        out = dict(batch)
+        out[self.label_column] = np.asarray(
+            [self.mapping_[v] for v in batch[self.label_column]],
+            dtype=np.int64)
+        return out
+
+
+class OneHotEncoder(Preprocessor):
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+        self.mappings_: Dict[str, Dict[Any, int]] = {}
+
+    def _fit(self, ds) -> None:
+        values: Dict[str, set] = {c: set() for c in self.columns}
+        for arrays in _column_arrays(ds, self.columns):
+            for col, arr in arrays.items():
+                values[col].update(arr.tolist())
+        self.mappings_ = {
+            col: {v: i for i, v in enumerate(sorted(vals))}
+            for col, vals in values.items()}
+
+    def _transform_batch(self, batch):
+        out = dict(batch)
+        for col, mapping in self.mappings_.items():
+            arr = batch[col]
+            onehot = np.zeros((len(arr), len(mapping)), dtype=np.float32)
+            for i, v in enumerate(arr):
+                onehot[i, mapping[v]] = 1.0
+            out[col] = onehot
+        return out
+
+
+class Concatenator(Preprocessor):
+    """Concatenate numeric columns into one vector column (ref:
+    concatenator.py; the standard last step before train ingest)."""
+
+    def __init__(self, columns: List[str], output_column_name: str = "concat",
+                 dtype=np.float32):
+        self.columns = list(columns)
+        self.output_column_name = output_column_name
+        self.dtype = dtype
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _fit(self, ds) -> None:
+        pass
+
+    def _transform_batch(self, batch):
+        out = {k: v for k, v in batch.items() if k not in self.columns}
+        arrays = [np.asarray(batch[c]).reshape(len(batch[c]), -1)
+                  for c in self.columns]
+        out[self.output_column_name] = np.concatenate(
+            arrays, axis=1).astype(self.dtype)
+        return out
+
+
+class Chain(Preprocessor):
+    """Apply preprocessors in sequence (ref: chain.py)."""
+
+    def __init__(self, *preprocessors: Preprocessor):
+        self.preprocessors = list(preprocessors)
+
+    def fit(self, ds) -> "Chain":
+        for p in self.preprocessors:
+            ds_fitted = p.fit_transform(ds)
+            ds = ds_fitted
+        self._fitted = True
+        return self
+
+    def transform(self, ds):
+        for p in self.preprocessors:
+            ds = p.transform(ds)
+        return ds
+
+    def _needs_fit(self) -> bool:
+        return any(p._needs_fit() for p in self.preprocessors)

@@ -1,0 +1,99 @@
+"""ActorPool: load-balance tasks over a fixed set of actors.
+
+Parity with the reference (ref: python/ray/util/actor_pool.py ActorPool —
+submit/get_next/get_next_unordered/map/map_unordered/has_next + push/pop
+idle)."""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Iterable, List
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable[Any]):
+        self._idle: collections.deque = collections.deque(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: collections.deque = collections.deque()
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """fn(actor, value) -> ObjectRef; queued when no actor is idle."""
+        if self._idle:
+            actor = self._idle.popleft()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = ref
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future or self._pending_submits)
+
+    def _return_actor(self, actor) -> None:
+        self._idle.append(actor)
+        if self._pending_submits:
+            fn, value = self._pending_submits.popleft()
+            self.submit(fn, value)
+
+    def get_next(self, timeout: float = None) -> Any:
+        """Next result in SUBMISSION order. The actor returns to the idle
+        set even when the task raised (a task error does not kill the
+        actor) or the get timed out."""
+        import ray_tpu
+
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        ref = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        _, actor = self._future_to_actor.pop(ref)
+        try:
+            return ray_tpu.get(ref, timeout=timeout)
+        finally:
+            self._return_actor(actor)
+
+    def get_next_unordered(self, timeout: float = None) -> Any:
+        """Next COMPLETED result, any order."""
+        import ray_tpu
+
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        while not self._future_to_actor:  # everything still queued
+            if not self._idle:
+                raise RuntimeError(
+                    "submits are queued but the pool has no actors to run "
+                    "them (push() an actor back first)")
+            fn, value = self._pending_submits.popleft()
+            self.submit(fn, value)
+        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+                                num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("no result within timeout")
+        ref = ready[0]
+        index, actor = self._future_to_actor.pop(ref)
+        del self._index_to_future[index]
+        try:
+            return ray_tpu.get(ref)
+        finally:
+            self._return_actor(actor)
+
+    def map(self, fn, values: Iterable[Any]) -> Iterable[Any]:
+        for value in values:
+            self.submit(fn, value)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn, values: Iterable[Any]) -> Iterable[Any]:
+        for value in values:
+            self.submit(fn, value)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def push(self, actor) -> None:
+        self._return_actor(actor)
+
+    def pop_idle(self):
+        return self._idle.pop() if self._idle else None

@@ -1,0 +1,157 @@
+"""multiprocessing.Pool shim over cluster tasks.
+
+Parity with the reference (ref: python/ray/util/multiprocessing/pool.py —
+Pool.map/map_async/imap/imap_unordered/apply/apply_async/starmap): drop-in
+for the stdlib Pool where workers are cluster tasks, so pools span nodes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional
+
+
+class AsyncResult:
+    def __init__(self, refs: List[Any], single: bool = False):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        values = ray_tpu.get(self._refs, timeout=timeout)
+        return values[0] if self._single else values
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        import ray_tpu
+
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        import ray_tpu
+
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                                timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result is not ready yet")  # stdlib contract
+        try:
+            self.get(timeout=0)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """Task-backed process pool. `processes` bounds in-flight tasks for
+    map/imap/imap_unordered (map_async/starmap submit eagerly; the cluster
+    supplies actual parallelism)."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 ray_remote_args: Optional[dict] = None):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._limit = processes or 8
+        self._remote_args = ray_remote_args or {}
+        self._closed = False
+
+    def _remote_fn(self, func):
+        import ray_tpu
+
+        return ray_tpu.remote(**self._remote_args)(func) \
+            if self._remote_args else ray_tpu.remote(func)
+
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool is closed")
+
+    # ------------------------------------------------------------- apply
+    def apply(self, func, args=(), kwds=None):
+        return self.apply_async(func, args, kwds).get()
+
+    def apply_async(self, func, args=(), kwds=None) -> AsyncResult:
+        self._check_open()
+        remote_fn = self._remote_fn(func)
+        return AsyncResult([remote_fn.remote(*args, **(kwds or {}))],
+                           single=True)
+
+    # --------------------------------------------------------------- map
+    def map(self, func, iterable: Iterable[Any], chunksize=None) -> List:
+        return list(self.imap(func, iterable))  # bounded in-flight window
+
+    def map_async(self, func, iterable: Iterable[Any],
+                  chunksize=None) -> AsyncResult:
+        self._check_open()
+        remote_fn = self._remote_fn(func)
+        return AsyncResult([remote_fn.remote(item) for item in iterable])
+
+    def starmap(self, func, iterable: Iterable[tuple]) -> List:
+        self._check_open()
+        remote_fn = self._remote_fn(func)
+        import ray_tpu
+
+        return ray_tpu.get([remote_fn.remote(*args) for args in iterable])
+
+    def imap(self, func, iterable: Iterable[Any], chunksize=None):
+        """Lazy ordered map with a bounded in-flight window."""
+        self._check_open()
+        import ray_tpu
+
+        remote_fn = self._remote_fn(func)
+        items = iter(iterable)
+        window: List[Any] = []
+        try:
+            for _ in range(self._limit):
+                window.append(remote_fn.remote(next(items)))
+        except StopIteration:
+            pass
+        while window:
+            yield ray_tpu.get(window.pop(0))
+            try:
+                window.append(remote_fn.remote(next(items)))
+            except StopIteration:
+                pass
+
+    def imap_unordered(self, func, iterable: Iterable[Any], chunksize=None):
+        """Lazy unordered map with a bounded in-flight window."""
+        self._check_open()
+        import ray_tpu
+
+        remote_fn = self._remote_fn(func)
+        items = iter(iterable)
+        pending = set()
+        try:
+            for _ in range(self._limit):
+                pending.add(remote_fn.remote(next(items)))
+        except StopIteration:
+            pass
+        while pending:
+            ready, rest = ray_tpu.wait(list(pending), num_returns=1,
+                                       timeout=300)
+            pending = set(rest)
+            for ref in ready:
+                yield ray_tpu.get(ref)
+                try:
+                    pending.add(remote_fn.remote(next(items)))
+                except StopIteration:
+                    pass
+
+    # ---------------------------------------------------------- lifecycle
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+
+    def join(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

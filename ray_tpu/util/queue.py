@@ -1,0 +1,110 @@
+"""Distributed FIFO queue backed by an actor.
+
+Parity with the reference (ref: python/ray/util/queue.py Queue —
+put/get/put_nowait/get_nowait/size/empty/full, blocking with timeouts via
+the actor's async methods)."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, List, Optional
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self._q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+
+    async def put(self, item: Any, timeout: Optional[float] = None) -> bool:
+        try:
+            await asyncio.wait_for(self._q.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def get(self, timeout: Optional[float] = None):
+        try:
+            return True, await asyncio.wait_for(self._q.get(), timeout)
+        except asyncio.TimeoutError:
+            return False, None
+
+    def put_nowait(self, item: Any) -> bool:
+        try:
+            self._q.put_nowait(item)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    def get_nowait(self):
+        try:
+            return True, self._q.get_nowait()
+        except asyncio.QueueEmpty:
+            return False, None
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    def maxsize(self) -> int:
+        return self._q.maxsize
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        import ray_tpu
+        from ..actor import ActorClass
+
+        self._actor = ActorClass(_QueueActor, max_concurrency=64,
+                                 **(actor_options or {})).remote(maxsize)
+        self._ray = ray_tpu
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        if not block:
+            if not self._ray.get(self._actor.put_nowait.remote(item)):
+                raise Full()
+            return
+        if not self._ray.get(self._actor.put.remote(item, timeout)):
+            raise Full()
+
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        if not block:
+            ok, item = self._ray.get(self._actor.get_nowait.remote())
+            if not ok:
+                raise Empty()
+            return item
+        ok, item = self._ray.get(self._actor.get.remote(timeout))
+        if not ok:
+            raise Empty()
+        return item
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def qsize(self) -> int:
+        return self._ray.get(self._actor.qsize.remote())
+
+    def size(self) -> int:
+        return self.qsize()
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        maxsize = self._ray.get(self._actor.maxsize.remote())
+        return maxsize > 0 and self.qsize() >= maxsize
+
+    def shutdown(self) -> None:
+        import ray_tpu
+
+        ray_tpu.kill(self._actor)

@@ -1,0 +1,128 @@
+"""Ecosystem shim + preprocessor tests (ref: python/ray/tests/
+test_actor_pool.py, test_queue.py, test_multiprocessing.py;
+data preprocessor tests ref: python/ray/data/tests/preprocessors/)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Queue
+
+
+@ray_tpu.remote
+class Doubler:
+    def work(self, x):
+        return 2 * x
+
+
+def test_actor_pool_ordered(shared_cluster):
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.work.remote(v), range(8)))
+    assert out == [2 * i for i in range(8)]
+
+
+def test_actor_pool_unordered_and_backpressure(shared_cluster):
+    pool = ActorPool([Doubler.remote()])  # 1 actor, 6 submits -> queueing
+    out = sorted(pool.map_unordered(lambda a, v: a.work.remote(v), range(6)))
+    assert out == [0, 2, 4, 6, 8, 10]
+
+
+def test_queue_fifo_and_empty(shared_cluster):
+    q = Queue(maxsize=4)
+    for i in range(3):
+        q.put(i)
+    assert q.qsize() == 3
+    assert [q.get() for _ in range(3)] == [0, 1, 2]
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.shutdown()
+
+
+def test_queue_cross_actor(shared_cluster):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return n
+
+    ray_tpu.get(producer.remote(q, 5), timeout=60)
+    assert sorted(q.get() for _ in range(5)) == [0, 1, 2, 3, 4]
+    q.shutdown()
+
+
+def test_multiprocessing_pool(shared_cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def square(x):
+        return x * x
+
+    with Pool(processes=4) as pool:
+        assert pool.map(square, range(6)) == [0, 1, 4, 9, 16, 25]
+        assert pool.apply(square, (7,)) == 49
+        async_result = pool.map_async(square, [2, 3])
+        assert async_result.get(timeout=60) == [4, 9]
+        assert list(pool.imap(square, range(5))) == [0, 1, 4, 9, 16]
+        assert sorted(pool.imap_unordered(square, range(5))) == [0, 1, 4, 9, 16]
+        assert pool.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+
+
+def test_preprocessors_scalers(shared_cluster):
+    from ray_tpu import data as rdata
+    from ray_tpu.data.preprocessors import (Concatenator, LabelEncoder,
+                                            MinMaxScaler, StandardScaler)
+
+    rows = [{"x": float(i), "y": float(2 * i), "label": "ab"[i % 2]}
+            for i in range(100)]
+    ds = rdata.from_items(rows)
+
+    scaled = StandardScaler(["x"]).fit_transform(ds)
+    xs = np.concatenate([b["x"] for b in scaled.iter_batches(
+        batch_size=32, batch_format="numpy")])
+    assert abs(xs.mean()) < 1e-6
+    assert abs(xs.std() - 1.0) < 1e-2
+
+    mm = MinMaxScaler(["y"]).fit_transform(ds)
+    ys = np.concatenate([b["y"] for b in mm.iter_batches(
+        batch_size=32, batch_format="numpy")])
+    assert ys.min() == 0.0 and ys.max() == 1.0
+
+    enc = LabelEncoder("label").fit_transform(ds)
+    labels = np.concatenate([b["label"] for b in enc.iter_batches(
+        batch_size=32, batch_format="numpy")])
+    assert set(labels.tolist()) == {0, 1}
+
+    cat = Concatenator(["x", "y"], output_column_name="features")
+    feats = next(iter(cat.transform(ds).iter_batches(
+        batch_size=10, batch_format="numpy")))["features"]
+    assert feats.shape == (10, 2)
+
+
+def test_preprocessor_requires_fit(shared_cluster):
+    from ray_tpu import data as rdata
+    from ray_tpu.data.preprocessors import StandardScaler
+
+    ds = rdata.from_items([{"x": 1.0}])
+    with pytest.raises(RuntimeError, match="must be fit"):
+        StandardScaler(["x"]).transform(ds)
+
+
+def test_ray_perf_runs(shared_cluster):
+    import subprocess
+    import sys
+
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    result = subprocess.run(
+        [sys.executable, "benchmarks/ray_perf.py", "--scale", "0.05"],
+        capture_output=True, text=True, timeout=300, cwd=repo)
+    assert result.returncode == 0, result.stderr[-800:]
+    import json
+
+    metrics = json.loads(result.stdout.strip().splitlines()[-1])
+    assert metrics["tasks_per_s"] > 0
+    assert metrics["actor_calls_sync_per_s"] > 0
