@@ -137,6 +137,7 @@ class ActorClass:
             "resources": _build_actor_resources(opts),
             "max_restarts": opts.get("max_restarts", 0),
             "max_concurrency": opts.get("max_concurrency", 1),
+            "runtime_env": opts.get("runtime_env"),
         }
         spec_opts.update(resolve_strategy(opts.get("scheduling_strategy")))
         actor_id = core.create_actor(

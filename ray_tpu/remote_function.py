@@ -61,6 +61,7 @@ class RemoteFunction:
             "max_retries": opts.get("max_retries", 3),
             "retry_exceptions": opts.get("retry_exceptions", False),
             "name": opts.get("name") or self._fn.__name__,
+            "runtime_env": opts.get("runtime_env"),
         }
         spec_opts.update(resolve_strategy(opts.get("scheduling_strategy")))
         refs = core.submit_task(self._export(), args, kwargs, spec_opts)

@@ -476,6 +476,7 @@ class CoreWorker:
             "placement_group_id": opts.get("placement_group_id"),
             "bundle_index": opts.get("bundle_index", -1),
             "scheduling_strategy": opts.get("scheduling_strategy"),
+            "runtime_env": opts.get("runtime_env"),
         }
         spec.update(self._pack_args(args, kwargs))
         for oid in return_ids:
@@ -579,6 +580,7 @@ class CoreWorker:
             "placement_group_id": opts.get("placement_group_id"),
             "bundle_index": opts.get("bundle_index", -1),
             "scheduling_strategy": opts.get("scheduling_strategy"),
+            "runtime_env": opts.get("runtime_env"),
             "owner_addr": self.address,
         }
         spec.update(self._pack_args(args, kwargs))

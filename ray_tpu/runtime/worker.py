@@ -15,6 +15,7 @@ loop thread so the RPC io loop never blocks on user code.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import collections
 import inspect
 import os
@@ -31,6 +32,36 @@ from .config import get_config
 from .core import CoreWorker, ObjectRef, set_core
 from .ids import ObjectID, TaskID, WorkerID
 from .rpc import EventLoopThread
+
+
+@contextlib.contextmanager
+def _applied_runtime_env(runtime_env):
+    """Scoped env_vars for one task (the reference isolates runtime envs
+    with per-env worker pools, ref: raylet/worker_pool.cc; here plain
+    tasks run one-at-a-time per worker so set/restore is equivalent for
+    env_vars). working_dir applies to actors only."""
+    env_vars = (runtime_env or {}).get("env_vars") or {}
+    if not env_vars:
+        yield
+        return
+    saved = {k: os.environ.get(k) for k in env_vars}
+    os.environ.update({k: str(v) for k, v in env_vars.items()})
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _apply_runtime_env_permanent(runtime_env):
+    runtime_env = runtime_env or {}
+    for k, v in (runtime_env.get("env_vars") or {}).items():
+        os.environ[k] = str(v)
+    if runtime_env.get("working_dir"):
+        os.chdir(runtime_env["working_dir"])
 
 
 class _UserLoop:
@@ -95,11 +126,14 @@ class Executor:
     def _run_task(self, spec: dict):
         task_id = spec["task_id"]
         try:
-            fn = self.core.load_function(spec["fn_key"])
-            args, kwargs = self._unpack_args(spec)
-            result = fn(*args, **kwargs)
-            if inspect.isgenerator(result):
-                result = list(result)
+            # the env context covers function load (module import time),
+            # arg deserialization, the call, AND generator consumption
+            with _applied_runtime_env(spec.get("runtime_env")):
+                fn = self.core.load_function(spec["fn_key"])
+                args, kwargs = self._unpack_args(spec)
+                result = fn(*args, **kwargs)
+                if inspect.isgenerator(result):
+                    result = list(result)
             self._send_results(spec, result)
         except Exception as e:
             self._send_error(spec, e)
@@ -173,6 +207,9 @@ class Executor:
                 max_workers=self.max_concurrency,
                 thread_name_prefix="rtpu-actor")
         try:
+            # actors own their worker process: runtime env applies for
+            # life, and BEFORE user code loads (import-time reads see it)
+            _apply_runtime_env_permanent(spec.get("runtime_env"))
             cls = self.core.load_function(spec["cls_key"])
             args, kwargs = self._unpack_args(spec)
             self.actor_instance = cls(*args, **kwargs)

@@ -31,6 +31,9 @@ from .config import CONTROLLER_NAME
 _SUBMIT_POOL = concurrent.futures.ThreadPoolExecutor(
     max_workers=64, thread_name_prefix="serve-submit")
 
+# prefix-affinity gives way to load balance beyond this in-flight skew
+_PREFIX_IMBALANCE = 4
+
 
 class DeploymentResponse:
     """Future-like result of handle.remote() (ref: serve/handle.py
@@ -142,9 +145,13 @@ class _Router:
                     f"after {timeout_s}s")
             time.sleep(0.1)
 
-    def pick(self) -> "Any":
+    def pick(self, routing_key: Optional[str] = None) -> "Any":
         """Power-of-two-choices over in-flight counts
-        (ref: pow_2_router.py:27)."""
+        (ref: pow_2_router.py:27). With a routing_key, prefer the
+        rendezvous-hash choice for that key (prefix-aware routing: requests
+        sharing a prompt prefix land on the replica whose KV prefix cache
+        already holds it; ref: request_router/prefix_aware/
+        prefix_aware_router.py) and fall back to pow-2 when saturated."""
         deadline = time.time() + 120.0
         while True:
             self.refresh()
@@ -156,6 +163,29 @@ class _Router:
                     self.cond.wait(timeout=0.2)
                     self._last_refresh = 0.0
                     continue
+                if routing_key is not None:
+                    # rendezvous hashing: stable under replica changes AND
+                    # across processes (hashlib, not salted builtin hash)
+                    import hashlib
+
+                    def _score(h):
+                        return hashlib.md5(
+                            f"{routing_key}|{h.actor_id}".encode()).digest()
+
+                    preferred = max(candidates, key=_score)
+                    pref_load = self.inflight.get(preferred.actor_id, 0)
+                    min_load = min(self.inflight.get(h.actor_id, 0)
+                                   for h in candidates)
+                    # prefix affinity only while the preferred replica is
+                    # not badly imbalanced vs the least-loaded one (the
+                    # reference's prefix router falls back on load, not
+                    # only at the hard cap) and under its cap
+                    if (pref_load - min_load <= _PREFIX_IMBALANCE
+                            and (self.max_ongoing <= 0
+                                 or pref_load < self.max_ongoing)):
+                        self.inflight[preferred.actor_id] = pref_load + 1
+                        return preferred
+                    # imbalanced/saturated: fall through to pow-2
                 if len(candidates) > 2:
                     candidates = random.sample(candidates, 2)
                 best = min(candidates,
@@ -184,24 +214,34 @@ class DeploymentHandle:
     routing state is rebuilt lazily in each process."""
 
     def __init__(self, app_name: str, deployment_name: str,
-                 method_name: str = "__call__"):
+                 method_name: str = "__call__",
+                 routing_key: Optional[str] = None):
         self.app_name = app_name
         self.deployment_name = deployment_name
         self._method_name = method_name
+        self._routing_key = routing_key
+
+    _UNSET = object()
 
     def options(self, *, method_name: Optional[str] = None,
+                routing_key: Any = _UNSET,
                 **_ignored) -> "DeploymentHandle":
-        return DeploymentHandle(self.app_name, self.deployment_name,
-                                method_name or self._method_name)
+        return DeploymentHandle(
+            self.app_name, self.deployment_name,
+            method_name or self._method_name,
+            self._routing_key if routing_key is DeploymentHandle._UNSET
+            else routing_key)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
-        return DeploymentHandle(self.app_name, self.deployment_name, name)
+        return DeploymentHandle(self.app_name, self.deployment_name, name,
+                                self._routing_key)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         app, deployment = self.app_name, self.deployment_name
         method_name = self._method_name
+        routing_key = self._routing_key
 
         def submit():
             resolved = tuple(
@@ -211,7 +251,7 @@ class DeploymentHandle:
                 k: (v._to_object_ref() if isinstance(v, DeploymentResponse)
                     else v) for k, v in kwargs.items()}
             router = _Router.get(app, deployment)
-            replica = router.pick()
+            replica = router.pick(routing_key)
             try:
                 ref = replica.handle_request.remote(method_name, resolved,
                                                     resolved_kw)
@@ -226,7 +266,8 @@ class DeploymentHandle:
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self.app_name, self.deployment_name, self._method_name))
+                (self.app_name, self.deployment_name, self._method_name,
+                 self._routing_key))
 
     def __repr__(self):
         return (f"DeploymentHandle({self.app_name}#{self.deployment_name}"

@@ -31,6 +31,10 @@ class LLMConfig:
     tokenizer: Any = None
     num_replicas: int = 1
     max_ongoing_requests: int = 64
+    # per-replica actor options (resources, runtime_env — e.g. pin
+    # JAX_PLATFORMS for CPU smoke deployments)
+    ray_actor_options: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
 
 
 @deployment
@@ -70,7 +74,7 @@ class LLMServer:
     async def generate(self, prompt: str = None, *,
                        prompt_ids: Optional[List[int]] = None,
                        max_tokens: int = 64, temperature: float = 0.0,
-                       top_k: int = 0, seed: int = 0) -> Dict[str, Any]:
+                       top_k: int = 0, seed: Optional[int] = None) -> Dict[str, Any]:
         """Generate to completion; returns text + token ids + usage."""
         if prompt_ids is None:
             prompt_ids = self.tokenizer.encode(prompt)
@@ -149,11 +153,16 @@ class OpenAIIngress:
         else:
             return {"error": {"message": f"unknown path {path}",
                               "type": "invalid_request_error"}}
-        out = await self.llm.generate.remote(
+        # prefix-aware routing: requests sharing a prompt prefix hit the
+        # replica whose prefix cache already holds it
+        prefix_key = prompt[:256]
+        out = await self.llm.options(
+            method_name="generate", routing_key=prefix_key).remote(
             prompt,
             max_tokens=int(body.get("max_tokens", 64)),
             temperature=float(body.get("temperature", 0.0)),
-            seed=int(body.get("seed", 0)))
+            seed=(int(body["seed"]) if body.get("seed") is not None
+                  else None))
         created = int(time.time())
         if kind == "chat.completion":
             choice = {"index": 0, "finish_reason": out["finish_reason"],
@@ -179,6 +188,7 @@ def build_openai_app(llm_config: LLMConfig):
         name=f"LLMServer:{llm_config.model_id}",
         num_replicas=llm_config.num_replicas,
         max_ongoing_requests=llm_config.max_ongoing_requests,
+        ray_actor_options=llm_config.ray_actor_options,
     ).bind(llm_config)
     return OpenAIIngress.options(name="OpenAIIngress").bind(
         server, llm_config.model_id)

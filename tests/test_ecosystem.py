@@ -126,3 +126,28 @@ def test_ray_perf_runs(shared_cluster):
     metrics = json.loads(result.stdout.strip().splitlines()[-1])
     assert metrics["tasks_per_s"] > 0
     assert metrics["actor_calls_sync_per_s"] > 0
+
+
+def test_runtime_env_env_vars(shared_cluster):
+    @ray_tpu.remote
+    def read_env():
+        import os
+
+        return os.environ.get("RTPU_TEST_FLAG")
+
+    assert ray_tpu.get(read_env.options(
+        runtime_env={"env_vars": {"RTPU_TEST_FLAG": "on"}}).remote(),
+        timeout=60) == "on"
+    # scoped: the var does not leak into later tasks on the same worker
+    assert ray_tpu.get(read_env.remote(), timeout=60) is None
+
+    @ray_tpu.remote
+    class EnvActor:
+        def read(self):
+            import os
+
+            return os.environ.get("RTPU_ACTOR_FLAG")
+
+    actor = EnvActor.options(
+        runtime_env={"env_vars": {"RTPU_ACTOR_FLAG": "actor-on"}}).remote()
+    assert ray_tpu.get(actor.read.remote(), timeout=60) == "actor-on"
