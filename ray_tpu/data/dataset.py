@@ -13,6 +13,8 @@ import itertools
 from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
                     Tuple, Union)
 
+import uuid
+
 import numpy as np
 
 from .block import Block, BlockAccessor, rows_to_block
@@ -98,6 +100,59 @@ class Dataset:
 
     def zip(self, other: "Dataset") -> "Dataset":
         return self._append(Zip(other=other._plan))
+
+    def join(self, other: "Dataset", on: Union[str, List[str]],
+             how: str = "inner", *, suffix: str = "_right") -> "Dataset":
+        """Broadcast hash join (ref: python/ray/data/dataset.py join; the
+        reference's join is a shuffle join — here the RIGHT side is
+        materialized and broadcast to the left's map tasks, the standard
+        plan for a small dimension table joined onto a large fact side).
+
+        Lazy like every other transform: the right side executes only when
+        the joined dataset is consumed (once per worker process, memoized
+        by join id).
+
+        how: "inner" | "left". Right columns colliding with left names get
+        `suffix`.
+        """
+        if how not in ("inner", "left"):
+            raise ValueError(f"unsupported join type {how!r}")
+        keys = [on] if isinstance(on, str) else list(on)
+        join_id = uuid.uuid4().hex
+        right_plan = other._plan
+
+        def _join_batch(batch: Dict[str, Any]) -> Dict[str, Any]:
+            lookup, extra_cols = _join_lookup(join_id, right_plan, keys)
+            n = len(next(iter(batch.values()))) if batch else 0
+            out: Dict[str, List[Any]] = {c: [] for c in batch}
+            left_names = set(batch)
+            renamed = {}
+            for col in extra_cols:
+                name = col + suffix if col in left_names else col
+                if name in out:
+                    raise ValueError(
+                        f"join output column {name!r} collides with an "
+                        f"existing left column even after suffixing; pass "
+                        f"a different suffix=")
+                renamed[col] = name
+                out[name] = []
+            for i in range(n):
+                key = tuple(batch[k][i] for k in keys)
+                matches = lookup.get(key)
+                if matches is None:
+                    if how == "inner":
+                        continue
+                    matches = [None]
+                for match in matches:
+                    for col in batch:
+                        out[col].append(batch[col][i])
+                    for col in extra_cols:
+                        out[renamed[col]].append(
+                            None if match is None else match[col])
+            return {k: np.asarray(v) if v and not isinstance(
+                v[0], (dict, list)) else v for k, v in out.items()}
+
+        return self.map_batches(_join_batch)
 
     def limit(self, n: int) -> "Dataset":
         return self._append(Limit(n=n))
@@ -351,6 +406,25 @@ class Dataset:
 
 def _count_block(block: Block) -> int:
     return BlockAccessor(block).num_rows()
+
+
+_JOIN_LOOKUPS: Dict[str, tuple] = {}
+
+
+def _join_lookup(join_id: str, right_plan, keys: List[str]):
+    """Materialize the join's right side once per process (broadcast side
+    of the hash join); later tasks in this worker reuse the lookup."""
+    cached = _JOIN_LOOKUPS.get(join_id)
+    if cached is not None:
+        return cached
+    rows = Dataset(right_plan).take_all()
+    lookup: Dict[tuple, List[dict]] = {}
+    for row in rows:
+        lookup.setdefault(tuple(row[k] for k in keys), []).append(row)
+    extra_cols = [c for c in (rows[0].keys() if rows else [])
+                  if c not in keys]
+    _JOIN_LOOKUPS[join_id] = (lookup, extra_cols)
+    return _JOIN_LOOKUPS[join_id]
 
 
 class GroupedData:

@@ -95,6 +95,7 @@ class Controller:
         self.placement_groups: Dict[str, Dict[str, Any]] = {}
         self.jobs: Dict[str, Dict[str, Any]] = {}
         self.unschedulable: collections.deque = collections.deque(maxlen=1000)
+        self.trace_spans: collections.deque = collections.deque(maxlen=100000)
         self.task_events: collections.deque = collections.deque(maxlen=100000)
         self.metrics: Dict[str, Any] = {}
         self._server = RpcServer(address, self._handlers(), on_disconnect=self._on_disconnect)
@@ -139,6 +140,8 @@ class Controller:
             # observability
             "add_task_events": self.add_task_events,
             "list_task_events": self.list_task_events,
+            "add_trace_spans": self.add_trace_spans,
+            "list_trace_spans": self.list_trace_spans,
             "report_metrics": self.report_metrics,
             "get_metrics": self.get_metrics,
             "cluster_status": self.cluster_status,
@@ -508,6 +511,13 @@ class Controller:
 
     async def list_task_events(self, limit: int = 1000):
         return list(self.task_events)[-limit:]
+
+    async def add_trace_spans(self, spans: List[Dict[str, Any]]):
+        self.trace_spans.extend(spans)
+        return True
+
+    async def list_trace_spans(self, limit: int = 10000):
+        return list(self.trace_spans)[-limit:]
 
     async def report_metrics(self, node_id: str, metrics: Dict[str, Any]):
         self.metrics[node_id] = metrics

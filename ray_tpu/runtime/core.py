@@ -478,6 +478,15 @@ class CoreWorker:
             "scheduling_strategy": opts.get("scheduling_strategy"),
             "runtime_env": opts.get("runtime_env"),
         }
+        from ..util import tracing
+
+        if tracing.is_enabled():
+            # propagate the ambient span so the worker's execution span
+            # parents under this submission (ref: tracing_helper.py
+            # _inject_tracing_into_function)
+            with tracing.span(f"task::{spec['name']}", kind="producer",
+                              attributes={"task_id": task_id.hex()}):
+                spec["trace_ctx"] = tracing.current_context()
         spec.update(self._pack_args(args, kwargs))
         for oid in return_ids:
             self.owned.add(oid)

@@ -128,12 +128,31 @@ class Executor:
         try:
             # the env context covers function load (module import time),
             # arg deserialization, the call, AND generator consumption
-            with _applied_runtime_env(spec.get("runtime_env")):
+            from ..util import tracing
+
+            if spec.get("trace_ctx") and not tracing.is_enabled():
+                tracing.enable()  # tracing is on cluster-wide when the
+                # submitter traces (ref: tracing_helper propagates the otel
+                # context the same way)
+            with _applied_runtime_env(spec.get("runtime_env")), \
+                    tracing.span(f"task::{spec.get('name', 'task')}",
+                                 kind="consumer",
+                                 context=spec.get("trace_ctx")):
                 fn = self.core.load_function(spec["fn_key"])
                 args, kwargs = self._unpack_args(spec)
                 result = fn(*args, **kwargs)
                 if inspect.isgenerator(result):
                     result = list(result)
+            if tracing.is_enabled():
+                # flush this task's spans to the controller so the driver's
+                # tracing.collect() sees worker-side spans
+                spans = tracing.drain()
+                if spans:
+                    try:
+                        self.core.controller.call(
+                            "add_trace_spans", spans=spans, _timeout=5)
+                    except Exception:
+                        pass
             self._send_results(spec, result)
         except Exception as e:
             self._send_error(spec, e)

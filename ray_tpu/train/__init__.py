@@ -28,11 +28,12 @@ from .session import (  # noqa: F401
     report,
 )
 from .trainer import JaxTrainer, get_dataset_shard  # noqa: F401
+from .torch import TorchTrainer  # noqa: F401
 
 __all__ = [
     "Checkpoint", "CheckpointConfig", "CheckpointManager", "FailureConfig",
     "Result", "RunConfig", "ScalingConfig", "TrainContext", "TrainController",
-    "JaxTrainer", "ScalingPolicy", "FixedScalingPolicy",
+    "JaxTrainer", "TorchTrainer", "ScalingPolicy", "FixedScalingPolicy",
     "ElasticScalingPolicy", "FailurePolicy", "report", "get_context",
     "get_checkpoint", "get_dataset_shard",
 ]

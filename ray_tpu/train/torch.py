@@ -1,0 +1,170 @@
+"""TorchTrainer: data-parallel torch training over cluster workers.
+
+Parity with the reference's flagship Train API (ref:
+python/ray/train/torch/torch_trainer.py TorchTrainer;
+train/torch/config.py:66 _setup_torch_process_group — TCP rendezvous with
+the cluster KV as the store coordinator here, same scheme as
+worker_group._maybe_init_jax_distributed; train/torch/
+train_loop_utils.py:153 prepare_model DDP wrap, prepare_data_loader).
+Torch in this stack is the CPU/DDP escape hatch — the TPU path is
+JaxTrainer — but the worker-group/controller machinery is shared, so torch
+loops get the same elasticity, failure policies and checkpointing.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+from .config import Result, RunConfig, ScalingConfig
+from .trainer import JaxTrainer
+
+
+class TorchTrainer(JaxTrainer):
+    """Runs `train_loop_per_worker` on N workers with a gloo process group
+    initialized before the loop (rendezvous through the cluster KV,
+    ref: train/torch/config.py:66)."""
+
+    def fit(self) -> Result:
+        inner = self.train_loop_per_worker
+        # per-fit nonce keys the rendezvous so concurrent/successive runs
+        # in one cluster can't cross-connect on a stale address
+        self.train_loop_per_worker = _with_torch_process_group(
+            inner, fit_id=uuid.uuid4().hex[:12])
+        try:
+            return super().fit()
+        finally:
+            self.train_loop_per_worker = inner
+
+
+def _with_torch_process_group(train_fn: Callable, fit_id: str) -> Callable:
+    def wrapped(config: Dict[str, Any]):
+        from . import get_context
+        from ..runtime.core import get_core
+        from .worker_group import _accepts_config
+
+        ctx = get_context()
+        world = ctx.get_world_size()
+        rank = ctx.get_world_rank()
+        core = get_core()
+        ns = f"__torch_pg:{ctx.experiment_name}"
+        key = f"master:{fit_id}:{world}"
+        if world > 1:
+            import torch.distributed as dist
+
+            if not dist.is_initialized():
+                if rank == 0:
+                    import socket
+
+                    sock = socket.socket()
+                    sock.bind(("", 0))
+                    port = sock.getsockname()[1]
+                    sock.close()
+                    host = socket.gethostbyname(socket.gethostname())
+                    core.controller.call(
+                        "kv_put", ns=ns, key=key,
+                        value=f"{host}:{port}".encode(), overwrite=True)
+                    addr = f"{host}:{port}"
+                else:
+                    deadline = time.monotonic() + 120
+                    addr = None
+                    while time.monotonic() < deadline:
+                        raw = core.controller.call("kv_get", ns=ns, key=key)
+                        if raw:
+                            addr = (raw.decode()
+                                    if isinstance(raw, bytes) else raw)
+                            break
+                        time.sleep(0.1)
+                    if addr is None:
+                        raise TimeoutError("torch rendezvous timed out")
+                dist.init_process_group(
+                    "gloo", init_method=f"tcp://{addr}",
+                    rank=rank, world_size=world)
+        try:
+            if _accepts_config(train_fn):
+                train_fn(config)
+            else:
+                train_fn()
+        finally:
+            if world > 1:
+                import torch.distributed as dist
+
+                if dist.is_initialized():
+                    dist.destroy_process_group()
+                if rank == 0:
+                    try:  # clear the address so restarts re-rendezvous
+                        core.controller.call("kv_del", ns=ns, key=key)
+                    except Exception:
+                        pass
+
+    return wrapped
+
+
+def prepare_model(model):
+    """Wrap in DDP when distributed (ref: train_loop_utils.py:153)."""
+    import torch.distributed as dist
+
+    if dist.is_available() and dist.is_initialized() \
+            and dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+
+        return DistributedDataParallel(model)
+    return model
+
+
+class _DistributedLoader:
+    """Iterates the rebuilt loader, bumping the sampler epoch each pass so
+    shuffling differs across epochs (the reference's prepare_data_loader
+    handles set_epoch the same way)."""
+
+    def __init__(self, loader, sampler):
+        self._loader = loader
+        self._sampler = sampler
+        self._epoch = 0
+
+    def __iter__(self):
+        self._sampler.set_epoch(self._epoch)
+        self._epoch += 1
+        return iter(self._loader)
+
+    def __len__(self):
+        return len(self._loader)
+
+    def __getattr__(self, name):
+        return getattr(self._loader, name)
+
+
+def prepare_data_loader(data_loader):
+    """Shard a DataLoader across workers with a DistributedSampler
+    (ref: train_loop_utils.py prepare_data_loader). Preserves the
+    loader's settings; batch_sampler-based loaders are not supported."""
+    import torch.distributed as dist
+
+    if not (dist.is_available() and dist.is_initialized()
+            and dist.get_world_size() > 1):
+        return data_loader
+    if data_loader.batch_size is None:
+        raise NotImplementedError(
+            "prepare_data_loader does not support batch_sampler-based "
+            "DataLoaders; pass batch_size instead")
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+
+    sampler = DistributedSampler(data_loader.dataset)
+    kwargs = dict(
+        batch_size=data_loader.batch_size,
+        sampler=sampler,
+        num_workers=data_loader.num_workers,
+        collate_fn=data_loader.collate_fn,
+        pin_memory=data_loader.pin_memory,
+        drop_last=data_loader.drop_last,
+        timeout=data_loader.timeout,
+        worker_init_fn=data_loader.worker_init_fn,
+        generator=data_loader.generator,
+    )
+    if data_loader.num_workers > 0:
+        kwargs["prefetch_factor"] = data_loader.prefetch_factor
+        kwargs["persistent_workers"] = data_loader.persistent_workers
+    return _DistributedLoader(DataLoader(data_loader.dataset, **kwargs),
+                              sampler)

@@ -1,0 +1,129 @@
+"""Distributed tracing: spans around task/actor submission and execution.
+
+Parity with the reference's tracing layer (ref:
+python/ray/util/tracing/tracing_helper.py — opt-in wrappers around
+submit/execute that propagate an OpenTelemetry context through task specs;
+enabled via ray.init(_tracing_startup_hook=...)). Here tracing is
+self-contained: spans are plain dicts flushed through the task-event
+channel to the controller, with trace/parent ids propagated in task specs,
+and exportable as chrome-trace or OTLP-shaped JSON. Opt-in via
+`tracing.enable()` (no-op overhead when off).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+_enabled = False
+_lock = threading.Lock()
+_finished: List[Dict[str, Any]] = []
+_current_span: contextvars.ContextVar = contextvars.ContextVar(
+    "rtpu_span", default=None)
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def current_context() -> Optional[Dict[str, str]]:
+    """The (trace_id, span_id) pair to propagate to a child process."""
+    span = _current_span.get()
+    if span is None:
+        return None
+    return {"trace_id": span["trace_id"], "parent_id": span["span_id"]}
+
+
+@contextlib.contextmanager
+def span(name: str, kind: str = "internal",
+         context: Optional[Dict[str, str]] = None,
+         attributes: Optional[Dict[str, Any]] = None):
+    """Record one span. `context` carries a remote parent (from
+    current_context() shipped in a task spec); otherwise the parent is the
+    ambient span in this task/thread."""
+    if not _enabled:
+        yield None
+        return
+    parent = _current_span.get()
+    trace_id = (context or {}).get("trace_id") or (
+        parent["trace_id"] if parent else uuid.uuid4().hex)
+    parent_id = (context or {}).get("parent_id") or (
+        parent["span_id"] if parent else None)
+    record = {
+        "name": name,
+        "kind": kind,
+        "trace_id": trace_id,
+        "span_id": uuid.uuid4().hex[:16],
+        "parent_id": parent_id,
+        "start": time.time(),
+        "attributes": dict(attributes or {}),
+    }
+    token = _current_span.set(record)
+    try:
+        yield record
+    except Exception as e:
+        record["attributes"]["error"] = repr(e)
+        record["status"] = "ERROR"
+        raise
+    finally:
+        record["end"] = time.time()
+        record.setdefault("status", "OK")
+        _current_span.reset(token)
+        with _lock:
+            _finished.append(record)
+
+
+def drain() -> List[Dict[str, Any]]:
+    """Return + clear this process's finished spans."""
+    with _lock:
+        out, _finished[:] = list(_finished), []
+    return out
+
+
+def collect() -> List[Dict[str, Any]]:
+    """All spans: this process's (drained) + the cluster's (workers flush
+    theirs to the controller after each traced task)."""
+    spans = drain()
+    try:
+        from ..runtime.core import get_core
+
+        core = get_core(required=False)
+        if core is not None:
+            spans.extend(core.controller.call("list_trace_spans",
+                                              _timeout=10))
+    except Exception:
+        pass
+    return spans
+
+
+def chrome_trace(spans: Optional[List[Dict[str, Any]]] = None
+                 ) -> List[Dict[str, Any]]:
+    """Spans as chrome://tracing complete events (grouped per trace)."""
+    out = []
+    for record in (spans if spans is not None else drain()):
+        out.append({
+            "ph": "X",
+            "name": record["name"],
+            "cat": record["kind"],
+            "pid": record["trace_id"][:8],
+            "tid": (record["parent_id"] or record["span_id"])[:8],
+            "ts": record["start"] * 1e6,
+            "dur": max(record["end"] - record["start"], 0.0) * 1e6,
+            "args": {**record["attributes"], "span_id": record["span_id"],
+                     "status": record["status"]},
+        })
+    return out

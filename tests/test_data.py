@@ -194,3 +194,24 @@ def test_materialize_caches():
     ds.count()
     # map ran once per block during materialize only
     assert ds._plan.ops[0].__class__.__name__ == "InputData"
+
+
+def test_join_inner_and_left(shared_cluster):
+    import ray_tpu.data as rdata
+
+    left = rdata.from_items([{"id": i, "value": i * 10} for i in range(6)])
+    right = rdata.from_items([{"id": i, "label": f"L{i}"}
+                              for i in range(0, 6, 2)])
+    inner = left.join(right, on="id").take_all()
+    assert sorted(r["id"] for r in inner) == [0, 2, 4]
+    assert all(r["label"] == f"L{r['id']}" for r in inner)
+
+    left_join = left.join(right, on="id", how="left").take_all()
+    assert len(left_join) == 6
+    missing = [r for r in left_join if r["id"] % 2 == 1]
+    assert all(r["label"] is None for r in missing)
+
+    # column collision gets suffixed
+    right2 = rdata.from_items([{"id": i, "value": -i} for i in range(6)])
+    joined = left.join(right2, on="id").take_all()
+    assert all(r["value_right"] == -r["id"] for r in joined)

@@ -144,3 +144,34 @@ def test_cli_attaches_to_running_session(shared_cluster):
         [sys.executable, "-m", "ray_tpu", "status"],
         capture_output=True, text=True, timeout=120)
     assert result.returncode == 0, result.stderr[-800:]
+
+
+def test_tracing_spans_propagate(shared_cluster):
+    from ray_tpu.util import tracing
+
+    tracing.enable()
+    try:
+        @ray_tpu.remote
+        def traced_task():
+            from ray_tpu.util import tracing as t
+
+            with t.span("inner-work"):
+                pass
+            return [s["trace_id"] for s in t.drain()]
+
+        with tracing.span("driver-root") as root:
+            inner_traces = ray_tpu.get(traced_task.remote(), timeout=60)
+        spans = tracing.collect()  # local + worker spans via controller
+        names = {s["name"] for s in spans}
+        assert "driver-root" in names
+        assert any(s["name"].startswith("task::traced_task")
+                   for s in spans)
+        # worker-side execution span reached the controller with the
+        # driver's trace id
+        worker_spans = [s for s in spans if s["kind"] == "consumer"]
+        assert any(s["trace_id"] == root["trace_id"] for s in worker_spans)
+        assert inner_traces and inner_traces[0] == root["trace_id"]
+        trace = tracing.chrome_trace(spans)
+        assert all(e["ph"] == "X" for e in trace)
+    finally:
+        tracing.disable()
