@@ -408,14 +408,23 @@ def _count_block(block: Block) -> int:
     return BlockAccessor(block).num_rows()
 
 
-_JOIN_LOOKUPS: Dict[str, tuple] = {}
+import collections as _collections
+
+_JOIN_LOOKUPS: "_collections.OrderedDict[str, tuple]" = \
+    _collections.OrderedDict()
+_JOIN_LOOKUPS_MAX = 8  # LRU bound: each entry pins a full right table
 
 
 def _join_lookup(join_id: str, right_plan, keys: List[str]):
     """Materialize the join's right side once per process (broadcast side
-    of the hash join); later tasks in this worker reuse the lookup."""
+    of the hash join); later tasks in this worker reuse the lookup, bounded
+    by an LRU so long-lived workers don't accumulate right tables.
+
+    Limitation: an EMPTY right side yields no right-column schema, so a
+    left join against it emits left columns only."""
     cached = _JOIN_LOOKUPS.get(join_id)
     if cached is not None:
+        _JOIN_LOOKUPS.move_to_end(join_id)
         return cached
     rows = Dataset(right_plan).take_all()
     lookup: Dict[tuple, List[dict]] = {}
@@ -424,6 +433,8 @@ def _join_lookup(join_id: str, right_plan, keys: List[str]):
     extra_cols = [c for c in (rows[0].keys() if rows else [])
                   if c not in keys]
     _JOIN_LOOKUPS[join_id] = (lookup, extra_cols)
+    while len(_JOIN_LOOKUPS) > _JOIN_LOOKUPS_MAX:
+        _JOIN_LOOKUPS.popitem(last=False)
     return _JOIN_LOOKUPS[join_id]
 
 

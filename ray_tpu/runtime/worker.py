@@ -130,10 +130,6 @@ class Executor:
             # arg deserialization, the call, AND generator consumption
             from ..util import tracing
 
-            if spec.get("trace_ctx") and not tracing.is_enabled():
-                tracing.enable()  # tracing is on cluster-wide when the
-                # submitter traces (ref: tracing_helper propagates the otel
-                # context the same way)
             with _applied_runtime_env(spec.get("runtime_env")), \
                     tracing.span(f"task::{spec.get('name', 'task')}",
                                  kind="consumer",
@@ -143,20 +139,23 @@ class Executor:
                 result = fn(*args, **kwargs)
                 if inspect.isgenerator(result):
                     result = list(result)
-            if tracing.is_enabled():
-                # flush this task's spans to the controller so the driver's
-                # tracing.collect() sees worker-side spans
-                spans = tracing.drain()
-                if spans:
-                    try:
-                        self.core.controller.call(
-                            "add_trace_spans", spans=spans, _timeout=5)
-                    except Exception:
-                        pass
             self._send_results(spec, result)
         except Exception as e:
             self._send_error(spec, e)
         finally:
+            if spec.get("trace_ctx"):
+                # flush this task's spans (incl. ERROR spans from failed
+                # tasks) to the controller, one-way so the result path
+                # never blocks on it
+                from ..util import tracing as _tracing
+
+                spans = _tracing.drain()
+                if spans:
+                    try:
+                        self.core.controller.notify("add_trace_spans",
+                                                    spans=spans)
+                    except Exception:
+                        pass
             try:
                 self.core.nodelet.notify("task_finished",
                                          worker_id=self.core.worker_id.hex(),

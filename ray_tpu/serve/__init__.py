@@ -20,11 +20,12 @@ from .api import (  # noqa: F401
 from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions  # noqa: F401
 from .deployment import Application, Deployment, deployment  # noqa: F401
 from .handle import DeploymentHandle, DeploymentResponse  # noqa: F401
+from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
 from .replica import Request  # noqa: F401
 
 __all__ = [
     "deployment", "Deployment", "Application", "run", "start", "status",
     "delete", "shutdown", "get_app_handle", "get_deployment_handle",
-    "get_proxy_url", "DeploymentHandle", "DeploymentResponse",
+    "get_proxy_url", "DeploymentHandle", "DeploymentResponse", "multiplexed", "get_multiplexed_model_id",
     "AutoscalingConfig", "DeploymentConfig", "HTTPOptions", "Request",
 ]

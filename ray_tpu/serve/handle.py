@@ -215,33 +215,47 @@ class DeploymentHandle:
 
     def __init__(self, app_name: str, deployment_name: str,
                  method_name: str = "__call__",
-                 routing_key: Optional[str] = None):
+                 routing_key: Optional[str] = None,
+                 model_id: Optional[str] = None):
         self.app_name = app_name
         self.deployment_name = deployment_name
         self._method_name = method_name
         self._routing_key = routing_key
+        self._model_id = model_id
 
     _UNSET = object()
 
     def options(self, *, method_name: Optional[str] = None,
                 routing_key: Any = _UNSET,
+                multiplexed_model_id: Optional[str] = None,
                 **_ignored) -> "DeploymentHandle":
-        return DeploymentHandle(
+        handle = DeploymentHandle(
             self.app_name, self.deployment_name,
             method_name or self._method_name,
             self._routing_key if routing_key is DeploymentHandle._UNSET
-            else routing_key)
+            else routing_key,
+            self._model_id)
+        if multiplexed_model_id is not None:
+            # the model id routes (affinity: reuse the replica that has the
+            # model loaded, ref: serve multiplexed routing) AND travels
+            # with the request so get_multiplexed_model_id() sees it
+            handle._routing_key = multiplexed_model_id
+            handle._model_id = multiplexed_model_id
+        return handle
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
         return DeploymentHandle(self.app_name, self.deployment_name, name,
-                                self._routing_key)
+                                self._routing_key, self._model_id)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         app, deployment = self.app_name, self.deployment_name
         method_name = self._method_name
         routing_key = self._routing_key
+        model_id = self._model_id
+        if model_id is not None:
+            kwargs = {**kwargs, "_multiplexed_model_id": model_id}
 
         def submit():
             resolved = tuple(
@@ -267,7 +281,7 @@ class DeploymentHandle:
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.app_name, self.deployment_name, self._method_name,
-                 self._routing_key))
+                 self._routing_key, self._model_id))
 
     def __repr__(self):
         return (f"DeploymentHandle({self.app_name}#{self.deployment_name}"

@@ -1,0 +1,83 @@
+"""Model multiplexing: many models per deployment, LRU-cached per replica.
+
+Parity with the reference (ref: python/ray/serve/api.py @serve.multiplexed;
+serve/_private/multiplex.py _ModelMultiplexWrapper — per-replica LRU of
+loaded models keyed by model id; serve.get_multiplexed_model_id reads the
+id of the CURRENT request). Requests carry the model id through the handle
+(`handle.options(multiplexed_model_id=...)`), which doubles as the routing
+key so repeat requests for one model land on the replica that has it
+loaded.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import contextvars
+import functools
+import inspect
+from typing import Any, Callable, Optional
+
+_current_model_id: contextvars.ContextVar = contextvars.ContextVar(
+    "rtpu_multiplexed_model_id", default="")
+
+
+def get_multiplexed_model_id() -> str:
+    """The model id of the request being handled (ref: serve/api.py
+    get_multiplexed_model_id)."""
+    return _current_model_id.get()
+
+
+def _set_model_id(model_id: str):
+    return _current_model_id.set(model_id)
+
+
+def multiplexed(func: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """Decorate an async `load_model(self, model_id)` method; calls are
+    LRU-cached per replica and evictions release the oldest model."""
+
+    def wrap(load_fn):
+        if not inspect.iscoroutinefunction(load_fn):
+            raise TypeError("@serve.multiplexed requires an async loader")
+
+        cache: "collections.OrderedDict[str, Any]" = collections.OrderedDict()
+        inflight: dict = {}  # model_id -> Task (concurrent misses share it)
+        lock = asyncio.Lock()
+
+        @functools.wraps(load_fn)
+        async def loader(self, model_id: Optional[str] = None):
+            if model_id is None:
+                model_id = get_multiplexed_model_id()
+            async with lock:
+                if model_id in cache:
+                    cache.move_to_end(model_id)
+                    return cache[model_id]
+                task = inflight.get(model_id)
+                if task is None:
+                    task = asyncio.ensure_future(load_fn(self, model_id))
+                    inflight[model_id] = task
+            try:
+                model = await task
+            finally:
+                async with lock:
+                    inflight.pop(model_id, None)
+            async with lock:
+                cache[model_id] = model
+                cache.move_to_end(model_id)
+                while len(cache) > max_num_models_per_replica:
+                    evicted_id, evicted = cache.popitem(last=False)
+                    del_fn = getattr(evicted, "__del__", None)
+                    if callable(del_fn):
+                        try:
+                            del_fn()
+                        except Exception:
+                            pass
+            return model
+
+        loader._is_multiplexed = True
+        return loader
+
+    if func is not None:
+        return wrap(func)
+    return wrap

@@ -65,6 +65,12 @@ class ReplicaActor:
                              kwargs: dict) -> Any:
         self._ongoing += 1
         self._total += 1
+        model_id = kwargs.pop("_multiplexed_model_id", None)
+        token = None
+        if model_id is not None:
+            from .multiplex import _set_model_id
+
+            token = _set_model_id(model_id)
         try:
             if method_name in ("__call__", ""):
                 target = self._user_callable
@@ -78,6 +84,10 @@ class ReplicaActor:
             return out
         finally:
             self._ongoing -= 1
+            if token is not None:
+                from .multiplex import _current_model_id
+
+                _current_model_id.reset(token)
 
     def reconfigure(self, user_config: Any) -> None:
         self._config.user_config = user_config

@@ -78,9 +78,26 @@ def _with_torch_process_group(train_fn: Callable, fit_id: str) -> Callable:
                         time.sleep(0.1)
                     if addr is None:
                         raise TimeoutError("torch rendezvous timed out")
-                dist.init_process_group(
-                    "gloo", init_method=f"tcp://{addr}",
-                    rank=rank, world_size=world)
+                import datetime
+
+                try:
+                    dist.init_process_group(
+                        "gloo", init_method=f"tcp://{addr}",
+                        rank=rank, world_size=world,
+                        timeout=datetime.timedelta(seconds=60))
+                except Exception:
+                    # stale address from a previous attempt (rank-0 crash
+                    # skipped kv_del): re-poll once — the restarted rank 0
+                    # overwrites the key with its fresh address
+                    if rank == 0:
+                        raise
+                    time.sleep(2.0)
+                    raw = core.controller.call("kv_get", ns=ns, key=key)
+                    addr = raw.decode() if isinstance(raw, bytes) else raw
+                    dist.init_process_group(
+                        "gloo", init_method=f"tcp://{addr}",
+                        rank=rank, world_size=world,
+                        timeout=datetime.timedelta(seconds=60))
         try:
             if _accepts_config(train_fn):
                 train_fn(config)
@@ -151,7 +168,15 @@ def prepare_data_loader(data_loader):
     from torch.utils.data import DataLoader
     from torch.utils.data.distributed import DistributedSampler
 
-    sampler = DistributedSampler(data_loader.dataset)
+    # preserve the loader's ordering semantics: SequentialSampler means
+    # the user asked for unshuffled data (ref: prepare_data_loader derives
+    # shuffle from the existing sampler)
+    from torch.utils.data import SequentialSampler
+
+    shuffle = not isinstance(getattr(data_loader, "sampler", None),
+                             SequentialSampler)
+    sampler = DistributedSampler(data_loader.dataset, shuffle=shuffle,
+                                 drop_last=data_loader.drop_last)
     kwargs = dict(
         batch_size=data_loader.batch_size,
         sampler=sampler,

@@ -55,7 +55,11 @@ def span(name: str, kind: str = "internal",
     """Record one span. `context` carries a remote parent (from
     current_context() shipped in a task spec); otherwise the parent is the
     ambient span in this task/thread."""
-    if not _enabled:
+    if not (_enabled or context is not None
+            or _current_span.get() is not None):
+        # record when tracing is on, a remote parent context arrived with
+        # the work, or an ambient traced span is open — so user spans
+        # inside a traced task record without latching the process flag
         yield None
         return
     parent = _current_span.get()
@@ -96,7 +100,10 @@ def drain() -> List[Dict[str, Any]]:
 
 def collect() -> List[Dict[str, Any]]:
     """All spans: this process's (drained) + the cluster's (workers flush
-    theirs to the controller after each traced task)."""
+    theirs to the controller after each traced task). The controller side
+    is a RETAINED ring (up to 100k spans, like the task-event sink), so
+    repeated collect() calls re-return cluster spans; local spans are
+    consumed."""
     spans = drain()
     try:
         from ..runtime.core import get_core

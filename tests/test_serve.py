@@ -206,3 +206,42 @@ def test_replica_failure_recovers(serve_cluster):
             time.sleep(0.3)
     assert ok, "replica was not replaced after failure"
     serve.delete("fragile")
+
+
+def test_model_multiplexing(serve_cluster):
+    """@serve.multiplexed LRU-caches models per replica; the request's
+    model id routes with affinity and is visible via
+    get_multiplexed_model_id (ref: serve multiplex API)."""
+
+    @serve.deployment(num_replicas=2)
+    class MultiModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"id": model_id, "weights": len(model_id)}
+
+        async def __call__(self, x):
+            model_id = serve.get_multiplexed_model_id()
+            model = await self.get_model()
+            return {"model": model["id"], "out": x * model["weights"],
+                    "loads": list(self.loads)}
+
+    handle = serve.run(MultiModel.bind(), name="mux")
+    try:
+        out1 = handle.options(multiplexed_model_id="abc").remote(2)\
+            .result(timeout_s=60)
+        assert out1["model"] == "abc" and out1["out"] == 6
+        # same model id -> same replica, loader NOT re-run (LRU hit)
+        out2 = handle.options(multiplexed_model_id="abc").remote(3)\
+            .result(timeout_s=60)
+        assert out2["out"] == 9
+        assert out2["loads"].count("abc") == 1
+        # different model id loads separately
+        out3 = handle.options(multiplexed_model_id="wxyz").remote(1)\
+            .result(timeout_s=60)
+        assert out3["model"] == "wxyz" and out3["out"] == 4
+    finally:
+        serve.delete("mux")
