@@ -10,13 +10,21 @@ Tune-compatible trainables.
 """
 
 from .algorithms.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
+from .algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig  # noqa: F401
 from .algorithms.dqn import DQN, DQNConfig  # noqa: F401
+from .algorithms.impala import (APPO, IMPALA, APPOConfig,  # noqa: F401
+                                IMPALAConfig)
 from .algorithms.ppo import PPO, PPOConfig  # noqa: F401
+from .algorithms.sac import SAC, SACConfig  # noqa: F401
 from .core.learner import Learner  # noqa: F401
-from .core.rl_module import DiscreteMLPModule, RLModuleSpec  # noqa: F401
+from .core.rl_module import (DiscreteMLPModule, GaussianMLPModule,  # noqa: F401
+                             RLModuleSpec, SACModule)
 from .env.env_runner import SingleAgentEnvRunner  # noqa: F401
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
-    "Learner", "RLModuleSpec", "DiscreteMLPModule", "SingleAgentEnvRunner",
+    "SAC", "SACConfig", "IMPALA", "IMPALAConfig", "APPO", "APPOConfig",
+    "BC", "BCConfig", "MARWIL", "MARWILConfig",
+    "Learner", "RLModuleSpec", "DiscreteMLPModule", "GaussianMLPModule",
+    "SACModule", "SingleAgentEnvRunner",
 ]

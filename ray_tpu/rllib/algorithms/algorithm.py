@@ -116,6 +116,7 @@ class Algorithm:
              "jax_platform": config.jax_platform},
             num_env_runners=config.num_env_runners, seed=config.seed)
         obs_space, act_space = self.env_runner_group.get_spaces()
+        self.obs_space, self.act_space = obs_space, act_space
         module_spec = config.module_spec
         learner_cls = self.learner_class
         learner_cfg = config.learner_config()

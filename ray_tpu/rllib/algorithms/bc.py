@@ -1,0 +1,160 @@
+"""Offline RL: MARWIL and BC (behavior cloning).
+
+Parity with the reference (ref: rllib/algorithms/marwil/marwil.py — BC is
+MARWIL with beta=0, ref: rllib/algorithms/bc/bc.py; loss ref:
+rllib/algorithms/marwil/torch/marwil_torch_learner.py — advantage-
+exponentiated imitation weight + value-function regression).
+
+Offline data is consumed as recorded episodes (lists of Episode objects or
+plain {"obs", "actions", "rewards"} dicts) or any iterable of such; the
+Monte-Carlo returns that MARWIL weights against are computed once up
+front, so each update is a pure minibatch op.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.learner import Learner
+from ..core.rl_module import categorical_logp
+from ..env.episodes import Episode
+from .algorithm import Algorithm, AlgorithmConfig
+
+
+def _rtg(rewards: np.ndarray, gamma: float) -> np.ndarray:
+    """Discounted returns-to-go for one reward stream."""
+    rtg = np.zeros_like(rewards)
+    acc = 0.0
+    for t in range(len(rewards) - 1, -1, -1):
+        acc = rewards[t] + gamma * acc
+        rtg[t] = acc
+    return rtg
+
+
+def _returns_std(data, gamma: float) -> float:
+    """Std of discounted returns-to-go across the dataset — MARWIL's
+    advantage scale. Touches only the reward streams (no obs flattening),
+    so it is cheap enough to run at config time."""
+    chunks = [
+        _rtg(np.asarray(item.rewards if isinstance(item, Episode)
+                        else item["rewards"], np.float32), gamma)
+        for item in data]
+    return float(np.std(np.concatenate(chunks)) + 1e-6)
+
+
+def _to_offline_batch(data, gamma: float) -> Dict[str, np.ndarray]:
+    """Flatten episodes into one batch with discounted returns-to-go."""
+    batches = []
+    for item in data:
+        if isinstance(item, Episode):
+            batch = item.to_batch()
+        else:
+            batch = {k: np.asarray(v) for k, v in item.items()}
+        batches.append({"obs": batch["obs"].astype(np.float32),
+                        "actions": batch["actions"],
+                        "returns": _rtg(
+                            batch["rewards"].astype(np.float32), gamma)})
+    return {key: np.concatenate([b[key] for b in batches])
+            for key in ("obs", "actions", "returns")}
+
+
+class MARWILLearner(Learner):
+    def loss(self, params, batch):
+        cfg = self.config
+        beta = cfg.get("beta", 1.0)
+        fwd = self.module.forward_train(params, batch["obs"])
+        logp = categorical_logp(fwd["logits"], batch["actions"])
+        if beta == 0.0:  # pure BC: no critic, no weighting
+            bc_loss = -logp.mean()
+            return bc_loss, {"bc_loss": bc_loss,
+                             "logp_mean": logp.mean()}
+        vf = fwd["vf"]
+        adv = batch["returns"] - vf
+        # exponentiated-advantage imitation weight; advantage is
+        # stop-gradded (the critic learns only from its own MSE term).
+        # adv_scale is a dataset-level constant baked into the learner
+        # config (a per-batch scalar would break LearnerGroup sharding).
+        adv_scale = cfg.get("adv_scale", 1.0)
+        weight = jnp.exp(jnp.clip(
+            beta * jax.lax.stop_gradient(adv) / max(adv_scale, 1e-8),
+            -10.0, 10.0))
+        pi_loss = -(weight * logp).mean()
+        vf_loss = jnp.square(adv).mean()
+        total = pi_loss + cfg.get("vf_coeff", 1.0) * vf_loss
+        return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                       "mean_weight": weight.mean(),
+                       "logp_mean": logp.mean()}
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = MARWIL
+        self.beta = 1.0
+        self.vf_coeff = 1.0
+        self.offline_data: Union[List, None] = None
+        self.minibatch_size = 256
+        self.updates_per_iteration = 50
+
+    def offline(self, *, data=None, beta=None) -> "AlgorithmConfig":
+        if data is not None:
+            self.offline_data = data
+        if beta is not None:
+            self.beta = beta
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        # the dataset is read-only to the algorithm; share it by reference
+        # instead of letting deepcopy duplicate (possibly GBs of) arrays
+        data, self.offline_data = self.offline_data, None
+        try:
+            dup = super().copy()
+        finally:
+            self.offline_data = data
+        dup.offline_data = data
+        return dup
+
+    def learner_config(self) -> Dict[str, Any]:
+        cfg = super().learner_config()
+        cfg.update(beta=self.beta, vf_coeff=self.vf_coeff)
+        if self.beta and self.offline_data is not None:
+            cfg["adv_scale"] = _returns_std(self.offline_data, self.gamma)
+        return cfg
+
+
+class MARWIL(Algorithm):
+    learner_class = MARWILLearner
+
+    def __init__(self, config):
+        super().__init__(config)
+        assert config.offline_data is not None, \
+            "MARWIL/BC need config.offline(data=...)"
+        self._batch = _to_offline_batch(config.offline_data, config.gamma)
+        self._rng = np.random.default_rng(config.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        n = len(self._batch["returns"])
+        metrics: Dict[str, float] = {}
+        for _ in range(cfg.updates_per_iteration):
+            idx = self._rng.integers(0, n, min(cfg.minibatch_size, n))
+            metrics = self.learner_group.update(
+                {key: val[idx] for key, val in self._batch.items()})
+        return metrics
+
+
+class BCConfig(MARWILConfig):
+    """BC = MARWIL with beta=0 (ref: rllib/algorithms/bc/bc.py)."""
+
+    def __init__(self):
+        super().__init__()
+        self.algo_class = BC
+        self.beta = 0.0
+
+
+class BC(MARWIL):
+    pass

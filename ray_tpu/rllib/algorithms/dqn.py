@@ -13,7 +13,9 @@ import numpy as np
 
 from ..core.learner import Learner
 from ..core.rl_module import QMLPModule, RLModuleSpec
-from ..utils.replay_buffers import UniformReplayBuffer
+from ..env.episodes import episode_to_transitions
+from ..utils.replay_buffers import (PrioritizedReplayBuffer,
+                                    UniformReplayBuffer)
 from .algorithm import Algorithm, AlgorithmConfig
 
 
@@ -42,7 +44,10 @@ class DQNLearner(Learner):
         target = batch["rewards"] + gamma * (1 - batch["dones"]) * \
             jax.lax.stop_gradient(q_next)
         td = q - target
-        loss = jnp.square(td).mean()
+        if "weights" in batch:  # prioritized replay: IS-corrected TD loss
+            loss = (batch["weights"] * jnp.square(td)).mean()
+        else:
+            loss = jnp.square(td).mean()
         return loss, {"td_error_mean": jnp.abs(td).mean(),
                       "q_mean": q.mean()}
 
@@ -67,6 +72,9 @@ class DQNConfig(AlgorithmConfig):
         self.algo_class = DQN
         self.module_spec = RLModuleSpec(module_class=QMLPModule)
         self.buffer_size = 50_000
+        self.replay_buffer = "uniform"  # or "prioritized"
+        self.prioritized_alpha = 0.6
+        self.prioritized_beta = 0.4
         self.learning_starts = 1000
         self.rollout_fragment_length = 200
         self.update_batch_size = 64
@@ -87,8 +95,31 @@ class DQN(Algorithm):
 
     def __init__(self, config):
         super().__init__(config)
-        self.buffer = UniformReplayBuffer(config.buffer_size,
-                                          seed=config.seed)
+        if config.replay_buffer == "prioritized":
+            self.buffer = PrioritizedReplayBuffer(
+                config.buffer_size, alpha=config.prioritized_alpha,
+                beta=config.prioritized_beta, seed=config.seed)
+            # driver-side TD computation for priority feedback; uses the
+            # online net for both roles (priorities are a sampling
+            # heuristic — the exact double-Q target is not needed here)
+            module = config.module_spec.build(self.obs_space,
+                                              self.act_space)
+            gamma = config.gamma
+
+            def _td(params, batch):
+                q_all = module.forward_train(params, batch["obs"])["q"]
+                q = jnp.take_along_axis(
+                    q_all, batch["actions"][..., None], axis=-1)[..., 0]
+                q_next = module.forward_train(
+                    params, batch["next_obs"])["q"].max(-1)
+                target = batch["rewards"] \
+                    + gamma * (1 - batch["dones"]) * q_next
+                return q - target
+
+            self._jit_td = jax.jit(_td)
+        else:
+            self.buffer = UniformReplayBuffer(config.buffer_size,
+                                              seed=config.seed)
 
     def _epsilon(self) -> float:
         cfg = self.config
@@ -105,27 +136,25 @@ class DQN(Algorithm):
             epsilon=self._epsilon())
         self._record_episodes(episodes)
         for episode in episodes:
-            batch = episode.to_batch()
-            obs = batch["obs"]
-            if len(obs) < 2 and not episode.terminated:
-                continue
-            next_obs = np.concatenate([obs[1:], obs[-1:]], axis=0)
-            dones = np.zeros(len(obs), np.float32)
-            if episode.terminated:
-                # final next_obs is unused when done=1
-                dones[-1] = 1.0
-                keep = len(obs)
-            else:
-                # truncated/cut fragment: the true next_obs of the final
-                # transition is unknown here, so drop that transition
-                keep = len(obs) - 1
-            self.buffer.add_batch({
-                "obs": obs[:keep], "actions": batch["actions"][:keep],
-                "rewards": batch["rewards"][:keep],
-                "next_obs": next_obs[:keep], "dones": dones[:keep]})
+            transitions = episode_to_transitions(episode)
+            if transitions is not None:
+                self.buffer.add_batch(transitions)
         metrics: Dict[str, float] = {"epsilon": self._epsilon()}
         if len(self.buffer) >= cfg.learning_starts:
+            prioritized = cfg.replay_buffer == "prioritized"
+            sampled = []
             for _ in range(cfg.updates_per_iteration):
-                metrics.update(self.learner_group.update(
-                    self.buffer.sample(cfg.update_batch_size)))
+                batch = self.buffer.sample(cfg.update_batch_size)
+                indexes = batch.pop("batch_indexes", None)
+                metrics.update(self.learner_group.update(batch))
+                if prioritized:
+                    sampled.append((indexes, batch))
+            if prioritized and sampled:
+                # refresh priorities with post-update weights (fetched
+                # once per iteration; at most one iteration stale)
+                weights = self.learner_group.get_weights()
+                for indexes, batch in sampled:
+                    td = self._jit_td(weights, batch)
+                    self.buffer.update_priorities(
+                        indexes, np.asarray(td))
         return metrics

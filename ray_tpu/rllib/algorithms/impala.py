@@ -1,0 +1,292 @@
+"""IMPALA + APPO: asynchronous actor-learner training with V-trace.
+
+Parity with the reference (ref: rllib/algorithms/impala/impala.py — async
+sample collection decoupled from learner updates; v-trace loss ref:
+rllib/algorithms/impala/torch/vtrace_torch_v2.py; APPO ref:
+rllib/algorithms/appo/appo.py — v-trace + PPO-style clipped surrogate).
+
+TPU-first shape: trajectories are padded to a fixed [B, T] so the whole
+v-trace computation — target logits, importance ratios, the reverse-time
+recursion (lax.scan), and the policy/value/entropy losses — compiles to one
+XLA program with static shapes. Asynchrony lives in the driver: env-runner
+actors always have a sample() in flight and results are consumed as they
+land (ray_tpu.wait), so the learner never blocks on the slowest runner.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.learner import Learner
+from ..core.rl_module import categorical_entropy, categorical_logp
+from ..env.episodes import Episode
+from .algorithm import Algorithm, AlgorithmConfig
+
+
+def episodes_to_sequences(episodes: List[Episode], T: int
+                          ) -> Dict[str, np.ndarray]:
+    """Chunk episode fragments into fixed-length [B, T] sequences.
+
+    Chunks keep exact bootstrap information: a mid-episode split uses the
+    next chunk's first obs as its bootstrap obs, so v-trace targets are
+    unbiased regardless of where the sampler cut."""
+    seqs: List[Dict[str, np.ndarray]] = []
+    for ep in episodes:
+        batch = ep.to_batch()
+        L = len(batch["actions"])
+        if L == 0:
+            continue
+        obs_dim = batch["obs"].shape[-1]
+        for s in range(0, L, T):
+            e = min(s + T, L)
+            n = e - s
+            is_tail = e == L
+            chunk = {
+                "obs": np.zeros((T, obs_dim), np.float32),
+                "actions": np.zeros(
+                    (T,) + batch["actions"].shape[1:],
+                    batch["actions"].dtype),
+                "rewards": np.zeros(T, np.float32),
+                "behavior_logp": np.zeros(T, np.float32),
+                "mask": np.zeros(T, np.float32),
+                "bootstrap_obs": np.zeros(obs_dim, np.float32),
+                "terminated": np.float32(
+                    ep.terminated if is_tail else 0.0),
+                "length": np.int32(n),
+            }
+            chunk["obs"][:n] = batch["obs"][s:e]
+            chunk["actions"][:n] = batch["actions"][s:e]
+            chunk["rewards"][:n] = batch["rewards"][s:e]
+            chunk["behavior_logp"][:n] = batch["logp"][s:e]
+            chunk["mask"][:n] = 1.0
+            if is_tail:
+                if not ep.terminated and ep.last_obs is not None:
+                    chunk["bootstrap_obs"] = np.asarray(
+                        ep.last_obs, np.float32)
+            else:
+                chunk["bootstrap_obs"] = batch["obs"][e]
+            seqs.append(chunk)
+    batch = {key: np.stack([s[key] for s in seqs]) for key in seqs[0]}
+    # Pad B up to a power-of-two bucket (all-zero mask rows are inert in
+    # the loss) so jit compiles once per bucket, not once per batch size.
+    B = len(seqs)
+    bucket = max(8, 1 << (B - 1).bit_length())
+    if bucket != B:
+        batch = {key: np.concatenate(
+            [val, np.zeros((bucket - B,) + val.shape[1:], val.dtype)])
+            for key, val in batch.items()}
+    return batch
+
+
+def last_step_mask(mask):
+    """One-hot [B, T] mask marking each row's final real (unpadded) step."""
+    return (jnp.cumsum(mask, axis=1) == mask.sum(1, keepdims=True)) * mask
+
+
+def vtrace_returns(values, bootstrap, rewards, discounts, rhos, mask,
+                   clip_rho: float = 1.0, clip_c: float = 1.0,
+                   is_last=None):
+    """V-trace targets vs_t and policy-gradient advantages ([B, T] each).
+
+    discounts[b, t] is the continuation discount INTO t+1 (0 at terminal
+    steps and in padding); bootstrap[b] closes the final real step.
+    `is_last` (the one-hot last-real-step mask) can be passed in when the
+    caller already computed it for the discounts — the two MUST agree on
+    where each row ends or targets splice at the wrong step.
+    """
+    B, T = values.shape
+    if is_last is None:
+        is_last = last_step_mask(mask)
+    next_values = jnp.concatenate(
+        [values[:, 1:], jnp.zeros((B, 1), values.dtype)], axis=1)
+    next_values = next_values * (1 - is_last) + bootstrap[:, None] * is_last
+    rho_clipped = jnp.minimum(rhos, clip_rho)
+    c_clipped = jnp.minimum(rhos, clip_c)
+    deltas = rho_clipped * (rewards + discounts * next_values
+                            - values) * mask
+
+    def step(acc, xs):
+        delta, disc, c = xs
+        acc = delta + disc * c * acc
+        return acc, acc
+
+    _, accs = jax.lax.scan(
+        step, jnp.zeros(B, values.dtype),
+        (deltas.T, discounts.T, c_clipped.T), reverse=True)
+    vs = values + accs.T
+    next_vs = jnp.concatenate(
+        [vs[:, 1:], jnp.zeros((B, 1), values.dtype)], axis=1)
+    next_vs = next_vs * (1 - is_last) + bootstrap[:, None] * is_last
+    pg_adv = rho_clipped * (rewards + discounts * next_vs - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+class IMPALALearner(Learner):
+    use_clipped_surrogate = False  # APPO flips this
+
+    def loss(self, params, batch):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        B, T = batch["rewards"].shape
+        flat_obs = batch["obs"].reshape(B * T, -1)
+        fwd = self.module.forward_train(params, flat_obs)
+        logits = fwd["logits"].reshape(B, T, -1)
+        values = fwd["vf"].reshape(B, T)
+        target_logp = categorical_logp(logits, batch["actions"])
+        rhos = jnp.exp(target_logp - batch["behavior_logp"])
+        mask = batch["mask"]
+        # continuation discount into t+1: zero at the true terminal step
+        is_last = last_step_mask(mask)
+        discounts = gamma * mask * (
+            1 - is_last * batch["terminated"][:, None])
+        bootstrap = jax.lax.stop_gradient(self.module.forward_train(
+            params, batch["bootstrap_obs"])["vf"])
+        bootstrap = bootstrap * (1 - batch["terminated"])
+        vs, pg_adv = vtrace_returns(
+            values, bootstrap, batch["rewards"], discounts, rhos, mask,
+            clip_rho=cfg.get("vtrace_clip_rho", 1.0),
+            clip_c=cfg.get("vtrace_clip_c", 1.0), is_last=is_last)
+        denom = jnp.maximum(mask.sum(), 1.0)
+        # standardize pg advantages (masked): keeps the policy term O(1)
+        # so the value head's large early errors can't starve it through
+        # the shared global-norm clip
+        adv_mean = (pg_adv * mask).sum() / denom
+        adv_var = (jnp.square(pg_adv - adv_mean) * mask).sum() / denom
+        pg_adv = (pg_adv - adv_mean) / jnp.maximum(
+            jnp.sqrt(adv_var), 1e-4)
+        if self.use_clipped_surrogate:  # APPO
+            clip = cfg.get("clip_param", 0.2)
+            surrogate = jnp.minimum(
+                rhos * pg_adv,
+                jnp.clip(rhos, 1 - clip, 1 + clip) * pg_adv)
+            pi_loss = -(surrogate * mask).sum() / denom
+        else:  # IMPALA: v-trace policy gradient
+            pi_loss = -(target_logp * pg_adv * mask).sum() / denom
+        vf_loss = 0.5 * (jnp.square(vs - values) * mask).sum() / denom
+        entropy = (categorical_entropy(logits) * mask).sum() / denom
+        total = (pi_loss + cfg.get("vf_loss_coeff", 0.5) * vf_loss
+                 - cfg.get("entropy_coeff", 0.005) * entropy)
+        return total, {
+            "policy_loss": pi_loss, "vf_loss": vf_loss, "entropy": entropy,
+            "mean_rho": (rhos * mask).sum() / denom,
+        }
+
+
+class APPOLearner(IMPALALearner):
+    use_clipped_surrogate = True
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = IMPALA
+        self.lr = 6e-4
+        self.rollout_fragment_length = 50
+        self.train_batch_size = 500  # timesteps consumed per training_step
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.005
+        self.vtrace_clip_rho = 1.0
+        self.vtrace_clip_c = 1.0
+        self.max_sample_wait_s = 30.0
+        # learner sequence length; None derives it from the per-ENV
+        # fragment length (sample() spreads rollout_fragment_length across
+        # the env vector, so per-env fragments are ~fragment/num_envs —
+        # chunking at the cross-env total would make batches mostly
+        # padding)
+        self.vtrace_seq_len: Optional[int] = None
+
+    def resolved_seq_len(self) -> int:
+        if self.vtrace_seq_len is not None:
+            return self.vtrace_seq_len
+        return max(8, self.rollout_fragment_length
+                   // max(1, self.num_envs_per_env_runner))
+
+    def learner_config(self) -> Dict[str, Any]:
+        cfg = super().learner_config()
+        cfg.update(vf_loss_coeff=self.vf_loss_coeff,
+                   entropy_coeff=self.entropy_coeff,
+                   vtrace_clip_rho=self.vtrace_clip_rho,
+                   vtrace_clip_c=self.vtrace_clip_c)
+        return cfg
+
+
+class IMPALA(Algorithm):
+    """Async actor-learner loop: every remote runner always has a sample()
+    in flight; the learner consumes whatever has landed (ref:
+    impala.py — the aggregator/learner decoupling, minus the separate
+    aggregation actors which a single-host learner does not need)."""
+
+    learner_class = IMPALALearner
+
+    def __init__(self, config):
+        super().__init__(config)
+        self._inflight: Dict[Any, int] = {}
+
+    def _launch(self, runner_index: int, weights) -> None:
+        cfg = self.config
+        runner = self.env_runner_group._remote[runner_index]
+        ref = runner.sample.remote(
+            cfg.rollout_fragment_length, explore=True, weights=weights)
+        self._inflight[ref] = runner_index
+
+    def _sample_async(self) -> List[Episode]:
+        import ray_tpu
+
+        cfg = self.config
+        group = self.env_runner_group
+        weights = self.learner_group.get_weights()
+        if group._remote is None:  # local mode degenerates to sync
+            return group.sample(cfg.train_batch_size, weights=weights,
+                                explore=True)
+        for i in range(len(group._remote)):
+            if i not in self._inflight.values():
+                self._launch(i, weights)
+        episodes: List[Episode] = []
+        steps = 0
+        while steps < cfg.train_batch_size and self._inflight:
+            ready, _ = ray_tpu.wait(
+                list(self._inflight), num_returns=1,
+                timeout=cfg.max_sample_wait_s)
+            if not ready:
+                break
+            for ref in ready:
+                idx = self._inflight.pop(ref)
+                try:
+                    result = ray_tpu.get(ref)
+                    episodes.extend(result)
+                    steps += sum(len(e) for e in result)
+                except Exception:
+                    group._remote[idx] = group._spawn(idx)
+                # keep the pipe full: relaunch immediately with the
+                # freshest weights (behavior lag = exactly one fragment)
+                self._launch(idx, weights)
+        return episodes
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        episodes = self._sample_async()
+        if not episodes:
+            return {"num_env_runner_restarts": 1.0}
+        self._record_episodes(episodes)
+        batch = episodes_to_sequences(episodes, cfg.resolved_seq_len())
+        return self.learner_group.update(batch)
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = APPO
+        self.clip_param = 0.2
+
+    def learner_config(self) -> Dict[str, Any]:
+        cfg = super().learner_config()
+        cfg["clip_param"] = self.clip_param
+        return cfg
+
+
+class APPO(IMPALA):
+    learner_class = APPOLearner

@@ -19,7 +19,19 @@ class PPOLearner(Learner):
     def loss(self, params, batch):
         cfg = self.config
         fwd = self.module.forward_train(params, batch["obs"])
-        logp = categorical_logp(fwd["logits"], batch["actions"])
+        if "logits" in fwd:
+            logp = categorical_logp(fwd["logits"], batch["actions"])
+            entropy = categorical_entropy(fwd["logits"])
+        else:  # GaussianMLPModule (Box actions, tanh-squashed)
+            from ..core.rl_module import squashed_gaussian_logp
+
+            logp = squashed_gaussian_logp(
+                batch["actions"], fwd["mean"], fwd["log_std"])
+            # pre-tanh gaussian entropy: closed-form proxy for the
+            # squashed dist (standard practice — the exact squashed
+            # entropy has no closed form)
+            entropy = (fwd["log_std"]
+                       + 0.5 * jnp.log(2.0 * jnp.pi * jnp.e)).sum(-1)
         ratio = jnp.exp(logp - batch["logp"])
         adv = batch["advantages"]
         clip = cfg.get("clip_param", 0.3)
@@ -28,7 +40,6 @@ class PPOLearner(Learner):
         vf = fwd["vf"]
         vf_loss = jnp.square(vf - batch["value_targets"])
         vf_loss = jnp.minimum(vf_loss, cfg.get("vf_clip_param", 10.0))
-        entropy = categorical_entropy(fwd["logits"])
         total = (-surrogate.mean()
                  + cfg.get("vf_loss_coeff", 1.0) * vf_loss.mean()
                  - cfg.get("entropy_coeff", 0.0) * entropy.mean())

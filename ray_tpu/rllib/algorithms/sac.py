@@ -1,0 +1,151 @@
+"""SAC: soft actor-critic for continuous control.
+
+Parity with the reference (ref: rllib/algorithms/sac/sac.py — tanh-gaussian
+actor, twin Q critics with polyak-averaged targets, learned entropy
+temperature; loss ref: rllib/algorithms/sac/torch/sac_torch_learner.py).
+The three optimization problems (critic TD, actor, temperature) compile to
+ONE jitted update: cross-terms are cut with stop_gradient so a single
+value_and_grad over the combined scalar yields exactly the per-subtree
+gradients of the standard three-step scheme.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.learner import Learner
+from ..core.rl_module import (RLModuleSpec, SACModule,
+                              squashed_gaussian_sample)
+from ..env.episodes import episode_to_transitions
+from ..utils.replay_buffers import UniformReplayBuffer
+from .algorithm import Algorithm, AlgorithmConfig
+
+
+class SACLearner(Learner):
+    def __init__(self, module, config: Dict[str, Any], seed: int = 0):
+        super().__init__(module, config, seed=seed)
+        # learned temperature joins the trainable tree; targets stay out
+        # of it (injected per-batch like DQN's target params)
+        self.params["log_alpha"] = jnp.asarray(
+            float(np.log(config.get("initial_alpha", 1.0))))
+        self.opt_state = self.tx.init(self.params)
+        self.target_params = jax.device_get(
+            {"q1": self.params["q1"], "q2": self.params["q2"]})
+        self._host_rng = jax.random.PRNGKey(seed + 7)
+        self._tau = config.get("tau", 0.005)
+        self.target_entropy = config.get(
+            "target_entropy", -float(module.act_dim))
+
+    def loss(self, params, batch):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        module = self.module
+        rng = batch["rng"]
+        r_next, r_cur = jax.random.split(rng)
+        alpha = jnp.exp(params["log_alpha"])
+
+        # --- critic: TD target from target nets + fresh next-action
+        fwd_next = module.forward_train(params, batch["next_obs"])
+        a_next, logp_next = squashed_gaussian_sample(
+            r_next, fwd_next["mean"], fwd_next["log_std"])
+        tq1, tq2 = module.q_values(batch["target"], batch["next_obs"],
+                                   a_next)
+        q_target = jnp.minimum(tq1, tq2) - alpha * logp_next
+        td_target = batch["rewards"] + gamma * (1 - batch["dones"]) * \
+            jax.lax.stop_gradient(q_target)
+        q1, q2 = module.q_values(params, batch["obs"], batch["actions"])
+        critic_loss = (jnp.square(q1 - td_target).mean()
+                       + jnp.square(q2 - td_target).mean())
+
+        # --- actor: maximize min-Q of reparameterized action minus
+        # entropy cost; Q params frozen so the actor term cannot bend
+        # the critics
+        fwd = module.forward_train(params, batch["obs"])
+        a_new, logp_new = squashed_gaussian_sample(
+            r_cur, fwd["mean"], fwd["log_std"])
+        q_frozen = {"q1": jax.lax.stop_gradient(params["q1"]),
+                    "q2": jax.lax.stop_gradient(params["q2"])}
+        aq1, aq2 = module.q_values(q_frozen, batch["obs"], a_new)
+        actor_loss = (jax.lax.stop_gradient(alpha) * logp_new
+                      - jnp.minimum(aq1, aq2)).mean()
+
+        # --- temperature: drive policy entropy toward the target
+        alpha_loss = (-params["log_alpha"] * jax.lax.stop_gradient(
+            logp_new + self.target_entropy)).mean()
+
+        total = critic_loss + actor_loss + alpha_loss
+        return total, {
+            "critic_loss": critic_loss, "actor_loss": actor_loss,
+            "alpha_loss": alpha_loss, "alpha": alpha,
+            "entropy": -logp_new.mean(), "q_mean": q1.mean(),
+        }
+
+    def prepare_batch(self, batch):
+        self._host_rng, sub = jax.random.split(self._host_rng)
+        return {**batch, "rng": sub, "target": self.target_params}
+
+    def after_update(self):
+        tau = self._tau
+        online = {"q1": self.params["q1"], "q2": self.params["q2"]}
+        self.target_params = jax.tree.map(
+            lambda t, o: (1 - tau) * t + tau * o,
+            self.target_params, jax.device_get(online))
+
+    def set_weights(self, weights):
+        super().set_weights(weights)
+        self.target_params = jax.device_get(
+            {"q1": self.params["q1"], "q2": self.params["q2"]})
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = SAC
+        self.module_spec = RLModuleSpec(module_class=SACModule,
+                                        hidden=(256, 256))
+        self.lr = 3e-4
+        self.buffer_size = 100_000
+        self.learning_starts = 1500
+        self.rollout_fragment_length = 200
+        self.update_batch_size = 256
+        self.updates_per_iteration = 100
+        self.tau = 0.005
+        self.initial_alpha = 1.0
+        self.target_entropy = None  # None -> -act_dim
+
+    def learner_config(self) -> Dict[str, Any]:
+        cfg = super().learner_config()
+        cfg.update(tau=self.tau, initial_alpha=self.initial_alpha)
+        if self.target_entropy is not None:
+            cfg["target_entropy"] = self.target_entropy
+        return cfg
+
+
+class SAC(Algorithm):
+    learner_class = SACLearner
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.buffer = UniformReplayBuffer(config.buffer_size,
+                                          seed=config.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        weights = self.learner_group.get_weights()
+        episodes = self.env_runner_group.sample(
+            cfg.rollout_fragment_length, weights=weights, explore=True)
+        self._record_episodes(episodes)
+        for episode in episodes:
+            transitions = episode_to_transitions(episode)
+            if transitions is not None:
+                self.buffer.add_batch(transitions)
+        metrics: Dict[str, float] = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iteration):
+                metrics.update(self.learner_group.update(
+                    self.buffer.sample(cfg.update_batch_size)))
+        return metrics

@@ -116,6 +116,102 @@ class QMLPModule(RLModule):
         return {"q": q}
 
 
+class _ContinuousActorModule(RLModule):
+    """Shared tanh-gaussian actor head for Box action spaces: the
+    2*act_dim pi net whose output splits into (mean, clipped log_std),
+    plus the action bounds the env runner rescales with."""
+
+    LOG_STD_MIN = -20.0
+    LOG_STD_MAX = 2.0
+
+    def __init__(self, obs_space, act_space, spec):
+        super().__init__(obs_space, act_space, spec)
+        self.act_dim = int(np.prod(act_space.shape))
+        self.act_low = np.asarray(act_space.low, np.float32)
+        self.act_high = np.asarray(act_space.high, np.float32)
+        self.pi = _MLPNet(spec.hidden, 2 * self.act_dim)
+
+    def _actor_forward(self, params, obs):
+        out = self.pi.apply({"params": params["pi"]}, obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        return mean, jnp.clip(log_std, self.LOG_STD_MIN, self.LOG_STD_MAX)
+
+
+class GaussianMLPModule(_ContinuousActorModule):
+    """Tanh-squashed diagonal-Gaussian policy + value head for Box action
+    spaces (ref: rllib default continuous catalog; squashed-gaussian dist
+    ref: rllib/models/torch/torch_distributions.py TorchSquashedGaussian).
+    """
+
+    def __init__(self, obs_space, act_space, spec):
+        super().__init__(obs_space, act_space, spec)
+        self.vf = _MLPNet(spec.hidden, 1)
+
+    def init(self, rng):
+        obs = jnp.zeros((1, self.obs_dim), jnp.float32)
+        r1, r2 = jax.random.split(rng)
+        return {"pi": self.pi.init(r1, obs)["params"],
+                "vf": self.vf.init(r2, obs)["params"]}
+
+    def forward_train(self, params, obs):
+        mean, log_std = self._actor_forward(params, obs)
+        value = self.vf.apply({"params": params["vf"]}, obs)[..., 0]
+        return {"mean": mean, "log_std": log_std, "vf": value}
+
+
+class SACModule(_ContinuousActorModule):
+    """Tanh-gaussian actor + twin Q critics (ref:
+    rllib/algorithms/sac/sac.py — actor, q, twin_q nets; targets live in
+    the SACLearner, mirroring how DQN keeps its target params)."""
+
+    def __init__(self, obs_space, act_space, spec):
+        super().__init__(obs_space, act_space, spec)
+        self.q1 = _MLPNet(spec.hidden, 1)
+        self.q2 = _MLPNet(spec.hidden, 1)
+
+    def init(self, rng):
+        obs = jnp.zeros((1, self.obs_dim), jnp.float32)
+        obs_act = jnp.zeros((1, self.obs_dim + self.act_dim), jnp.float32)
+        r1, r2, r3 = jax.random.split(rng, 3)
+        return {"pi": self.pi.init(r1, obs)["params"],
+                "q1": self.q1.init(r2, obs_act)["params"],
+                "q2": self.q2.init(r3, obs_act)["params"]}
+
+    def forward_train(self, params, obs):
+        mean, log_std = self._actor_forward(params, obs)
+        return {"mean": mean, "log_std": log_std}
+
+    def q_values(self, params, obs, actions):
+        obs_act = jnp.concatenate([obs, actions], axis=-1)
+        q1 = self.q1.apply({"params": params["q1"]}, obs_act)[..., 0]
+        q2 = self.q2.apply({"params": params["q2"]}, obs_act)[..., 0]
+        return q1, q2
+
+
+def squashed_gaussian_sample(rng, mean, log_std):
+    """Sample a tanh-squashed gaussian action in [-1, 1]; returns
+    (action, logp) with the tanh change-of-variables correction."""
+    std = jnp.exp(log_std)
+    pre = mean + std * jax.random.normal(rng, mean.shape)
+    act = jnp.tanh(pre)
+    logp = gaussian_logp(pre, mean, log_std) - jnp.log(
+        jnp.maximum(1.0 - jnp.square(act), 1e-6)).sum(-1)
+    return act, logp
+
+
+def gaussian_logp(x, mean, log_std):
+    std = jnp.exp(log_std)
+    return (-0.5 * jnp.square((x - mean) / std)
+            - log_std - 0.5 * jnp.log(2.0 * jnp.pi)).sum(-1)
+
+
+def squashed_gaussian_logp(actions, mean, log_std):
+    """logp of already-squashed actions in (-1, 1)."""
+    pre = jnp.arctanh(jnp.clip(actions, -1.0 + 1e-6, 1.0 - 1e-6))
+    return gaussian_logp(pre, mean, log_std) - jnp.log(
+        jnp.maximum(1.0 - jnp.square(actions), 1e-6)).sum(-1)
+
+
 def categorical_sample(rng, logits):
     return jax.random.categorical(rng, logits, axis=-1)
 

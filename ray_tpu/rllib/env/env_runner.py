@@ -100,7 +100,25 @@ class SingleAgentEnvRunner:
         while steps < num_timesteps:
             obs = np.stack(self._cur_obs)
             fwd = self._jit_fwd(self.params, obs)
-            if "logits" in fwd:
+            continuous = "mean" in fwd
+            if continuous:
+                # tanh-squashed gaussian (Box action spaces). Canonical
+                # actions in [-1, 1] are what learners consume; the env
+                # sees them rescaled to its [low, high].
+                from ..core.rl_module import squashed_gaussian_sample
+
+                n = len(np.asarray(fwd["mean"]))
+                if explore:
+                    self._rng, sub = jax.random.split(self._rng)
+                    act_j, logp_j = squashed_gaussian_sample(
+                        sub, fwd["mean"], fwd["log_std"])
+                    actions = np.asarray(act_j, np.float32)
+                    logps = np.asarray(logp_j, np.float32)
+                else:
+                    actions = np.tanh(np.asarray(fwd["mean"], np.float32))
+                    logps = np.zeros(n, np.float32)
+                vf = np.asarray(fwd.get("vf", np.zeros(n)), np.float32)
+            elif "logits" in fwd:
                 logits = np.asarray(fwd["logits"], np.float32)
                 vf = np.asarray(fwd.get("vf", np.zeros(len(logits))),
                                 np.float32)
@@ -125,8 +143,15 @@ class SingleAgentEnvRunner:
             for i, env in enumerate(self.envs):
                 episode = self._episodes[i]
                 episode.obs.append(self._cur_obs[i])
-                action = int(actions[i])
-                next_obs, reward, terminated, truncated, _ = env.step(action)
+                if continuous:
+                    action = actions[i]
+                    low = self.module.act_low
+                    high = self.module.act_high
+                    env_action = low + (action + 1.0) * 0.5 * (high - low)
+                else:
+                    action = env_action = int(actions[i])
+                next_obs, reward, terminated, truncated, _ = env.step(
+                    env_action)
                 episode.actions.append(action)
                 episode.rewards.append(float(reward))
                 episode.logp.append(float(logps[i]))
@@ -137,6 +162,7 @@ class SingleAgentEnvRunner:
                     episode.truncated = bool(truncated)
                     if truncated:
                         episode.last_value = self._value_of(next_obs)
+                        episode.last_obs = np.asarray(next_obs, np.float32)
                     out.append(episode)
                     next_obs, _ = env.reset()
                     self._episodes[i] = Episode()
@@ -148,6 +174,7 @@ class SingleAgentEnvRunner:
                 episode.truncated = True
                 episode.cut = True
                 episode.last_value = self._value_of(self._cur_obs[i])
+                episode.last_obs = np.asarray(self._cur_obs[i], np.float32)
                 out.append(episode)
                 # the continuation fragment carries the running return so
                 # the eventual terminal fragment reports the FULL episode

@@ -27,6 +27,10 @@ class Episode:
     prior_reward: float = 0.0
     # bootstrap value for truncated fragments (GAE tail)
     last_value: float = 0.0
+    # bootstrap OBS for truncated fragments — lets off-policy learners
+    # (v-trace) recompute the bootstrap value under CURRENT params instead
+    # of trusting the behavior policy's stale estimate
+    last_obs: Optional[np.ndarray] = None
 
     def __len__(self) -> int:
         return len(self.actions)
@@ -41,13 +45,50 @@ class Episode:
         return self.prior_reward + self.total_reward
 
     def to_batch(self) -> Dict[str, np.ndarray]:
+        if self.actions and isinstance(self.actions[0], np.ndarray):
+            actions = np.stack(self.actions).astype(np.float32)
+        else:
+            actions = np.asarray(self.actions, np.int32)
         return {
             "obs": np.stack(self.obs).astype(np.float32),
-            "actions": np.asarray(self.actions, np.int32),
+            "actions": actions,
             "rewards": np.asarray(self.rewards, np.float32),
             "logp": np.asarray(self.logp, np.float32),
             "vf_preds": np.asarray(self.vf_preds, np.float32),
         }
+
+
+def episode_to_transitions(episode: Episode
+                           ) -> Optional[Dict[str, np.ndarray]]:
+    """Convert one fragment into (obs, actions, rewards, next_obs, dones)
+    transitions for replay buffers (DQN/SAC).
+
+    The runner records `last_obs` for truncated/cut fragments, so every
+    collected step becomes a transition; only when the bootstrap obs is
+    genuinely missing is the final transition dropped."""
+    batch = episode.to_batch()
+    obs = batch["obs"]
+    if len(obs) == 0:
+        return None
+    dones = np.zeros(len(obs), np.float32)
+    if episode.terminated:
+        # final next_obs is unused when done=1
+        tail = obs[-1:]
+        dones[-1] = 1.0
+    elif episode.last_obs is not None:
+        tail = np.asarray(episode.last_obs, np.float32)[None]
+    else:
+        if len(obs) < 2:
+            return None
+        # no bootstrap obs recorded: the final step's next_obs is unknown
+        obs = obs[:-1]
+        dones = dones[:-1]
+        tail = batch["obs"][len(obs):len(obs) + 1]
+    keep = len(obs)
+    next_obs = np.concatenate([batch["obs"][1:keep], tail], axis=0)
+    return {"obs": obs, "actions": batch["actions"][:keep],
+            "rewards": batch["rewards"][:keep], "next_obs": next_obs,
+            "dones": dones}
 
 
 def compute_gae(episode: Episode, gamma: float, lam: float
