@@ -66,6 +66,15 @@ class LlamaConfig:
     loss_chunk: int = 512
     scan_layers: bool = True
     attention_impl: Optional[str] = None  # None = auto (flash on TPU)
+    # MoE (Mixtral-style): 0 = dense MLP. Experts are stacked [E, ...]
+    # params with the "expert" logical axis -> the mesh's ep axis; the
+    # capacity-based einsum dispatch keeps every shape static so XLA turns
+    # the token shuffle into all-to-alls over ICI.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    capacity_factor: float = 1.25
+    router_aux_loss_coef: float = 0.02
+    moe_group_size: int = 2048  # dispatch group (bounds routing memory)
 
     @property
     def head_dim_(self) -> int:
@@ -77,8 +86,20 @@ class LlamaConfig:
         hd = self.head_dim_
         attn = h * hd * (self.num_heads + 2 * self.num_kv_heads) \
             + self.num_heads * hd * h
-        mlp = 3 * h * f
+        if self.num_experts:
+            mlp = self.num_experts * 3 * h * f + h * self.num_experts
+        else:
+            mlp = 3 * h * f
         return l * (attn + mlp + 2 * h) + 2 * v * h + h
+
+    def active_params(self) -> int:
+        """Params touched per token (= num_params for dense models); the
+        MFU-relevant count for MoE."""
+        if not self.num_experts:
+            return self.num_params()
+        h, f, l = self.hidden_size, self.intermediate_size, self.num_layers
+        dense = self.num_params() - l * self.num_experts * 3 * h * f
+        return dense + l * self.num_experts_per_tok * 3 * h * f
 
 
 # ---------------------------------------------------------------- components
@@ -211,6 +232,92 @@ class MLP(nn.Module):
             name="down_proj")(y)
 
 
+class MoEMLP(nn.Module):
+    """Mixtral-style sparse MoE FFN, GShard-style grouped einsum dispatch.
+
+    TPU-first shape discipline: tokens are split into fixed-size groups and
+    routed with a capacity-bounded one-hot dispatch tensor, so every shape
+    is static — XLA lowers the token shuffle to all-to-alls over the ep
+    mesh axis (expert weights carry the "expert" logical axis). The
+    dispatch tensor is [G, g, E, C] with C ~ k*g/E, i.e. linear in total
+    tokens (the per-group capacity bound is what prevents the quadratic
+    [T, E, k*T/E] blowup of ungrouped dispatch).
+
+    Returns (out, aux) where aux is the Switch/GShard load-balancing loss
+    E * sum_e(frac_tokens_e * frac_probs_e) for this layer.
+    """
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        E, k = cfg.num_experts, cfg.num_experts_per_tok
+        f = cfg.intermediate_size
+        b, s, h = x.shape
+        T = b * s
+        g = min(cfg.moe_group_size, T)
+        pad = (-T) % g
+        xt = x.reshape(T, h)
+        if pad:
+            xt = jnp.pad(xt, ((0, pad), (0, 0)))
+        G = (T + pad) // g
+        xg = xt.reshape(G, g, h)
+
+        router = self.param(
+            "router", A(nn.initializers.normal(0.02), ("embed", None)),
+            (h, E), jnp.float32)
+        # routing in fp32 (tiny matmul, numerically load-bearing)
+        logits = jnp.einsum("Gth,he->Gte", xg.astype(jnp.float32), router)
+        probs = jax.nn.softmax(logits, axis=-1)              # [G,g,E]
+        gate, idx = jax.lax.top_k(probs, k)                  # [G,g,k]
+        gate = gate / jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
+
+        capacity = max(1, int(cfg.capacity_factor * k * g / E))
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)     # [G,g,k,E]
+        assigns = onehot.reshape(G, g * k, E)
+        # position of each assignment within its expert's capacity buffer
+        pos = (jnp.cumsum(assigns, axis=1) - assigns)
+        pos = (pos * assigns).sum(-1).reshape(G, g, k)       # [G,g,k]
+        keep = (pos < capacity).astype(cfg.dtype)
+        disp = (onehot.astype(cfg.dtype)[..., None]
+                * jax.nn.one_hot(pos, capacity, dtype=cfg.dtype)[
+                    :, :, :, None, :])                       # [G,g,k,E,C]
+        disp = disp * keep[..., None, None]
+        combine = (disp * gate.astype(cfg.dtype)[..., None, None]).sum(2)
+        dispatch = disp.sum(2)                               # [G,g,E,C]
+
+        w_gu = self.param(
+            "experts_gate_up",
+            A(nn.initializers.lecun_normal(), ("expert", "embed", "mlp")),
+            (E, h, 2 * f), cfg.param_dtype)
+        w_dn = self.param(
+            "experts_down",
+            A(nn.initializers.lecun_normal(), ("expert", "mlp", "embed")),
+            (E, f, h), cfg.param_dtype)
+        ex_in = jnp.einsum("Gtec,Gth->Gech", dispatch, xg)   # [G,E,C,h]
+        gu = jnp.einsum("Gech,ehm->Gecm", ex_in, w_gu.astype(cfg.dtype))
+        gate_p, up_p = jnp.split(gu, 2, axis=-1)
+        y = nn.silu(gate_p) * up_p
+        ex_out = jnp.einsum("Gecf,efh->Gech", y, w_dn.astype(cfg.dtype))
+        out = jnp.einsum("Gtec,Gech->Gth", combine, ex_out)
+        out = out.reshape(G * g, h)[:T].reshape(b, s, h)
+
+        # Switch/GShard load-balancing aux loss over REAL tokens only
+        frac_tokens = onehot.reshape(G * g, k, E)[:T].sum((0, 1)) \
+            .astype(jnp.float32) / (T * k)
+        frac_probs = probs.reshape(G * g, E)[:T].mean(0)
+        aux = E * jnp.sum(frac_tokens * frac_probs)
+        # Sown (not returned) so per-token nll stays pure cross-entropy;
+        # trainers opt in with apply(..., mutable=["losses"]) and add the
+        # (already coefficient-scaled) terms to their loss. sow is a no-op
+        # for callers that don't mutate the collection (e.g. serving).
+        self.sow("losses", "router_aux_scaled",
+                 cfg.router_aux_loss_coef * aux,
+                 reduce_fn=lambda a, b: a + b, init_fn=lambda: 0.0)
+        return out
+
+
 class DecoderLayer(nn.Module):
     config: LlamaConfig
 
@@ -222,8 +329,11 @@ class DecoderLayer(nn.Module):
             positions, kv_cache=kv_cache, segment_ids=segment_ids)
         h = checkpoint_name(h, "attn_out")
         x = x + h
-        h = MLP(cfg, name="mlp")(
-            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="mlp_norm")(x))
+        normed = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="mlp_norm")(x)
+        if cfg.num_experts:
+            h = MoEMLP(cfg, name="moe")(normed)
+        else:
+            h = MLP(cfg, name="mlp")(normed)
         h = checkpoint_name(h, "mlp_out")
         return x + h, new_cache
 
@@ -278,7 +388,7 @@ class LlamaModel(nn.Module):
                     policy=_remat_policy(cfg.remat_policy))
             (x, _, _), new_caches = nn.scan(
                 layer_cls,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "losses": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
@@ -355,6 +465,17 @@ CONFIGS = {
                              intermediate_size=14336, num_layers=32,
                              num_heads=32, num_kv_heads=8,
                              rope_theta=500000.0),
+    "tiny-moe": LlamaConfig(vocab_size=256, hidden_size=64,
+                            intermediate_size=128, num_layers=2,
+                            num_heads=4, num_kv_heads=2, max_seq_len=256,
+                            remat=False, num_experts=4,
+                            num_experts_per_tok=2, moe_group_size=64),
+    # Mixtral-8x7B shape (the open MoE reference point)
+    "mixtral-8x7b": LlamaConfig(vocab_size=32000, hidden_size=4096,
+                                intermediate_size=14336, num_layers=32,
+                                num_heads=32, num_kv_heads=8,
+                                rope_theta=1e6, num_experts=8,
+                                num_experts_per_tok=2),
 }
 
 

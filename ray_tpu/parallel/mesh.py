@@ -10,6 +10,7 @@ Axis conventions (order matters — outer axes ride DCN, inner ride ICI):
   dp    data parallel (pure replication of params)
   fsdp  data parallel with parameter sharding (ZeRO-3 style)
   sp    sequence/context parallel (ring attention axis)
+  ep    expert parallel (MoE experts sharded across chips)
   tp    tensor parallel (megatron-style in/out sharding)
 No NCCL anywhere: inside a slice collectives ride ICI; across slices the
 same mesh axes map onto DCN via the standard JAX device order.
@@ -25,7 +26,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("dp", "fsdp", "sp", "tp")
+AXES = ("dp", "fsdp", "sp", "ep", "tp")
 
 
 @dataclass(frozen=True)
@@ -35,10 +36,12 @@ class MeshConfig:
     dp: int = 1
     fsdp: int = -1
     sp: int = 1
+    ep: int = 1
     tp: int = 1
 
     def resolved(self, n_devices: int) -> Dict[str, int]:
-        sizes = {"dp": self.dp, "fsdp": self.fsdp, "sp": self.sp, "tp": self.tp}
+        sizes = {"dp": self.dp, "fsdp": self.fsdp, "sp": self.sp,
+                 "ep": self.ep, "tp": self.tp}
         fill_axes = [a for a, s in sizes.items() if s == -1]
         known = math.prod(s for s in sizes.values() if s != -1)
         if n_devices % known != 0:
@@ -70,7 +73,7 @@ def create_mesh(config: Optional[MeshConfig] = None,
 
 
 def single_device_mesh() -> Mesh:
-    return Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1, 1, 1), AXES)
+    return Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1, 1, 1, 1), AXES)
 
 
 # ---------------------------------------------------------------------------

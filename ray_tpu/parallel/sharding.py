@@ -19,6 +19,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
     ("batch", ("dp", "fsdp")),
     ("seq", "sp"),
+    ("expert", "ep"),       # MoE expert axis
+
     ("embed", "fsdp"),      # ZeRO-style parameter sharding
     ("qkv", "tp"),
     ("heads", "tp"),

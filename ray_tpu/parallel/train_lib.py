@@ -106,11 +106,18 @@ class ShardedTrainer:
         mask = batch.get("loss_mask")
         mask = mask[:, 1:] if mask is not None else None
         if getattr(self.model, "supports_fused_loss", False):
-            # fused chunked CE: [B,S,V] fp32 logits never materialize
-            nll = self.model.apply({"params": params}, input_ids,
-                                   targets=targets)
+            # fused chunked CE: [B,S,V] fp32 logits never materialize.
+            # mutable=["losses"] collects auxiliary regularizers the model
+            # sows (MoE router load-balancing) WITHOUT polluting the
+            # per-token nll, which stays pure cross-entropy.
+            nll, variables = self.model.apply(
+                {"params": params}, input_ids, targets=targets,
+                mutable=["losses"])
             nll = nll[:, :-1]  # final position has no next token
-            return masked_mean(nll, mask)
+            loss = masked_mean(nll, mask)
+            for leaf in jax.tree.leaves(variables.get("losses", {})):
+                loss = loss + jnp.sum(leaf)
+            return loss
         # model without a fused-loss path: dense logits + CE
         logits = self.model.apply({"params": params}, input_ids)[:, :-1]
         return cross_entropy_loss(logits, input_ids[:, 1:], mask)

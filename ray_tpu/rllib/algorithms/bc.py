@@ -35,17 +35,6 @@ def _rtg(rewards: np.ndarray, gamma: float) -> np.ndarray:
     return rtg
 
 
-def _returns_std(data, gamma: float) -> float:
-    """Std of discounted returns-to-go across the dataset — MARWIL's
-    advantage scale. Touches only the reward streams (no obs flattening),
-    so it is cheap enough to run at config time."""
-    chunks = [
-        _rtg(np.asarray(item.rewards if isinstance(item, Episode)
-                        else item["rewards"], np.float32), gamma)
-        for item in data]
-    return float(np.std(np.concatenate(chunks)) + 1e-6)
-
-
 def _to_offline_batch(data, gamma: float) -> Dict[str, np.ndarray]:
     """Flatten episodes into one batch with discounted returns-to-go."""
     batches = []
@@ -108,21 +97,38 @@ class MARWILConfig(AlgorithmConfig):
         return self
 
     def copy(self) -> "AlgorithmConfig":
-        # the dataset is read-only to the algorithm; share it by reference
-        # instead of letting deepcopy duplicate (possibly GBs of) arrays
-        data, self.offline_data = self.offline_data, None
+        # the dataset (and any flattened cache of it) is read-only to the
+        # algorithm; share by reference instead of letting deepcopy
+        # duplicate (possibly GBs of) arrays
+        data = self.offline_data
+        cache = getattr(self, "_flat_batch", None)
+        self.offline_data = None
+        self._flat_batch = None
         try:
             dup = super().copy()
         finally:
             self.offline_data = data
+            self._flat_batch = cache
         dup.offline_data = data
+        dup._flat_batch = None  # cache is per-built-algorithm
         return dup
+
+    def flattened_batch(self) -> Dict[str, np.ndarray]:
+        """Flatten the offline episodes once and cache (learner_config and
+        the algorithm both need it)."""
+        if getattr(self, "_flat_batch", None) is None:
+            self._flat_batch = _to_offline_batch(self.offline_data,
+                                                 self.gamma)
+        return self._flat_batch
 
     def learner_config(self) -> Dict[str, Any]:
         cfg = super().learner_config()
         cfg.update(beta=self.beta, vf_coeff=self.vf_coeff)
         if self.beta and self.offline_data is not None:
-            cfg["adv_scale"] = _returns_std(self.offline_data, self.gamma)
+            # dataset-level advantage scale, from the same flattened
+            # batch the algorithm trains on (computed once)
+            cfg["adv_scale"] = float(
+                np.std(self.flattened_batch()["returns"]) + 1e-6)
         return cfg
 
 
@@ -133,7 +139,7 @@ class MARWIL(Algorithm):
         super().__init__(config)
         assert config.offline_data is not None, \
             "MARWIL/BC need config.offline(data=...)"
-        self._batch = _to_offline_batch(config.offline_data, config.gamma)
+        self._batch = config.flattened_batch()
         self._rng = np.random.default_rng(config.seed)
 
     def training_step(self) -> Dict[str, Any]:

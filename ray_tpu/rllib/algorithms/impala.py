@@ -15,6 +15,7 @@ land (ray_tpu.wait), so the learner never blocks on the slowest runner.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -25,6 +26,8 @@ from ..core.learner import Learner
 from ..core.rl_module import categorical_entropy, categorical_logp
 from ..env.episodes import Episode
 from .algorithm import Algorithm, AlgorithmConfig
+
+logger = logging.getLogger(__name__)
 
 
 def episodes_to_sequences(episodes: List[Episode], T: int
@@ -225,6 +228,8 @@ class IMPALA(Algorithm):
     def __init__(self, config):
         super().__init__(config)
         self._inflight: Dict[Any, int] = {}
+        self._empty_rounds = 0
+        self._last_error: Optional[Exception] = None
 
     def _launch(self, runner_index: int, weights) -> None:
         cfg = self.config
@@ -259,11 +264,26 @@ class IMPALA(Algorithm):
                     result = ray_tpu.get(ref)
                     episodes.extend(result)
                     steps += sum(len(e) for e in result)
-                except Exception:
+                except Exception as e:
+                    logger.exception("env runner %d failed; restarting",
+                                     idx)
+                    self._last_error = e
                     group._remote[idx] = group._spawn(idx)
                 # keep the pipe full: relaunch immediately with the
                 # freshest weights (behavior lag = exactly one fragment)
                 self._launch(idx, weights)
+        # deterministic-failure guard (mirrors EnvRunnerGroup.sample):
+        # N consecutive empty rounds means every runner is failing — stop
+        # spinning and surface the last error
+        if episodes:
+            self._empty_rounds = 0
+        else:
+            self._empty_rounds += 1
+            if self._empty_rounds >= 3:
+                raise RuntimeError(
+                    "all async env runners failed for 3 consecutive "
+                    "sample rounds; last error below") \
+                    from self._last_error
         return episodes
 
     def training_step(self) -> Dict[str, Any]:

@@ -33,10 +33,16 @@ class SACLearner(Learner):
         self.params["log_alpha"] = jnp.asarray(
             float(np.log(config.get("initial_alpha", 1.0))))
         self.opt_state = self.tx.init(self.params)
-        self.target_params = jax.device_get(
-            {"q1": self.params["q1"], "q2": self.params["q2"]})
+        # targets live on device; the polyak average is a jitted tree-map
+        # (no host round-trip in the 100-updates-per-iteration hot path)
+        self.target_params = jax.tree.map(
+            jnp.array, {"q1": self.params["q1"], "q2": self.params["q2"]})
         self._host_rng = jax.random.PRNGKey(seed + 7)
-        self._tau = config.get("tau", 0.005)
+        tau = config.get("tau", 0.005)
+        self._tau = tau
+        self._jit_polyak = jax.jit(
+            lambda target, online: jax.tree.map(
+                lambda t, o: (1 - tau) * t + tau * o, target, online))
         self.target_entropy = config.get(
             "target_entropy", -float(module.act_dim))
 
@@ -89,16 +95,14 @@ class SACLearner(Learner):
         return {**batch, "rng": sub, "target": self.target_params}
 
     def after_update(self):
-        tau = self._tau
-        online = {"q1": self.params["q1"], "q2": self.params["q2"]}
-        self.target_params = jax.tree.map(
-            lambda t, o: (1 - tau) * t + tau * o,
-            self.target_params, jax.device_get(online))
+        self.target_params = self._jit_polyak(
+            self.target_params,
+            {"q1": self.params["q1"], "q2": self.params["q2"]})
 
     def set_weights(self, weights):
         super().set_weights(weights)
-        self.target_params = jax.device_get(
-            {"q1": self.params["q1"], "q2": self.params["q2"]})
+        self.target_params = jax.tree.map(
+            jnp.array, {"q1": self.params["q1"], "q2": self.params["q2"]})
 
 
 class SACConfig(AlgorithmConfig):

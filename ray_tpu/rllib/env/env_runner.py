@@ -147,7 +147,14 @@ class SingleAgentEnvRunner:
                     action = actions[i]
                     low = self.module.act_low
                     high = self.module.act_high
-                    env_action = low + (action + 1.0) * 0.5 * (high - low)
+                    # rescale only finitely-bounded dims; unbounded Box
+                    # dims (gym's default is +-inf) pass through the raw
+                    # tanh action — inf bounds would rescale to nan
+                    bounded = np.isfinite(low) & np.isfinite(high)
+                    safe_low = np.where(bounded, low, -1.0)
+                    safe_high = np.where(bounded, high, 1.0)
+                    env_action = safe_low + (action + 1.0) * 0.5 \
+                        * (safe_high - safe_low)
                 else:
                     action = env_action = int(actions[i])
                 next_obs, reward, terminated, truncated, _ = env.step(

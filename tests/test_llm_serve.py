@@ -195,3 +195,38 @@ def test_openai_app_over_serve(shared_cluster):
         assert models["data"][0]["id"] == "tiny-llm"
     finally:
         serve.delete("llm")
+
+
+def test_batch_llm_processor_pipeline(shared_cluster):
+    """Batch inference Processor over ray_tpu.data (ref:
+    llm/_internal/batch/processor/vllm_engine_proc.py + stages/)."""
+    from ray_tpu import data as rdata
+    from ray_tpu.serve.llm.batch import (ProcessorConfig,
+                                         build_llm_processor)
+    from ray_tpu.serve.llm.engine import EngineConfig, SamplingParams
+
+    ds = rdata.from_items([
+        {"question": "hello there"},
+        {"question": "what is a tpu?"},
+        {"question": "short"},
+    ])
+    config = ProcessorConfig(
+        engine=EngineConfig(model="tiny", max_model_len=256,
+                            num_pages=64),
+        sampling=SamplingParams(max_tokens=8), batch_size=4)
+    processor = build_llm_processor(
+        config,
+        preprocess=lambda row: {"messages": [
+            {"role": "user", "content": row["question"]}]},
+        postprocess=lambda row: {
+            "n_out": row["num_generated_tokens"],
+            "n_in": row["num_input_tokens"],
+            "text": row["generated_text"]})
+    rows = processor(ds).take_all()
+    assert len(rows) == 3
+    assert all(r["n_out"] == 8 for r in rows)
+    assert all(r["n_in"] > 0 for r in rows)
+    # a second run through the same processor reuses worker-cached
+    # engines (no reinit crash, same results shape)
+    rows2 = processor(ds).take_all()
+    assert len(rows2) == 3
