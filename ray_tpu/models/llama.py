@@ -243,8 +243,10 @@ class MoEMLP(nn.Module):
     tokens (the per-group capacity bound is what prevents the quadratic
     [T, E, k*T/E] blowup of ungrouped dispatch).
 
-    Returns (out, aux) where aux is the Switch/GShard load-balancing loss
-    E * sum_e(frac_tokens_e * frac_probs_e) for this layer.
+    Returns the mixed output; the Switch/GShard load-balancing loss
+    E * sum_e(frac_tokens_e * frac_probs_e), pre-scaled by
+    router_aux_loss_coef, is sown into the "losses" collection (see the
+    sow call below for the consumer contract).
     """
 
     config: LlamaConfig

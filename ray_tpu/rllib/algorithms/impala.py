@@ -252,6 +252,7 @@ class IMPALA(Algorithm):
                 self._launch(i, weights)
         episodes: List[Episode] = []
         steps = 0
+        self._last_error = None  # per-round: only fresh errors escalate
         while steps < cfg.train_batch_size and self._inflight:
             ready, _ = ray_tpu.wait(
                 list(self._inflight), num_returns=1,
@@ -273,11 +274,12 @@ class IMPALA(Algorithm):
                 # freshest weights (behavior lag = exactly one fragment)
                 self._launch(idx, weights)
         # deterministic-failure guard (mirrors EnvRunnerGroup.sample):
-        # N consecutive empty rounds means every runner is failing — stop
-        # spinning and surface the last error
+        # escalate only on consecutive rounds that actually OBSERVED
+        # runner exceptions — an empty round from a slow-but-healthy
+        # runner (wait timeout, no error) is not a failure
         if episodes:
             self._empty_rounds = 0
-        else:
+        elif self._last_error is not None:
             self._empty_rounds += 1
             if self._empty_rounds >= 3:
                 raise RuntimeError(

@@ -5,7 +5,20 @@ the engine is in-repo and TPU-native: paged attention in jnp/Pallas over
 block tables, bucketed jit shapes, prefix caching, continuous batching.
 """
 
+from .batch import (  # noqa: F401
+    HttpRequestProcessorConfig,
+    Processor,
+    ProcessorConfig,
+    build_http_request_processor,
+    build_llm_processor,
+)
 from .cache import OutOfPages, PageAllocator  # noqa: F401
+from .disagg import (  # noqa: F401
+    DecodeServer,
+    PDRouter,
+    PrefillServer,
+    build_pd_openai_app,
+)
 from .engine import (  # noqa: F401
     EngineConfig,
     LLMEngine,
@@ -25,4 +38,7 @@ __all__ = [
     "EngineConfig", "LLMEngine", "SamplingParams", "OutputDelta", "Request",
     "PageAllocator", "OutOfPages", "LLMConfig", "LLMServer", "OpenAIIngress",
     "build_openai_app", "ByteTokenizer", "get_tokenizer",
+    "Processor", "ProcessorConfig", "build_llm_processor",
+    "HttpRequestProcessorConfig", "build_http_request_processor",
+    "PrefillServer", "DecodeServer", "PDRouter", "build_pd_openai_app",
 ]

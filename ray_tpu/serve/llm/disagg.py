@@ -1,0 +1,166 @@
+"""Prefill/decode disaggregation.
+
+Parity with the reference (ref: llm/_internal/serve/deployments/
+prefill_decode_disagg/prefill_decode_disagg.py — separate prefill and
+decode vLLM deployment groups with KV transfer between them; the reference
+delegates the actual KV movement to vLLM's connector). Here the handoff is
+native: the prefill engine runs exactly the prompt pass and first token,
+`extract_kv` gathers the request's pages into a dense blob, and the decode
+engine `inject_request`s it and continues batched decoding.
+
+Why disaggregate on TPU: prefill is compute-bound (big MXU matmuls over the
+whole prompt) while decode is HBM-bandwidth-bound (one token per step over
+the KV cache). Separate engines let each side batch and scale to its own
+bottleneck — prefill replicas never stall the decode batch's latency, and
+decode replicas keep a full continuous batch resident.
+
+Deployment shape: PrefillServer replicas + DecodeServer replicas behind a
+PDIngress that routes prompt→prefill→handoff→decode.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import deployment
+from .engine import LLMEngine, SamplingParams
+from .server import EngineDriverMixin, LLMConfig, OpenAIIngress
+from .tokenizer import get_tokenizer
+
+
+@deployment
+class PrefillServer(EngineDriverMixin):
+    """Runs prompt prefill + first token only, then hands the KV off.
+
+    Concurrency-safe: requests go through the shared driver loop with
+    SamplingParams(prefill_only=True); the engine gathers the KV blob
+    inside step() (driver thread) and parks it for pop_extracted, so no
+    coroutine ever touches the donated page buffers directly."""
+
+    def __init__(self, llm_config: LLMConfig):
+        self.config = llm_config
+        self.engine = LLMEngine(llm_config.engine)
+        self._ids = itertools.count()
+        self._init_driver()
+
+    async def prefill(self, prompt_ids: List[int],
+                      sampling_kwargs: Dict[str, Any]) -> Dict[str, Any]:
+        """Returns the handoff blob (KV pages + first token)."""
+        request_id = f"pf-{next(self._ids)}"
+        sampling = SamplingParams(**sampling_kwargs)
+        sampling.prefill_only = True
+        queue: asyncio.Queue = asyncio.Queue()
+        self._waiters[request_id] = queue
+        self.engine.add_request(request_id, prompt_ids, sampling)
+        first: List[int] = []
+        try:
+            async for delta in self._await_request(request_id, queue):
+                first.extend(delta.new_token_ids)
+        finally:
+            self._waiters.pop(request_id, None)
+        handoff = self.engine.pop_extracted(request_id)
+        handoff["done"] = False
+        return handoff
+
+
+@deployment
+class DecodeServer(EngineDriverMixin):
+    """Adopts prefilled requests and runs batched decode to completion."""
+
+    def __init__(self, llm_config: LLMConfig):
+        self.config = llm_config
+        self.engine = LLMEngine(llm_config.engine)
+        self._ids = itertools.count()
+        self._init_driver()
+
+    async def decode(self, handoff: Dict[str, Any],
+                     sampling_kwargs: Dict[str, Any]) -> Dict[str, Any]:
+        request_id = f"dec-{next(self._ids)}"
+        queue: asyncio.Queue = asyncio.Queue()
+        self._waiters[request_id] = queue
+        self.engine.inject_request(request_id, handoff,
+                                   SamplingParams(**sampling_kwargs))
+        out_ids = list(handoff["output_ids"])
+        finish_reason = None
+        try:
+            async for delta in self._await_request(request_id, queue):
+                out_ids.extend(delta.new_token_ids)
+                if delta.finished:
+                    finish_reason = delta.finish_reason
+        finally:
+            self._waiters.pop(request_id, None)
+        return {"output_ids": out_ids, "finish_reason": finish_reason}
+
+
+@deployment
+class PDRouter:
+    """LLMServer-compatible facade over the prefill + decode tiers (the
+    OpenAI ingress calls .generate exactly as it would a colocated
+    LLMServer)."""
+
+    def __init__(self, prefill_handle, decode_handle,
+                 llm_config: LLMConfig):
+        self.prefill = prefill_handle
+        self.decode = decode_handle
+        self.config = llm_config
+        self.tokenizer = get_tokenizer(llm_config.tokenizer)
+
+    async def generate(self, prompt: str = None, *,
+                       prompt_ids: Optional[List[int]] = None,
+                       max_tokens: int = 64, temperature: float = 0.0,
+                       top_k: int = 0,
+                       seed: Optional[int] = None) -> Dict[str, Any]:
+        if prompt_ids is None:
+            prompt_ids = self.tokenizer.encode(prompt)
+        sampling = {"max_tokens": max_tokens, "temperature": temperature,
+                    "top_k": top_k, "seed": seed}
+        t0 = time.time()
+        handoff = await self.prefill.options(
+            method_name="prefill").remote(prompt_ids, sampling)
+        ttft = time.time() - t0
+        if max_tokens <= len(handoff["output_ids"]):
+            # prefill's first token already satisfied the budget
+            out_ids = handoff["output_ids"]
+            finish_reason = "length"
+        else:
+            result = await self.decode.options(
+                method_name="decode").remote(handoff, sampling)
+            out_ids = result["output_ids"]
+            finish_reason = result["finish_reason"]
+        return {
+            "text": self.tokenizer.decode(out_ids),
+            "token_ids": out_ids,
+            "finish_reason": finish_reason,
+            "usage": {"prompt_tokens": len(prompt_ids),
+                      "completion_tokens": len(out_ids),
+                      "total_tokens": len(prompt_ids) + len(out_ids)},
+            "ttft_s": ttft,
+        }
+
+    async def check_health(self) -> bool:
+        return True
+
+
+def build_pd_openai_app(llm_config: LLMConfig, *,
+                        num_prefill_replicas: int = 1,
+                        num_decode_replicas: int = 1):
+    """OpenAI-compatible app with disaggregated prefill/decode tiers
+    (ref: prefill_decode_disagg.py build_app)."""
+    prefill = PrefillServer.options(
+        name=f"PrefillServer:{llm_config.model_id}",
+        num_replicas=num_prefill_replicas,
+        ray_actor_options=llm_config.ray_actor_options,
+    ).bind(llm_config)
+    decode = DecodeServer.options(
+        name=f"DecodeServer:{llm_config.model_id}",
+        num_replicas=num_decode_replicas,
+        ray_actor_options=llm_config.ray_actor_options,
+    ).bind(llm_config)
+    router = PDRouter.options(
+        name=f"PDRouter:{llm_config.model_id}").bind(
+        prefill, decode, llm_config)
+    return OpenAIIngress.options(name="OpenAIIngress").bind(
+        router, llm_config.model_id)

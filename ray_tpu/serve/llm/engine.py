@@ -40,6 +40,10 @@ class SamplingParams:
     top_k: int = 0            # 0 => full vocab
     stop_token_ids: tuple = ()
     seed: Optional[int] = None  # None => engine-level RNG
+    # disaggregation: stop after the first token and stash the request's
+    # KV blob for pop_extracted() (gathered inside step(), on the driver
+    # thread, so no reader ever races the donated page buffers)
+    prefill_only: bool = False
 
 
 @dataclasses.dataclass
@@ -130,6 +134,8 @@ class LLMEngine:
         self._intake: List[Request] = []
         self._intake_lock = threading.Lock()
         self._aborted: set = set()
+        self._injections: List[tuple] = []
+        self.extracted: Dict[str, Dict[str, Any]] = {}
         self.waiting: List[Request] = []
         self.running: List[Request] = []
         self.requests: Dict[str, Request] = {}
@@ -155,7 +161,7 @@ class LLMEngine:
 
     def has_work(self) -> bool:
         with self._intake_lock:
-            if self._intake:
+            if self._intake or self._injections:
                 return True
         return bool(self.waiting or self.running)
 
@@ -167,8 +173,9 @@ class LLMEngine:
         batched decode step."""
         deltas: List[OutputDelta] = []
         self._drain_intake(deltas)
+        injected = self._try_admit_injection()
         admitted = self._try_admit(deltas)
-        if not admitted and self.running:
+        if not (injected or admitted) and self.running:
             self._decode_step(deltas)
         return deltas
 
@@ -352,6 +359,14 @@ class LLMEngine:
     def _append_token(self, req: Request, token: int,
                       deltas: List[OutputDelta]) -> None:
         req.output_ids.append(token)
+        if req.sampling.prefill_only:
+            # gather-then-release inside the driver thread: the blob is
+            # complete before the finished delta is observable
+            self.extracted[req.request_id] = self._gather_kv(req)
+            self._finish(req, "prefill_done")
+            deltas.append(OutputDelta(req.request_id, [token], True,
+                                      "prefill_done"))
+            return
         stop = None
         eos = self.config.eos_token_id
         if eos is not None and token == eos:
@@ -389,6 +404,88 @@ class LLMEngine:
         req.finish_reason = reason
         self.allocator.release(req.pages)
         req.pages = []
+        # drop the bookkeeping entry: long-lived engines (batch workers,
+        # serve replicas) would otherwise accumulate one Request per call
+        self.requests.pop(req.request_id, None)
+
+    # ------------------------------------------- prefill/decode handoff
+
+    def _gather_kv(self, req: Request) -> Dict[str, Any]:
+        idx = np.asarray(req.pages, np.int32)
+        return {
+            "k": np.asarray(self.k_pages[:, idx]),
+            "v": np.asarray(self.v_pages[:, idx]),
+            "prompt_ids": list(req.prompt_ids),
+            "output_ids": list(req.output_ids),
+        }
+
+    def extract_kv(self, request_id: str) -> Dict[str, Any]:
+        """Gather a running request's KV pages + generation state into a
+        host blob for disaggregated prefill→decode handoff (ref:
+        llm/_internal/serve/deployments/prefill_decode_disagg/ — the
+        reference moves KV between vLLM instances; here pages move
+        between engines as dense arrays). Synchronous-driver use only;
+        concurrent servers use SamplingParams(prefill_only=True) +
+        pop_extracted, which gathers inside step()."""
+        req = self.requests[request_id]
+        assert req.state == RUNNING, f"{request_id} not running"
+        return self._gather_kv(req)
+
+    def pop_extracted(self, request_id: str) -> Dict[str, Any]:
+        """Fetch (and drop) the KV blob of a prefill_only request that
+        finished with reason 'prefill_done'."""
+        return self.extracted.pop(request_id)
+
+    def release_request(self, request_id: str) -> None:
+        """Drop a request after handoff (its pages return to the pool)."""
+        req = self.requests.pop(request_id, None)
+        if req is not None and req.state != FINISHED:
+            self._finish(req, "transferred")
+
+    def inject_request(self, request_id: str, handoff: Dict[str, Any],
+                       sampling: Optional[SamplingParams] = None) -> None:
+        """Adopt a prefilled request: queue it for admission; the next
+        step() scatters its KV pages and resumes decoding from its
+        pending token. Queued (not applied inline) so injections respect
+        the same max_batch/page admission control as fresh prompts."""
+        with self._intake_lock:
+            self._injections.append(
+                (request_id, handoff, sampling or SamplingParams()))
+
+    def _try_admit_injection(self) -> bool:
+        """Admit the oldest queued injection if batch slots + pages allow
+        (called from step(), before fresh-prompt admission — transferred
+        requests already paid for their prefill)."""
+        import jax.numpy as jnp
+
+        with self._intake_lock:
+            if not self._injections:
+                return False
+            if len(self.running) >= self.config.max_batch:
+                return False
+            request_id, handoff, sampling = self._injections[0]
+            n = handoff["k"].shape[1]
+            if self.allocator.num_free() < n:
+                return False
+            self._injections.pop(0)
+        pages = self.allocator.allocate(n)
+        idx = jnp.asarray(np.asarray(pages, np.int32))
+        self.k_pages = self.k_pages.at[:, idx].set(
+            jnp.asarray(handoff["k"], self.k_pages.dtype))
+        self.v_pages = self.v_pages.at[:, idx].set(
+            jnp.asarray(handoff["v"], self.v_pages.dtype))
+        req = Request(request_id, list(handoff["prompt_ids"]), sampling)
+        req.output_ids = list(handoff["output_ids"])
+        req.pages = pages
+        req.state = RUNNING
+        # mark the whole transferred prompt as hashed so the decode
+        # engine never re-registers pages it did not fill page-aligned
+        page = self.config.page_size
+        req.n_hashed = (len(req.prompt_ids) // page) * page
+        req.n_cached = 0
+        self.requests[request_id] = req
+        self.running.append(req)
+        return True
 
     # ------------------------------------------------------------ stats
 

@@ -37,8 +37,56 @@ class LLMConfig:
         default_factory=dict)
 
 
+class EngineDriverMixin:
+    """Single driver coroutine + per-request waiter queues over the
+    non-thread-safe engine. Concurrent request coroutines never call
+    engine.step() themselves — they register a queue and await deltas —
+    so the donated page buffers only ever see one stepping thread.
+    """
+
+    def _init_driver(self):
+        self._waiters: Dict[str, asyncio.Queue] = {}
+        self._driver_task: Optional[asyncio.Task] = None
+
+    async def _ensure_driver(self):
+        if self._driver_task is None or self._driver_task.done():
+            self._driver_task = asyncio.get_running_loop().create_task(
+                self._drive())
+
+    async def _drive(self):
+        loop = asyncio.get_running_loop()
+        while True:
+            while self.engine.has_work():
+                deltas = await loop.run_in_executor(None, self.engine.step)
+                for delta in deltas:
+                    queue = self._waiters.get(delta.request_id)
+                    if queue is not None:
+                        queue.put_nowait(delta)
+                if not deltas:
+                    await asyncio.sleep(0.005)
+            # Linger one tick before exiting: work enqueued between the
+            # check above and task completion is picked up here. There is
+            # no await between the final has_work() and return, so (the
+            # event loop being single-threaded) no add_request can slip
+            # into that window unseen.
+            await asyncio.sleep(0.005)
+            if not self.engine.has_work():
+                return
+
+    async def _await_request(self, request_id: str,
+                             queue: "asyncio.Queue"):
+        """Yield deltas for request_id until the finished one (caller
+        registered the queue in self._waiters)."""
+        await self._ensure_driver()
+        while True:
+            delta = await queue.get()
+            yield delta
+            if delta.finished:
+                return
+
+
 @deployment
-class LLMServer:
+class LLMServer(EngineDriverMixin):
     """Hosts one engine. A single driver coroutine pulls engine steps on an
     executor thread while requests are pending, so the replica's event loop
     stays free (ref: llm_server.py engine loop task)."""
@@ -52,24 +100,7 @@ class LLMServer:
                 self.tokenizer, "eos_token_id", None)
         self.engine = LLMEngine(engine_cfg)
         self._ids = itertools.count()
-        self._waiters: Dict[str, asyncio.Queue] = {}
-        self._driver_task: Optional[asyncio.Task] = None
-
-    async def _ensure_driver(self):
-        if self._driver_task is None or self._driver_task.done():
-            self._driver_task = asyncio.get_running_loop().create_task(
-                self._drive())
-
-    async def _drive(self):
-        loop = asyncio.get_running_loop()
-        while self.engine.has_work():
-            deltas = await loop.run_in_executor(None, self.engine.step)
-            for delta in deltas:
-                queue = self._waiters.get(delta.request_id)
-                if queue is not None:
-                    queue.put_nowait(delta)
-            if not deltas:
-                await asyncio.sleep(0.005)
+        self._init_driver()
 
     async def generate(self, prompt: str = None, *,
                        prompt_ids: Optional[List[int]] = None,

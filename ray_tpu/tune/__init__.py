@@ -34,6 +34,14 @@ from .search import (  # noqa: F401
     sample_from,
     uniform,
 )
+from .searchers import (  # noqa: F401
+    ConcurrencyLimiter,
+    HyperOptSearch,
+    ListSearcher,
+    OptunaSearch,
+    Searcher,
+    TPESearcher,
+)
 
 
 @dataclass
@@ -45,6 +53,7 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: Optional[int] = None
     scheduler: Optional[TrialScheduler] = None
+    search_alg: Optional[Searcher] = None  # adaptive (TPE/optuna/...)
     search_seed: Optional[int] = None
 
 
@@ -138,8 +147,16 @@ class Tuner:
         if not ray_tpu.is_initialized():
             ray_tpu.init()
         tc = self.tune_config
-        gen = BasicVariantGenerator(seed=tc.search_seed)
-        configs = list(gen.generate(self.param_space, tc.num_samples))
+        if tc.search_alg is not None:
+            searcher, configs = tc.search_alg, None
+            num_trials = tc.num_samples
+            if searcher.metric is None:
+                searcher.metric = tc.metric
+                searcher.mode = tc.mode
+        else:
+            gen = BasicVariantGenerator(seed=tc.search_seed)
+            configs = list(gen.generate(self.param_space, tc.num_samples))
+            searcher, num_trials = None, None
         name = self.run_config.name or f"tune_{int(time.time())}"
         storage = self.run_config.storage_path or os.path.join(
             os.path.expanduser("~"), "rtpu_results")
@@ -152,6 +169,8 @@ class Tuner:
             self.trainable, configs,
             experiment_dir=experiment_dir,
             scheduler=scheduler,
+            searcher=searcher,
+            num_trials=num_trials,
             max_concurrent=tc.max_concurrent_trials,
             max_failures=self.run_config.failure_config.max_failures,
             resources_per_trial=self.resources_per_trial,

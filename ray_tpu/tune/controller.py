@@ -48,15 +48,19 @@ class Trial:
 
 
 class TuneController:
-    def __init__(self, trainable: Callable, configs: List[Dict[str, Any]],
+    def __init__(self, trainable: Callable,
+                 configs: Optional[List[Dict[str, Any]]] = None,
                  *, experiment_dir: str,
                  scheduler: Optional[TrialScheduler] = None,
+                 searcher: Optional[Any] = None,
+                 num_trials: Optional[int] = None,
                  max_concurrent: Optional[int] = None,
                  max_failures: int = 0,
                  resources_per_trial: Optional[Dict[str, float]] = None,
                  stop: Optional[Dict[str, Any]] = None,
                  poll_interval: float = 0.1):
         from ..runtime import serialization
+        from .searchers import ListSearcher
 
         self.trainable_blob = serialization.dumps_inline(trainable)
         self.stop_criteria = stop or {}
@@ -67,25 +71,60 @@ class TuneController:
         self.resources = resources_per_trial or {"CPU": 1.0}
         self.poll_interval = poll_interval
         os.makedirs(experiment_dir, exist_ok=True)
-        self.trials = [
-            Trial(trial_id=f"trial_{i:05d}", config=cfg,
-                  checkpoint_manager=CheckpointManager(
-                      os.path.join(experiment_dir, f"trial_{i:05d}",
-                                   "checkpoints")))
-            for i, cfg in enumerate(configs)]
-        if isinstance(self.scheduler, PopulationBasedTraining):
-            for t in self.trials:
-                self.scheduler.register(t.trial_id, t.config)
+        # Everything runs through the Searcher protocol: a static config
+        # list (BasicVariantGenerator output) becomes a ListSearcher;
+        # adaptive searchers (TPE, optuna) suggest lazily as capacity
+        # frees so completed results inform later trials.
+        if searcher is None:
+            assert configs is not None, "configs or searcher required"
+            searcher = ListSearcher(configs)
+            num_trials = len(configs)
+        self.searcher = searcher
+        self.num_trials = num_trials if num_trials is not None else 10**9
+        self.trials: List[Trial] = []
+        self._created = 0
 
     # ------------------------------------------------------------------ run
+    def _make_trial(self) -> Optional[Trial]:
+        trial_id = f"trial_{self._created:05d}"
+        config = self.searcher.suggest(trial_id)
+        if config is None:
+            return None
+        self._created += 1
+        trial = Trial(
+            trial_id=trial_id, config=config,
+            checkpoint_manager=CheckpointManager(
+                os.path.join(self.experiment_dir, trial_id,
+                             "checkpoints")))
+        self.trials.append(trial)
+        if isinstance(self.scheduler, PopulationBasedTraining):
+            self.scheduler.register(trial_id, config)
+        return trial
+
     def run(self) -> List[Trial]:
-        pending = list(self.trials)
+        pending: List[Trial] = []
         running: List[Trial] = []
-        while pending or running:
+        exhausted = False
+        while True:
             while pending and len(running) < self.max_concurrent:
                 trial = pending.pop(0)
                 self._start_trial(trial)
                 running.append(trial)
+            while (not exhausted and self._created < self.num_trials
+                   and len(running) < self.max_concurrent):
+                trial = self._make_trial()
+                if trial is None:
+                    # a ConcurrencyLimiter returns None while throttled;
+                    # with nothing running it can only mean exhaustion
+                    if not running:
+                        exhausted = True
+                    break
+                self._start_trial(trial)
+                running.append(trial)
+            if self._created >= self.num_trials:
+                exhausted = True
+            if not pending and not running and exhausted:
+                break
             time.sleep(self.poll_interval)
             for trial in list(running):
                 done = self._poll_trial(trial)
@@ -98,6 +137,9 @@ class TuneController:
                         trial.resume_checkpoint = (
                             trial.checkpoint_manager.latest_checkpoint)
                         pending.append(trial)
+                    else:
+                        self.searcher.on_trial_complete(
+                            trial.trial_id, trial.last_metrics)
         return self.trials
 
     # ------------------------------------------------------------ internals

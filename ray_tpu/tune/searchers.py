@@ -1,0 +1,329 @@
+"""Adaptive search algorithms (ref: tune/search/ — basic_variant, optuna,
+hyperopt, ConcurrencyLimiter ...).
+
+The reference wraps external optimizers (optuna/hyperopt/ax/...); those
+adapters exist here too (gated on availability), but the workhorse is a
+NATIVE TPESearcher — a dependency-free Tree-structured Parzen Estimator
+over the tune search-space Domains — so adaptive search works in a
+hermetic TPU environment out of the box.
+
+Searcher protocol (ref: tune/search/searcher.py):
+    suggest(trial_id) -> config dict (or None when exhausted)
+    on_trial_complete(trial_id, result) -> feed the final metrics back
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from .search import (BasicVariantGenerator, Categorical, Domain, Float,
+                     Function, GridSearch, Integer)
+
+
+class Searcher:
+    """Base adaptive searcher."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None) -> None:
+        pass
+
+
+class ListSearcher(Searcher):
+    """Non-adaptive: serves a pre-generated config list (the
+    BasicVariantGenerator path reshaped into the Searcher protocol)."""
+
+    def __init__(self, configs: List[Dict[str, Any]]):
+        super().__init__()
+        self._configs = list(configs)
+        self._next = 0
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._next >= len(self._configs):
+            return None
+        cfg = self._configs[self._next]
+        self._next += 1
+        return cfg
+
+
+def _flatten_space(space: Dict[str, Any], prefix: Tuple[str, ...] = ()
+                   ) -> List[Tuple[Tuple[str, ...], Any]]:
+    out = []
+    for key, val in space.items():
+        path = prefix + (key,)
+        if isinstance(val, dict):
+            out.extend(_flatten_space(val, path))
+        else:
+            out.append((path, val))
+    return out
+
+
+def _set_path(cfg: Dict[str, Any], path: Tuple[str, ...], value: Any):
+    node = cfg
+    for key in path[:-1]:
+        node = node.setdefault(key, {})
+    node[path[-1]] = value
+
+
+class TPESearcher(Searcher):
+    """Native Tree-structured Parzen Estimator.
+
+    After `n_initial` random trials, each dimension's observations are
+    split into good/bad sets at the gamma quantile of the objective;
+    candidates are drawn from a KDE over the good set and ranked by the
+    density ratio l(x)/g(x) (the standard TPE acquisition). Floats use
+    gaussian kernels (in log space for loguniform domains), integers
+    likewise with rounding, categoricals use smoothed frequency counts.
+    """
+
+    def __init__(self, space: Dict[str, Any],
+                 metric: Optional[str] = None, mode: str = "max",
+                 n_initial: int = 10, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self.space = space
+        self.dims = [(path, dom) for path, dom in _flatten_space(space)
+                     if isinstance(dom, (Float, Integer, Categorical,
+                                         GridSearch))]
+        self.static = [(path, val) for path, val in _flatten_space(space)
+                       if not isinstance(val, (Float, Integer, Categorical,
+                                               GridSearch, Function))]
+        self.fns = [(path, val) for path, val in _flatten_space(space)
+                    if isinstance(val, Function)]
+        self.n_initial = n_initial
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.rng = np.random.RandomState(seed)
+        self._pending: Dict[str, Dict[str, Any]] = {}
+        self._history: List[Tuple[Dict[str, Any], float]] = []
+
+    # ------------------------------------------------------------ suggest
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if len(self._history) < self.n_initial or not self.dims:
+            flat = {path: self._sample_prior(dom)
+                    for path, dom in self.dims}
+        else:
+            flat = self._tpe_sample()
+        cfg: Dict[str, Any] = {}
+        for path, val in self.static:
+            _set_path(cfg, path, val)
+        for path, fn in self.fns:
+            _set_path(cfg, path, fn.fn())
+        for path, val in flat.items():
+            _set_path(cfg, path, val)
+        self._pending[trial_id] = flat
+        return cfg
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None) -> None:
+        flat = self._pending.pop(trial_id, None)
+        if flat is None or not result or self.metric not in result:
+            return
+        value = float(result[self.metric])
+        if not math.isfinite(value):
+            return
+        score = value if self.mode == "max" else -value
+        self._history.append((flat, score))
+
+    # ----------------------------------------------------------- sampling
+
+    def _sample_prior(self, dom) -> Any:
+        if isinstance(dom, GridSearch):
+            return dom.values[self.rng.randint(len(dom.values))]
+        return dom.sample(self.rng)
+
+    def _tpe_sample(self) -> Dict[str, Any]:
+        ranked = sorted(self._history, key=lambda p: -p[1])
+        n_good = max(1, int(self.gamma * len(ranked)))
+        good = [flat for flat, _ in ranked[:n_good]]
+        bad = [flat for flat, _ in ranked[n_good:]] or good
+        out: Dict[Tuple[str, ...], Any] = {}
+        for path, dom in self.dims:
+            good_v = [g[path] for g in good if path in g]
+            bad_v = [b[path] for b in bad if path in b]
+            if not good_v:
+                out[path] = self._sample_prior(dom)
+                continue
+            cands = [self._kde_draw(dom, good_v)
+                     for _ in range(self.n_candidates)]
+            scores = [self._kde_logpdf(dom, c, good_v)
+                      - self._kde_logpdf(dom, c, bad_v) for c in cands]
+            out[path] = cands[int(np.argmax(scores))]
+        return out
+
+    # per-domain kernel helpers -------------------------------------------
+
+    def _to_unit(self, dom, v: float) -> float:
+        if isinstance(dom, Float) and dom.log:
+            return math.log(v)
+        return float(v)
+
+    def _from_unit(self, dom, u: float) -> Any:
+        if isinstance(dom, Float):
+            if dom.log:
+                u = math.exp(u)
+            v = min(max(u, dom.lower), dom.upper)
+            if dom.q:
+                v = round(v / dom.q) * dom.q
+            return float(v)
+        if isinstance(dom, Integer):
+            return int(min(max(round(u), dom.lower), dom.upper - 1))
+        raise TypeError(dom)
+
+    def _bandwidth(self, dom, values: List[float]) -> float:
+        if isinstance(dom, Float):
+            lo, hi = dom.lower, dom.upper
+            if dom.log:
+                lo, hi = math.log(lo), math.log(hi)
+        else:
+            lo, hi = dom.lower, dom.upper
+        spread = np.std(values) if len(values) > 1 else 0.0
+        return max(spread, (hi - lo) * 0.1, 1e-8)
+
+    def _kde_draw(self, dom, values: List[Any]) -> Any:
+        if isinstance(dom, (Categorical, GridSearch)):
+            cats = dom.categories if isinstance(dom, Categorical) \
+                else dom.values
+            counts = np.array(
+                [1.0 + sum(v == c for v in values) for c in cats])
+            return cats[self.rng.choice(len(cats),
+                                        p=counts / counts.sum())]
+        unit = [self._to_unit(dom, v) for v in values]
+        center = unit[self.rng.randint(len(unit))]
+        draw = self.rng.normal(center, self._bandwidth(dom, unit))
+        return self._from_unit(dom, draw)
+
+    def _kde_logpdf(self, dom, x: Any, values: List[Any]) -> float:
+        if not values:
+            return -1e9
+        if isinstance(dom, (Categorical, GridSearch)):
+            cats = dom.categories if isinstance(dom, Categorical) \
+                else dom.values
+            count = 1.0 + sum(v == x for v in values)
+            return math.log(count / (len(values) + len(cats)))
+        unit = [self._to_unit(dom, v) for v in values]
+        xu = self._to_unit(dom, x)
+        bw = self._bandwidth(dom, unit)
+        dens = np.mean([math.exp(-0.5 * ((xu - u) / bw) ** 2)
+                        / (bw * math.sqrt(2 * math.pi)) for u in unit])
+        return math.log(max(dens, 1e-300))
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps in-flight suggestions (ref: tune/search/concurrency_limiter.py).
+    The controller already bounds concurrency; this additionally throttles
+    eager searchers that need results before suggesting well (TPE)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int = 4):
+        # self.searcher must exist before super().__init__ assigns the
+        # metric/mode properties (their setters forward to it)
+        self.searcher = searcher
+        super().__init__(searcher.metric, searcher.mode)
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if len(self._live) >= self.max_concurrent:
+            return None  # controller retries on the next loop tick
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None:
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None) -> None:
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result)
+
+    @property
+    def metric(self):
+        return self.searcher.metric
+
+    @metric.setter
+    def metric(self, value):
+        self.searcher.metric = value
+
+    @property
+    def mode(self):
+        return self.searcher.mode
+
+    @mode.setter
+    def mode(self, value):
+        self.searcher.mode = value
+
+
+class OptunaSearch(Searcher):
+    """Adapter over optuna's TPE (ref: tune/search/optuna/optuna_search.py).
+    Gated: raises with guidance when optuna is not installed (it is not in
+    the hermetic TPU image; the native TPESearcher needs no extra deps)."""
+
+    def __init__(self, space: Dict[str, Any],
+                 metric: Optional[str] = None, mode: str = "max",
+                 seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        try:
+            import optuna
+        except ImportError as e:
+            raise ImportError(
+                "optuna is not installed; use ray_tpu.tune.TPESearcher "
+                "(native, no dependencies) instead") from e
+        self._optuna = optuna
+        sampler = optuna.samplers.TPESampler(seed=seed)
+        self._study = optuna.create_study(
+            direction="maximize" if mode == "max" else "minimize",
+            sampler=sampler)
+        self.space = space
+        self._trials: Dict[str, Any] = {}
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        ot = self._study.ask()
+        self._trials[trial_id] = ot
+        cfg: Dict[str, Any] = {}
+        for path, dom in _flatten_space(self.space):
+            name = ".".join(path)
+            if isinstance(dom, Float):
+                val = ot.suggest_float(name, dom.lower, dom.upper,
+                                       log=dom.log)
+            elif isinstance(dom, Integer):
+                val = ot.suggest_int(name, dom.lower, dom.upper - 1)
+            elif isinstance(dom, Categorical):
+                val = ot.suggest_categorical(name, dom.categories)
+            elif isinstance(dom, Function):
+                val = dom.fn()
+            else:
+                val = dom
+            _set_path(cfg, path, val)
+        return cfg
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None) -> None:
+        ot = self._trials.pop(trial_id, None)
+        if ot is None or not result or self.metric not in result:
+            return
+        self._study.tell(ot, float(result[self.metric]))
+
+
+class HyperOptSearch(Searcher):
+    """Adapter stub for hyperopt (ref: tune/search/hyperopt/), gated the
+    same way as OptunaSearch."""
+
+    def __init__(self, *args, **kwargs):
+        try:
+            import hyperopt  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "hyperopt is not installed; use ray_tpu.tune.TPESearcher "
+                "(native, no dependencies) instead") from e
+        raise NotImplementedError(
+            "hyperopt adapter: install hyperopt and use OptunaSearch-style "
+            "wiring, or the native TPESearcher")
