@@ -7,6 +7,8 @@ shuffles, streaming iteration for TPU ingest (iter_jax_batches).
 
 from __future__ import annotations
 
+import builtins
+
 from typing import Any, List, Optional
 
 from .block import Block, BlockAccessor  # noqa: F401
@@ -94,9 +96,58 @@ def read_numpy(paths, *, parallelism: int = -1) -> Dataset:
     return _from_read_tasks(numpy_read_tasks(paths, parallelism))
 
 
+def read_binary_files(paths, *, parallelism: int = -1,
+                      include_paths: bool = False) -> Dataset:
+    """ref: read_api.py read_binary_files."""
+    from .datasource import binary_read_tasks
+
+    return _from_read_tasks(
+        binary_read_tasks(paths, parallelism, include_paths=include_paths))
+
+
+def read_images(paths, *, parallelism: int = -1,
+                size: Optional[tuple] = None, mode: Optional[str] = None,
+                include_paths: bool = False) -> Dataset:
+    """ref: read_api.py read_images (PIL-decoded HWC arrays)."""
+    from .datasource import image_read_tasks
+
+    return _from_read_tasks(
+        image_read_tasks(paths, parallelism, size=size, mode=mode,
+                         include_paths=include_paths))
+
+
+def from_torch(torch_dataset) -> Dataset:
+    """ref: read_api.py from_torch — materialize a map- or iterable-style
+    torch dataset into rows."""
+    if hasattr(torch_dataset, "__len__") and hasattr(torch_dataset,
+                                                     "__getitem__"):
+        items = [torch_dataset[i]
+                 for i in builtins.range(len(torch_dataset))]
+    else:  # IterableDataset: no len/indexing
+        items = list(torch_dataset)
+    return from_items(items)
+
+
+def from_huggingface(hf_dataset) -> Dataset:
+    """ref: read_api.py from_huggingface — adopt an HF datasets.Dataset
+    via its arrow table (zero-copy when possible)."""
+    if getattr(hf_dataset, "_indices", None) is not None:
+        # shuffle()/select()/filter() keep an indices mapping over the
+        # unchanged arrow table — materialize it or we'd return the
+        # wrong (unshuffled/unfiltered) rows
+        hf_dataset = hf_dataset.flatten_indices()
+    try:
+        table = hf_dataset.data.table
+    except AttributeError:
+        return from_items([dict(r) for r in hf_dataset])
+    return from_arrow(table.combine_chunks())
+
+
 __all__ = [
     "Block", "BlockAccessor", "DataIterator", "Dataset", "GroupedData",
     "StreamingExecutor", "range", "range_tensor", "from_items", "from_numpy",
-    "from_arrow", "from_pandas", "read_parquet", "read_csv", "read_json",
+    "from_arrow", "from_pandas", "from_torch", "from_huggingface",
+    "read_parquet", "read_csv", "read_json",
+    "read_binary_files", "read_images",
     "read_text", "read_numpy",
 ]

@@ -125,6 +125,64 @@ def numpy_read_tasks(paths, parallelism: int = -1):
     return [make(g) for g in _group(files, parallelism)]
 
 
+def binary_read_tasks(paths, parallelism: int = -1,
+                      include_paths: bool = False):
+    """ref: data/read_api.py read_binary_files — one row per file with
+    its raw bytes (and optionally the path)."""
+    files = _expand_paths(paths)
+    if parallelism == -1:
+        parallelism = len(files)
+
+    def make(group):
+        def read() -> List[Block]:
+            blocks = []
+            for f in group:
+                with open(f, "rb") as fh:
+                    row = {"bytes": np.array([fh.read()], dtype=object)}
+                if include_paths:
+                    row["path"] = np.array([f], dtype=object)
+                blocks.append(row)
+            return blocks
+
+        return read
+
+    return [make(g) for g in _group(files, parallelism)]
+
+
+def image_read_tasks(paths, parallelism: int = -1,
+                     size: Optional[tuple] = None,
+                     mode: Optional[str] = None,
+                     include_paths: bool = False):
+    """ref: data/read_api.py read_images / _internal/datasource/
+    image_datasource.py — decode to HWC uint8 arrays, optional resize and
+    mode conversion."""
+    files = _expand_paths(paths)
+    if parallelism == -1:
+        parallelism = len(files)
+
+    def make(group):
+        def read() -> List[Block]:
+            from PIL import Image
+
+            blocks = []
+            for f in group:
+                img = Image.open(f)
+                if mode is not None:
+                    img = img.convert(mode)
+                if size is not None:
+                    img = img.resize((size[1], size[0]))
+                arr = np.asarray(img)
+                row = {"image": arr[None]}
+                if include_paths:
+                    row["path"] = np.array([f], dtype=object)
+                blocks.append(row)
+            return blocks
+
+        return read
+
+    return [make(g) for g in _group(files, parallelism)]
+
+
 def range_read_tasks(n: int, parallelism: int = -1,
                      tensor_shape: Optional[tuple] = None) -> List[Callable]:
     if parallelism == -1:

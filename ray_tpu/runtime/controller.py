@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import os
+import pickle
 import time
 from typing import Any, Dict, List, Optional
 
@@ -84,9 +86,25 @@ class ActorInfo:
 
 
 class Controller:
-    def __init__(self, session_name: str, address: str):
+    """Cluster control plane (GCS equivalent).
+
+    Fault tolerance (ref: gcs server restart replay gcs_init_data.cc +
+    RedisStoreClient redis_store_client.h:111): pass ``persist_dir`` to
+    journal the durable tables — KV store, jobs, placement-group specs,
+    and named-actor specs — to an atomic snapshot file after each
+    mutation. A controller restarted over the same directory replays
+    them: KV/jobs/PGs come back as they were; named actors come back
+    PENDING and reschedule once nodes re-register. Node liveness and
+    in-flight leases are runtime state and are intentionally NOT
+    persisted (the reference rebuilds them from raylet reconnection the
+    same way).
+    """
+
+    def __init__(self, session_name: str, address: str,
+                 persist_dir: Optional[str] = None):
         self.session_name = session_name
         self.address = address
+        self.persist_dir = persist_dir
         self.nodes: Dict[str, NodeInfo] = {}
         self.actors: Dict[str, ActorInfo] = {}
         self.named_actors: Dict[tuple, str] = {}  # (namespace, name) -> actor_id
@@ -101,6 +119,104 @@ class Controller:
         self._server = RpcServer(address, self._handlers(), on_disconnect=self._on_disconnect)
         self._health_task: Optional[asyncio.Task] = None
         self.start_time = time.time()
+        if persist_dir:
+            os.makedirs(persist_dir, exist_ok=True)
+            self._replay_persisted()
+
+    # ------------------------------------------------------- persistence
+    #
+    # Two tiers keep per-mutation cost bounded:
+    # - meta.pkl: jobs / PG specs / named-actor specs — small tables,
+    #   rewritten atomically on their (rare) mutations
+    # - kv.journal: append-only record stream for the KV store (which
+    #   holds pickled functions — MBs; rewriting it per put would make
+    #   every control RPC O(total state)); compacted into kv.pkl on
+    #   restart replay
+
+    def _meta_path(self) -> str:
+        return os.path.join(self.persist_dir, "meta.pkl")
+
+    def _kv_paths(self):
+        return (os.path.join(self.persist_dir, "kv.pkl"),
+                os.path.join(self.persist_dir, "kv.journal"))
+
+    def _persist(self) -> None:
+        """Atomic snapshot of the small metadata tables (jobs, PG specs,
+        named actors). KV mutations go through _journal_kv instead."""
+        if not self.persist_dir:
+            return
+        state = {
+            "jobs": dict(self.jobs),
+            "placement_groups": {
+                pg_id: {k: v for k, v in pg.items() if k != "placement"}
+                for pg_id, pg in self.placement_groups.items()},
+            "named_actors": {
+                f"{ns}\x00{name}": actor_id
+                for (ns, name), actor_id in self.named_actors.items()},
+            "actor_specs": {
+                info.actor_id: info.spec
+                for info in self.actors.values()
+                if info.spec.get("name") and info.state != ACTOR_DEAD},
+        }
+        path = self._meta_path()
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, path)
+
+    def _journal_kv(self, op: str, ns: str, key: str,
+                    value: Optional[bytes] = None) -> None:
+        """Append one KV mutation record — O(record), not O(store)."""
+        if not self.persist_dir:
+            return
+        _, journal = self._kv_paths()
+        with open(journal, "ab") as f:
+            pickle.dump((op, ns, key, value), f)
+
+    def _replay_persisted(self) -> None:
+        """Replay snapshot + journal into fresh tables (ref:
+        gcs_init_data.cc — the restarted GCS reloads its tables before
+        serving), then compact the journal."""
+        meta_path = self._meta_path()
+        if os.path.exists(meta_path):
+            with open(meta_path, "rb") as f:
+                state = pickle.load(f)
+            self.jobs.update(state.get("jobs", {}))
+            for pg_id, pg in state.get("placement_groups", {}).items():
+                # bundles must be re-reserved on live nodes; mark pending
+                self.placement_groups[pg_id] = dict(
+                    pg, state="PENDING", placement=None)
+            for key, actor_id in state.get("named_actors", {}).items():
+                ns, _, name = key.partition("\x00")
+                self.named_actors[(ns, name)] = actor_id
+            for actor_id, spec in state.get("actor_specs", {}).items():
+                info = ActorInfo(actor_id, spec)
+                info.state = ACTOR_RESTARTING
+                self.actors[actor_id] = info
+        snap, journal = self._kv_paths()
+        if os.path.exists(snap):
+            with open(snap, "rb") as f:
+                for ns, kvs in pickle.load(f).items():
+                    self.kv[ns].update(kvs)
+        if os.path.exists(journal):
+            with open(journal, "rb") as f:
+                while True:
+                    try:
+                        op, ns, key, value = pickle.load(f)
+                    except EOFError:
+                        break
+                    if op == "put":
+                        self.kv[ns][key] = value
+                    else:
+                        self.kv[ns].pop(key, None)
+            # compact: fold the journal into the snapshot
+            tmp = snap + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump({ns: dict(kvs)
+                             for ns, kvs in self.kv.items()}, f)
+            os.replace(tmp, snap)
+            os.unlink(journal)
+        # actor/PG rescheduling kicks off in start() (needs the loop)
 
     def _handlers(self):
         return {
@@ -151,6 +267,14 @@ class Controller:
     async def start(self):
         await self._server.start()
         self._health_task = asyncio.ensure_future(self._health_loop())
+        # replayed named actors + pending PGs reschedule once nodes
+        # re-register
+        for info in self.actors.values():
+            if info.state == ACTOR_RESTARTING:
+                asyncio.ensure_future(self._schedule_actor(info))
+        for pg in self.placement_groups.values():
+            if pg.get("state") == "PENDING":
+                asyncio.ensure_future(self._retry_pg(pg))
 
     async def stop(self):
         if self._health_task:
@@ -231,13 +355,17 @@ class Controller:
         if not overwrite and key in self.kv[ns]:
             return False
         self.kv[ns][key] = value
+        self._journal_kv("put", ns, key, value)
         return True
 
     async def kv_get(self, ns: str, key: str):
         return self.kv[ns].get(key)
 
     async def kv_del(self, ns: str, key: str):
-        return self.kv[ns].pop(key, None) is not None
+        existed = self.kv[ns].pop(key, None) is not None
+        if existed:
+            self._journal_kv("del", ns, key)
+        return existed
 
     async def kv_keys(self, ns: str, prefix: str = ""):
         return [k for k in self.kv[ns] if k.startswith(prefix)]
@@ -261,6 +389,7 @@ class Controller:
         self.actors[actor_id] = info
         if name:
             self.named_actors[(namespace, name)] = actor_id
+            self._persist()
         asyncio.ensure_future(self._schedule_actor(info))
         return {"status": "registered", "actor_id": actor_id}
 
@@ -323,6 +452,7 @@ class Controller:
             name = info.spec.get("name")
             if name:
                 self.named_actors.pop((info.spec.get("namespace", ""), name), None)
+                self._persist()
             await self._publish(f"actor:{actor_id}", info.snapshot())
         return True
 
@@ -390,6 +520,7 @@ class Controller:
             pg = {"pg_id": pg_id, "state": "PENDING", "bundles": bundles,
                   "strategy": strategy, "name": name, "placement": None}
             self.placement_groups[pg_id] = pg
+            self._persist()
             asyncio.ensure_future(self._retry_pg(pg))
             return {"state": "PENDING"}
         ok = await self._reserve_placement(pg_id, bundles, placement)
@@ -397,12 +528,14 @@ class Controller:
             pg = {"pg_id": pg_id, "state": "PENDING", "bundles": bundles,
                   "strategy": strategy, "name": name, "placement": None}
             self.placement_groups[pg_id] = pg
+            self._persist()
             asyncio.ensure_future(self._retry_pg(pg))
             return {"state": "PENDING"}
         self.placement_groups[pg_id] = {
             "pg_id": pg_id, "state": "CREATED", "bundles": bundles,
             "strategy": strategy, "name": name, "placement": placement,
         }
+        self._persist()
         await self._publish(f"pg:{pg_id}", self.placement_groups[pg_id])
         return {"state": "CREATED", "placement": placement}
 
@@ -445,6 +578,7 @@ class Controller:
         pg = self.placement_groups.pop(pg_id, None)
         if pg is None:
             return False
+        self._persist()
         if pg.get("placement"):
             for idx, node_id in enumerate(pg["placement"]):
                 node = self.nodes.get(node_id)
@@ -493,12 +627,14 @@ class Controller:
     async def register_job(self, job_id: str, info: Dict[str, Any]):
         self.jobs[job_id] = dict(info, job_id=job_id, state="RUNNING",
                                  start_time=time.time())
+        self._persist()
         return True
 
     async def mark_job_finished(self, job_id: str):
         if job_id in self.jobs:
             self.jobs[job_id]["state"] = "FINISHED"
             self.jobs[job_id]["end_time"] = time.time()
+            self._persist()
         return True
 
     async def list_jobs(self):
@@ -553,10 +689,14 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--session-name", required=True)
     parser.add_argument("--address", required=True)
+    parser.add_argument("--persist-dir", default=None,
+                        help="journal durable tables here; restarting "
+                             "over the same dir replays them (GCS FT)")
     args = parser.parse_args()
 
     async def run():
-        controller = Controller(args.session_name, args.address)
+        controller = Controller(args.session_name, args.address,
+                                persist_dir=args.persist_dir)
         await controller.start()
         await asyncio.Event().wait()
 

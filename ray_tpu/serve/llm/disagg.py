@@ -56,11 +56,18 @@ class PrefillServer(EngineDriverMixin):
         self._waiters[request_id] = queue
         self.engine.add_request(request_id, prompt_ids, sampling)
         first: List[int] = []
+        reason = None
         try:
             async for delta in self._await_request(request_id, queue):
                 first.extend(delta.new_token_ids)
+                reason = delta.finish_reason
         finally:
             self._waiters.pop(request_id, None)
+        if reason != "prefill_done":
+            # the first token already terminated the request (EOS/stop/
+            # length) — nothing to hand off
+            return {"done": True, "output_ids": first,
+                    "finish_reason": reason}
         handoff = self.engine.pop_extracted(request_id)
         handoff["done"] = False
         return handoff
@@ -121,7 +128,11 @@ class PDRouter:
         handoff = await self.prefill.options(
             method_name="prefill").remote(prompt_ids, sampling)
         ttft = time.time() - t0
-        if max_tokens <= len(handoff["output_ids"]):
+        if handoff["done"]:
+            # the first token terminated the request (EOS/stop/length)
+            out_ids = handoff["output_ids"]
+            finish_reason = handoff["finish_reason"]
+        elif max_tokens <= len(handoff["output_ids"]):
             # prefill's first token already satisfied the budget
             out_ids = handoff["output_ids"]
             finish_reason = "length"

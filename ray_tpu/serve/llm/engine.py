@@ -356,27 +356,33 @@ class LLMEngine:
         probs /= probs.sum()
         return int(rng.choice(len(probs), p=probs))
 
+    def _stop_reason(self, req: Request, token: int) -> Optional[str]:
+        eos = self.config.eos_token_id
+        if eos is not None and token == eos:
+            return "stop"
+        if token in req.sampling.stop_token_ids:
+            return "stop"
+        if len(req.output_ids) >= req.sampling.max_tokens:
+            return "length"
+        if req.total_len >= self.config.max_model_len:
+            return "length"
+        return None
+
     def _append_token(self, req: Request, token: int,
                       deltas: List[OutputDelta]) -> None:
         req.output_ids.append(token)
-        if req.sampling.prefill_only:
+        stop = self._stop_reason(req, token)
+        if req.sampling.prefill_only and stop is None:
             # gather-then-release inside the driver thread: the blob is
-            # complete before the finished delta is observable
+            # complete before the finished delta is observable. When the
+            # first token already terminates (EOS/stop/length), fall
+            # through to the normal finish instead — there is nothing
+            # worth handing to a decode engine.
             self.extracted[req.request_id] = self._gather_kv(req)
             self._finish(req, "prefill_done")
             deltas.append(OutputDelta(req.request_id, [token], True,
                                       "prefill_done"))
             return
-        stop = None
-        eos = self.config.eos_token_id
-        if eos is not None and token == eos:
-            stop = "stop"
-        elif token in req.sampling.stop_token_ids:
-            stop = "stop"
-        elif len(req.output_ids) >= req.sampling.max_tokens:
-            stop = "length"
-        elif req.total_len >= self.config.max_model_len:
-            stop = "length"
         if stop:
             self._finish(req, stop)
             deltas.append(OutputDelta(req.request_id, [token], True, stop))

@@ -29,11 +29,13 @@ from .session import (  # noqa: F401
 )
 from .trainer import JaxTrainer, get_dataset_shard  # noqa: F401
 from .torch import TorchTrainer  # noqa: F401
+from .gbdt import LightGBMTrainer, XGBoostTrainer  # noqa: F401
 
 __all__ = [
     "Checkpoint", "CheckpointConfig", "CheckpointManager", "FailureConfig",
     "Result", "RunConfig", "ScalingConfig", "TrainContext", "TrainController",
-    "JaxTrainer", "TorchTrainer", "ScalingPolicy", "FixedScalingPolicy",
+    "JaxTrainer", "TorchTrainer", "XGBoostTrainer", "LightGBMTrainer",
+    "ScalingPolicy", "FixedScalingPolicy",
     "ElasticScalingPolicy", "FailurePolicy", "report", "get_context",
     "get_checkpoint", "get_dataset_shard",
 ]

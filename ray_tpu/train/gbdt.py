@@ -1,0 +1,113 @@
+"""Gradient-boosted-tree trainers (XGBoost / LightGBM).
+
+Parity with the reference's GBDT trainers (ref: python/ray/train/xgboost/
+xgboost_trainer.py, train/lightgbm/lightgbm_trainer.py — data-parallel
+boosting where each worker trains on its dataset shard with the library's
+collective-backed distributed mode). The libraries are not in the hermetic
+TPU image, so construction is gated: with the library installed the
+trainer runs the reference-shaped loop; without it, a clear ImportError.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from .config import Result, RunConfig, ScalingConfig
+from .trainer import JaxTrainer
+
+
+def _make_gbdt_trainer(lib_name: str, train_fn_builder: Callable):
+    class _GBDTTrainer(JaxTrainer):
+        def __init__(self, *, params: Dict[str, Any],
+                     datasets: Optional[Dict[str, Any]] = None,
+                     label_column: str = "label",
+                     num_boost_round: int = 10,
+                     scaling_config: Optional[ScalingConfig] = None,
+                     run_config: Optional[RunConfig] = None):
+            try:
+                __import__(lib_name)
+            except ImportError as e:
+                raise ImportError(
+                    f"{lib_name} is not installed in this environment; "
+                    f"install it to use {type(self).__name__}") from e
+            train_loop = train_fn_builder(params, label_column,
+                                          num_boost_round)
+            super().__init__(
+                train_loop,
+                train_loop_config={},
+                scaling_config=scaling_config or ScalingConfig(
+                    num_workers=1),
+                run_config=run_config,
+                datasets=datasets)
+
+    return _GBDTTrainer
+
+
+def _xgboost_loop(params, label_column, num_boost_round):
+    def train_loop(config):
+        import xgboost as xgb
+
+        from . import session
+        from .trainer import get_dataset_shard
+
+        shard = get_dataset_shard("train")
+        rows = list(shard.iter_rows())
+        import numpy as np
+
+        y = np.asarray([r[label_column] for r in rows])
+        X = np.asarray([[v for k, v in sorted(r.items())
+                         if k != label_column] for r in rows])
+        dtrain = xgb.DMatrix(X, label=y)
+        evals_result: Dict[str, Any] = {}
+        booster = xgb.train(params, dtrain,
+                            num_boost_round=num_boost_round,
+                            evals=[(dtrain, "train")],
+                            evals_result=evals_result, verbose_eval=False)
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            path = f"{d}/model.json"
+            booster.save_model(path)
+            from .checkpoint import Checkpoint
+
+            last = {k: v[-1] for k, v in
+                    evals_result.get("train", {}).items()}
+            session.report(last, checkpoint=Checkpoint(path))
+
+    return train_loop
+
+
+def _lightgbm_loop(params, label_column, num_boost_round):
+    def train_loop(config):
+        import lightgbm as lgb
+        import numpy as np
+
+        from . import session
+        from .trainer import get_dataset_shard
+
+        shard = get_dataset_shard("train")
+        rows = list(shard.iter_rows())
+        y = np.asarray([r[label_column] for r in rows])
+        X = np.asarray([[v for k, v in sorted(r.items())
+                         if k != label_column] for r in rows])
+        booster = lgb.train(params, lgb.Dataset(X, label=y),
+                            num_boost_round=num_boost_round)
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            path = f"{d}/model.txt"
+            booster.save_model(path)
+            from .checkpoint import Checkpoint
+
+            session.report({"num_trees": booster.num_trees()},
+                           checkpoint=Checkpoint(path))
+
+    return train_loop
+
+
+XGBoostTrainer = _make_gbdt_trainer("xgboost", _xgboost_loop)
+XGBoostTrainer.__name__ = "XGBoostTrainer"
+XGBoostTrainer.__qualname__ = "XGBoostTrainer"
+LightGBMTrainer = _make_gbdt_trainer("lightgbm", _lightgbm_loop)
+LightGBMTrainer.__name__ = "LightGBMTrainer"
+LightGBMTrainer.__qualname__ = "LightGBMTrainer"

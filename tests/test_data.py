@@ -215,3 +215,75 @@ def test_join_inner_and_left(shared_cluster):
     right2 = rdata.from_items([{"id": i, "value": -i} for i in range(6)])
     joined = left.join(right2, on="id").take_all()
     assert all(r["value_right"] == -r["id"] for r in joined)
+
+
+def test_read_binary_files_and_images(shared_cluster, tmp_path):
+    """ref: read_api.py read_binary_files / read_images."""
+    from PIL import Image
+
+    from ray_tpu import data as rdata
+
+    (tmp_path / "a.bin").write_bytes(b"\x00\x01payload")
+    (tmp_path / "b.bin").write_bytes(b"other")
+    rows = rdata.read_binary_files(
+        [str(tmp_path / "a.bin"), str(tmp_path / "b.bin")],
+        include_paths=True).take_all()
+    by_path = {r["path"]: r["bytes"] for r in rows}
+    assert by_path[str(tmp_path / "a.bin")] == b"\x00\x01payload"
+
+    img = Image.fromarray(
+        (np.arange(12 * 10 * 3) % 255).astype(np.uint8).reshape(12, 10, 3))
+    img.save(tmp_path / "img.png")
+    out = rdata.read_images([str(tmp_path / "img.png")],
+                            size=(6, 5), mode="RGB").take_all()
+    assert out[0]["image"].shape == (6, 5, 3)
+    assert out[0]["image"].dtype == np.uint8
+
+
+def test_from_torch_and_huggingface(shared_cluster):
+    import torch.utils.data
+
+    from ray_tpu import data as rdata
+
+    class Squares(torch.utils.data.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return {"x": i, "y": i * i}
+
+    ds = rdata.from_torch(Squares())
+    rows = ds.take_all()
+    assert len(rows) == 8 and rows[3]["y"] == 9
+
+    import datasets as hf
+
+    hfd = hf.Dataset.from_dict({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    out = rdata.from_huggingface(hfd).take_all()
+    assert len(out) == 3 and out[2]["b"] == "z"
+
+
+def test_from_huggingface_respects_indices(shared_cluster):
+    """shuffle()/select() carry an _indices mapping over the raw arrow
+    table; adoption must materialize it, not return unshuffled rows."""
+    import datasets as hf
+
+    from ray_tpu import data as rdata
+
+    base = hf.Dataset.from_dict({"a": list(range(10))})
+    picked = base.select([7, 3, 1])
+    rows = rdata.from_huggingface(picked).take_all()
+    assert [r["a"] for r in rows] == [7, 3, 1]
+
+
+def test_from_torch_iterable_dataset(shared_cluster):
+    import torch.utils.data
+
+    from ray_tpu import data as rdata
+
+    class Stream(torch.utils.data.IterableDataset):
+        def __iter__(self):
+            return iter({"v": i} for i in range(5))
+
+    rows = rdata.from_torch(Stream()).take_all()
+    assert [r["v"] for r in rows] == [0, 1, 2, 3, 4]

@@ -316,3 +316,17 @@ def test_pd_concurrent_requests_one_replica(shared_cluster):
 
     outs = asyncio.run(main())
     assert all(len(ids) == 6 for ids in outs), [len(o) for o in outs]
+
+
+def test_pd_prefill_respects_stop_on_first_token():
+    """A request whose first token terminates (max_tokens=1 / EOS) must
+    finish at the prefill tier with the real reason — never hand off."""
+    cfg = EngineConfig(**ENGINE_CFG, seed=0)
+    engine = LLMEngine(cfg)
+    sampling = SamplingParams(max_tokens=1, prefill_only=True)
+    engine.add_request("r", [1, 2, 3, 4, 5], sampling)
+    out = _collect(engine, ["r"])
+    assert out["r"]["fin"] == "length"  # not prefill_done
+    assert "r" not in engine.extracted
+    # pages released (nothing leaked for a finished request)
+    assert engine.allocator.num_free() == cfg.num_pages - 1

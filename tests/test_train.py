@@ -277,3 +277,75 @@ def test_torch_trainer_ddp_gloo(fresh_cluster, tmp_path):
         run_config=rt_train.RunConfig(storage_path=str(tmp_path)),
     ).fit()
     assert result.metrics["loss"] < 0.1, result.metrics
+
+
+def test_transformers_integration_reports(fresh_cluster, tmp_path):
+    """HF Trainer logs flow through RayTrainReportCallback into train
+    reports (ref: train/huggingface/transformers/_transformers_utils.py
+    RayTrainReportCallback + prepare_trainer)."""
+    from ray_tpu.train import TorchTrainer, ScalingConfig, RunConfig
+
+    def train_loop(config):
+        import numpy as np
+        import torch
+        import transformers
+
+        from ray_tpu.train.huggingface import prepare_trainer
+
+        cfg = transformers.DistilBertConfig(
+            vocab_size=64, dim=32, hidden_dim=64, n_layers=1, n_heads=2,
+            max_position_embeddings=32, num_labels=2)
+        model = transformers.DistilBertForSequenceClassification(cfg)
+        rng = np.random.default_rng(0)
+
+        class DS(torch.utils.data.Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return {
+                    "input_ids": torch.tensor(
+                        rng.integers(0, 64, 16), dtype=torch.long),
+                    "attention_mask": torch.ones(16, dtype=torch.long),
+                    "labels": torch.tensor(i % 2, dtype=torch.long),
+                }
+
+        args = transformers.TrainingArguments(
+            output_dir=config["out"], max_steps=2, logging_steps=1,
+            per_device_train_batch_size=4, report_to=[], use_cpu=True,
+            save_strategy="no", disable_tqdm=True)
+        hf_trainer = transformers.Trainer(
+            model=model, args=args, train_dataset=DS())
+        hf_trainer = prepare_trainer(hf_trainer)
+        hf_trainer.train()
+
+    trainer = TorchTrainer(
+        train_loop, train_loop_config={"out": str(tmp_path / "hf")},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert "step" in result.metrics and result.metrics["step"] == 2
+
+
+def test_gbdt_trainers_gated_without_libs():
+    from ray_tpu.train import LightGBMTrainer, XGBoostTrainer
+
+    try:
+        import xgboost  # noqa: F401
+
+        has_xgb = True
+    except ImportError:
+        has_xgb = False
+    if not has_xgb:
+        with pytest.raises(ImportError, match="xgboost"):
+            XGBoostTrainer(params={})
+    try:
+        import lightgbm  # noqa: F401
+
+        has_lgb = True
+    except ImportError:
+        has_lgb = False
+    if not has_lgb:
+        with pytest.raises(ImportError, match="lightgbm"):
+            LightGBMTrainer(params={})
