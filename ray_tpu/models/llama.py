@@ -356,6 +356,34 @@ class ScannedLayer(nn.Module):
         return (x, positions, segment_ids), new_cache
 
 
+class LayerStack(nn.Module):
+    """A sub-stack of decoder layers — one pipeline stage's worth.
+
+    Param tree matches a [layers_per_stage]-length slice of the full
+    model's scanned "layers" collection, so stage params are literally
+    slices of LlamaModel params (see ops/pipeline.py stack_to_stages).
+    """
+
+    config: LlamaConfig
+    layers_per_stage: int
+
+    @nn.compact
+    def __call__(self, x, positions):
+        layer_cls = ScannedLayer
+        if self.config.remat:
+            layer_cls = nn.remat(
+                ScannedLayer, prevent_cse=False,
+                policy=_remat_policy(self.config.remat_policy))
+        (x, _, _), _ = nn.scan(
+            layer_cls,
+            variable_axes={"params": 0, "losses": 0},
+            split_rngs={"params": True},
+            length=self.layers_per_stage,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )(self.config, name="layers")((x, positions, None), None)
+        return x
+
+
 class LlamaModel(nn.Module):
     config: LlamaConfig
     # train_lib feature-detects the fused chunked-CE `targets=` path

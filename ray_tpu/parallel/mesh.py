@@ -7,6 +7,8 @@ every parallelism strategy as an axis of one jax.sharding.Mesh and let XLA
 insert ICI/DCN collectives (ref inventory of strategies: SURVEY.md §2.4).
 
 Axis conventions (order matters — outer axes ride DCN, inner ride ICI):
+  pp    pipeline parallel (stages across pod slices; activations flow
+        stage-to-stage via ppermute — see ops/pipeline.py)
   dp    data parallel (pure replication of params)
   fsdp  data parallel with parameter sharding (ZeRO-3 style)
   sp    sequence/context parallel (ring attention axis)
@@ -26,13 +28,14 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("dp", "fsdp", "sp", "ep", "tp")
+AXES = ("pp", "dp", "fsdp", "sp", "ep", "tp")
 
 
 @dataclass(frozen=True)
 class MeshConfig:
     """Degrees for each parallelism axis. -1 on one axis = fill remaining."""
 
+    pp: int = 1
     dp: int = 1
     fsdp: int = -1
     sp: int = 1
@@ -40,8 +43,8 @@ class MeshConfig:
     tp: int = 1
 
     def resolved(self, n_devices: int) -> Dict[str, int]:
-        sizes = {"dp": self.dp, "fsdp": self.fsdp, "sp": self.sp,
-                 "ep": self.ep, "tp": self.tp}
+        sizes = {"pp": self.pp, "dp": self.dp, "fsdp": self.fsdp,
+                 "sp": self.sp, "ep": self.ep, "tp": self.tp}
         fill_axes = [a for a, s in sizes.items() if s == -1]
         known = math.prod(s for s in sizes.values() if s != -1)
         if n_devices % known != 0:
@@ -73,7 +76,7 @@ def create_mesh(config: Optional[MeshConfig] = None,
 
 
 def single_device_mesh() -> Mesh:
-    return Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1, 1, 1, 1), AXES)
+    return Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1, 1, 1, 1, 1), AXES)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,156 @@
+"""Pipeline-parallel training for the Llama family.
+
+Greenfield TPU-native PP (the reference delegates PP to vLLM/torch, ref:
+SURVEY.md §2.4): decoder layers split into pp stages, each stage's stacked
+params sharded over the mesh's pp axis, microbatches streamed through the
+GPipe ppermute schedule (ops/pipeline.py). Embedding, final norm, LM head
+and the loss run replicated across pp (they are a few percent of FLOPs);
+dp still shards the batch via GSPMD around the manual pp axis.
+
+v1 scope: dense Llama configs with scan_layers (MoE's sown aux losses
+don't traverse the pipeline wrapper yet); stage-internal tp/fsdp
+sharding is left to a later pass — pp composes with dp today.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.llama import LayerStack, LlamaModel, RMSNorm
+from ..ops.pipeline import pipeline_apply, stack_to_stages
+from .mesh import active_mesh
+from .train_lib import TrainState, default_optimizer
+
+
+class PipelinedTrainer:
+    """Holds model + pp mesh + jitted GPipe train step.
+
+    Usage mirrors ShardedTrainer:
+        trainer = PipelinedTrainer(model, mesh, num_microbatches=4)
+        state = trainer.init(rng, batch)
+        state, metrics = trainer.step(state, batch)
+    """
+
+    def __init__(self, model: LlamaModel, mesh: Mesh,
+                 num_microbatches: int = 4,
+                 optimizer: Optional[optax.GradientTransformation] = None):
+        cfg = model.config
+        self.model = model
+        self.mesh = mesh
+        self.num_microbatches = num_microbatches
+        self.tx = optimizer or default_optimizer()
+        self.num_stages = mesh.shape["pp"]
+        if not cfg.scan_layers:
+            raise ValueError("PipelinedTrainer needs scan_layers=True")
+        if cfg.num_layers % self.num_stages:
+            raise ValueError(
+                f"{cfg.num_layers} layers not divisible into "
+                f"{self.num_stages} stages")
+        if cfg.num_experts:
+            raise ValueError("PipelinedTrainer v1 is dense-only (MoE "
+                             "aux losses don't cross the pipeline yet)")
+        self.layers_per_stage = cfg.num_layers // self.num_stages
+        self.stack = LayerStack(cfg, self.layers_per_stage)
+        self._jit_step = None
+
+    # ------------------------------------------------------------- init
+
+    def init(self, rng, example_batch) -> TrainState:
+        ids = example_batch["input_ids"]
+        S = self.num_stages
+
+        def _init(rng):
+            params = nn.meta.unbox(self.model.init(
+                rng, jnp.zeros_like(ids))["params"])
+            params["layers"] = stack_to_stages(params["layers"], S)
+            return TrainState(step=jnp.zeros((), jnp.int32),
+                              params=params,
+                              opt_state=self.tx.init(params))
+
+        shardings = self._state_shardings(_init)
+        with active_mesh(self.mesh):
+            return jax.jit(_init, out_shardings=shardings)(rng)
+
+    def _state_shardings(self, init_fn):
+        abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        pp = NamedSharding(self.mesh, P("pp"))
+        rep = NamedSharding(self.mesh, P())
+
+        def assign(path, leaf):
+            names = [getattr(k, "key", getattr(k, "name", ""))
+                     for k in path]
+            return pp if "layers" in names else rep
+
+        return jax.tree_util.tree_map_with_path(assign, abstract)
+
+    # ------------------------------------------------------------- step
+
+    def _loss(self, params, batch):
+        cfg = self.model.config
+        ids = batch["input_ids"]
+        b, s = ids.shape
+        # Pipeline activations cross stage boundaries in f32: every
+        # collective in the manual pp section (ppermute shifts, the psum
+        # broadcast) then runs in f32 — XLA's bf16 all-reduce promotion
+        # pass crashes on the CPU backend inside manual sections, and f32
+        # boundary precision is numerically conservative anyway. Compute
+        # INSIDE a stage still runs in cfg.dtype (bf16 on the MXU).
+        x = params["embed"][ids].astype(jnp.float32)
+
+        def stage_fn(stage_layers, xb):
+            positions = jnp.broadcast_to(jnp.arange(xb.shape[1]),
+                                         xb.shape[:2])
+            out = self.stack.apply({"params": {"layers": stage_layers}},
+                                   xb.astype(cfg.dtype), positions)
+            return out.astype(jnp.float32)
+
+        x = pipeline_apply(stage_fn, params["layers"], x,
+                           mesh=self.mesh,
+                           num_microbatches=self.num_microbatches)
+        x = x.astype(cfg.dtype)
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                    name="fn").apply({"params": params["final_norm"]}, x)
+        logits = nn.DenseGeneral(
+            features=cfg.vocab_size, use_bias=False, axis=-1,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="h").apply({"params": params["lm_head"]}, x)
+        targets = jnp.concatenate([ids[:, 1:], ids[:, :1]], axis=1)
+        logits = logits[:, :-1].astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, targets[:, :-1][..., None], axis=-1)[..., 0]
+        return nll.mean()
+
+    def _build_step(self):
+        def _step(state: TrainState, batch):
+            loss, grads = jax.value_and_grad(self._loss)(state.params,
+                                                         batch)
+            updates, new_opt = self.tx.update(grads, state.opt_state,
+                                              state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            return (TrainState(step=state.step + 1, params=new_params,
+                               opt_state=new_opt),
+                    {"loss": loss,
+                     "grad_norm": optax.global_norm(grads)})
+
+        self._jit_step = jax.jit(_step, donate_argnums=(0,))
+        return self._jit_step
+
+    def step(self, state: TrainState, batch
+             ) -> Tuple[TrainState, Dict[str, Any]]:
+        if not isinstance(batch, dict):
+            batch = {"input_ids": batch}
+        if self._jit_step is None:
+            self._build_step()
+        with active_mesh(self.mesh):
+            return self._jit_step(state, batch)
+
+    def eval_loss(self, state: TrainState, batch) -> jax.Array:
+        with active_mesh(self.mesh):
+            return jax.jit(self._loss)(state.params, batch)

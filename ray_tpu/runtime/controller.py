@@ -205,6 +205,10 @@ class Controller:
                         op, ns, key, value = pickle.load(f)
                     except EOFError:
                         break
+                    except pickle.UnpicklingError:
+                        # torn tail: the previous controller died
+                        # mid-append; everything before it is intact
+                        break
                     if op == "put":
                         self.kv[ns][key] = value
                     else:

@@ -129,13 +129,10 @@ class PDRouter:
             method_name="prefill").remote(prompt_ids, sampling)
         ttft = time.time() - t0
         if handoff["done"]:
-            # the first token terminated the request (EOS/stop/length)
+            # the first token terminated the request (EOS/stop/length —
+            # the engine's _stop_reason runs before the handoff)
             out_ids = handoff["output_ids"]
             finish_reason = handoff["finish_reason"]
-        elif max_tokens <= len(handoff["output_ids"]):
-            # prefill's first token already satisfied the budget
-            out_ids = handoff["output_ids"]
-            finish_reason = "length"
         else:
             result = await self.decode.options(
                 method_name="decode").remote(handoff, sampling)

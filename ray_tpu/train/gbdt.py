@@ -1,11 +1,13 @@
 """Gradient-boosted-tree trainers (XGBoost / LightGBM).
 
-Parity with the reference's GBDT trainers (ref: python/ray/train/xgboost/
-xgboost_trainer.py, train/lightgbm/lightgbm_trainer.py — data-parallel
-boosting where each worker trains on its dataset shard with the library's
-collective-backed distributed mode). The libraries are not in the hermetic
-TPU image, so construction is gated: with the library installed the
-trainer runs the reference-shaped loop; without it, a clear ImportError.
+Shaped after the reference's GBDT trainers (ref: python/ray/train/xgboost/
+xgboost_trainer.py, train/lightgbm/lightgbm_trainer.py). Scope: single-
+worker boosting over a ray_tpu.data dataset (num_workers > 1 is rejected
+— the libraries' collective-backed distributed modes are not wired up, and
+training N independent models on shards would be silently wrong). The
+libraries are not in the hermetic TPU image, so construction is gated:
+with the library installed the trainer runs; without it, a clear
+ImportError.
 """
 
 from __future__ import annotations
@@ -30,6 +32,12 @@ def _make_gbdt_trainer(lib_name: str, train_fn_builder: Callable):
                 raise ImportError(
                     f"{lib_name} is not installed in this environment; "
                     f"install it to use {type(self).__name__}") from e
+            if scaling_config is not None and \
+                    getattr(scaling_config, "num_workers", 1) > 1:
+                raise ValueError(
+                    f"{type(self).__name__} supports num_workers=1 only "
+                    "(distributed GBDT collectives are not wired up; "
+                    "N independent shard-models would be silently wrong)")
             train_loop = train_fn_builder(params, label_column,
                                           num_boost_round)
             super().__init__(
@@ -66,13 +74,14 @@ def _xgboost_loop(params, label_column, num_boost_round):
         import tempfile
 
         with tempfile.TemporaryDirectory() as d:
-            path = f"{d}/model.json"
-            booster.save_model(path)
+            booster.save_model(f"{d}/model.json")
             from .checkpoint import Checkpoint
 
             last = {k: v[-1] for k, v in
                     evals_result.get("train", {}).items()}
-            session.report(last, checkpoint=Checkpoint(path))
+            # report stages a DIRECTORY; it is copied before the
+            # tempdir is torn down
+            session.report(last, checkpoint=Checkpoint(d))
 
     return train_loop
 
@@ -95,12 +104,11 @@ def _lightgbm_loop(params, label_column, num_boost_round):
         import tempfile
 
         with tempfile.TemporaryDirectory() as d:
-            path = f"{d}/model.txt"
-            booster.save_model(path)
+            booster.save_model(f"{d}/model.txt")
             from .checkpoint import Checkpoint
 
             session.report({"num_trees": booster.num_trees()},
-                           checkpoint=Checkpoint(path))
+                           checkpoint=Checkpoint(d))
 
     return train_loop
 

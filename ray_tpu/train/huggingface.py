@@ -4,60 +4,48 @@ Parity with the reference (ref: python/ray/train/huggingface/transformers/
 _transformers_utils.py — RayTrainReportCallback bridges HF Trainer logs/
 checkpoints into ray train's report(); prepare_trainer wires it in). The
 HF Trainer runs inside a TorchTrainer worker loop; this module only
-bridges its callback stream into the session.
+bridges its callback stream into the session. Importing this module
+requires transformers (it is an opt-in integration).
 """
 
 from __future__ import annotations
 
 import os
-import tempfile
 from typing import Optional
+
+import transformers
 
 from . import session
 from .checkpoint import Checkpoint
 
 
-class RayTrainReportCallback:
-    """transformers.TrainerCallback that reports HF logs (and the latest
-    checkpoint, when one was just saved) to ray_tpu.train (ref:
-    _transformers_utils.py RayTrainReportCallback)."""
+class RayTrainReportCallback(transformers.TrainerCallback):
+    """Reports HF logs (and the latest checkpoint, when one was just
+    saved) to ray_tpu.train (ref: _transformers_utils.py
+    RayTrainReportCallback). Usable directly:
+    ``hf_trainer.add_callback(RayTrainReportCallback())``."""
 
     def __init__(self):
-        import transformers
-
-        # subclassing at runtime keeps transformers an optional import
-        # for everyone who never touches this module
-        outer = self
-
-        class _Bridge(transformers.TrainerCallback):
-            def on_save(self, args, state, control, **kwargs):
-                outer._latest_checkpoint = os.path.join(
-                    args.output_dir,
-                    f"checkpoint-{state.global_step}")
-
-            def on_log(self, args, state, control, logs=None, **kwargs):
-                if not state.is_world_process_zero:
-                    return
-                metrics = dict(logs or {})
-                metrics["step"] = state.global_step
-                metrics["epoch"] = state.epoch
-                ckpt_dir, outer._latest_checkpoint = (
-                    outer._latest_checkpoint, None)
-                session.report(
-                    metrics,
-                    checkpoint=Checkpoint(ckpt_dir) if ckpt_dir else None)
-
         self._latest_checkpoint: Optional[str] = None
-        self._bridge = _Bridge()
 
-    @property
-    def callback(self):
-        return self._bridge
+    def on_save(self, args, state, control, **kwargs):
+        self._latest_checkpoint = os.path.join(
+            args.output_dir, f"checkpoint-{state.global_step}")
+
+    def on_log(self, args, state, control, logs=None, **kwargs):
+        if not state.is_world_process_zero:
+            return
+        metrics = dict(logs or {})
+        metrics["step"] = state.global_step
+        metrics["epoch"] = state.epoch
+        ckpt_dir, self._latest_checkpoint = self._latest_checkpoint, None
+        session.report(
+            metrics,
+            checkpoint=Checkpoint(ckpt_dir) if ckpt_dir else None)
 
 
 def prepare_trainer(trainer):
     """Attach the report bridge to an HF Trainer (ref:
     _transformers_utils.py prepare_trainer). Returns the trainer."""
-    bridge = RayTrainReportCallback()
-    trainer.add_callback(bridge.callback)
+    trainer.add_callback(RayTrainReportCallback())
     return trainer

@@ -13,7 +13,7 @@ from ray_tpu.parallel.train_lib import ShardedTrainer, default_optimizer
 
 def test_mesh_config_resolution():
     assert MeshConfig(dp=2, fsdp=2, sp=1, tp=2).resolved(8) == {
-        "dp": 2, "fsdp": 2, "sp": 1, "ep": 1, "tp": 2}
+        "pp": 1, "dp": 2, "fsdp": 2, "sp": 1, "ep": 1, "tp": 2}
     assert MeshConfig(dp=1, fsdp=-1, sp=1, tp=2).resolved(8)["fsdp"] == 4
     with pytest.raises(ValueError):
         MeshConfig(dp=3, fsdp=1, sp=1, tp=1).resolved(8)
