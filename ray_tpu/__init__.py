@@ -27,11 +27,11 @@ from .api import (  # noqa: F401
 )
 from .actor import ActorClass, ActorHandle  # noqa: F401
 from .remote_function import RemoteFunction  # noqa: F401
-from .runtime.core import ObjectRef  # noqa: F401
+from .runtime.core import ObjectRef, ObjectRefGenerator  # noqa: F401
 
 __all__ = [
     "__version__", "init", "shutdown", "is_initialized", "remote", "get",
-    "put", "wait", "kill", "cancel", "free", "get_actor", "ObjectRef",
+    "put", "wait", "kill", "cancel", "free", "get_actor", "ObjectRef", "ObjectRefGenerator",
     "ActorClass", "ActorHandle", "RemoteFunction", "cluster_resources",
     "available_resources", "nodes", "timeline", "exceptions",
 ]

@@ -58,6 +58,7 @@ class PipelinedTrainer:
         self.layers_per_stage = cfg.num_layers // self.num_stages
         self.stack = LayerStack(cfg, self.layers_per_stage)
         self._jit_step = None
+        self._jit_eval = None
 
     # ------------------------------------------------------------- init
 
@@ -152,5 +153,7 @@ class PipelinedTrainer:
             return self._jit_step(state, batch)
 
     def eval_loss(self, state: TrainState, batch) -> jax.Array:
+        if self._jit_eval is None:
+            self._jit_eval = jax.jit(self._loss)
         with active_mesh(self.mesh):
-            return jax.jit(self._loss)(state.params, batch)
+            return self._jit_eval(state.params, batch)

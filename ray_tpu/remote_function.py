@@ -64,7 +64,15 @@ class RemoteFunction:
             "runtime_env": opts.get("runtime_env"),
         }
         spec_opts.update(resolve_strategy(opts.get("scheduling_strategy")))
+        if spec_opts["num_returns"] == "dynamic":
+            raise ValueError(
+                "num_returns='dynamic' (the reference's legacy API, where "
+                "get(ref) returns the generator) is not supported; use "
+                "num_returns='streaming', whose .remote() returns the "
+                "ObjectRefGenerator directly")
         refs = core.submit_task(self._export(), args, kwargs, spec_opts)
+        if spec_opts["num_returns"] == "streaming":
+            return refs  # an ObjectRefGenerator
         if spec_opts["num_returns"] == 1:
             return refs[0]
         return refs

@@ -12,6 +12,8 @@ Tune-compatible trainables.
 from .algorithms.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
 from .algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig  # noqa: F401
 from .algorithms.dqn import DQN, DQNConfig  # noqa: F401
+from .algorithms.multi_agent_ppo import (MultiAgentPPO,  # noqa: F401
+                                         MultiAgentPPOConfig)
 from .algorithms.impala import (APPO, IMPALA, APPOConfig,  # noqa: F401
                                 IMPALAConfig)
 from .algorithms.ppo import PPO, PPOConfig  # noqa: F401
@@ -20,11 +22,15 @@ from .core.learner import Learner  # noqa: F401
 from .core.rl_module import (DiscreteMLPModule, GaussianMLPModule,  # noqa: F401
                              RLModuleSpec, SACModule)
 from .env.env_runner import SingleAgentEnvRunner  # noqa: F401
+from .env.multi_agent import (MultiAgentEnv,  # noqa: F401
+                              MultiAgentEnvRunner)
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
     "SAC", "SACConfig", "IMPALA", "IMPALAConfig", "APPO", "APPOConfig",
     "BC", "BCConfig", "MARWIL", "MARWILConfig",
+    "MultiAgentPPO", "MultiAgentPPOConfig", "MultiAgentEnv",
+    "MultiAgentEnvRunner",
     "Learner", "RLModuleSpec", "DiscreteMLPModule", "GaussianMLPModule",
     "SACModule", "SingleAgentEnvRunner",
 ]

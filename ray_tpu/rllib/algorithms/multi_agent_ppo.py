@@ -1,0 +1,117 @@
+"""Multi-agent PPO.
+
+Parity with the reference's multi-agent new-API stack (ref:
+rllib/core/rl_module/multi_rl_module.py MultiRLModule — a dict of
+per-policy modules; rllib/algorithms/ppo/ppo.py with
+config.multi_agent(policies=..., policy_mapping_fn=...)). Each policy owns
+its PPOLearner (jitted optax update); experience routes to learners by
+the policy_mapping_fn, so shared-policy (parameter-tied) and independent
+policies are both just mapping choices.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core.rl_module import RLModuleSpec
+from ..env.multi_agent import MultiAgentEnvRunnerGroup
+from .algorithm import AlgorithmConfig
+from .ppo import PPOConfig, PPOLearner, ppo_update_from_episodes
+
+
+class MultiAgentPPOConfig(PPOConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = MultiAgentPPO
+        self.policies: Dict[str, Optional[RLModuleSpec]] = {}
+        self.policy_mapping_fn: Callable[[str], str] = lambda aid: aid
+
+    def multi_agent(self, *, policies: Dict[str, Optional[RLModuleSpec]],
+                    policy_mapping_fn: Optional[Callable] = None
+                    ) -> "MultiAgentPPOConfig":
+        """ref: algorithm_config.py AlgorithmConfig.multi_agent."""
+        self.policies = dict(policies)
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+
+class MultiAgentPPO:
+    """Per-policy PPO learners over a MultiAgentEnvRunnerGroup (the
+    multi-agent analogue of the Algorithm sample→update→sync loop)."""
+
+    def __init__(self, config: MultiAgentPPOConfig):
+        assert config.policies, "use config.multi_agent(policies=...)"
+        self.config = config
+        self.iteration = 0
+        self._timesteps_total = 0
+        self._episode_returns: Dict[str, List[float]] = {
+            p: [] for p in config.policies}
+        module_specs = {
+            policy_id: spec or config.module_spec
+            for policy_id, spec in config.policies.items()}
+        self.env_runner_group = MultiAgentEnvRunnerGroup(
+            config.env, module_specs, config.policy_mapping_fn,
+            {"jax_platform": config.jax_platform},
+            num_env_runners=config.num_env_runners, seed=config.seed)
+        specs = self.env_runner_group.get_specs()
+        self.learners: Dict[str, PPOLearner] = {}
+        for policy_id, module_spec in module_specs.items():
+            agent = next(a for a in specs
+                         if config.policy_mapping_fn(a) == policy_id)
+            obs_space, act_space = specs[agent]
+            module = module_spec.build(obs_space, act_space)
+            self.learners[policy_id] = PPOLearner(
+                module, config.learner_config(), seed=config.seed)
+
+    def get_weights(self) -> Dict[str, Any]:
+        return {p: learner.get_weights()
+                for p, learner in self.learners.items()}
+
+    def set_weights(self, weights: Dict[str, Any]) -> None:
+        for policy_id, w in weights.items():
+            self.learners[policy_id].set_weights(w)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        episodes_by_policy = self.env_runner_group.sample(
+            cfg.train_batch_size, weights=self.get_weights(),
+            explore=True)
+        metrics: Dict[str, Any] = {}
+        for policy_id, episodes in episodes_by_policy.items():
+            if not episodes:
+                continue
+            for episode in episodes:
+                self._timesteps_total += len(episode)
+                if not episode.cut:
+                    self._episode_returns[policy_id].append(
+                        episode.full_return)
+            learner = self.learners[policy_id]
+            pm = ppo_update_from_episodes(
+                learner.update, episodes, cfg, self.iteration)
+            for key in ("policy_loss", "entropy"):
+                if key in pm:
+                    metrics[f"{policy_id}/{key}"] = pm[key]
+        return metrics
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.time()
+        metrics = self.training_step()
+        self.iteration += 1
+        result = {
+            "training_iteration": self.iteration,
+            "timesteps_total": self._timesteps_total,
+            "time_this_iter_s": time.time() - t0,
+            **metrics,
+        }
+        for policy_id, returns in self._episode_returns.items():
+            recent = returns[-100:]
+            result[f"{policy_id}/episode_return_mean"] = (
+                float(np.mean(recent)) if recent else float("nan"))
+        return result
+
+    def stop(self) -> None:
+        pass

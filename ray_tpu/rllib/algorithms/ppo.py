@@ -72,6 +72,30 @@ class PPOConfig(AlgorithmConfig):
         return cfg
 
 
+def ppo_update_from_episodes(update_fn, episodes, cfg,
+                             iteration: int) -> Dict[str, float]:
+    """Shared PPO update machinery: GAE per fragment, batch-level
+    advantage standardization, epoch x minibatch SGD through update_fn.
+    Used by both the single-agent PPO and MultiAgentPPO (per policy)."""
+    batches = [compute_gae(ep, cfg.gamma, cfg.lam) for ep in episodes]
+    batch = {key: np.concatenate([b[key] for b in batches])
+             for key in batches[0]}
+    adv = batch["advantages"]
+    batch["advantages"] = ((adv - adv.mean())
+                           / np.maximum(adv.std(), 1e-4))
+    n = len(adv)
+    rng = np.random.default_rng(cfg.seed + iteration)
+    metrics: Dict[str, float] = {}
+    mb = min(cfg.minibatch_size, n)
+    for _ in range(cfg.num_epochs):
+        perm = rng.permutation(n)
+        for start in range(0, n - mb + 1, mb):
+            idx = perm[start:start + mb]
+            metrics = update_fn(
+                {key: val[idx] for key, val in batch.items()})
+    return metrics
+
+
 class PPO(Algorithm):
     learner_class = PPOLearner
 
@@ -85,20 +109,5 @@ class PPO(Algorithm):
             # skip the update rather than crash — next iteration resamples.
             return {"num_env_runner_restarts": 1.0}
         self._record_episodes(episodes)
-        batches = [compute_gae(ep, cfg.gamma, cfg.lam) for ep in episodes]
-        batch = {key: np.concatenate([b[key] for b in batches])
-                 for key in batches[0]}
-        adv = batch["advantages"]
-        batch["advantages"] = ((adv - adv.mean())
-                               / np.maximum(adv.std(), 1e-4))
-        n = len(adv)
-        rng = np.random.default_rng(cfg.seed + self.iteration)
-        metrics: Dict[str, float] = {}
-        mb = min(cfg.minibatch_size, n)
-        for _ in range(cfg.num_epochs):
-            perm = rng.permutation(n)
-            for start in range(0, n - mb + 1, mb):
-                idx = perm[start:start + mb]
-                metrics = self.learner_group.update(
-                    {key: val[idx] for key, val in batch.items()})
-        return metrics
+        return ppo_update_from_episodes(
+            self.learner_group.update, episodes, cfg, self.iteration)

@@ -1,0 +1,243 @@
+"""Multi-agent environments and sampling.
+
+Parity with the reference's multi-agent stack (ref:
+rllib/env/multi_agent_env.py MultiAgentEnv — dict-keyed obs/action/reward
+spaces with the "__all__" termination convention;
+rllib/env/multi_agent_env_runner.py MultiAgentEnvRunner — per-agent
+episode accumulation routed to policies via a policy_mapping_fn).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .episodes import Episode
+
+logger = logging.getLogger(__name__)
+
+
+class MultiAgentEnv:
+    """Agent-dict environment interface (ref: multi_agent_env.py).
+
+    reset() -> (obs_dict, info_dict)
+    step(action_dict) -> (obs, rewards, terminateds, truncateds, infos),
+    each keyed by agent id; terminateds/truncateds carry "__all__".
+    Only agents present in the obs dict act next step.
+    """
+
+    possible_agents: List[str] = []
+
+    def reset(self, *, seed: Optional[int] = None
+              ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[str, Any]):
+        raise NotImplementedError
+
+    def observation_space(self, agent_id: str):
+        raise NotImplementedError
+
+    def action_space(self, agent_id: str):
+        raise NotImplementedError
+
+
+class MultiAgentEnvRunner:
+    """Samples a MultiAgentEnv, splitting experience per POLICY (ref:
+    rllib/env/multi_agent_env_runner.py). One env per runner; runs local
+    or behind a ray_tpu actor (the group below)."""
+
+    def __init__(self, env_spec, module_specs: Dict[str, Any],
+                 policy_mapping_fn: Callable[[str], str],
+                 config: Dict[str, Any], seed: int = 0,
+                 worker_index: int = 0):
+        import jax
+
+        from .env_runner import _apply_platform
+
+        _apply_platform(config.get("jax_platform", "cpu"))
+        self.env = env_spec() if callable(env_spec) else env_spec
+        self.policy_mapping_fn = policy_mapping_fn
+        base_seed = seed + worker_index * 10_000
+        self.modules: Dict[str, Any] = {}
+        self.params: Dict[str, Any] = {}
+        self._jit_fwd: Dict[str, Any] = {}
+        for policy_id, spec in module_specs.items():
+            agent = next(a for a in self.env.possible_agents
+                         if policy_mapping_fn(a) == policy_id)
+            module = spec.build(self.env.observation_space(agent),
+                                self.env.action_space(agent))
+            self.modules[policy_id] = module
+            self.params[policy_id] = module.init(
+                jax.random.PRNGKey(base_seed + len(self.params)))
+            self._jit_fwd[policy_id] = jax.jit(module.forward_train)
+        self._rng = jax.random.PRNGKey(base_seed + 101)
+        self._episodes: Dict[str, Episode] = {}
+        self._cur_obs: Dict[str, np.ndarray] = {}
+        self._reset()
+
+    def _reset(self):
+        obs, _ = self.env.reset()
+        self._cur_obs = {a: np.asarray(o, np.float32)
+                         for a, o in obs.items()}
+        self._episodes = {a: Episode() for a in obs}
+
+    def set_weights(self, weights: Dict[str, Any]) -> None:
+        self.params.update(weights)
+
+    def get_specs(self) -> Dict[str, Tuple[Any, Any]]:
+        return {a: (self.env.observation_space(a),
+                    self.env.action_space(a))
+                for a in self.env.possible_agents}
+
+    def sample(self, num_timesteps: int, weights=None,
+               explore: bool = True) -> Dict[str, List[Episode]]:
+        """Collect ~num_timesteps env steps; returns policy_id ->
+        finished/cut episode fragments (GAE bootstraps filled)."""
+        import jax
+
+        if weights is not None:
+            self.params.update(weights)
+        out: Dict[str, List[Episode]] = {p: [] for p in self.modules}
+        steps = 0
+        while steps < num_timesteps:
+            actions: Dict[str, int] = {}
+            cache: Dict[str, Tuple] = {}
+            for agent, obs in self._cur_obs.items():
+                policy_id = self.policy_mapping_fn(agent)
+                fwd = self._jit_fwd[policy_id](
+                    self.params[policy_id], obs[None])
+                logits = np.asarray(fwd["logits"], np.float32)[0]
+                value = float(np.asarray(fwd.get("vf", [0.0]))[0])
+                if explore:
+                    self._rng, sub = jax.random.split(self._rng)
+                    action = int(jax.random.categorical(
+                        sub, fwd["logits"][0]))
+                else:
+                    action = int(logits.argmax())
+                logp_all = logits - _logsumexp(logits)
+                actions[agent] = action
+                cache[agent] = (action, float(logp_all[action]), value)
+            obs, rewards, terms, truncs, _ = self.env.step(actions)
+            all_done = terms.get("__all__", False) or \
+                truncs.get("__all__", False)
+            for agent, (action, logp, value) in cache.items():
+                episode = self._episodes[agent]
+                episode.obs.append(self._cur_obs[agent])
+                episode.actions.append(action)
+                episode.rewards.append(float(rewards.get(agent, 0.0)))
+                episode.logp.append(logp)
+                episode.vf_preds.append(value)
+                steps += 1
+                done = all_done or terms.get(agent, False) or \
+                    truncs.get(agent, False)
+                if done:
+                    episode.terminated = bool(
+                        terms.get(agent, False)
+                        or terms.get("__all__", False))
+                    episode.truncated = not episode.terminated
+                    if episode.truncated and agent in obs:
+                        next_obs = np.asarray(obs[agent], np.float32)
+                        episode.last_obs = next_obs
+                        episode.last_value = self._value_of(agent,
+                                                            next_obs)
+                    out[self.policy_mapping_fn(agent)].append(episode)
+                    self._episodes[agent] = Episode()
+            if all_done:
+                # flush any agents that never got a personal done flag
+                for agent, episode in self._episodes.items():
+                    if len(episode) > 0:
+                        episode.terminated = True
+                        out[self.policy_mapping_fn(agent)].append(episode)
+                self._reset()
+            else:
+                self._cur_obs = {a: np.asarray(o, np.float32)
+                                 for a, o in obs.items()}
+                for agent in obs:
+                    if agent not in self._episodes:
+                        self._episodes[agent] = Episode()
+        # cut in-flight fragments (bootstrapped) into the batch
+        for agent, episode in self._episodes.items():
+            if len(episode) > 0:
+                episode.truncated = True
+                episode.cut = True
+                cur = self._cur_obs.get(agent)
+                if cur is not None:
+                    episode.last_obs = cur
+                    episode.last_value = self._value_of(agent, cur)
+                out[self.policy_mapping_fn(agent)].append(episode)
+                self._episodes[agent] = Episode(
+                    prior_reward=episode.full_return)
+        return out
+
+    def _value_of(self, agent: str, obs: np.ndarray) -> float:
+        policy_id = self.policy_mapping_fn(agent)
+        fwd = self._jit_fwd[policy_id](self.params[policy_id], obs[None])
+        if "vf" in fwd:
+            return float(np.asarray(fwd["vf"])[0])
+        return 0.0
+
+    def ping(self) -> str:
+        return "pong"
+
+
+def _logsumexp(logits: np.ndarray) -> float:
+    m = logits.max()
+    return m + np.log(np.exp(logits - m).sum())
+
+
+class MultiAgentEnvRunnerGroup:
+    """Local runner or N remote runner actors (restart-on-failure),
+    multi-agent counterpart of EnvRunnerGroup."""
+
+    def __init__(self, env_spec, module_specs, policy_mapping_fn,
+                 config: Dict[str, Any], num_env_runners: int = 0,
+                 seed: int = 0):
+        self._args = (env_spec, module_specs, policy_mapping_fn,
+                      dict(config), seed)
+        if num_env_runners == 0:
+            self._local = MultiAgentEnvRunner(
+                env_spec, module_specs, policy_mapping_fn, config, seed)
+            self._remote = None
+        else:
+            self._local = None
+            self._remote = [self._spawn(i)
+                            for i in range(num_env_runners)]
+
+    def _spawn(self, index: int):
+        import ray_tpu
+
+        env_spec, specs, mapping, config, seed = self._args
+        cls = ray_tpu.remote(MultiAgentEnvRunner)
+        return cls.remote(env_spec, specs, mapping, config, seed,
+                          worker_index=index + 1)
+
+    def get_specs(self):
+        if self._local is not None:
+            return self._local.get_specs()
+        import ray_tpu
+
+        return ray_tpu.get(self._remote[0].get_specs.remote())
+
+    def sample(self, num_timesteps: int, weights=None,
+               explore: bool = True) -> Dict[str, List[Episode]]:
+        if self._local is not None:
+            return self._local.sample(num_timesteps, weights=weights,
+                                      explore=explore)
+        import ray_tpu
+
+        share = -(-num_timesteps // len(self._remote))
+        refs = [r.sample.remote(share, weights=weights, explore=explore)
+                for r in self._remote]
+        merged: Dict[str, List[Episode]] = {}
+        for i, ref in enumerate(refs):
+            try:
+                for policy_id, eps in ray_tpu.get(ref, timeout=120).items():
+                    merged.setdefault(policy_id, []).extend(eps)
+            except Exception:
+                logger.exception("multi-agent runner %d failed; "
+                                 "restarting", i)
+                self._remote[i] = self._spawn(i)
+        return merged

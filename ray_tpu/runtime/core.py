@@ -128,7 +128,8 @@ _IN_SHM = object()  # memory-store marker: value lives in the shm store
 
 
 class _PendingTask:
-    __slots__ = ("spec", "return_ids", "retries_left", "arg_refs", "submitted_at")
+    __slots__ = ("spec", "return_ids", "retries_left", "arg_refs",
+                 "submitted_at", "stream_received")
 
     def __init__(self, spec, return_ids, retries_left, arg_refs):
         self.spec = spec
@@ -136,6 +137,39 @@ class _PendingTask:
         self.retries_left = retries_left
         self.arg_refs = arg_refs  # pin args for the task's lifetime
         self.submitted_at = time.time()
+        self.stream_received = 0  # streaming generators: items seen
+
+
+_END_OF_STREAM = object()  # streaming-generator terminator marker
+
+
+class ObjectRefGenerator:
+    """Iterator of ObjectRefs produced by a streaming-generator task
+    (ref: _raylet.pyx:283 ObjectRefGenerator / task_manager.h:67
+    ObjectRefStream). Each __next__ blocks until the producer's next
+    yield lands at the owner, then returns its (already-resolved)
+    ObjectRef; StopIteration when the producer returns; the producer's
+    exception re-raises from the get() on the failing ref."""
+
+    def __init__(self, task_id: "TaskID", core: "CoreWorker"):
+        self._task_id = task_id
+        self._core = core
+        self._index = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        oid = ObjectID.for_task_return(self._task_id, self._index)
+        value = self._core._wait_stream_item(oid)
+        if value is _END_OF_STREAM:
+            raise StopIteration
+        self._index += 1
+        return ObjectRef(oid, owner_addr=self._core.address)
+
+    def __repr__(self):
+        return (f"ObjectRefGenerator(task={self._task_id.hex()}, "
+                f"next={self._index})")
 
 
 class CoreWorker:
@@ -182,6 +216,7 @@ class CoreWorker:
     def start(self, extra_handlers: Optional[dict] = None):
         handlers = {
             "task_result": self._h_task_result,
+            "task_stream_item": self._h_task_stream_item,
             "fetch_object": self._h_fetch_object,
             "ping": lambda: "pong",
         }
@@ -459,8 +494,10 @@ class CoreWorker:
                     opts: Dict[str, Any]) -> List[ObjectRef]:
         task_id = TaskID.from_random()
         num_returns = opts.get("num_returns", 1)
-        return_ids = [ObjectID.for_task_return(task_id, i)
-                      for i in range(num_returns)]
+        streaming = num_returns in ("streaming", "dynamic")
+        return_ids = [] if streaming else [
+            ObjectID.for_task_return(task_id, i)
+            for i in range(num_returns)]
         arg_refs = _collect_refs(args, kwargs)
         spec = {
             "type": "task",
@@ -496,6 +533,8 @@ class CoreWorker:
                                   return_ids, arg_refs)
         self.nodelet.call("submit_task", spec=spec)
         self._record_event(task_id, spec["name"], "SUBMITTED")
+        if streaming:
+            return ObjectRefGenerator(task_id, self)
         return [ObjectRef(oid, owner_addr=self.address) for oid in return_ids]
 
     def _register_pending(self, task_id, spec, return_ids, arg_refs):
@@ -508,9 +547,37 @@ class CoreWorker:
             # mutated only on the io loop (no lock needed)
             self._actor_inflight.setdefault(actor_id, set()).add(spec["task_id"])
 
+    # handler: streaming task pushed one yielded item to us (the owner)
+    async def _h_task_stream_item(self, task_id: bytes, index: int,
+                                  kind: str, payload=None):
+        tid = TaskID(task_id)
+        pending = self.pending_tasks.get(tid)
+        if pending is None:
+            return True
+        pending.stream_received = max(pending.stream_received, index + 1)
+        oid = ObjectID.for_task_return(tid, index)
+        self.owned.add(oid)
+        if kind == "inline":
+            self._resolve(oid, serialization.loads_inline(payload))
+        else:
+            self._resolve(oid, _IN_SHM)
+        return True
+
+    def _wait_stream_item(self, oid: ObjectID):
+        """Block until a stream slot resolves; returns the RAW memory-
+        store entry (may be _END_OF_STREAM / _IN_SHM / an exception —
+        the generator decides, get() materializes)."""
+
+        async def _wait():
+            if oid not in self.memory_store:
+                await self._event(oid).wait()
+            return self.memory_store.get(oid)
+
+        return EventLoopThread.get().run(_wait())
+
     # handler: executing worker pushed results to us (the owner)
     async def _h_task_result(self, task_id: bytes, status: str, results=None,
-                             error=None):
+                             error=None, stream_len=None):
         tid = TaskID(task_id)
         pending = self.pending_tasks.get(tid)
         if pending is None:
@@ -518,6 +585,34 @@ class CoreWorker:
         actor_id = pending.spec.get("actor_id")
         if actor_id is not None:
             self._actor_inflight.get(actor_id, set()).discard(task_id)
+        if pending.spec.get("num_returns") in ("streaming", "dynamic"):
+            # terminate the stream: sentinel (ok) or the error, placed at
+            # the first slot the consumer hasn't received. Streaming
+            # tasks are never retried — the consumer may have already
+            # observed earlier yields (ref: streaming generators have
+            # their own replay semantics; here we surface the failure).
+            self.pending_tasks.pop(tid, None)
+            end = stream_len if stream_len is not None \
+                else pending.stream_received
+            end_oid = ObjectID.for_task_return(tid, end)
+            if status == "ok":
+                self._resolve(end_oid, _END_OF_STREAM)
+                self._record_event(tid, pending.spec.get("name", ""),
+                                   "FINISHED")
+            else:
+                err = (serialization.loads_inline(error)
+                       if status == "app_error" else
+                       exceptions.WorkerCrashedError(
+                           f"task {tid.hex()} failed: {error}"))
+                self._resolve(end_oid, err)
+                # the slot AFTER the error terminates iteration, so
+                # `for ref in stream` / list(stream) still end: the
+                # consumer sees the error ref, then StopIteration
+                self._resolve(ObjectID.for_task_return(tid, end + 1),
+                              _END_OF_STREAM)
+                self._record_event(tid, pending.spec.get("name", ""),
+                                   "FAILED")
+            return True
         if status == "ok":
             self.pending_tasks.pop(tid, None)
             for oid, (kind, payload) in zip(pending.return_ids, results):
@@ -621,6 +716,10 @@ class CoreWorker:
                           kwargs: dict, opts: Dict[str, Any]) -> List[ObjectRef]:
         task_id = TaskID.from_random()
         num_returns = opts.get("num_returns", 1)
+        if not isinstance(num_returns, int):
+            raise ValueError(
+                f"num_returns={num_returns!r} is not supported for actor "
+                "tasks (streaming generators are task-only for now)")
         return_ids = [ObjectID.for_task_return(task_id, i)
                       for i in range(num_returns)]
         seq = self._actor_seq.get(actor_id, 0)
@@ -715,9 +814,14 @@ class CoreWorker:
 
     # ------------------------------------------------------------ misc
     def cancel(self, ref: ObjectRef, force: bool = False):
-        # find the producing task
+        # find the producing task; streaming tasks have no pre-declared
+        # return ids, so match by the deterministic slot derivation
         for tid, pending in list(self.pending_tasks.items()):
-            if ref.id() in pending.return_ids:
+            if ref.id() in pending.return_ids or (
+                    pending.spec.get("num_returns") == "streaming"
+                    and any(ObjectID.for_task_return(tid, i) == ref.id()
+                            for i in range(
+                                pending.stream_received + 2))):
                 self.nodelet.call("cancel_task", task_id=tid.binary(),
                                   force=force)
                 return True

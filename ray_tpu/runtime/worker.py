@@ -130,6 +130,7 @@ class Executor:
             # arg deserialization, the call, AND generator consumption
             from ..util import tracing
 
+            streaming = spec.get("num_returns") in ("streaming", "dynamic")
             with _applied_runtime_env(spec.get("runtime_env")), \
                     tracing.span(f"task::{spec.get('name', 'task')}",
                                  kind="consumer",
@@ -137,6 +138,15 @@ class Executor:
                 fn = self.core.load_function(spec["fn_key"])
                 args, kwargs = self._unpack_args(spec)
                 result = fn(*args, **kwargs)
+                if streaming:
+                    if not inspect.isgenerator(result):
+                        raise TypeError(
+                            "num_returns='streaming' requires the task "
+                            "to be a generator function")
+                    # stream INSIDE the env/tracing context: each yield
+                    # ships to the owner as it is produced
+                    self._stream_results(spec, result)
+                    return
                 if inspect.isgenerator(result):
                     result = list(result)
             self._send_results(spec, result)
@@ -166,6 +176,37 @@ class Executor:
     def _package(self, value: Any):
         sv = serialization.serialize(value)
         return sv
+
+    def _stream_results(self, spec: dict, gen) -> None:
+        """Ship each yield to the owner as it is produced (streaming
+        generator protocol; ref: _raylet.pyx:1113
+        StreamingGeneratorExecutionContext — per-item returns reported
+        back incrementally, not buffered). A mid-stream exception
+        propagates to the caller (-> _send_error; the owner terminates
+        the stream with the error at the next slot)."""
+        task_id = TaskID(spec["task_id"])
+        owner = self.core.client_for(spec["owner_addr"])
+        index = 0
+        for value in gen:
+            sv = serialization.serialize(value)
+            oid = ObjectID.for_task_return(task_id, index)
+            if sv.total_size() <= get_config().max_direct_call_object_size:
+                owner.notify("task_stream_item", task_id=spec["task_id"],
+                             index=index, kind="inline",
+                             payload=serialization.dumps_inline(value))
+            else:
+                self.core.store.put_serialized(oid, sv)
+                try:
+                    self.core.nodelet.notify(
+                        "object_sealed", oid=oid.binary(),
+                        size=sv.total_size())
+                except Exception:
+                    pass
+                owner.notify("task_stream_item", task_id=spec["task_id"],
+                             index=index, kind="shm", payload=None)
+            index += 1
+        owner.notify("task_result", task_id=spec["task_id"], status="ok",
+                     results=[], stream_len=index)
 
     def _send_results(self, spec: dict, result: Any):
         num_returns = spec.get("num_returns", 1)

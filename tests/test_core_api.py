@@ -128,3 +128,131 @@ def test_nested_tasks(shared_cluster):
 def test_cluster_resources(shared_cluster):
     total = ray_tpu.cluster_resources()
     assert total["CPU"] >= 4
+
+
+def test_streaming_generator_tasks(shared_cluster):
+    """num_returns='streaming' yields ObjectRefs incrementally as the
+    producer runs (ref: ObjectRefStream task_manager.h:67 +
+    StreamingGeneratorExecutionContext _raylet.pyx:1113)."""
+    import numpy as np
+
+    import ray_tpu
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+        yield np.zeros(300_000)  # large item takes the shm path
+
+    refs = list(gen.remote(4))
+    assert len(refs) == 5
+    values = ray_tpu.get(refs[:4])
+    assert values == [0, 10, 20, 30]
+    assert ray_tpu.get(refs[4]).shape == (300_000,)
+
+
+def test_streaming_generator_is_lazy(shared_cluster):
+    """The first yield must be consumable before the producer finishes."""
+    import time
+
+    import ray_tpu
+
+    @ray_tpu.remote(num_returns="streaming")
+    def slow():
+        yield "first"
+        time.sleep(5)
+        yield "second"
+
+    # warm a worker so spawn time doesn't mask laziness
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get(noop.remote(), timeout=60)
+    t0 = time.time()
+    stream = slow.remote()
+    first = ray_tpu.get(next(stream), timeout=60)
+    elapsed = time.time() - t0
+    assert first == "first"
+    assert elapsed < 4.0, f"first item blocked on full stream: {elapsed}"
+    assert ray_tpu.get(next(stream), timeout=60) == "second"
+
+
+def test_streaming_generator_midstream_error(shared_cluster):
+    import pytest as _pytest
+
+    import ray_tpu
+    from ray_tpu import exceptions
+
+    @ray_tpu.remote(num_returns="streaming")
+    def bad():
+        yield 1
+        raise ValueError("boom")
+
+    stream = bad.remote()
+    assert ray_tpu.get(next(stream), timeout=60) == 1
+    with _pytest.raises(exceptions.TaskError, match="boom"):
+        ray_tpu.get(next(stream), timeout=60)
+
+
+def test_streaming_requires_generator(shared_cluster):
+    import pytest as _pytest
+
+    import ray_tpu
+    from ray_tpu import exceptions
+
+    @ray_tpu.remote(num_returns="streaming")
+    def not_a_gen():
+        return 42
+
+    stream = not_a_gen.remote()
+    with _pytest.raises(exceptions.TaskError, match="generator"):
+        ray_tpu.get(next(stream), timeout=60)
+
+
+def test_streaming_generator_error_terminates_iteration(shared_cluster):
+    """list() over a failing stream must terminate: the error ref arrives,
+    then StopIteration (no hang)."""
+    import ray_tpu
+    from ray_tpu import exceptions
+
+    @ray_tpu.remote(num_returns="streaming")
+    def bad():
+        yield 1
+        raise ValueError("kaput")
+
+    refs = list(bad.remote())  # must not hang
+    assert len(refs) == 2
+    assert ray_tpu.get(refs[0], timeout=60) == 1
+    import pytest as _pytest
+
+    with _pytest.raises(exceptions.TaskError, match="kaput"):
+        ray_tpu.get(refs[1], timeout=60)
+
+
+def test_streaming_rejected_for_actor_tasks(shared_cluster):
+    import pytest as _pytest
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        def gen(self):
+            yield 1
+
+    actor = A.remote()
+    with _pytest.raises(ValueError, match="actor"):
+        actor.gen.options(num_returns="streaming").remote()
+
+
+def test_num_returns_dynamic_rejected(shared_cluster):
+    import pytest as _pytest
+
+    import ray_tpu
+
+    @ray_tpu.remote(num_returns="dynamic")
+    def g():
+        yield 1
+
+    with _pytest.raises(ValueError, match="streaming"):
+        g.remote()
