@@ -1,0 +1,71 @@
+// Native data-plane kernels for ray_tpu.data shuffles.
+//
+// The reference's data plane leans on native code for its hot loops
+// (Arrow compute kernels + the C++ object manager move the bytes; ref:
+// src/ray/object_manager/ for transfer, python/ray/data relies on Arrow's
+// C++ kernels). Here the per-row Python hashing in the groupby/shuffle map
+// phase is the measured hot spot, so it gets a native kernel: splitmix64
+// over numeric key columns and FNV-1a over byte rows, combined across
+// columns, then reduced to partition ids. Exposed through the same ctypes
+// C ABI as the rest of csrc/ (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+constexpr uint64_t kFnvOffset = 1469598103934665603ULL;
+constexpr uint64_t kFnvPrime = 1099511628211ULL;
+
+inline uint64_t fnv1a(const uint8_t* data, int64_t len) {
+  uint64_t h = kFnvOffset;
+  for (int64_t i = 0; i < len; ++i) {
+    h ^= data[i];
+    h *= kFnvPrime;
+  }
+  return h;
+}
+
+inline uint64_t combine(uint64_t acc, uint64_t h) {
+  // boost-style hash combine on 64 bits
+  return acc ^ (h + 0x9e3779b97f4a7c15ULL + (acc << 6) + (acc >> 2));
+}
+
+}  // namespace
+
+extern "C" {
+
+// Combine a 64-bit integer key column into the per-row accumulator.
+// acc: n accumulators (callers initialize to 0 for the first column).
+void rtpu_hash_combine_i64(const int64_t* keys, int64_t n, uint64_t* acc) {
+  for (int64_t i = 0; i < n; ++i) {
+    acc[i] = combine(acc[i], splitmix64(static_cast<uint64_t>(keys[i])));
+  }
+}
+
+// Combine a fixed-width byte column (n rows x width bytes, row-major).
+void rtpu_hash_combine_bytes(const uint8_t* data, int64_t n, int64_t width,
+                             uint64_t* acc) {
+  for (int64_t i = 0; i < n; ++i) {
+    acc[i] = combine(acc[i], fnv1a(data + i * width, width));
+  }
+}
+
+// Reduce accumulators to partition ids in [0, nparts).
+void rtpu_hash_to_partition(const uint64_t* acc, int64_t n, int32_t nparts,
+                            int32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    // final mix avoids correlation between low bits and the combine
+    out[i] = static_cast<int32_t>(splitmix64(acc[i]) %
+                                  static_cast<uint64_t>(nparts));
+  }
+}
+
+}  // extern "C"

@@ -94,6 +94,14 @@ def get_lib() -> Optional[ctypes.CDLL]:
             lib.rtpu_store_stats.argtypes = [ctypes.c_void_p,
                                              ctypes.POINTER(
                                                  ctypes.c_uint64 * 4)]
+            lib.rtpu_hash_combine_i64.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+            lib.rtpu_hash_combine_bytes.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_void_p]
+            lib.rtpu_hash_to_partition.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_void_p]
             lib.rtpu_sched_pick.restype = ctypes.c_int
             lib.rtpu_sched_pick.argtypes = [
                 ctypes.POINTER(ctypes.c_double),
@@ -219,3 +227,75 @@ def native_pick(avail, total, req, strategy: str, local_index: int = -1,
         STRATEGY_CODES.get(strategy, 0), local_index, hybrid_threshold,
         seed)
     return idx
+
+
+# ---------------------------------------------------------------- dataio
+def hash_partition(columns, num_parts: int):
+    """Vectorized hash-partition of rows by key columns -> int32 partition
+    ids (csrc/dataio.cc; numpy fallback computes the SAME hashes, so
+    mixed native/fallback workers agree on the partitioning).
+
+    Accepts numpy columns: integers/bools (cast i64), floats (bit-cast),
+    and bytes/str (fixed-width encode).
+    """
+    import numpy as np
+
+    n = len(columns[0])
+    acc = np.zeros(n, np.uint64)
+    lib = get_lib()
+    prepped = []
+    for col in columns:
+        col = np.asarray(col)
+        if col.dtype.kind in "iub":
+            prepped.append(("i64", np.ascontiguousarray(col, np.int64)))
+        elif col.dtype.kind == "f":
+            prepped.append(("i64", np.ascontiguousarray(
+                col.astype(np.float64)).view(np.int64)))
+        else:  # strings / bytes / objects -> fixed-width bytes
+            as_bytes = np.asarray(col, dtype="S")
+            prepped.append(("bytes", np.ascontiguousarray(as_bytes)))
+    if lib is not None:
+        import ctypes
+
+        for kind, arr in prepped:
+            if kind == "i64":
+                lib.rtpu_hash_combine_i64(
+                    arr.ctypes.data_as(ctypes.c_void_p), n,
+                    acc.ctypes.data_as(ctypes.c_void_p))
+            else:
+                lib.rtpu_hash_combine_bytes(
+                    arr.ctypes.data_as(ctypes.c_void_p), n,
+                    arr.dtype.itemsize,
+                    acc.ctypes.data_as(ctypes.c_void_p))
+        out = np.empty(n, np.int32)
+        lib.rtpu_hash_to_partition(
+            acc.ctypes.data_as(ctypes.c_void_p), n, num_parts,
+            out.ctypes.data_as(ctypes.c_void_p))
+        return out
+    # numpy fallback: identical algorithm, vectorized uint64 wraparound
+    def _splitmix64(x):
+        x = (x + np.uint64(0x9E3779B97F4A7C15)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+        x = ((x ^ (x >> np.uint64(30)))
+             * np.uint64(0xBF58476D1CE4E5B9)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+        x = ((x ^ (x >> np.uint64(27)))
+             * np.uint64(0x94D049BB133111EB)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+        return x ^ (x >> np.uint64(31))
+
+    def _combine(a, h):
+        return a ^ ((h + np.uint64(0x9E3779B97F4A7C15)
+                     + ((a << np.uint64(6)) & np.uint64(0xFFFFFFFFFFFFFFFF))
+                     + (a >> np.uint64(2))) & np.uint64(0xFFFFFFFFFFFFFFFF))
+
+    with np.errstate(over="ignore"):
+        for kind, arr in prepped:
+            if kind == "i64":
+                acc = _combine(acc, _splitmix64(arr.view(np.uint64)))
+            else:
+                fnv = np.full(n, np.uint64(1469598103934665603))
+                width = arr.dtype.itemsize
+                raw = arr.view(np.uint8).reshape(n, width)
+                for j in range(width):
+                    fnv = ((fnv ^ raw[:, j])
+                           * np.uint64(1099511628211)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+                acc = _combine(acc, fnv)
+        return (_splitmix64(acc) % np.uint64(num_parts)).astype(np.int32)

@@ -66,9 +66,9 @@ def _partition_block(block: Block, n: int, kind: str, args: Dict[str, Any]):
             parts[idx].append(r)
     elif kind == "aggregate":
         keys = args["keys"]
-        for r in rows:
-            h = hash(tuple(r[k] for k in keys)) % n
-            parts[h].append(r)
+        part_ids = _hash_partition_rows(rows, keys, n)
+        for r, pid in zip(rows, part_ids):
+            parts[pid].append(r)
     else:
         raise ValueError(kind)
     out = tuple(rows_to_block(p) for p in parts)
@@ -90,6 +90,25 @@ def _reduce_partition(kind: str, args: Dict[str, Any], *parts: Block) -> Block:
     elif kind == "aggregate":
         return _aggregate_rows(merged_rows, args)
     return rows_to_block(merged_rows)
+
+
+def _hash_partition_rows(rows, keys, n: int):
+    """Partition ids for the groupby map phase. The hot path is the
+    native vectorized hasher (csrc/dataio.cc via _native.hash_partition
+    — identical results from its numpy fallback); rows whose key columns
+    don't columnize (mixed/nested types) fall back to per-row hashing."""
+    try:
+        from .._native import hash_partition
+
+        columns = []
+        for k in keys:
+            col = np.asarray([r[k] for r in rows])
+            if col.dtype == object:
+                raise TypeError(k)
+            columns.append(col)
+        return hash_partition(columns, n)
+    except Exception:
+        return [hash(tuple(r[k] for k in keys)) % n for r in rows]
 
 
 def _sort_key(row, key):
