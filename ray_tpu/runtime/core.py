@@ -125,6 +125,7 @@ class ObjectRef:
 
 
 _IN_SHM = object()  # memory-store marker: value lives in the shm store
+_MISSING = object()  # sentinel for fast-path memory-store lookups
 
 
 class _PendingTask:
@@ -195,6 +196,7 @@ class CoreWorker:
 
         self.memory_store: Dict[ObjectID, Any] = {}
         self._events: Dict[ObjectID, asyncio.Event] = {}
+        self._sync_waiters: Dict[ObjectID, list] = {}
         self.pending_tasks: Dict[TaskID, _PendingTask] = {}
         self.local_refs: Dict[ObjectID, int] = {}
         self.owned: set = set()  # ObjectIDs owned by this process
@@ -308,6 +310,11 @@ class CoreWorker:
         self.owned.discard(oid)
         self.memory_store.pop(oid, None)
         self._events.pop(oid, None)
+        # wake stranded sync waiters; they will observe the loss
+        for sw in self._sync_waiters.pop(oid, ()):
+            sw[0] -= 1
+            if sw[0] <= 0:
+                sw[1].set()
         self.store.delete(oid)
 
     # ------------------------------------------------------------ events
@@ -323,6 +330,21 @@ class CoreWorker:
         ev = self._events.get(oid)
         if ev is not None:
             ev.set()
+        for sw in self._sync_waiters.pop(oid, ()):
+            sw[0] -= 1
+            if sw[0] <= 0:
+                sw[1].set()
+
+    def _arm_sync_wait(self, oids, sw):
+        """Runs on the io loop: count refs still unresolved and subscribe
+        the sync waiter (a [count, threading.Event] pair) to them."""
+        for oid in oids:
+            if oid in self.memory_store:
+                sw[0] -= 1
+            else:
+                self._sync_waiters.setdefault(oid, []).append(sw)
+        if sw[0] <= 0:
+            sw[1].set()
 
     # ------------------------------------------------------------ clients
     def client_for(self, address: str) -> RpcClient:
@@ -337,11 +359,13 @@ class CoreWorker:
         oid = ObjectID.for_put()
         sv = serialization.serialize(value)
         self.owned.add(oid)
+        # fresh oid: no waiter can exist yet, so a plain (GIL-atomic) dict
+        # set is enough — no io-loop bounce on the put hot path
         if sv.total_size() <= get_config().max_direct_call_object_size:
-            self._resolve_threadsafe(oid, value)
+            self.memory_store[oid] = value
         else:
             self.store.put_serialized(oid, sv)
-            self._resolve_threadsafe(oid, _IN_SHM)
+            self.memory_store[oid] = _IN_SHM
         return ObjectRef(oid, owner_addr=self.address)
 
     def _resolve_threadsafe(self, oid, value):
@@ -406,6 +430,26 @@ class CoreWorker:
             return self.store.get(oid)
         return value
 
+    def _materialize_threadsafe(self, oid: ObjectID):
+        value = self.memory_store.get(oid, _MISSING)
+        if value is _IN_SHM:
+            return self.store.get(oid)
+        if value is _MISSING:
+            raise exceptions.ObjectLostError(oid.hex(), "resolved then lost")
+        return value
+
+    def _disarm_sync_wait(self, sw):
+        empty = []
+        for oid, waiters in self._sync_waiters.items():
+            try:
+                waiters.remove(sw)
+            except ValueError:
+                pass
+            if not waiters:
+                empty.append(oid)
+        for oid in empty:
+            del self._sync_waiters[oid]
+
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
         if single:
@@ -414,10 +458,41 @@ class CoreWorker:
             if not isinstance(r, ObjectRef):
                 raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
 
-        async def _gather():
-            return await asyncio.gather(*(self._get_value(r, timeout) for r in refs))
+        # fast path: everything already resolved in the memory store — read
+        # it straight off this thread (dict reads are GIL-atomic), skipping
+        # the ~200us io-loop bridge entirely
+        ms = self.memory_store
+        values = []
+        for r in refs:
+            v = ms.get(r.id(), _MISSING)
+            if v is _MISSING:
+                values = None
+                break
+            values.append(self.store.get(r.id()) if v is _IN_SHM else v)
+        if values is None:
+            # locally-owned pending refs (results of our own tasks): wait on
+            # a plain threading.Event set by _resolve — one wakeup, no
+            # coroutine scaffolding. Anything borrowed needs the async
+            # owner-fetch machinery.
+            owned = self.owned
+            if all(r.id() in ms or r.id() in owned for r in refs):
+                missing = [r.id() for r in refs if r.id() not in ms]
+                sw = [len(missing), threading.Event()]
+                loop = EventLoopThread.get().loop
+                loop.call_soon_threadsafe(self._arm_sync_wait, missing, sw)
+                if not sw[1].wait(timeout):
+                    loop.call_soon_threadsafe(self._disarm_sync_wait, sw)
+                    raise exceptions.GetTimeoutError(
+                        "get() timed out waiting for "
+                        + ", ".join(o.hex() for o in missing
+                                    if o not in ms))
+                values = [self._materialize_threadsafe(r.id()) for r in refs]
+            else:
+                async def _gather():
+                    return await asyncio.gather(
+                        *(self._get_value(r, timeout) for r in refs))
 
-        values = EventLoopThread.get().run(_gather())
+                values = EventLoopThread.get().run(_gather())
         for v in values:
             if isinstance(v, exceptions.RtpuError):
                 raise v
@@ -529,13 +604,25 @@ class CoreWorker:
             self.owned.add(oid)
             # create events eagerly on the io loop so get() can wait
         loop = EventLoopThread.get().loop
-        loop.call_soon_threadsafe(self._register_pending, task_id, spec,
+        loop.call_soon_threadsafe(self._register_and_submit, task_id, spec,
                                   return_ids, arg_refs)
-        self.nodelet.call("submit_task", spec=spec)
         self._record_event(task_id, spec["name"], "SUBMITTED")
         if streaming:
             return ObjectRefGenerator(task_id, self)
         return [ObjectRef(oid, owner_addr=self.address) for oid in return_ids]
+
+    def _register_and_submit(self, task_id, spec, return_ids, arg_refs):
+        self._register_pending(task_id, spec, return_ids, arg_refs)
+        asyncio.ensure_future(self._submit_to_nodelet(spec))
+
+    async def _submit_to_nodelet(self, spec):
+        # one-way (no per-task ack round-trip), but a submit-path failure
+        # must still fail the pending task instead of hanging its refs
+        try:
+            await self.nodelet.notify_async("submit_task", spec=spec)
+        except Exception as e:
+            await self._h_task_result(spec["task_id"], "system_error",
+                                      error=f"task submission failed: {e}")
 
     def _register_pending(self, task_id, spec, return_ids, arg_refs):
         self.pending_tasks[task_id] = _PendingTask(
@@ -567,6 +654,10 @@ class CoreWorker:
         """Block until a stream slot resolves; returns the RAW memory-
         store entry (may be _END_OF_STREAM / _IN_SHM / an exception —
         the generator decides, get() materializes)."""
+
+        v = self.memory_store.get(oid, _MISSING)
+        if v is not _MISSING:
+            return v
 
         async def _wait():
             if oid not in self.memory_store:
@@ -661,7 +752,22 @@ class CoreWorker:
             elif self.store.contains(obj_id):
                 return ("shm", None)
             else:
-                raise exceptions.ObjectLostError(obj_id.hex(), "not owned here")
+                # the borrower can race ahead of our registration (its
+                # fetch rides a different socket than our submit path);
+                # grace-wait before declaring the object lost
+                deadline = time.monotonic() + 2.0
+                while time.monotonic() < deadline:
+                    await asyncio.sleep(0.02)
+                    if obj_id in self.memory_store:
+                        break
+                    if obj_id in self._events or obj_id in self.owned:
+                        await self._event(obj_id).wait()
+                        break
+                    if self.store.contains(obj_id):
+                        return ("shm", None)
+                else:
+                    raise exceptions.ObjectLostError(
+                        obj_id.hex(), "not owned here")
         value = self.memory_store.get(obj_id)
         if value is _IN_SHM:
             return ("shm", None)
@@ -741,10 +847,14 @@ class CoreWorker:
         for oid in return_ids:
             self.owned.add(oid)
         loop = EventLoopThread.get().loop
-        loop.call_soon_threadsafe(self._register_pending, task_id, spec,
-                                  return_ids, arg_refs)
-        EventLoopThread.get().spawn(self._send_actor_task(actor_id, spec))
+        loop.call_soon_threadsafe(self._register_and_send_actor, task_id,
+                                  spec, return_ids, arg_refs, actor_id)
         return [ObjectRef(oid, owner_addr=self.address) for oid in return_ids]
+
+    def _register_and_send_actor(self, task_id, spec, return_ids, arg_refs,
+                                 actor_id):
+        self._register_pending(task_id, spec, return_ids, arg_refs)
+        asyncio.ensure_future(self._send_actor_task(actor_id, spec))
 
     async def _ensure_actor_sub(self, actor_id: str):
         """Watch actor state so in-flight calls fail fast when it dies
@@ -788,7 +898,9 @@ class CoreWorker:
             if spec["task_id"] not in self._actor_inflight.get(actor_id, set()):
                 return  # already failed (incarnation lost); don't deliver stale
             client = self.client_for(addr)
-            await client.call_async("actor_call", spec=spec)
+            # one-way: the enqueue ack carries no information — results and
+            # failures both come back as task_result pushes
+            await client.notify_async("actor_call", spec=spec)
         except exceptions.ActorDiedError as e:
             await self._h_task_result(spec["task_id"], "app_error",
                                       error=serialization.dumps_inline(e))

@@ -35,15 +35,36 @@ from .ids import NodeID, TaskID, WorkerID
 from .rpc import RpcClient, RpcServer, ServerConn
 
 
-async def _ensure_proc_dead(proc, grace: float = 2.0):
+class _SpawnAmbiguous(Exception):
+    """A factory spawn request whose outcome is unknown (sent but no
+    reply): neither retrying nor cold-starting is safe for that id."""
+
+
+def _pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+async def _ensure_proc_dead(proc, pid: int = -1, grace: float = 2.0):
     """SIGKILL a terminated worker that ignores SIGTERM."""
     deadline = time.monotonic() + grace
     while time.monotonic() < deadline:
-        if proc.poll() is not None:
+        if proc is not None:
+            if proc.poll() is not None:
+                return
+        elif not _pid_alive(pid):
             return
         await asyncio.sleep(0.1)
     try:
-        proc.kill()
+        if proc is not None:
+            proc.kill()
+        elif pid > 0:
+            os.kill(pid, 9)
     except Exception:
         pass
 
@@ -95,6 +116,10 @@ class Nodelet:
         self._bg: List[asyncio.Task] = []
         self._stopping = False
         self.object_bytes = 0
+        self._owner_clients: Dict[str, RpcClient] = {}
+        self._factory_proc = None
+        self._factory_path = os.path.join(
+            session_dir, "sock", f"factory-{node_id[:8]}.sock")
 
     def _handlers(self):
         return {
@@ -102,6 +127,7 @@ class Nodelet:
             "lease_worker_for_actor": self.lease_worker_for_actor,
             "worker_register": self.worker_register,
             "task_finished": self.task_finished,
+            "task_done": self.task_done,
             "actor_exited": self.actor_exited,
             "reserve_bundle": self.reserve_bundle,
             "return_bundle": self.return_bundle,
@@ -116,6 +142,7 @@ class Nodelet:
     # ------------------------------------------------------------ lifecycle
     async def start(self):
         await self._server.start()
+        self._start_factory()
         await self.controller.call_async(
             "register_node", node_id=self.node_id, address=self.address,
             resources=self.total_resources, labels=self.labels)
@@ -130,6 +157,18 @@ class Nodelet:
             t.cancel()
         for w in list(self.workers.values()):
             self._kill_worker(w)
+        if self._factory_proc is not None:
+            try:
+                self._factory_proc.terminate()
+            except Exception:
+                pass
+            try:
+                os.unlink(self._factory_path)
+            except OSError:
+                pass
+        for client in self._owner_clients.values():
+            client.close()
+        self._owner_clients.clear()
         await self._server.stop()
 
     def _on_shutdown(self):
@@ -158,12 +197,17 @@ class Nodelet:
             await asyncio.sleep(0.2)
             now = time.monotonic()
             for w in list(self.workers.values()):
-                if w.proc is not None and w.proc.poll() is not None:
+                if (w.proc is not None and w.proc.poll() is not None) or \
+                        (w.proc is None and w.pid > 0 and not _pid_alive(w.pid)):
                     await self._on_worker_death(w)
                 elif (not w.is_actor and w.current_task is None
                       and len(self.workers) > get_config().prestart_workers
                       and now - w.idle_since > cfg.worker_idle_timeout_s):
                     self._kill_worker(w)
+            # stall check: queued work, nothing running, nothing starting
+            if (self.queue or self.pending_actor_leases) and not self.idle \
+                    and self.starting == 0:
+                self._dispatch()
 
     # ------------------------------------------------------------ worker pool
     def _start_worker(self, force: bool = False):
@@ -171,33 +215,125 @@ class Nodelet:
             return
         self.starting += 1
         worker_id = WorkerID.from_random().hex()
+        # record a placeholder so death-before-register is detectable
+        ws = WorkerState(worker_id, "", -1, None)
+        ws.current_task = {"placeholder": True}
+        self.workers[worker_id] = ws
+        # fork+exec takes single-digit milliseconds — never on the io loop
+        # (the loop also serves get()/fetch responses; blocking it is what
+        # starved owner-fetches in round 1)
+        try:
+            loop = asyncio.get_running_loop()
+            loop.run_in_executor(None, self._spawn_worker_proc, ws, worker_id)
+        except RuntimeError:
+            self._spawn_worker_proc(ws, worker_id)
+
+    def _start_factory(self):
+        """Launch the prefork worker factory (pays the python+jax import
+        cost once; forks workers in ~10ms; ref: worker_pool.cc prestart)."""
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
-        out = open(os.path.join(log_dir, f"worker-{worker_id[:8]}.log"), "ab")
-        env = dict(os.environ)
-        env["RTPU_WORKER_ID"] = worker_id
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.runtime.worker",
+        out = open(os.path.join(log_dir, "worker-factory.log"), "ab")
+        self._factory_proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.runtime.worker_factory",
+             "--listen", self._factory_path,
              "--session-name", self.session_name,
              "--session-dir", self.session_dir,
              "--node-id", self.node_id,
              "--nodelet-addr", self.address,
-             "--controller-addr", self.controller_addr,
-             "--worker-id", worker_id],
-            stdout=out, stderr=subprocess.STDOUT, env=env,
-            start_new_session=True)
-        # record a placeholder so death-before-register is detectable
-        ws = WorkerState(worker_id, "", proc.pid, proc)
-        ws.current_task = {"placeholder": True}
-        self.workers[worker_id] = ws
+             "--controller-addr", self.controller_addr],
+            stdout=out, stderr=subprocess.STDOUT)
+
+    def _fork_from_factory(self, worker_id: str) -> int:
+        """Ask the factory for a forked worker; returns the pid.
+
+        Two phases with different retry rules: connecting retries until the
+        factory binds its socket; the spawn request itself is sent AT MOST
+        ONCE (a retried request could fork a duplicate worker with the same
+        worker_id out of the factory's backlog)."""
+        import json
+        import socket as socket_mod
+
+        deadline = time.monotonic() + 15.0
+        sock = None
+        while True:  # phase 1: retryable connect
+            sock = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+            sock.settimeout(2.0)
+            try:
+                sock.connect(self._factory_path)
+                break
+            except OSError:
+                sock.close()
+                if self._stopping or time.monotonic() > deadline or (
+                        self._factory_proc is not None
+                        and self._factory_proc.poll() is not None):
+                    raise
+                time.sleep(0.05)
+        try:  # phase 2: exactly-once request
+            sock.settimeout(60.0)  # covers the factory's warm import
+            sock.sendall((json.dumps({"worker_id": worker_id}) + "\n").encode())
+            data = b""
+            while not data.endswith(b"\n"):
+                chunk = sock.recv(4096)
+                if not chunk:
+                    raise _SpawnAmbiguous("factory closed mid-request")
+                data += chunk
+            return json.loads(data)["pid"]
+        except _SpawnAmbiguous:
+            raise
+        except OSError as e:
+            # the request may still be served from the factory's backlog —
+            # cold-starting now could duplicate this worker_id
+            raise _SpawnAmbiguous(str(e))
+        finally:
+            sock.close()
+
+    def _spawn_worker_proc(self, ws: WorkerState, worker_id: str):
+        try:
+            try:
+                ws.pid = self._fork_from_factory(worker_id)
+                return
+            except _SpawnAmbiguous:
+                # give up on this worker_id; the reap loop's stall check
+                # will start a fresh worker if the queue still needs one
+                self.workers.pop(worker_id, None)
+                self.starting = max(0, self.starting - 1)
+                return
+            except OSError:
+                if self._stopping:
+                    return
+                # factory unreachable/dead: cold-start below
+            log_dir = os.path.join(self.session_dir, "logs")
+            os.makedirs(log_dir, exist_ok=True)
+            out = open(os.path.join(log_dir, f"worker-{worker_id[:8]}.log"), "ab")
+            env = dict(os.environ)
+            env["RTPU_WORKER_ID"] = worker_id
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.runtime.worker",
+                 "--session-name", self.session_name,
+                 "--session-dir", self.session_dir,
+                 "--node-id", self.node_id,
+                 "--nodelet-addr", self.address,
+                 "--controller-addr", self.controller_addr,
+                 "--worker-id", worker_id],
+                stdout=out, stderr=subprocess.STDOUT, env=env,
+                start_new_session=True)
+            ws.proc = proc
+            ws.pid = proc.pid
+        except Exception:
+            self.workers.pop(worker_id, None)
+            self.starting = max(0, self.starting - 1)
+            traceback.print_exc()
 
     async def worker_register(self, worker_id: str, address: str, pid: int):
         ws = self.workers.get(worker_id)
         if ws is None:
+            # unknown id: adopt it (e.g. a fork whose spawn reply was lost)
             ws = WorkerState(worker_id, address, pid)
             self.workers[worker_id] = ws
-        else:
-            self.starting -= 1
+        elif ws.current_task and ws.current_task.get("placeholder"):
+            self.starting = max(0, self.starting - 1)
+        ws.pid = pid
         ws.address = address
         ws.current_task = None
         ws.client = RpcClient(address)
@@ -213,9 +349,12 @@ class Nodelet:
                 self.idle.remove(ws.worker_id)
             except ValueError:
                 pass
-        if ws.proc is not None:
+        if ws.proc is not None or ws.pid > 0:
             try:
-                ws.proc.terminate()
+                if ws.proc is not None:
+                    ws.proc.terminate()
+                else:
+                    os.kill(ws.pid, 15)
             except Exception:
                 pass
             # escalate to SIGKILL: user code may install SIGTERM handlers
@@ -223,15 +362,23 @@ class Nodelet:
             # process alive past terminate()
             try:
                 asyncio.get_running_loop().create_task(
-                    _ensure_proc_dead(ws.proc))
+                    _ensure_proc_dead(ws.proc, ws.pid))
             except RuntimeError:
-                try:
-                    ws.proc.wait(timeout=2)
-                except Exception:
+                if ws.proc is not None:
                     try:
-                        ws.proc.kill()
+                        ws.proc.wait(timeout=2)
                     except Exception:
-                        pass
+                        try:
+                            ws.proc.kill()
+                        except Exception:
+                            pass
+                elif _pid_alive(ws.pid):
+                    time.sleep(0.2)
+                    if _pid_alive(ws.pid):
+                        try:
+                            os.kill(ws.pid, 9)
+                        except Exception:
+                            pass
 
     async def _on_worker_death(self, ws: WorkerState):
         self.workers.pop(ws.worker_id, None)
@@ -332,6 +479,9 @@ class Nodelet:
 
     # ------------------------------------------------------------ task path
     async def submit_task(self, spec: dict):
+        # shallow-copy: with in-process dispatch the caller's spec dict
+        # arrives by reference, and we annotate it (_spilled/_bundle_key)
+        spec = dict(spec)
         if spec["task_id"] in self.cancelled:
             self.cancelled.discard(spec["task_id"])
             await self._report_cancelled(spec)
@@ -418,6 +568,23 @@ class Nodelet:
             await ws.client.notify_async("create_actor", spec=spec)
         except Exception:
             await self._on_worker_death(ws)
+
+    async def task_done(self, worker_id: str, task_id: bytes,
+                        owner_addr: str, result: dict):
+        """Combined finish+result (one worker send per task): forward the
+        result to the owner — an in-process dispatch when the owner is the
+        local driver — then free the worker and redispatch. Result first:
+        a scheduling-path exception must never drop a computed result."""
+        self._owner_client(owner_addr).notify_nowait("task_result", **result)
+        await self.task_finished(worker_id, task_id)
+        return True
+
+    def _owner_client(self, address: str) -> RpcClient:
+        client = self._owner_clients.get(address)
+        if client is None:
+            client = RpcClient(address)
+            self._owner_clients[address] = client
+        return client
 
     async def task_finished(self, worker_id: str, task_id: bytes):
         ws = self.workers.get(worker_id)

@@ -84,6 +84,20 @@ def _get_chaos() -> _Chaos:
 
 
 # --------------------------------------------------------------------------
+# In-process server registry: when a client and server share a process (the
+# single-host session runs controller + nodelet on the driver's loop), calls
+# dispatch directly on the loop with zero serialization and zero socket hops
+# — the moral equivalent of the reference embedding the plasma store inside
+# the raylet process (object_manager.h:80) applied to the control plane.
+# --------------------------------------------------------------------------
+_local_servers: Dict[str, "RpcServer"] = {}
+
+
+async def _hang_forever():
+    await asyncio.Event().wait()
+
+
+# --------------------------------------------------------------------------
 # Event loop thread
 # --------------------------------------------------------------------------
 class EventLoopThread:
@@ -210,8 +224,11 @@ class RpcServer:
             self._server = await asyncio.start_unix_server(self._on_conn, parsed[1])
         else:
             self._server = await asyncio.start_server(self._on_conn, parsed[1], parsed[2])
+        _local_servers[self.address] = self
 
     async def stop(self):
+        if _local_servers.get(self.address) is self:
+            del _local_servers[self.address]
         if self._server is not None:
             self._server.close()
             try:
@@ -284,7 +301,10 @@ class RpcServer:
 
 
 def _wants_conn(handler) -> bool:
-    cached = getattr(handler, "_rtpu_wants_conn", None)
+    # cache on the underlying function: bound methods are re-created per
+    # access and reject attribute writes, so cache there via __func__
+    target = getattr(handler, "__func__", handler)
+    cached = getattr(target, "_rtpu_wants_conn", None)
     if cached is None:
         import inspect
 
@@ -293,7 +313,7 @@ def _wants_conn(handler) -> bool:
         except (TypeError, ValueError):
             cached = False
         try:
-            handler._rtpu_wants_conn = cached
+            target._rtpu_wants_conn = cached
         except AttributeError:
             pass
     return cached
@@ -302,6 +322,35 @@ def _wants_conn(handler) -> bool:
 # --------------------------------------------------------------------------
 # Client
 # --------------------------------------------------------------------------
+class _LocalConn:
+    """Stands in for ServerConn when client and server share a process:
+    server-pushed notifications route straight into the client's
+    notify_handlers (pubsub etc.) without a socket."""
+
+    __slots__ = ("client", "closed", "meta", "server")
+
+    def __init__(self, client: "RpcClient", server: "RpcServer"):
+        self.client = client
+        self.server = server
+        self.closed = False
+        self.meta: Dict[str, Any] = {}
+
+    async def send(self, msg_tuple) -> None:
+        raise RpcError("local connections carry no raw frames")
+
+    async def notify(self, method: str, **kwargs) -> None:
+        if self.closed:
+            return
+        handler = self.client.notify_handlers.get(method)
+        if handler is not None:
+            try:
+                res = handler(**kwargs)
+                if asyncio.iscoroutine(res):
+                    asyncio.ensure_future(res)
+            except Exception:
+                traceback.print_exc()
+
+
 class RpcClient:
     """Persistent client to one server address.
 
@@ -321,6 +370,48 @@ class RpcClient:
         self._ids = itertools.count(1)
         self._connect_lock: Optional[asyncio.Lock] = None
         self._closed = False
+        self._local_conn: Optional[_LocalConn] = None
+
+    def _local_server(self) -> Optional["RpcServer"]:
+        return _local_servers.get(self.address)
+
+    async def _call_local(self, server: "RpcServer", method: str,
+                          kwargs: dict, _timeout: Optional[float],
+                          one_way: bool = False):
+        """Direct in-process dispatch (no socket, no pickling). Chaos
+        injection still applies so FT tests behave identically."""
+        if _get_chaos().should_drop_request(method):
+            if one_way:
+                return None
+            if _timeout:
+                await asyncio.wait_for(_hang_forever(), _timeout)
+            await _hang_forever()
+        if self._local_conn is None or self._local_conn.server is not server:
+            self._local_conn = _LocalConn(self, server)
+        handler = server.handlers.get(method)
+        try:
+            if handler is None:
+                raise RpcError(f"no handler for {method!r}")
+            if _wants_conn(handler):
+                kwargs = dict(kwargs, _conn=self._local_conn)
+            result = handler(**kwargs)
+            if asyncio.iscoroutine(result):
+                if _timeout:
+                    result = await asyncio.wait_for(result, _timeout)
+                else:
+                    result = await result
+            return result
+        except asyncio.TimeoutError:
+            raise
+        except (ConnectionLost, ConnectionError):
+            raise
+        except RemoteHandlerError:
+            raise
+        except Exception as e:
+            # raise even for one-way sends: in-process callers CAN see
+            # handler failures, and e.g. the task-submit failback needs to
+            raise RemoteHandlerError(
+                type(e).__name__, repr(e), traceback.format_exc())
 
     # -- async interface (must run on the io loop) --
     async def _ensure_connected(self):
@@ -384,6 +475,9 @@ class RpcClient:
             self._pending.clear()
 
     async def call_async(self, method: str, _timeout: Optional[float] = None, **kwargs):
+        server = self._local_server()
+        if server is not None:
+            return await self._call_local(server, method, kwargs, _timeout)
         await self._ensure_connected()
         msg_id = next(self._ids)
         fut = asyncio.get_event_loop().create_future()
@@ -397,6 +491,10 @@ class RpcClient:
         return await fut
 
     async def notify_async(self, method: str, **kwargs):
+        server = self._local_server()
+        if server is not None:
+            await self._call_local(server, method, kwargs, None, one_way=True)
+            return
         await self._ensure_connected()
         payload = serialization.dumps_inline((NTF, method, kwargs))
         async with self._wlock:
@@ -412,10 +510,42 @@ class RpcClient:
     def notify(self, method: str, **kwargs):
         EventLoopThread.get().run(self.notify_async(method, **kwargs))
 
+    def notify_nowait(self, method: str, **kwargs):
+        """Fire-and-forget from ANY thread: schedules the send on the io
+        loop without waiting for it (the hot-path result/ack sends —
+        blocking an executor thread ~200us per send just to learn the
+        bytes left the socket buys nothing)."""
+        elt = EventLoopThread.get()
+        if threading.current_thread() is elt.thread:
+            asyncio.ensure_future(self._notify_swallow(method, kwargs))
+        else:
+            elt.loop.call_soon_threadsafe(self._spawn_notify, method, kwargs)
+
+    def _spawn_notify(self, method: str, kwargs: dict):
+        asyncio.ensure_future(self._notify_swallow(method, kwargs))
+
+    async def _notify_swallow(self, method: str, kwargs: dict):
+        try:
+            await self.notify_async(method, **kwargs)
+        except (ConnectionLost, ConnectionError, OSError):
+            pass
+        except Exception:
+            traceback.print_exc()
+
     def close(self):
         self._closed = True
 
         async def _close():
+            if self._local_conn is not None and not self._local_conn.closed:
+                self._local_conn.closed = True
+                srv = self._local_conn.server
+                if srv.on_disconnect is not None:
+                    try:
+                        res = srv.on_disconnect(self._local_conn)
+                        if asyncio.iscoroutine(res):
+                            await res
+                    except Exception:
+                        pass
             if self._writer is not None:
                 try:
                     self._writer.close()

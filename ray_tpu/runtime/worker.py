@@ -125,6 +125,7 @@ class Executor:
 
     def _run_task(self, spec: dict):
         task_id = spec["task_id"]
+        self._task_done_sent = False
         try:
             # the env context covers function load (module import time),
             # arg deserialization, the call, AND generator consumption
@@ -166,12 +167,13 @@ class Executor:
                                                     spans=spans)
                     except Exception:
                         pass
-            try:
-                self.core.nodelet.notify("task_finished",
-                                         worker_id=self.core.worker_id.hex(),
-                                         task_id=task_id)
-            except Exception:
-                pass
+            if not self._task_done_sent:
+                try:
+                    self.core.nodelet.notify_nowait(
+                        "task_finished", worker_id=self.core.worker_id.hex(),
+                        task_id=task_id)
+                except Exception:
+                    pass
 
     def _package(self, value: Any):
         sv = serialization.serialize(value)
@@ -233,9 +235,8 @@ class Executor:
                 except Exception:
                     pass
                 results.append(("shm", None))
-        owner = self.core.client_for(spec["owner_addr"])
-        owner.notify("task_result", task_id=spec["task_id"], status="ok",
-                     results=results)
+        self._deliver_result(spec, {"task_id": spec["task_id"],
+                                    "status": "ok", "results": results})
 
     def _send_error(self, spec: dict, exc: Exception):
         if isinstance(exc, exceptions.RtpuError):
@@ -245,12 +246,29 @@ class Executor:
                 type(exc).__name__, repr(exc), traceback.format_exc(),
                 task_desc=spec.get("name", "task"))
         try:
-            owner = self.core.client_for(spec["owner_addr"])
-            owner.notify("task_result", task_id=spec["task_id"],
-                         status="app_error",
-                         error=serialization.dumps_inline(err))
+            self._deliver_result(spec, {
+                "task_id": spec["task_id"], "status": "app_error",
+                "error": serialization.dumps_inline(err)})
         except Exception:
             traceback.print_exc()
+
+    def _deliver_result(self, spec: dict, result: dict):
+        """One send per finished plain task: result + worker-free ride the
+        same frame to the nodelet, which forwards task_result to the owner
+        (in-process dispatch when the owner is the driver). Actor calls and
+        streaming tasks keep the direct owner socket — actor results never
+        involve the nodelet, and stream items must stay FIFO with their
+        terminator on one connection."""
+        if spec.get("type") == "task" and \
+                spec.get("num_returns") not in ("streaming", "dynamic"):
+            self._task_done_sent = True
+            self.core.nodelet.notify_nowait(
+                "task_done", worker_id=self.core.worker_id.hex(),
+                task_id=spec["task_id"], owner_addr=spec["owner_addr"],
+                result=result)
+        else:
+            owner = self.core.client_for(spec["owner_addr"])
+            owner.notify_nowait("task_result", **result)
 
     # ------------------------------------------------------------ actors
     async def h_create_actor(self, spec: dict):
@@ -386,6 +404,24 @@ class Executor:
         return True
 
 
+def run_worker(*, session_name: str, session_dir: str, node_id: str,
+               nodelet_addr: str, controller_addr: str, worker_id: str):
+    core = CoreWorker(
+        mode="worker", session_name=session_name,
+        session_dir=session_dir, controller_addr=controller_addr,
+        nodelet_addr=nodelet_addr, node_id=node_id,
+        worker_id=WorkerID.from_hex(worker_id))
+    set_core(core)
+    executor = Executor(core)
+    core.start(extra_handlers=executor.handlers())
+    core.nodelet.call("worker_register", worker_id=worker_id,
+                      address=core.address, pid=os.getpid())
+    executor.shutdown_event.wait()
+    core.flush_events()
+    core.shutdown()
+    os._exit(0)
+
+
 def main():
     import argparse
 
@@ -397,21 +433,10 @@ def main():
     parser.add_argument("--controller-addr", required=True)
     parser.add_argument("--worker-id", required=True)
     args = parser.parse_args()
-
-    core = CoreWorker(
-        mode="worker", session_name=args.session_name,
-        session_dir=args.session_dir, controller_addr=args.controller_addr,
-        nodelet_addr=args.nodelet_addr, node_id=args.node_id,
-        worker_id=WorkerID.from_hex(args.worker_id))
-    set_core(core)
-    executor = Executor(core)
-    core.start(extra_handlers=executor.handlers())
-    core.nodelet.call("worker_register", worker_id=args.worker_id,
-                      address=core.address, pid=os.getpid())
-    executor.shutdown_event.wait()
-    core.flush_events()
-    core.shutdown()
-    os._exit(0)
+    run_worker(session_name=args.session_name, session_dir=args.session_dir,
+               node_id=args.node_id, nodelet_addr=args.nodelet_addr,
+               controller_addr=args.controller_addr,
+               worker_id=args.worker_id)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,123 @@
+"""Prefork worker factory: fast worker process creation.
+
+The reference hides python interpreter startup latency by prestarting and
+caching worker processes in the raylet's WorkerPool (ref:
+src/ray/raylet/worker_pool.cc — idle pools, prestart). On TPU hosts the
+problem is worse: site initialization imports jax (seconds of CPU), so a
+cold `python -m ray_tpu.runtime.worker` is ~100x more expensive than the
+task it will run. The factory pays that import cost once, then `fork()`s
+ready-to-run workers in ~10ms on demand.
+
+Single-threaded by construction (plain blocking sockets, no asyncio, no
+locks) so forked children never inherit a lock held by another thread.
+Children reset signals, start their own session, and run the normal worker
+main loop. SIGCHLD is set to SIG_IGN so dead workers auto-reap; the nodelet
+tracks worker liveness by pid.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import sys
+
+
+def _child_main(req: dict, args) -> None:
+    os.setsid()
+    worker_id = req["worker_id"]
+    log_dir = os.path.join(args.session_dir, "logs")
+    os.makedirs(log_dir, exist_ok=True)
+    log_fd = os.open(os.path.join(log_dir, f"worker-{worker_id[:8]}.log"),
+                     os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    os.dup2(log_fd, 1)
+    os.dup2(log_fd, 2)
+    os.close(log_fd)
+    signal.signal(signal.SIGCHLD, signal.SIG_DFL)
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    os.environ["RTPU_WORKER_ID"] = worker_id
+
+    from .worker import run_worker
+
+    run_worker(session_name=args.session_name, session_dir=args.session_dir,
+               node_id=args.node_id, nodelet_addr=args.nodelet_addr,
+               controller_addr=args.controller_addr, worker_id=worker_id)
+    os._exit(0)
+
+
+def serve(args) -> None:
+    # Bind FIRST so spawn requests issued while we import queue in the
+    # backlog (instead of failing over to cold starts), then warm
+    # everything a worker needs so children inherit imported modules.
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    if os.path.exists(args.listen):
+        os.unlink(args.listen)
+    sock.bind(args.listen)
+    sock.listen(128)
+
+    from . import worker as _warm  # noqa: F401
+
+    sock.settimeout(1.0)
+    signal.signal(signal.SIGCHLD, signal.SIG_IGN)  # auto-reap workers
+    parent = os.getppid()
+    while True:
+        try:
+            conn, _ = sock.accept()
+        except socket.timeout:
+            if os.getppid() != parent:
+                return  # nodelet died; die with it
+            continue
+        except OSError:
+            return
+        try:
+            data = b""
+            while not data.endswith(b"\n"):
+                chunk = conn.recv(4096)
+                if not chunk:
+                    break
+                data += chunk
+            if not data:
+                conn.close()
+                continue
+            req = json.loads(data)
+            pid = os.fork()
+            if pid == 0:
+                sock.close()
+                conn.close()
+                try:
+                    _child_main(req, args)
+                except BaseException:
+                    import traceback
+
+                    traceback.print_exc()
+                finally:
+                    os._exit(1)
+            conn.sendall((json.dumps({"pid": pid}) + "\n").encode())
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--listen", required=True)
+    parser.add_argument("--session-name", required=True)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--nodelet-addr", required=True)
+    parser.add_argument("--controller-addr", required=True)
+    args = parser.parse_args()
+    serve(args)
+
+
+if __name__ == "__main__":
+    main()
