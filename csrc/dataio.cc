@@ -58,6 +58,18 @@ void rtpu_hash_combine_bytes(const uint8_t* data, int64_t n, int64_t width,
   }
 }
 
+// Combine a fixed-width byte column hashing only each row's ACTUAL bytes
+// (lens[i] <= width). Fixed-width 'S' encodes pad with trailing NULs whose
+// count depends on the block-local max length — hashing them would send
+// the same key to different partitions in different blocks.
+void rtpu_hash_combine_bytes_varlen(const uint8_t* data, int64_t n,
+                                    int64_t width, const int64_t* lens,
+                                    uint64_t* acc) {
+  for (int64_t i = 0; i < n; ++i) {
+    acc[i] = combine(acc[i], fnv1a(data + i * width, lens[i]));
+  }
+}
+
 // Reduce accumulators to partition ids in [0, nparts).
 void rtpu_hash_to_partition(const uint64_t* acc, int64_t n, int32_t nparts,
                             int32_t* out) {

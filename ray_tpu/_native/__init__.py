@@ -99,6 +99,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
             lib.rtpu_hash_combine_bytes.argtypes = [
                 ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
                 ctypes.c_void_p]
+            lib.rtpu_hash_combine_bytes_varlen.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_void_p]
             lib.rtpu_hash_to_partition.argtypes = [
                 ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
                 ctypes.c_void_p]
@@ -251,9 +254,21 @@ def hash_partition(columns, num_parts: int):
         elif col.dtype.kind == "f":
             prepped.append(("i64", np.ascontiguousarray(
                 col.astype(np.float64)).view(np.int64)))
-        else:  # strings / bytes / objects -> fixed-width bytes
-            as_bytes = np.asarray(col, dtype="S")
-            prepped.append(("bytes", np.ascontiguousarray(as_bytes)))
+        else:  # strings / bytes -> fixed-width bytes + actual lengths
+            if col.dtype.kind == "U":
+                # utf-8 so non-ascii strings stay on the vectorized path
+                col = np.char.encode(col, "utf-8")
+            as_bytes = np.ascontiguousarray(np.asarray(col, dtype="S"))
+            # hash only each row's real bytes: the 'S' width (and its NUL
+            # padding) is block-local, and padding in the hash would
+            # partition the same key differently across blocks
+            width = as_bytes.dtype.itemsize
+            raw = as_bytes.view(np.uint8).reshape(n, width)
+            nonzero = raw != 0
+            lens = np.where(
+                nonzero.any(axis=1),
+                width - np.argmax(nonzero[:, ::-1], axis=1), 0).astype(np.int64)
+            prepped.append(("bytes", (as_bytes, np.ascontiguousarray(lens))))
     if lib is not None:
         import ctypes
 
@@ -263,9 +278,11 @@ def hash_partition(columns, num_parts: int):
                     arr.ctypes.data_as(ctypes.c_void_p), n,
                     acc.ctypes.data_as(ctypes.c_void_p))
             else:
-                lib.rtpu_hash_combine_bytes(
-                    arr.ctypes.data_as(ctypes.c_void_p), n,
-                    arr.dtype.itemsize,
+                data, lens = arr
+                lib.rtpu_hash_combine_bytes_varlen(
+                    data.ctypes.data_as(ctypes.c_void_p), n,
+                    data.dtype.itemsize,
+                    lens.ctypes.data_as(ctypes.c_void_p),
                     acc.ctypes.data_as(ctypes.c_void_p))
         out = np.empty(n, np.int32)
         lib.rtpu_hash_to_partition(
@@ -291,11 +308,14 @@ def hash_partition(columns, num_parts: int):
             if kind == "i64":
                 acc = _combine(acc, _splitmix64(arr.view(np.uint64)))
             else:
+                data, lens = arr
                 fnv = np.full(n, np.uint64(1469598103934665603))
-                width = arr.dtype.itemsize
-                raw = arr.view(np.uint8).reshape(n, width)
+                width = data.dtype.itemsize
+                raw = data.view(np.uint8).reshape(n, width)
                 for j in range(width):
-                    fnv = ((fnv ^ raw[:, j])
-                           * np.uint64(1099511628211)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+                    live = lens > j  # mirror varlen: stop at each row's len
+                    step = ((fnv ^ raw[:, j])
+                            * np.uint64(1099511628211)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+                    fnv = np.where(live, step, fnv)
                 acc = _combine(acc, fnv)
         return (_splitmix64(acc) % np.uint64(num_parts)).astype(np.int32)

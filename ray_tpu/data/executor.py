@@ -96,7 +96,12 @@ def _hash_partition_rows(rows, keys, n: int):
     """Partition ids for the groupby map phase. The hot path is the
     native vectorized hasher (csrc/dataio.cc via _native.hash_partition
     — identical results from its numpy fallback); rows whose key columns
-    don't columnize (mixed/nested types) fall back to per-row hashing."""
+    don't columnize (mixed/nested types) fall back to per-row hashing.
+    Both paths are deterministic across processes — map tasks in
+    different workers MUST agree on every key's partition (builtin
+    hash() is salted per process and would silently split groups).
+    Key column types must be consistent across the dataset's blocks so
+    every block takes the same path."""
     try:
         from .._native import hash_partition
 
@@ -108,7 +113,30 @@ def _hash_partition_rows(rows, keys, n: int):
             columns.append(col)
         return hash_partition(columns, n)
     except Exception:
-        return [hash(tuple(r[k] for k in keys)) % n for r in rows]
+        import hashlib
+        import pickle
+
+        def canon(v):
+            # hash-order containers must serialize identically in every
+            # process (set iteration order depends on PYTHONHASHSEED)
+            if isinstance(v, (set, frozenset)):
+                return ("__set__",
+                        tuple(sorted(pickle.dumps(canon(e), protocol=4)
+                                     for e in v)))
+            if isinstance(v, dict):
+                return ("__dict__",
+                        tuple(sorted((pickle.dumps(canon(k), protocol=4),
+                                      pickle.dumps(canon(val), protocol=4))
+                                     for k, val in v.items())))
+            if isinstance(v, (list, tuple)):
+                return tuple(canon(e) for e in v)
+            return v
+
+        return [int.from_bytes(
+            hashlib.blake2b(
+                pickle.dumps(tuple(canon(r[k]) for k in keys), protocol=4),
+                digest_size=8).digest(), "little") % n
+            for r in rows]
 
 
 def _sort_key(row, key):

@@ -126,7 +126,13 @@ class PipelinedTrainer:
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(
             logp, targets[:, :-1][..., None], axis=-1)[..., 0]
-        return nll.mean()
+        # honor loss_mask like ShardedTrainer (padding tokens must not
+        # train); mask is aligned to targets = inputs shifted left by one
+        mask = batch.get("loss_mask")
+        if mask is None:
+            return nll.mean()
+        mask = mask[:, 1:].astype(nll.dtype)
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
 
     def _build_step(self):
         def _step(state: TrainState, batch):

@@ -146,10 +146,24 @@ class MultiAgentEnvRunner:
                     out[self.policy_mapping_fn(agent)].append(episode)
                     self._episodes[agent] = Episode()
             if all_done:
-                # flush any agents that never got a personal done flag
+                # flush any agents that never got a personal done flag —
+                # a time-limit end (truncs['__all__']) is a truncation,
+                # so those fragments keep their value bootstrap (treating
+                # them as terminal would bias GAE at every env time limit)
+                all_truncated = truncs.get("__all__", False) and \
+                    not terms.get("__all__", False)
                 for agent, episode in self._episodes.items():
                     if len(episode) > 0:
-                        episode.terminated = True
+                        if all_truncated:
+                            episode.truncated = True
+                            final = obs.get(agent, self._cur_obs.get(agent))
+                            if final is not None:
+                                final = np.asarray(final, np.float32)
+                                episode.last_obs = final
+                                episode.last_value = self._value_of(
+                                    agent, final)
+                        else:
+                            episode.terminated = True
                         out[self.policy_mapping_fn(agent)].append(episode)
                 self._reset()
             else:

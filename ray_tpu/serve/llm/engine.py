@@ -136,6 +136,12 @@ class LLMEngine:
         self._aborted: set = set()
         self._injections: List[tuple] = []
         self.extracted: Dict[str, Dict[str, Any]] = {}
+        # unclaimed prefill KV blobs are dropped after a TTL or past a
+        # count cap — a decode caller that aborts between prefill_done
+        # and pop_extracted must not leak dense KV on a long-lived replica
+        self._extracted_order: List[tuple] = []  # (request_id, ts)
+        self.extracted_ttl_s: float = 120.0
+        self.extracted_max: int = 64
         self.waiting: List[Request] = []
         self.running: List[Request] = []
         self.requests: Dict[str, Request] = {}
@@ -158,6 +164,21 @@ class LLMEngine:
     def abort(self, request_id: str) -> None:
         with self._intake_lock:
             self._aborted.add(request_id)
+            # drop any unclaimed prefill KV for this request immediately
+            # (same lock as the engine thread's bookkeeping: an append
+            # racing an unlocked rebuild could strand a blob past the TTL)
+            if self.extracted.pop(request_id, None) is not None:
+                self._extracted_order[:] = [
+                    e for e in self._extracted_order if e[0] != request_id]
+
+    def _evict_extracted(self) -> None:
+        now = time.monotonic()
+        with self._intake_lock:
+            while self._extracted_order and (
+                    len(self._extracted_order) > self.extracted_max
+                    or now - self._extracted_order[0][1] > self.extracted_ttl_s):
+                rid, _ = self._extracted_order.pop(0)
+                self.extracted.pop(rid, None)
 
     def has_work(self) -> bool:
         with self._intake_lock:
@@ -378,7 +399,12 @@ class LLMEngine:
             # first token already terminates (EOS/stop/length), fall
             # through to the normal finish instead — there is nothing
             # worth handing to a decode engine.
-            self.extracted[req.request_id] = self._gather_kv(req)
+            blob = self._gather_kv(req)  # device gather OUTSIDE the lock
+            with self._intake_lock:
+                self.extracted[req.request_id] = blob
+                self._extracted_order.append(
+                    (req.request_id, time.monotonic()))
+            self._evict_extracted()
             self._finish(req, "prefill_done")
             deltas.append(OutputDelta(req.request_id, [token], True,
                                       "prefill_done"))
@@ -440,7 +466,16 @@ class LLMEngine:
     def pop_extracted(self, request_id: str) -> Dict[str, Any]:
         """Fetch (and drop) the KV blob of a prefill_only request that
         finished with reason 'prefill_done'."""
-        return self.extracted.pop(request_id)
+        with self._intake_lock:
+            blob = self.extracted.pop(request_id, None)
+            self._extracted_order[:] = [
+                e for e in self._extracted_order if e[0] != request_id]
+        if blob is None:
+            raise KeyError(
+                f"prefill KV for {request_id!r} is unavailable: the "
+                "handoff expired (TTL/cap eviction), was aborted, or the "
+                "request never finished prefill")
+        return blob
 
     def release_request(self, request_id: str) -> None:
         """Drop a request after handoff (its pages return to the pool)."""
