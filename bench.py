@@ -25,6 +25,87 @@ def _peak_flops(device) -> float:
     return 197e12
 
 
+def bench_serve(on_tpu: bool) -> dict:
+    """Paged-KV engine on the chip: p50 TTFT under continuous batching +
+    decode throughput (north star: p50 TTFT < 200 ms; the reference
+    publishes no serving goldens — it delegates the engine to vLLM)."""
+    import numpy as np
+
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine, SamplingParams
+
+    if on_tpu:
+        cfg = EngineConfig(model="llama-1b", page_size=16, num_pages=1024,
+                           max_model_len=512, max_batch=8,
+                           prefill_buckets=(128, 256, 512),
+                           dtype="bfloat16")
+        prompt_len, gen_len, n_req = 128, 24, 6
+    else:
+        cfg = EngineConfig(model="tiny", page_size=8, num_pages=64,
+                           max_model_len=128, max_batch=4,
+                           prefill_buckets=(16, 32, 64, 128),
+                           dtype="float32",
+                           model_overrides={"vocab_size": 512})
+        prompt_len, gen_len, n_req = 16, 4, 3
+    engine = LLMEngine(cfg)
+    rng = np.random.default_rng(0)
+
+    def prompt():
+        return list(rng.integers(0, 400, prompt_len))
+
+    # warmup: compile prefill + decode
+    engine.add_request("warm", prompt(), SamplingParams(max_tokens=2))
+    for _ in range(200):
+        deltas = engine.step()
+        if any(d.finished for d in deltas):
+            break
+
+    submit = {}
+    first_tok = {}
+    last_tok = {}
+    n_tokens = 0
+    for i in range(n_req):
+        rid = f"r{i}"
+        submit[rid] = time.perf_counter()
+        engine.add_request(rid, prompt(), SamplingParams(max_tokens=gen_len))
+    finished = 0
+    for _ in range(5000):
+        for d in engine.step():
+            now = time.perf_counter()
+            if d.request_id not in first_tok and d.new_token_ids:
+                first_tok[d.request_id] = now
+            n_tokens += len(d.new_token_ids)
+            last_tok[d.request_id] = now
+            if d.finished:
+                finished += 1
+        if finished >= n_req:
+            break
+    ttfts = sorted((first_tok[r] - submit[r]) * 1e3 for r in submit
+                   if r in first_tok)
+    span = max(last_tok.values()) - min(submit.values())
+    return {"ttft_ms_p50": round(ttfts[len(ttfts) // 2], 1),
+            "ttft_ms_max": round(ttfts[-1], 1),
+            "decode_tok_s": round(n_tokens / span, 1),
+            "n_requests": n_req, "prompt_len": prompt_len}
+
+
+def bench_runtime() -> dict:
+    """Core-runtime microbenchmarks (tasks/s, actor calls/s) — the
+    BASELINE.md table companion, measured on this host."""
+    import os
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = subprocess.run(
+        [sys.executable, os.path.join(here, "benchmarks", "ray_perf.py"),
+         "--scale", "0.5"],
+        capture_output=True, text=True, timeout=240, cwd=here)
+    for line in reversed(out.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(f"ray_perf produced no JSON: {out.stderr[-300:]}")
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -96,8 +177,29 @@ def main():
             "backend": jax.default_backend(),
         },
     }
+
+    # free trainer memory before the serving bench shares the chip
+    del state, trainer
+    import gc
+
+    gc.collect()
+
+    # secondary metrics, each time-guarded so the primary line always
+    # lands inside the driver's budget
+    start = globals().get("_T0", time.perf_counter())
+    if time.perf_counter() - start < 330:
+        try:
+            result["detail"]["serve"] = bench_serve(on_tpu)
+        except Exception as e:  # noqa: BLE001 — report, never block the line
+            result["detail"]["serve"] = {"error": repr(e)[:200]}
+    if time.perf_counter() - start < 450:
+        try:
+            result["detail"]["runtime"] = bench_runtime()
+        except Exception as e:  # noqa: BLE001
+            result["detail"]["runtime"] = {"error": repr(e)[:200]}
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
+    _T0 = time.perf_counter()
     main()

@@ -71,8 +71,24 @@ def _rebuild_handle(actor_id: str):
 
 
 class ActorHandle:
-    def __init__(self, actor_id: str):
+    def __init__(self, actor_id: str, owning: bool = False):
         self._actor_id = actor_id
+        self._owning = owning  # creator's original handle
+
+    def __del__(self):
+        # Owner-based actor lifetime (ref: actor fate-sharing with the
+        # creating handle — gcs_actor_manager.cc destroys owned actors
+        # whose owner's handle goes out of scope). Named actors persist.
+        if getattr(self, "_owning", False):
+            try:
+                from .runtime.core import get_core
+
+                core = get_core(required=False)
+                if core is not None and not core._shutting_down:
+                    # deferred until this owner's in-flight calls resolve
+                    core.release_actor_handle(self._actor_id)
+            except BaseException:  # interpreter teardown: names may be gone
+                pass
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
@@ -142,7 +158,9 @@ class ActorClass:
         spec_opts.update(resolve_strategy(opts.get("scheduling_strategy")))
         actor_id = core.create_actor(
             self._export(), self._cls.__name__, args, kwargs, spec_opts)
-        return ActorHandle(actor_id)
+        # unnamed actors fate-share with this creating handle; named
+        # actors outlive it (get_actor can retrieve them later)
+        return ActorHandle(actor_id, owning=not spec_opts.get("name"))
 
     @property
     def underlying_class(self):

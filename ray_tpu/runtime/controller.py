@@ -436,6 +436,12 @@ class Controller:
         info.worker_id = worker_id
         info.node_id = node_id
         await self._publish(f"actor:{actor_id}", info.snapshot())
+        if getattr(info, "drain_requested", False):
+            try:
+                client = RpcClient(address)
+                await client.notify_async("drain_exit")
+            except Exception:
+                pass
         return True
 
     async def actor_died(self, actor_id: str, reason: str = "",
@@ -472,16 +478,25 @@ class Controller:
     async def list_actors(self):
         return [a.snapshot() for a in self.actors.values()]
 
-    async def kill_actor(self, actor_id: str, no_restart: bool = True):
+    async def kill_actor(self, actor_id: str, no_restart: bool = True,
+                         drain: bool = False):
+        """drain=True: graceful fate-sharing kill (owner handle released)
+        — the actor finishes submitted calls before exiting."""
         info = self.actors.get(actor_id)
         if info is None:
             return False
         if no_restart:
             info.spec["max_restarts"] = 0
+        if drain and info.state != ACTOR_ALIVE:
+            # still being created: queued calls must run first — forward
+            # the drain once the actor comes up
+            info.drain_requested = True
+            return True
         if info.address:
             try:
                 client = RpcClient(info.address)
-                await client.notify_async("kill_self")
+                await client.notify_async("drain_exit" if drain
+                                          else "kill_self")
             except Exception:
                 pass
         if info.state != ACTOR_ALIVE:

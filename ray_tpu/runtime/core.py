@@ -19,6 +19,7 @@ Differences from the reference, by design:
 from __future__ import annotations
 
 import asyncio
+import collections
 import hashlib
 import threading
 import time
@@ -29,7 +30,7 @@ from .. import exceptions
 from . import serialization
 from .config import get_config
 from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
-from .object_store import make_store_client
+from .object_store import host_id as _get_host_id, make_store_client
 from .rpc import EventLoopThread, RpcClient, RpcServer, ConnectionLost, RemoteHandlerError
 
 _core_lock = threading.Lock()
@@ -51,7 +52,13 @@ def set_core(core: Optional["CoreWorker"]):
 
 
 def _deserialize_object_ref(id_bytes: bytes, owner_addr: Optional[str]):
-    return ObjectRef(ObjectID(id_bytes), owner_addr=owner_addr, borrowed=True)
+    ref = ObjectRef(ObjectID(id_bytes), owner_addr=owner_addr, borrowed=True)
+    core = get_core(required=False)
+    if core is not None and owner_addr and owner_addr != core.address:
+        # borrowing protocol (ref: reference_count.cc): tell the owner we
+        # hold this ref so it defers deletion until we drain
+        core._note_borrow(ref.id(), owner_addr)
+    return ref
 
 
 class ObjectRef:
@@ -128,6 +135,22 @@ _IN_SHM = object()  # memory-store marker: value lives in the shm store
 _MISSING = object()  # sentinel for fast-path memory-store lookups
 
 
+class _RemoteShm:
+    """Memory-store marker: the value lives in ANOTHER host's pool; pull
+    it through that host's nodelet (object-manager tier) on first read."""
+
+    __slots__ = ("host", "node_addr", "size")
+
+    def __init__(self, host: str, node_addr: str, size: int):
+        self.host = host
+        self.node_addr = node_addr
+        self.size = size
+
+    @classmethod
+    def from_loc(cls, loc: dict) -> "_RemoteShm":
+        return cls(loc.get("host", ""), loc["node_addr"], loc["size"])
+
+
 class _PendingTask:
     __slots__ = ("spec", "return_ids", "retries_left", "arg_refs",
                  "submitted_at", "stream_received")
@@ -186,13 +209,20 @@ class CoreWorker:
         self.node_id = node_id
         self.worker_id = worker_id or WorkerID.from_random()
         self.job_id = job_id or JobID.from_random()
-        self.address = f"unix:{session_dir}/sock/{self.worker_id.hex()}.sock"
+        # tcp cluster -> this process must be reachable across hosts
+        # (owner-fetch and actor calls are peer-to-peer); unix otherwise
+        if controller_addr.startswith("tcp:"):
+            self.address = "tcp:0.0.0.0:0"  # rewritten at start()
+        else:
+            self.address = f"unix:{session_dir}/sock/{self.worker_id.hex()}.sock"
 
         self.controller = RpcClient(controller_addr,
                                     notify_handlers={"pubsub": self._on_pubsub,
                                                      "shutdown": self._on_shutdown_ntf})
         self.nodelet = RpcClient(nodelet_addr)
         self.store = make_store_client(session_name)
+        self.host_id = _get_host_id()
+        self._pulls: Dict[ObjectID, asyncio.Future] = {}
 
         self.memory_store: Dict[ObjectID, Any] = {}
         self._events: Dict[ObjectID, asyncio.Event] = {}
@@ -200,6 +230,18 @@ class CoreWorker:
         self.pending_tasks: Dict[TaskID, _PendingTask] = {}
         self.local_refs: Dict[ObjectID, int] = {}
         self.owned: set = set()  # ObjectIDs owned by this process
+        # borrowing protocol state (ref: reference_count.cc)
+        self._borrowed_owners: Dict[ObjectID, str] = {}  # we borrow FROM
+        self.borrows: Dict[ObjectID, set] = {}  # borrower addrs of OUR objects
+        self._pending_delete: set = set()  # delete deferred on borrows
+        # lineage for reconstruction (ref: object_recovery_manager.h:43,
+        # task_manager.h:182 lineage cap)
+        self.lineage: Dict[ObjectID, tuple] = {}
+        self._lineage_order: collections.deque = collections.deque()
+        self.max_lineage_entries = 4096
+        self._recovering: Dict[TaskID, asyncio.Future] = {}
+        self._actor_arg_pins: list = []  # creation-arg blobs, actor lifetime
+        self._kill_when_drained: set = set()  # actor ids awaiting drain-kill
 
         self._clients: Dict[str, RpcClient] = {}
         self._actor_addr: Dict[str, str] = {}
@@ -220,13 +262,20 @@ class CoreWorker:
             "task_result": self._h_task_result,
             "task_stream_item": self._h_task_stream_item,
             "fetch_object": self._h_fetch_object,
+            "borrow_inc": self._h_borrow_inc,
+            "borrow_dec": self._h_borrow_dec,
             "ping": lambda: "pong",
         }
+        from .object_store import om_handlers
+
+        handlers.update(om_handlers(lambda: self.store))
         if extra_handlers:
             handlers.update(extra_handlers)
         self._server = RpcServer(self.address, handlers)
         EventLoopThread.get().run(self._server.start())
+        self.address = self._server.address  # ephemeral tcp port resolved
         EventLoopThread.get().spawn(self._metrics_flush_loop())
+        EventLoopThread.get().spawn(self._borrow_sweep_loop())
 
     async def _metrics_flush_loop(self):
         """Ship this process's metric registry to the controller every few
@@ -263,6 +312,16 @@ class CoreWorker:
                     metrics=snap, _timeout=2)
             except Exception:
                 pass
+        # best-effort: release our borrows so owners' deferred deletes run
+        for oid, owner in list(self._borrowed_owners.items()):
+            try:
+                self.client_for(owner).notify_nowait(
+                    "borrow_dec", oid=oid.binary(), borrower=self.address)
+            except Exception:
+                pass
+        if self._borrowed_owners:
+            time.sleep(0.1)  # let the scheduled dec sends flush
+        self._borrowed_owners.clear()
         self._shutting_down = True
         try:
             if self._server is not None:
@@ -302,14 +361,92 @@ class CoreWorker:
             if oid in self.owned:
                 self._delete_object(oid)
             else:
+                self.memory_store.pop(oid, None)  # cached borrow markers
                 self.store.release(oid)
+                owner = self._borrowed_owners.pop(oid, None)
+                if owner is not None and not self._shutting_down:
+                    try:
+                        self.client_for(owner).notify_nowait(
+                            "borrow_dec", oid=oid.binary(),
+                            borrower=self.address)
+                    except Exception:
+                        pass
         else:
             self.local_refs[oid] = count
 
+    def _note_borrow(self, oid: ObjectID, owner_addr: str):
+        """First local ref of a borrowed object: register with its owner
+        so the owner's delete is deferred while we hold it."""
+        if oid in self._borrowed_owners or oid in self.owned:
+            return
+        self._borrowed_owners[oid] = owner_addr
+        try:
+            self.client_for(owner_addr).notify_nowait(
+                "borrow_inc", oid=oid.binary(), borrower=self.address)
+        except Exception:
+            pass
+
+    # owner-side borrow bookkeeping
+    async def _h_borrow_inc(self, oid: bytes, borrower: str):
+        self.borrows.setdefault(ObjectID(oid), set()).add(borrower)
+        return True
+
+    async def _h_borrow_dec(self, oid: bytes, borrower: str):
+        obj_id = ObjectID(oid)
+        holders = self.borrows.get(obj_id)
+        if holders is not None:
+            holders.discard(borrower)
+            if not holders:
+                del self.borrows[obj_id]
+                if obj_id in self._pending_delete:
+                    self._pending_delete.discard(obj_id)
+                    self._delete_object(obj_id)
+        return True
+
+    async def _borrow_sweep_loop(self):
+        """GC borrows held by dead processes so deferred deletes drain
+        (the reference reconciles via worker-failure pubsub; a liveness
+        ping keeps this design single-mechanism). A borrower is declared
+        dead only after 3 consecutive failed sweeps (~30s) — a loop busy
+        deserializing for a couple of seconds is NOT dead, and releasing
+        a live borrower's ref would let the owner delete under it."""
+        ping_failures: Dict[str, int] = {}
+        while not self._shutting_down:
+            await asyncio.sleep(10.0)
+            blocked = [oid for oid in self._pending_delete
+                       if self.borrows.get(oid)]
+            checked: Dict[str, bool] = {}
+            for oid in blocked:
+                for addr in list(self.borrows.get(oid, ())):
+                    if addr not in checked:
+                        try:
+                            await self.client_for(addr).call_async(
+                                "ping", _timeout=5)
+                            checked[addr] = True
+                            ping_failures.pop(addr, None)
+                        except Exception:
+                            checked[addr] = False
+                            ping_failures[addr] = \
+                                ping_failures.get(addr, 0) + 1
+                    if not checked[addr] and ping_failures.get(addr, 0) >= 3:
+                        await self._h_borrow_dec(oid.binary(), addr)
+            # drop failure counts for addrs no longer borrowing anything
+            live = {a for holders in self.borrows.values() for a in holders}
+            for addr in list(ping_failures):
+                if addr not in live:
+                    ping_failures.pop(addr, None)
+
     def _delete_object(self, oid: ObjectID):
+        if self.borrows.get(oid):
+            # borrowers still hold it: defer (ref: reference_count.cc —
+            # owner waits for borrower refs to drain)
+            self._pending_delete.add(oid)
+            return
+        self._pending_delete.discard(oid)
         self.owned.discard(oid)
         self.memory_store.pop(oid, None)
         self._events.pop(oid, None)
+        self.lineage.pop(oid, None)
         # wake stranded sync waiters; they will observe the loss
         for sw in self._sync_waiters.pop(oid, ()):
             sw[0] -= 1
@@ -343,8 +480,20 @@ class CoreWorker:
                 sw[0] -= 1
             else:
                 self._sync_waiters.setdefault(oid, []).append(sw)
+                ev = self._events.get(oid)
+                if (ev is None or ev.is_set()) and oid in self.owned:
+                    # resolved once, then evicted: no producer will set
+                    # this again — reconstruct via lineage
+                    asyncio.ensure_future(self._recover_and_resolve(oid))
         if sw[0] <= 0:
             sw[1].set()
+
+    async def _recover_and_resolve(self, oid: ObjectID):
+        try:
+            await self._materialize_async(oid)
+        except Exception as e:  # noqa: BLE001 — waiters must wake
+            self._resolve(oid, exceptions.ObjectLostError(
+                oid.hex(), f"unrecoverable: {e}"))
 
     # ------------------------------------------------------------ clients
     def client_for(self, address: str) -> RpcClient:
@@ -382,7 +531,7 @@ class CoreWorker:
         oid = ref.id()
         deadline = time.monotonic() + timeout if timeout is not None else None
         if oid in self.memory_store:
-            return self._materialize(oid)
+            return await self._materialize_async(oid)
         if oid in self.owned or oid in self._events:
             ev = self._event(oid)
             try:
@@ -391,7 +540,7 @@ class CoreWorker:
             except asyncio.TimeoutError:
                 raise exceptions.GetTimeoutError(
                     f"get() timed out waiting for {oid.hex()}")
-            return self._materialize(oid)
+            return await self._materialize_async(oid)
         # borrowed object: shm first, then the owner
         if self.store.contains(oid):
             return self.store.get(oid)
@@ -405,38 +554,200 @@ class CoreWorker:
             except asyncio.TimeoutError:
                 raise exceptions.GetTimeoutError(
                     f"get() timed out waiting for {oid.hex()}")
-            return self._materialize(oid)
+            return await self._materialize_async(oid)
         client = self.client_for(owner)
-        remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
-        try:
-            kind, payload = await client.call_async(
-                "fetch_object", _timeout=remaining, oid=oid.binary())
-        except asyncio.TimeoutError:
-            raise exceptions.GetTimeoutError(
-                f"get() timed out fetching {oid.hex()} from owner")
-        except (ConnectionLost, RemoteHandlerError) as e:
-            raise exceptions.ObjectLostError(oid.hex(), f"owner unreachable: {e}")
-        if kind == "inline":
-            value = serialization.loads_inline(payload)
-            self.memory_store[oid] = value
-            return value
-        elif kind == "shm":
-            return self.store.get(oid)
-        raise exceptions.ObjectLostError(oid.hex(), f"unexpected fetch kind {kind}")
+        lost = False
+        for attempt in range(3):
+            remaining = None if deadline is None else max(
+                0.0, deadline - time.monotonic())
+            try:
+                kind, payload = await client.call_async(
+                    "fetch_object", _timeout=remaining, oid=oid.binary(),
+                    host=self.host_id, lost=lost)
+            except asyncio.TimeoutError:
+                raise exceptions.GetTimeoutError(
+                    f"get() timed out fetching {oid.hex()} from owner")
+            except (ConnectionLost, RemoteHandlerError) as e:
+                raise exceptions.ObjectLostError(
+                    oid.hex(), f"owner unreachable: {e}")
+            try:
+                if kind == "inline":
+                    value = serialization.loads_inline(payload)
+                    self.memory_store[oid] = value
+                    return value
+                elif kind == "shm":
+                    return self.store.get(oid)
+                elif kind == "remote":
+                    await self._pull_remote(oid, _RemoteShm.from_loc(payload))
+                    return self.store.get(oid)
+                raise exceptions.ObjectLostError(
+                    oid.hex(), f"unexpected fetch kind {kind}")
+            except (exceptions.ObjectLostError, FileNotFoundError,
+                    ConnectionLost):
+                if attempt >= 2:
+                    raise
+                # the copy we were pointed at is gone: tell the owner so
+                # it can reconstruct via lineage, then retry
+                lost = True
 
-    def _materialize(self, oid: ObjectID):
-        value = self.memory_store.get(oid)
-        if value is _IN_SHM:
-            return self.store.get(oid)
-        return value
+    # ------------------------------------------------ lineage reconstruction
+    def _remember_lineage(self, pending: "_PendingTask"):
+        """Keep the spec (and pinned args) of a task whose shm results may
+        be lost to eviction or node death (ref: task_manager.h:182 lineage;
+        object_recovery_manager.h:43). Bounded FIFO."""
+        entry = (pending.spec, pending.return_ids, pending.arg_refs)
+        first = pending.return_ids[0] if pending.return_ids else None
+        existing = self.lineage.get(first) if first is not None else None
+        if existing is not None and \
+                existing[0]["task_id"] == pending.spec["task_id"]:
+            # a recovered task re-completing: refresh entries in place —
+            # appending the ids to the FIFO again would let eviction of
+            # the OLD duplicate delete the still-covered dict entries
+            for oid in pending.return_ids:
+                self.lineage[oid] = entry
+            return
+        for oid in pending.return_ids:
+            self.lineage[oid] = entry
+        self._lineage_order.append(pending.return_ids)
+        while len(self._lineage_order) > self.max_lineage_entries:
+            for old in self._lineage_order.popleft():
+                self.lineage.pop(old, None)
+
+    async def _recover(self, oid: ObjectID, cause: str):
+        """Re-execute the producing task of a lost object."""
+        entry = self.lineage.get(oid)
+        if entry is None:
+            raise exceptions.ObjectLostError(oid.hex(), cause)
+        spec, return_ids, arg_refs = entry
+        tid = TaskID(spec["task_id"])
+        fut = self._recovering.get(tid)
+        if fut is not None:
+            await fut
+            return
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+        self._recovering[tid] = fut
+        try:
+            fresh = dict(spec)
+            fresh.pop("_spilled", None)
+            fresh.pop("_bundle_key", None)
+            for roid in return_ids:
+                self.memory_store.pop(roid, None)
+                self._events.pop(roid, None)  # fresh (unset) events
+            self._register_pending(tid, fresh, return_ids, arg_refs)
+            await self.nodelet.notify_async("submit_task", spec=fresh)
+            await asyncio.gather(
+                *(self._event(roid).wait() for roid in return_ids))
+        finally:
+            fut.set_result(True)
+            self._recovering.pop(tid, None)
+
+    async def _await_local_ingest(self, oid: ObjectID, timeout: float = 120.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.store.contains(oid):
+                return
+            await asyncio.sleep(0.05)
+        raise exceptions.ObjectLostError(
+            oid.hex(), "concurrent ingest never sealed")
+
+    async def _materialize_async(self, oid: ObjectID, attempt: int = 0):
+        value = self.memory_store.get(oid, _MISSING)
+        try:
+            if isinstance(value, _RemoteShm):
+                await self._pull_remote(oid, value)
+                value = _IN_SHM
+            if value is _IN_SHM:
+                return self.store.get(oid)
+        except (exceptions.ObjectLostError, FileNotFoundError,
+                ConnectionLost) as e:
+            if attempt >= 2:
+                raise exceptions.ObjectLostError(
+                    oid.hex(), f"unrecoverable after retries: {e}")
+            await self._recover(oid, f"lost: {e}")
+            return await self._materialize_async(oid, attempt + 1)
+        if value is _MISSING and oid in self.owned:
+            # resolved once, then evicted locally: reconstruct
+            if attempt >= 2:
+                raise exceptions.ObjectLostError(oid.hex(), "evicted")
+            await self._recover(oid, "evicted from local store")
+            return await self._materialize_async(oid, attempt + 1)
+        return value if value is not _MISSING else None
 
     def _materialize_threadsafe(self, oid: ObjectID):
         value = self.memory_store.get(oid, _MISSING)
         if value is _IN_SHM:
-            return self.store.get(oid)
-        if value is _MISSING:
-            raise exceptions.ObjectLostError(oid.hex(), "resolved then lost")
+            try:
+                return self.store.get(oid)
+            except FileNotFoundError:
+                value = _MISSING  # evicted: recover on the loop
+        if isinstance(value, _RemoteShm) or value is _MISSING:
+            return EventLoopThread.get().run(self._materialize_async(oid))
         return value
+
+    # ---------------------------------------------- cross-host object pull
+    async def _pull_remote(self, oid: ObjectID, rs: _RemoteShm):
+        """Chunked pull of an object from another host's nodelet into the
+        local pool (ref: object_manager/pull_manager.cc — here demand-
+        driven with per-object dedup and a small pipeline window)."""
+        if self.store.contains(oid):
+            self.memory_store[oid] = _IN_SHM
+            return
+        fut = self._pulls.get(oid)
+        if fut is not None:
+            res = await fut
+            if isinstance(res, Exception):
+                raise res
+            return
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+        self._pulls[oid] = fut
+        try:
+            client = self.client_for(rs.node_addr)
+            size = rs.size
+            if not size:
+                size = await client.call_async("om_meta", oid=oid.binary())
+                if size is None:
+                    raise exceptions.ObjectLostError(
+                        oid.hex(), f"not present on {rs.node_addr}")
+            try:
+                writer = self.store.create_for_ingest(oid, size)
+            except FileExistsError:
+                # another process on this host is already ingesting the
+                # same object into the shared pool; wait for its seal
+                await self._await_local_ingest(oid)
+                self.memory_store[oid] = _IN_SHM
+                fut.set_result(True)
+                self._pulls.pop(oid, None)
+                return
+            chunk = 4 << 20
+
+            async def _one(off: int):
+                data = await client.call_async(
+                    "om_read", oid=oid.binary(), offset=off,
+                    length=min(chunk, size - off))
+                if data is None:
+                    raise exceptions.ObjectLostError(
+                        oid.hex(), f"evicted from {rs.node_addr} mid-pull")
+                writer.write_at(off, data)
+
+            try:
+                offs = list(range(0, size, chunk))
+                for i in range(0, len(offs), 4):  # pipeline window
+                    await asyncio.gather(*(_one(o) for o in offs[i:i + 4]))
+                writer.seal()
+            except BaseException:
+                writer.abort()
+                raise
+            self.memory_store[oid] = _IN_SHM
+            self.nodelet.notify_nowait("object_sealed", oid=oid.binary(),
+                                       size=size)
+        except Exception as e:
+            fut.set_result(e)
+            self._pulls.pop(oid, None)
+            raise
+        fut.set_result(True)
+        self._pulls.pop(oid, None)
 
     def _disarm_sync_wait(self, sw):
         empty = []
@@ -465,17 +776,24 @@ class CoreWorker:
         values = []
         for r in refs:
             v = ms.get(r.id(), _MISSING)
-            if v is _MISSING:
+            if v is _MISSING or isinstance(v, _RemoteShm):
                 values = None
                 break
-            values.append(self.store.get(r.id()) if v is _IN_SHM else v)
+            if v is _IN_SHM:
+                try:
+                    v = self.store.get(r.id())
+                except FileNotFoundError:
+                    values = None  # evicted: recover via the slow path
+                    break
+            values.append(v)
         if values is None:
             # locally-owned pending refs (results of our own tasks): wait on
             # a plain threading.Event set by _resolve — one wakeup, no
             # coroutine scaffolding. Anything borrowed needs the async
             # owner-fetch machinery.
             owned = self.owned
-            if all(r.id() in ms or r.id() in owned for r in refs):
+            if all((r.id() in ms and not isinstance(ms[r.id()], _RemoteShm))
+                   or r.id() in owned for r in refs):
                 missing = [r.id() for r in refs if r.id() not in ms]
                 sw = [len(missing), threading.Event()]
                 loop = EventLoopThread.get().loop
@@ -498,6 +816,30 @@ class CoreWorker:
                 raise v
         return values[0] if single else values
 
+    async def _wait_resolved(self, ref: "ObjectRef", fetch_local: bool):
+        """Readiness without deserialization (wait() semantics): resolved
+        at the owner; plus locally present when fetch_local."""
+        oid = ref.id()
+        if oid in self.owned or oid in self._events or oid in self.memory_store:
+            if oid not in self.memory_store:
+                await self._event(oid).wait()
+            v = self.memory_store.get(oid)
+            if fetch_local and isinstance(v, _RemoteShm):
+                await self._pull_remote(oid, v)
+            return
+        if self.store.contains(oid):
+            return
+        owner = ref.owner_address
+        if owner is None or owner == self.address:
+            await self._event(oid).wait()
+            return
+        kind, payload = await self.client_for(owner).call_async(
+            "fetch_object", oid=oid.binary(), host=self.host_id)
+        if kind == "inline":
+            self.memory_store[oid] = serialization.loads_inline(payload)
+        elif kind == "remote" and fetch_local:
+            await self._pull_remote(oid, _RemoteShm.from_loc(payload))
+
     def wait(self, refs: List["ObjectRef"], num_returns: int = 1,
              timeout: Optional[float] = None,
              fetch_local: bool = True) -> Tuple[list, list]:
@@ -507,7 +849,7 @@ class CoreWorker:
             deadline = time.monotonic() + timeout if timeout is not None else None
 
             async def _one(r):
-                await self._get_value(r, None)
+                await self._wait_resolved(r, fetch_local)
                 return r
 
             tasks = {asyncio.ensure_future(_one(r)): r for r in pending}
@@ -551,7 +893,7 @@ class CoreWorker:
         return fn
 
     # ------------------------------------------------------------ task submission
-    def _pack_args(self, args: tuple, kwargs: dict):
+    def _pack_args(self, args: tuple, kwargs: dict, arg_refs: list):
         sv = serialization.serialize((args, kwargs))
         if sv.total_size() <= get_config().max_direct_call_object_size:
             data = sv.meta if not sv.buffers else None
@@ -562,7 +904,10 @@ class CoreWorker:
         oid = ObjectID.for_put()
         self.store.put_serialized(oid, sv)
         self.owned.add(oid)
-        self._resolve_threadsafe(oid, _IN_SHM)
+        self.memory_store[oid] = _IN_SHM
+        # refcount the blob like any owned object: freed when the pending
+        # task drops it (or pinned longer by a lineage entry)
+        arg_refs.append(ObjectRef(oid, owner_addr=self.address))
         return {"args_oid": oid.binary(), "args_owner": self.address}
 
     def submit_task(self, fn_key: str, args: tuple, kwargs: dict,
@@ -599,7 +944,7 @@ class CoreWorker:
             with tracing.span(f"task::{spec['name']}", kind="producer",
                               attributes={"task_id": task_id.hex()}):
                 spec["trace_ctx"] = tracing.current_context()
-        spec.update(self._pack_args(args, kwargs))
+        spec.update(self._pack_args(args, kwargs, arg_refs))
         for oid in return_ids:
             self.owned.add(oid)
             # create events eagerly on the io loop so get() can wait
@@ -647,8 +992,14 @@ class CoreWorker:
         if kind == "inline":
             self._resolve(oid, serialization.loads_inline(payload))
         else:
-            self._resolve(oid, _IN_SHM)
+            self._resolve(oid, self._shm_marker(payload))
         return True
+
+    def _shm_marker(self, loc: Optional[dict]):
+        """Location dict from an executing worker -> memory-store marker."""
+        if not loc or loc.get("host") == self.host_id:
+            return _IN_SHM
+        return _RemoteShm.from_loc(loc)
 
     def _wait_stream_item(self, oid: ObjectID):
         """Block until a stream slot resolves; returns the RAW memory-
@@ -675,7 +1026,11 @@ class CoreWorker:
             return True
         actor_id = pending.spec.get("actor_id")
         if actor_id is not None:
-            self._actor_inflight.get(actor_id, set()).discard(task_id)
+            inflight = self._actor_inflight.get(actor_id, set())
+            inflight.discard(task_id)
+            if not inflight and actor_id in self._kill_when_drained:
+                self._kill_when_drained.discard(actor_id)
+                asyncio.ensure_future(self._drain_kill(actor_id))
         if pending.spec.get("num_returns") in ("streaming", "dynamic"):
             # terminate the stream: sentinel (ok) or the error, placed at
             # the first slot the consumer hasn't received. Streaming
@@ -706,11 +1061,15 @@ class CoreWorker:
             return True
         if status == "ok":
             self.pending_tasks.pop(tid, None)
+            shm_any = False
             for oid, (kind, payload) in zip(pending.return_ids, results):
                 if kind == "inline":
                     self._resolve(oid, serialization.loads_inline(payload))
                 else:
-                    self._resolve(oid, _IN_SHM)
+                    shm_any = True
+                    self._resolve(oid, self._shm_marker(payload))
+            if shm_any and pending.spec.get("type") == "task":
+                self._remember_lineage(pending)
             self._record_event(tid, pending.spec.get("name", ""), "FINISHED")
         elif status == "app_error":
             err = serialization.loads_inline(error)
@@ -743,14 +1102,38 @@ class CoreWorker:
             for oid in pending.return_ids:
                 self._resolve(oid, exceptions.WorkerCrashedError("resubmit failed"))
 
-    # handler: a borrower asks us (the owner) for an object
-    async def _h_fetch_object(self, oid: bytes):
+    # handler: a borrower asks us (the owner) for an object. The reply is
+    # host-aware (the owner doubles as the object directory; ref:
+    # ownership_object_directory.cc): same-host borrowers read the shared
+    # pool directly, cross-host borrowers get a location to pull from.
+    def _shm_reply(self, obj_id: ObjectID, host: Optional[str]):
+        # serve from OUR server (this process can always read its own
+        # pool; the host may not run a nodelet when the owner is a
+        # remotely-connected driver)
+        if host in (None, self.host_id):
+            return ("shm", None)
+        return ("remote", {"host": self.host_id,
+                           "node_addr": self.address,
+                           "size": self.store.size_of(obj_id)})
+
+    async def _h_fetch_object(self, oid: bytes, host: str = None,
+                              lost: bool = False):
         obj_id = ObjectID(oid)
+        if lost:
+            # a borrower failed to pull the copy we pointed it at; verify
+            # and reconstruct before answering again
+            value = self.memory_store.get(obj_id, _MISSING)
+            if isinstance(value, _RemoteShm) or (
+                    value is _IN_SHM and not self.store.contains(obj_id)):
+                self.memory_store.pop(obj_id, None)
+            if self.memory_store.get(obj_id, _MISSING) is _MISSING \
+                    and not self.store.contains(obj_id):
+                await self._recover(obj_id, "reported lost by borrower")
         if obj_id not in self.memory_store:
             if obj_id in self._events or obj_id in self.owned:
                 await self._event(obj_id).wait()
             elif self.store.contains(obj_id):
-                return ("shm", None)
+                return self._shm_reply(obj_id, host)
             else:
                 # the borrower can race ahead of our registration (its
                 # fetch rides a different socket than our submit path);
@@ -764,13 +1147,20 @@ class CoreWorker:
                         await self._event(obj_id).wait()
                         break
                     if self.store.contains(obj_id):
-                        return ("shm", None)
+                        return self._shm_reply(obj_id, host)
                 else:
                     raise exceptions.ObjectLostError(
                         obj_id.hex(), "not owned here")
         value = self.memory_store.get(obj_id)
         if value is _IN_SHM:
-            return ("shm", None)
+            return self._shm_reply(obj_id, host)
+        if isinstance(value, _RemoteShm):
+            # we know where it lives but have not materialized it locally
+            if host == value.host:
+                return ("shm", None)
+            return ("remote", {"host": value.host,
+                               "node_addr": value.node_addr,
+                               "size": value.size})
         return ("inline", serialization.dumps_inline(value))
 
     # ------------------------------------------------------------ actors
@@ -793,7 +1183,9 @@ class CoreWorker:
             "runtime_env": opts.get("runtime_env"),
             "owner_addr": self.address,
         }
-        spec.update(self._pack_args(args, kwargs))
+        # pin creation-arg blobs for the actor's lifetime: restarts
+        # re-read args_oid from the owner
+        spec.update(self._pack_args(args, kwargs, self._actor_arg_pins))
         res = self.controller.call("register_actor", actor_id=actor_id, spec=spec)
         if res["status"] == "name_taken":
             raise ValueError(
@@ -842,8 +1234,8 @@ class CoreWorker:
             "seq": seq,
             "max_retries": 0,
         }
-        spec.update(self._pack_args(args, kwargs))
         arg_refs = _collect_refs(args, kwargs)
+        spec.update(self._pack_args(args, kwargs, arg_refs))
         for oid in return_ids:
             self.owned.add(oid)
         loop = EventLoopThread.get().loop
@@ -923,6 +1315,29 @@ class CoreWorker:
         self.controller.call("kill_actor", actor_id=actor_id,
                              no_restart=no_restart)
         self._actor_addr.pop(actor_id, None)
+
+    def release_actor_handle(self, actor_id: str):
+        """Owner dropped its (owning) handle: gracefully kill the actor,
+        but only after every call THIS owner already submitted resolves —
+        the kill must never overtake an in-flight call."""
+        try:
+            loop = EventLoopThread.get().loop
+            loop.call_soon_threadsafe(self._release_actor_handle, actor_id)
+        except Exception:
+            pass
+
+    def _release_actor_handle(self, actor_id: str):
+        if self._actor_inflight.get(actor_id):
+            self._kill_when_drained.add(actor_id)
+        else:
+            asyncio.ensure_future(self._drain_kill(actor_id))
+
+    async def _drain_kill(self, actor_id: str):
+        try:
+            await self.controller.call_async(
+                "kill_actor", actor_id=actor_id, no_restart=True, drain=True)
+        except Exception:
+            pass
 
     # ------------------------------------------------------------ misc
     def cancel(self, ref: ObjectRef, force: bool = False):

@@ -121,13 +121,23 @@ class Session:
         finally:
             client.close()
 
-    def add_node(self, num_cpus=1, num_tpus=None, resources=None, labels=None):
+    def add_node(self, num_cpus=1, num_tpus=None, resources=None, labels=None,
+                 env: Optional[Dict[str, str]] = None):
         """Start an extra nodelet process on this host — the multi-node test
-        fixture (ref: python/ray/cluster_utils.py:135 Cluster.add_node)."""
+        fixture (ref: python/ray/cluster_utils.py:135 Cluster.add_node).
+        `env` overrides let tests simulate a separate HOST (e.g.
+        RTPU_HOST_ID + RTPU_SHM_ROOT give the node its own object pool, so
+        object movement exercises the cross-host transfer tier)."""
         node_id = NodeID.from_random().hex()
-        addr = f"unix:{self.session_dir}/sock/nodelet-{node_id[:8]}.sock"
+        if env and env.get("RTPU_HOST_ID"):
+            # a simulated separate host needs a cross-"host"-reachable
+            # address; unix sockets only look host-local
+            addr = "tcp:127.0.0.1:0"
+        else:
+            addr = f"unix:{self.session_dir}/sock/nodelet-{node_id[:8]}.sock"
         log = open(os.path.join(self.session_dir, "logs",
                                 f"nodelet-{node_id[:8]}.log"), "ab")
+        proc_env = dict(os.environ, **(env or {}))
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.runtime.nodelet",
              "--session-name", self.session_name,
@@ -138,7 +148,8 @@ class Session:
              "--resources", json.dumps(_detect_resources(num_cpus, num_tpus,
                                                          resources)),
              "--labels", json.dumps(labels or {})],
-            stdout=log, stderr=subprocess.STDOUT, start_new_session=True)
+            stdout=log, stderr=subprocess.STDOUT, env=proc_env,
+            start_new_session=True)
         self._extra_nodelet_procs.append(proc)
         # wait for registration
         deadline = time.time() + 20

@@ -31,7 +31,7 @@ from typing import Any, Dict, List, Optional
 from .. import exceptions
 from . import serialization
 from .config import get_config
-from .ids import NodeID, TaskID, WorkerID
+from .ids import NodeID, ObjectID, TaskID, WorkerID
 from .rpc import RpcClient, RpcServer, ServerConn
 
 
@@ -120,8 +120,19 @@ class Nodelet:
         self._factory_proc = None
         self._factory_path = os.path.join(
             session_dir, "sock", f"factory-{node_id[:8]}.sock")
+        self._store = None  # lazy: object-manager reads only
+        from .object_store import host_id as _host_id
+
+        self.host_id = _host_id()
 
     def _handlers(self):
+        from .object_store import om_handlers
+
+        handlers = om_handlers(lambda: self.store)
+        handlers.update(self._base_handlers())
+        return handlers
+
+    def _base_handlers(self):
         return {
             "submit_task": self.submit_task,
             "lease_worker_for_actor": self.lease_worker_for_actor,
@@ -142,10 +153,12 @@ class Nodelet:
     # ------------------------------------------------------------ lifecycle
     async def start(self):
         await self._server.start()
+        self.address = self._server.address  # ephemeral tcp port resolved
         self._start_factory()
         await self.controller.call_async(
             "register_node", node_id=self.node_id, address=self.address,
-            resources=self.total_resources, labels=self.labels)
+            resources=self.total_resources,
+            labels=dict(self.labels, **{"rtpu.host_id": self.host_id}))
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._bg.append(asyncio.ensure_future(self._reap_loop()))
         for _ in range(get_config().prestart_workers):
@@ -211,7 +224,12 @@ class Nodelet:
 
     # ------------------------------------------------------------ worker pool
     def _start_worker(self, force: bool = False):
-        if not force and self.starting + len(self.workers) >= self.max_workers:
+        # the pool cap applies to TASK workers only: actor workers are
+        # explicit user-created processes (force-started, resource-bounded)
+        # and must not wedge task scheduling by filling the cap
+        n_task_workers = self.starting + sum(
+            1 for w in self.workers.values() if not w.is_actor)
+        if not force and n_task_workers >= self.max_workers:
             return
         self.starting += 1
         worker_id = WorkerID.from_random().hex()
@@ -486,12 +504,17 @@ class Nodelet:
             self.cancelled.discard(spec["task_id"])
             await self._report_cancelled(spec)
             return True
-        if not self._feasible_ever(spec) and not spec.get("_spilled"):
-            # not runnable on this node at all: spill to another node via the
+        strategy = spec.get("scheduling_strategy") or ""
+        affinity_elsewhere = (
+            strategy.startswith("NODE_AFFINITY:")
+            and strategy.split(":")[1] != self.node_id)
+        if (affinity_elsewhere or not self._feasible_ever(spec)) \
+                and not spec.get("_spilled"):
+            # not runnable here (or pinned elsewhere): route via the
             # controller (ref: cluster_task_manager.cc:422 ScheduleOnNode)
             target = await self.controller.call_async(
                 "pick_node", resources=spec.get("resources", {}),
-                strategy=spec.get("scheduling_strategy") or "HYBRID",
+                strategy=strategy or "HYBRID",
                 placement_group_id=spec.get("placement_group_id"),
                 bundle_index=spec.get("bundle_index", -1))
             if target is not None and target["node_id"] != self.node_id:
@@ -502,6 +525,26 @@ class Nodelet:
                     return True
                 finally:
                     client.close()
+            if affinity_elsewhere and not strategy.endswith(":soft") and (
+                    target is None or target["node_id"] != self.node_id):
+                # hard affinity to a node that cannot take it right now:
+                # fail fast if the target is dead/unknown, else retry
+                # instead of running in the wrong place
+                target_node = strategy.split(":")[1]
+                try:
+                    nodes = await self.controller.call_async("list_nodes")
+                    info = nodes.get(target_node)
+                except Exception:
+                    info = {"alive": True}  # controller hiccup: keep trying
+                if info is None or not info.get("alive"):
+                    await self._report_failure(
+                        spec, f"NODE_AFFINITY target {target_node} is dead "
+                              "or was never registered")
+                    return True
+                loop = asyncio.get_running_loop()
+                loop.call_later(0.5, lambda: asyncio.ensure_future(
+                    self.submit_task(spec)))
+                return True
         self.queue.append(spec)
         self._dispatch()
         return True
@@ -582,6 +625,11 @@ class Nodelet:
     def _owner_client(self, address: str) -> RpcClient:
         client = self._owner_clients.get(address)
         if client is None:
+            # bound the cache: exited drivers leave dead entries behind
+            while len(self._owner_clients) >= 64:
+                old_addr, old = next(iter(self._owner_clients.items()))
+                del self._owner_clients[old_addr]
+                old.close()
             client = RpcClient(address)
             self._owner_clients[address] = client
         return client
@@ -672,6 +720,19 @@ class Nodelet:
         return True
 
     # ------------------------------------------------------------ objects
+    #
+    # The nodelet doubles as this host's object manager (ref:
+    # src/ray/object_manager/object_manager.h:119): peers pull objects out
+    # of the host pool in chunks, independent of the producing worker's
+    # lifetime — the pool outlives workers.
+    @property
+    def store(self):
+        if self._store is None:
+            from .object_store import make_store_client
+
+            self._store = make_store_client(self.session_name)
+        return self._store
+
     async def object_sealed(self, oid: bytes, size: int):
         self.object_bytes += size
         return True

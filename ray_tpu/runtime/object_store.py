@@ -33,8 +33,21 @@ def _aligned(n: int) -> int:
     return (n + _ALIGN - 1) & ~(_ALIGN - 1)
 
 
+def host_id() -> str:
+    """Identity of this host's object pool. Processes sharing a host_id
+    MUST share a pool (they exchange bare shm references); distinct
+    host_ids exchange objects via chunked node-to-node transfer. Tests
+    simulate multiple hosts on one box by overriding RTPU_HOST_ID +
+    RTPU_SHM_ROOT together (the reference's cluster_utils.Cluster
+    equivalent for the data plane)."""
+    import socket
+
+    return os.environ.get("RTPU_HOST_ID") or socket.gethostname()
+
+
 def _shm_dir(session_name: str) -> str:
-    return f"/dev/shm/rtpu_{session_name}"
+    root = os.environ.get("RTPU_SHM_ROOT", "/dev/shm")
+    return os.path.join(root, f"rtpu_{session_name}")
 
 
 def _seg_path(session_name: str, oid: ObjectID) -> str:
@@ -163,6 +176,38 @@ class ObjectStoreClient:
             return os.stat(_seg_path(self.session_name, oid)).st_size
         except FileNotFoundError:
             return None
+
+    # ---- node-to-node transfer (object-manager tier; ref:
+    # src/ray/object_manager/object_manager.h:119 chunked push/pull) ----
+    def read_range(self, oid: ObjectID, offset: int, length: int) -> bytes:
+        with open(_seg_path(self.session_name, oid), "rb") as f:
+            return os.pread(f.fileno(), length, offset)
+
+    def create_for_ingest(self, oid: ObjectID, size: int) -> "_FileIngest":
+        return _FileIngest(_seg_path(self.session_name, oid), size)
+
+
+class _FileIngest:
+    """Chunk-at-a-time writer for objects pulled from another node;
+    invisible to readers until seal() (same .tmp+rename publish as put)."""
+
+    def __init__(self, path: str, size: int):
+        self._seg = _Segment.create(path, max(size, 1))
+
+    def write_at(self, offset: int, data: bytes) -> None:
+        self._seg.mm[offset:offset + len(data)] = data
+
+    def seal(self) -> None:
+        self._seg.seal()
+        self._seg.close()
+
+    def abort(self) -> None:
+        path = self._seg.path
+        self._seg.close()
+        try:
+            os.unlink(path + ".tmp")
+        except OSError:
+            pass
 
 
 class NativeObjectStoreClient:
@@ -296,6 +341,45 @@ class NativeObjectStoreClient:
     def stats(self) -> dict:
         return self._pool.stats()
 
+    # ---- node-to-node transfer (object-manager tier) ----
+    def read_range(self, oid: ObjectID, offset: int, length: int) -> bytes:
+        key = self._key(oid)
+        raw = self._pool.get_raw(key)  # bumps refcount: pins across read
+        if raw is None:
+            raise FileNotFoundError(oid.hex())
+        try:
+            file_off, size = raw
+            length = min(length, size - offset)
+            return os.pread(self._fd, length, file_off + offset)
+        finally:
+            self._pool.release(key)
+
+    def create_for_ingest(self, oid: ObjectID, size: int) -> "_PoolIngest":
+        key = self._key(oid)
+        mv = self._pool.create(key, max(size, 1))
+        return _PoolIngest(self._pool, key, mv)
+
+
+class _PoolIngest:
+    def __init__(self, pool, key: bytes, mv):
+        self._pool = pool
+        self._key = key
+        self._mv = mv
+
+    def write_at(self, offset: int, data: bytes) -> None:
+        self._mv[offset:offset + len(data)] = data
+
+    def seal(self) -> None:
+        self._mv.release()
+        self._pool.seal(self._key)
+
+    def abort(self) -> None:
+        self._mv.release()
+        try:
+            self._pool.delete(self._key)
+        except Exception:
+            pass
+
 
 def make_store_client(session_name: str):
     """Native pool when the toolchain/lib is available (default),
@@ -312,6 +396,25 @@ def make_store_client(session_name: str):
         except Exception:
             pass
     return ObjectStoreClient(session_name)
+
+
+def om_handlers(get_store) -> dict:
+    """RPC handlers for the object-manager read tier, shared by every
+    process that serves its pool to peers (nodelets and owners)."""
+    import asyncio
+
+    async def om_meta(oid: bytes):
+        return get_store().size_of(ObjectID(oid))
+
+    async def om_read(oid: bytes, offset: int, length: int):
+        loop = asyncio.get_event_loop()
+        try:
+            return await loop.run_in_executor(
+                None, get_store().read_range, ObjectID(oid), offset, length)
+        except FileNotFoundError:
+            return None
+
+    return {"om_meta": om_meta, "om_read": om_read}
 
 
 def cleanup_session(session_name: str):

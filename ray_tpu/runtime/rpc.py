@@ -167,6 +167,27 @@ def parse_address(address: str):
     raise ValueError(f"bad address {address!r}")
 
 
+def advertise_ip(peer_host: Optional[str] = None) -> str:
+    """This host's externally-reachable IP (RTPU_ADVERTISE_HOST overrides;
+    otherwise a UDP-connect probe towards the peer/default route)."""
+    import socket as socket_mod
+
+    override = os.environ.get("RTPU_ADVERTISE_HOST")
+    if override:
+        return override
+    probe_target = peer_host if peer_host and peer_host not in (
+        "0.0.0.0", "127.0.0.1", "localhost") else "8.8.8.8"
+    try:
+        s = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_DGRAM)
+        try:
+            s.connect((probe_target, 9))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
 async def _open_connection(address: str):
     parsed = parse_address(address)
     if parsed[0] == "unix":
@@ -223,7 +244,14 @@ class RpcServer:
                 os.unlink(parsed[1])
             self._server = await asyncio.start_unix_server(self._on_conn, parsed[1])
         else:
-            self._server = await asyncio.start_server(self._on_conn, parsed[1], parsed[2])
+            host, port = parsed[1], parsed[2]
+            self._server = await asyncio.start_server(
+                self._on_conn, host or None, port)
+            # ephemeral port / wildcard bind: advertise the real endpoint
+            real_port = self._server.sockets[0].getsockname()[1]
+            adv_host = advertise_ip() if host in ("0.0.0.0", "") else host
+            if port == 0 or host in ("0.0.0.0", ""):
+                self.address = f"tcp:{adv_host}:{real_port}"
         _local_servers[self.address] = self
 
     async def stop(self):
@@ -383,7 +411,7 @@ class RpcClient:
         if _get_chaos().should_drop_request(method):
             if one_way:
                 return None
-            if _timeout:
+            if _timeout is not None:
                 await asyncio.wait_for(_hang_forever(), _timeout)
             await _hang_forever()
         if self._local_conn is None or self._local_conn.server is not server:
@@ -396,7 +424,7 @@ class RpcClient:
                 kwargs = dict(kwargs, _conn=self._local_conn)
             result = handler(**kwargs)
             if asyncio.iscoroutine(result):
-                if _timeout:
+                if _timeout is not None:
                     result = await asyncio.wait_for(result, _timeout)
                 else:
                     result = await result
@@ -486,7 +514,7 @@ class RpcClient:
         async with self._wlock:
             self._writer.write(_frame(payload))
             await self._writer.drain()
-        if _timeout:
+        if _timeout is not None:
             return await asyncio.wait_for(fut, _timeout)
         return await fut
 

@@ -93,6 +93,11 @@ class Executor:
         self._expected_seq: Dict[str, int] = collections.defaultdict(int)
         self._seq_buffer: Dict[str, Dict[int, dict]] = collections.defaultdict(dict)
         self.shutdown_event = threading.Event()
+        # graceful drain: exit once in-flight/queued actor calls finish
+        # (owner-handle fate-sharing must not cut off submitted calls)
+        self._outstanding = 0
+        self._count_lock = threading.Lock()
+        self._draining = False
 
     def handlers(self):
         return {
@@ -100,6 +105,7 @@ class Executor:
             "create_actor": self.h_create_actor,
             "actor_call": self.h_actor_call,
             "kill_self": self.h_kill_self,
+            "drain_exit": self.h_drain_exit,
             "shutdown": self.h_kill_self,
         }
 
@@ -125,7 +131,7 @@ class Executor:
 
     def _run_task(self, spec: dict):
         task_id = spec["task_id"]
-        self._task_done_sent = False
+        done_sent = False
         try:
             # the env context covers function load (module import time),
             # arg deserialization, the call, AND generator consumption
@@ -150,24 +156,13 @@ class Executor:
                     return
                 if inspect.isgenerator(result):
                     result = list(result)
-            self._send_results(spec, result)
+            self._flush_spans(spec)
+            done_sent = self._send_results(spec, result)
         except Exception as e:
-            self._send_error(spec, e)
+            self._flush_spans(spec)
+            done_sent = self._send_error(spec, e)
         finally:
-            if spec.get("trace_ctx"):
-                # flush this task's spans (incl. ERROR spans from failed
-                # tasks) to the controller, one-way so the result path
-                # never blocks on it
-                from ..util import tracing as _tracing
-
-                spans = _tracing.drain()
-                if spans:
-                    try:
-                        self.core.controller.notify("add_trace_spans",
-                                                    spans=spans)
-                    except Exception:
-                        pass
-            if not self._task_done_sent:
+            if not done_sent:
                 try:
                     self.core.nodelet.notify_nowait(
                         "task_finished", worker_id=self.core.worker_id.hex(),
@@ -178,6 +173,23 @@ class Executor:
     def _package(self, value: Any):
         sv = serialization.serialize(value)
         return sv
+
+    def _flush_spans(self, spec: dict) -> None:
+        """Ship this task's spans (incl. ERROR spans) to the controller
+        BEFORE the result: when the caller observes the result, its
+        collect() must already see the execution spans (a one-way flush
+        raced the result and lost under load)."""
+        if not spec.get("trace_ctx"):
+            return
+        from ..util import tracing as _tracing
+
+        spans = _tracing.drain()
+        if spans:
+            try:
+                self.core.controller.call("add_trace_spans", spans=spans,
+                                          _timeout=10)
+            except Exception:
+                pass
 
     def _stream_results(self, spec: dict, gen) -> None:
         """Ship each yield to the owner as it is produced (streaming
@@ -197,20 +209,24 @@ class Executor:
                              index=index, kind="inline",
                              payload=serialization.dumps_inline(value))
             else:
-                self.core.store.put_serialized(oid, sv)
+                size = self.core.store.put_serialized(oid, sv)
                 try:
-                    self.core.nodelet.notify(
-                        "object_sealed", oid=oid.binary(),
-                        size=sv.total_size())
+                    self.core.nodelet.notify_nowait(
+                        "object_sealed", oid=oid.binary(), size=size)
                 except Exception:
                     pass
                 owner.notify("task_stream_item", task_id=spec["task_id"],
-                             index=index, kind="shm", payload=None)
+                             index=index, kind="shm",
+                             payload={"host": self.core.host_id,
+                                      "node_addr": self.core.nodelet_addr,
+                                      "size": size})
             index += 1
         owner.notify("task_result", task_id=spec["task_id"], status="ok",
                      results=[], stream_len=index)
 
-    def _send_results(self, spec: dict, result: Any):
+    def _send_results(self, spec: dict, result: Any) -> bool:
+        """Returns True if the combined task_done frame (result + worker
+        free) was sent, False if only the result went out."""
         num_returns = spec.get("num_returns", 1)
         if num_returns == 1:
             values = [result]
@@ -228,17 +244,22 @@ class Executor:
                 results.append(("inline", serialization.dumps_inline(value)))
             else:
                 oid = ObjectID.for_task_return(task_id, i)
-                self.core.store.put_serialized(oid, sv)
+                size = self.core.store.put_serialized(oid, sv)
                 try:
-                    self.core.nodelet.notify("object_sealed", oid=oid.binary(),
-                                             size=sv.total_size())
+                    self.core.nodelet.notify_nowait(
+                        "object_sealed", oid=oid.binary(), size=size)
                 except Exception:
                     pass
-                results.append(("shm", None))
-        self._deliver_result(spec, {"task_id": spec["task_id"],
-                                    "status": "ok", "results": results})
+                # location rides with the result: a cross-host owner pulls
+                # from this host's nodelet (object-manager tier)
+                results.append(("shm", {"host": self.core.host_id,
+                                        "node_addr": self.core.nodelet_addr,
+                                        "size": size}))
+        return self._deliver_result(spec, {"task_id": spec["task_id"],
+                                           "status": "ok",
+                                           "results": results})
 
-    def _send_error(self, spec: dict, exc: Exception):
+    def _send_error(self, spec: dict, exc: Exception) -> bool:
         if isinstance(exc, exceptions.RtpuError):
             err = exc
         else:
@@ -246,29 +267,32 @@ class Executor:
                 type(exc).__name__, repr(exc), traceback.format_exc(),
                 task_desc=spec.get("name", "task"))
         try:
-            self._deliver_result(spec, {
+            return self._deliver_result(spec, {
                 "task_id": spec["task_id"], "status": "app_error",
                 "error": serialization.dumps_inline(err)})
         except Exception:
             traceback.print_exc()
+            return False
 
-    def _deliver_result(self, spec: dict, result: dict):
+    def _deliver_result(self, spec: dict, result: dict) -> bool:
         """One send per finished plain task: result + worker-free ride the
         same frame to the nodelet, which forwards task_result to the owner
         (in-process dispatch when the owner is the driver). Actor calls and
         streaming tasks keep the direct owner socket — actor results never
         involve the nodelet, and stream items must stay FIFO with their
-        terminator on one connection."""
+        terminator on one connection. Returns True when the combined
+        task_done frame was used (no separate task_finished needed)."""
         if spec.get("type") == "task" and \
                 spec.get("num_returns") not in ("streaming", "dynamic"):
-            self._task_done_sent = True
             self.core.nodelet.notify_nowait(
                 "task_done", worker_id=self.core.worker_id.hex(),
                 task_id=spec["task_id"], owner_addr=spec["owner_addr"],
                 result=result)
-        else:
-            owner = self.core.client_for(spec["owner_addr"])
-            owner.notify_nowait("task_result", **result)
+            return True
+        owner = self.core.client_for(spec["owner_addr"])
+        owner.notify_nowait("task_result", **result)
+        self._maybe_drain_exit()
+        return False
 
     # ------------------------------------------------------------ actors
     async def h_create_actor(self, spec: dict):
@@ -307,6 +331,8 @@ class Executor:
             self.shutdown_event.set()
 
     async def h_actor_call(self, spec: dict):
+        with self._count_lock:
+            self._outstanding += 1
         caller = spec["caller_id"]
         seq = spec["seq"]
         buf = self._seq_buffer[caller]
@@ -390,6 +416,36 @@ class Executor:
             self._send_results(spec, result)
         except Exception as e:
             self._send_error(spec, e)
+
+    def _maybe_drain_exit(self):
+        """Called after each actor-call result: finish the drain once no
+        calls are in flight or buffered."""
+        if self.actor_id is None:
+            return
+        with self._count_lock:
+            self._outstanding = max(0, self._outstanding - 1)
+            idle = self._outstanding == 0
+        if self._draining and idle:
+            self._exit_actor("drained after owner handle release")
+
+    def _exit_actor(self, reason: str):
+        try:
+            self.core.nodelet.notify_nowait(
+                "actor_exited", worker_id=self.core.worker_id.hex(),
+                actor_id=self.actor_id, reason=reason, intended=True)
+        except Exception:
+            pass
+        self.shutdown_event.set()
+
+    async def h_drain_exit(self):
+        """Graceful fate-sharing kill (owner dropped its handle): finish
+        everything already submitted, then exit."""
+        self._draining = True
+        with self._count_lock:
+            idle = self._outstanding == 0
+        if idle:
+            self._exit_actor("owner handle released")
+        return True
 
     # ------------------------------------------------------------ control
     async def h_kill_self(self):

@@ -51,6 +51,7 @@ def serve(args) -> None:
     # backlog (instead of failing over to cold starts), then warm
     # everything a worker needs so children inherit imported modules.
     sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    os.makedirs(os.path.dirname(args.listen), exist_ok=True)
     if os.path.exists(args.listen):
         os.unlink(args.listen)
     sock.bind(args.listen)

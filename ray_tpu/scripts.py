@@ -96,12 +96,170 @@ def cmd_metrics(args):
     print(json.dumps(state.cluster_metrics(), indent=2, default=str))
 
 
+def cmd_start(args):
+    """`ray_tpu start --head` / `ray_tpu start --address tcp:HOST:PORT` —
+    multi-host bring-up (ref: python/ray/scripts/scripts.py:684 `ray
+    start`). Head: controller + nodelet over TCP; worker: a nodelet that
+    joins an existing controller. Processes are detached; `stop` kills
+    them via the session pidfile."""
+    import json as json_mod
+    import subprocess
+    import time
+
+    from .runtime.rpc import RpcClient, advertise_ip
+
+    if not args.head and not args.address:
+        print("pass --head or --address tcp:HOST:PORT", file=sys.stderr)
+        sys.exit(1)
+    resources = json_mod.loads(args.resources) if args.resources else {}
+    if args.num_cpus is not None:
+        resources["CPU"] = float(args.num_cpus)
+    if args.num_tpus is not None:
+        resources["TPU"] = float(args.num_tpus)
+
+    pids = []
+    if args.head:
+        session_name = args.session_name or f"cluster_{args.port}"
+        session_dir = f"/tmp/ray_tpu/{session_name}"
+        os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+        controller_addr = f"tcp:0.0.0.0:{args.port}"
+        log = open(os.path.join(session_dir, "logs", "controller.log"), "ab")
+        cmd = [sys.executable, "-m", "ray_tpu.runtime.controller",
+               "--session-name", session_name,
+               "--address", controller_addr]
+        if args.persist_dir:
+            cmd += ["--persist-dir", args.persist_dir]
+        proc = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
+                                start_new_session=True)
+        pids.append(proc.pid)
+        # record immediately: a readiness-wait failure must leave `stop`
+        # able to find this process
+        with open(os.path.join(session_dir, "head.pids"), "a") as f:
+            f.write(f"{proc.pid}\n")
+        public_addr = f"tcp:{advertise_ip()}:{args.port}"
+        _wait_ping(public_addr, 30)
+    else:
+        public_addr = args.address
+        client = RpcClient(public_addr)
+        session_name = client.call("cluster_status", _timeout=30)["session_name"]
+        client.close()
+        session_dir = f"/tmp/ray_tpu/{session_name}"
+        os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+
+    from .runtime.ids import NodeID
+    from .runtime.node import _detect_resources
+
+    node_id = NodeID.from_random().hex()
+    log = open(os.path.join(session_dir, "logs",
+                            f"nodelet-{node_id[:8]}.log"), "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.runtime.nodelet",
+         "--session-name", session_name,
+         "--session-dir", session_dir,
+         "--node-id", node_id,
+         "--address", "tcp:0.0.0.0:0",
+         "--controller-addr", public_addr,
+         "--resources", json_mod.dumps(_detect_resources(
+             resources.pop("CPU", None), resources.pop("TPU", None),
+             resources)),
+         "--labels", "{}"],
+        stdout=log, stderr=subprocess.STDOUT, start_new_session=True)
+    pids.append(proc.pid)
+    # record BEFORE the readiness wait: a timeout must leave `stop` able
+    # to find and kill the already-started nodelet
+    with open(os.path.join(session_dir, "head.pids" if args.head
+                           else f"node-{node_id[:8]}.pids"), "a") as f:
+        f.write(f"{proc.pid}\n")
+    _wait_node(public_addr, node_id, 60)
+    print(f"ray_tpu {'head' if args.head else 'node'} started.")
+    print(f"  address: {public_addr}")
+    if args.head:
+        print(f"  connect: ray_tpu.init(address={public_addr!r})")
+        print(f"  add workers: python -m ray_tpu start --address {public_addr}")
+
+
+def _wait_ping(address, timeout):
+    import time
+
+    from .runtime.rpc import RpcClient
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            client = RpcClient(address)
+            client.call("ping", _timeout=5)
+            client.close()
+            return
+        except Exception:
+            time.sleep(0.2)
+    print(f"timed out waiting for {address}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _wait_node(address, node_id, timeout):
+    import time
+
+    from .runtime.rpc import RpcClient
+
+    deadline = time.time() + timeout
+    client = RpcClient(address)
+    try:
+        while time.time() < deadline:
+            try:
+                if node_id in client.call("list_nodes", _timeout=5):
+                    return
+            except Exception:
+                pass
+            time.sleep(0.2)
+    finally:
+        client.close()
+    print("nodelet failed to register", file=sys.stderr)
+    sys.exit(1)
+
+
+def cmd_stop(args):
+    """Kill processes recorded in session pidfiles (`ray stop` equivalent:
+    ref scripts.py:1199)."""
+    import signal
+
+    pidfiles = glob.glob("/tmp/ray_tpu/*/head.pids") + \
+        glob.glob("/tmp/ray_tpu/*/node-*.pids")
+    killed = 0
+    for pf in pidfiles:
+        with open(pf) as f:
+            for line in f:
+                try:
+                    os.kill(int(line.strip()), signal.SIGTERM)
+                    killed += 1
+                except (ValueError, OSError):
+                    pass
+        os.unlink(pf)
+    print(f"stopped {killed} process(es)")
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="ray_tpu", description="TPU-native distributed runtime CLI")
     parser.add_argument("--address", help="controller address "
                         "(default: newest local session)")
     sub = parser.add_subparsers(dest="command", required=True)
+
+    p_start = sub.add_parser("start", help="start cluster processes")
+    p_start.add_argument("--head", action="store_true",
+                         help="start controller + first nodelet")
+    p_start.add_argument("--address", dest="address",
+                         help="join an existing controller (worker node)")
+    p_start.add_argument("--port", type=int, default=6380)
+    p_start.add_argument("--session-name", default=None)
+    p_start.add_argument("--num-cpus", type=float, default=None)
+    p_start.add_argument("--num-tpus", type=float, default=None)
+    p_start.add_argument("--resources", default=None, help="JSON dict")
+    p_start.add_argument("--persist-dir", default=None,
+                         help="controller FT journal directory")
+    p_start.set_defaults(func=cmd_start)
+
+    sub.add_parser("stop", help="stop started cluster processes"
+                   ).set_defaults(func=cmd_stop)
 
     sub.add_parser("status", help="cluster resource status"
                    ).set_defaults(func=cmd_status)

@@ -1,0 +1,145 @@
+"""Distributed reference counting with borrowing + lineage reconstruction.
+
+Ref: src/ray/core_worker/reference_count.cc (borrowing protocol) and
+object_recovery_manager.h:43 / task_manager.h:182 (lineage re-execution).
+The TPU-native design is simpler than the reference's task-reply borrower
+lists: borrower processes register with the owner directly on first
+deserialize and deregister when their last local ref drops; the owner
+defers deletion while borrows are outstanding. Lost shm objects whose
+producing task is in the owner's lineage table are reconstructed by
+re-executing the task.
+"""
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+
+@pytest.fixture
+def session():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    s = ray_tpu.init(num_cpus=2)
+    yield s
+    ray_tpu.shutdown()
+
+
+def test_borrower_survives_owner_dropping_ref(session):
+    """An actor holding a borrowed ref keeps the object alive after the
+    owner (driver) drops its last local reference."""
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.ref = None
+
+        def hold(self, refs):
+            self.ref = refs[0]
+            return True
+
+        def read(self):
+            return float(ray_tpu.get(self.ref).sum())
+
+    holder = Holder.remote()
+    payload = np.ones(1 << 20)  # 8 MB -> shm, not inline
+    ref = ray_tpu.put(payload)
+    # pass inside a container so the actor deserializes a BORROWED ref
+    # (top-level args are resolved to values before the call)
+    assert ray_tpu.get(holder.hold.remote([ref]), timeout=60)
+    del ref
+    gc.collect()
+    time.sleep(1.0)  # let any (incorrect) deletion happen
+    assert ray_tpu.get(holder.read.remote(), timeout=60) == float(1 << 20)
+
+
+def test_owner_deletes_after_borrowers_drain(session):
+    """Once the borrower also drops the ref, the owner's deferred delete
+    runs and the pool entry disappears."""
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.ref = None
+
+        def hold(self, refs):
+            self.ref = refs[0]
+            return True
+
+        def drop(self):
+            self.ref = None
+            return True
+
+    holder = Holder.remote()
+    ref = ray_tpu.put(np.ones(1 << 20))
+    oid = ref.id()
+    assert ray_tpu.get(holder.hold.remote([ref]), timeout=60)
+    del ref
+    gc.collect()
+    time.sleep(0.5)
+    from ray_tpu.runtime.core import get_core
+
+    core = get_core()
+    assert core.store.contains(oid)  # borrow defers deletion
+    assert ray_tpu.get(holder.drop.remote(), timeout=60)
+    deadline = time.time() + 15
+    while time.time() < deadline and core.store.contains(oid):
+        time.sleep(0.2)
+    assert not core.store.contains(oid)
+
+
+def test_lineage_reconstruction_after_node_death(tmp_path):
+    """Kill the node holding a task result before it is ever read; get()
+    re-executes the producing task (ref: object_recovery_manager.h:43)."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    session = ray_tpu.init(num_cpus=2)
+    try:
+        pool_b = str(tmp_path / "hostB_shm")
+        node_b = session.add_node(
+            num_cpus=2, env={"RTPU_HOST_ID": "sim-host-b",
+                             "RTPU_SHM_ROOT": pool_b})
+
+        @ray_tpu.remote(max_retries=2)
+        def produce():
+            return np.full(1 << 20, 3.25)  # 8 MB
+
+        ref = produce.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=node_b, soft=True)).remote()
+        # wait for completion WITHOUT materializing (the value stays in
+        # host B's pool; the owner only holds a location marker)
+        ready, _ = ray_tpu.wait([ref], timeout=120, fetch_local=False)
+        assert ready
+        # kill host B: the only copy dies with its pool
+        for proc in session._extra_nodelet_procs:
+            proc.kill()
+        time.sleep(1.0)
+        value = ray_tpu.get(ref, timeout=120)  # must reconstruct
+        assert value[0] == 3.25
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_lineage_reconstruction_preserves_arguments(session):
+    """Re-execution works when the producing task itself consumed a big
+    shm argument (the lineage entry pins it)."""
+    from ray_tpu.runtime.core import get_core
+
+    arg = ray_tpu.put(np.full(1 << 20, 2.0))
+
+    @ray_tpu.remote(max_retries=2)
+    def double(x):
+        return x * 2
+
+    ref = double.remote(arg)
+    assert ray_tpu.get(ref, timeout=60)[0] == 4.0
+    core = get_core()
+    # simulate local loss: evict the result from the pool
+    core.store.delete(ref.id())
+    core.memory_store.pop(ref.id(), None)
+    value = ray_tpu.get(ref, timeout=60)
+    assert value[0] == 4.0
