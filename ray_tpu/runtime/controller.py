@@ -299,7 +299,8 @@ class Controller:
         info.client = RpcClient(address)
         self.nodes[node_id] = info
         await self._publish("node", {"event": "node_added", "node": info.snapshot()})
-        return {"session_name": self.session_name}
+        return {"session_name": self.session_name,
+                "n_nodes": sum(1 for n in self.nodes.values() if n.alive)}
 
     async def heartbeat(self, node_id: str, available_resources: Dict[str, float],
                         load: Dict[str, Any] = None):
@@ -310,7 +311,8 @@ class Controller:
         node.available_resources = available_resources
         if not node.alive:
             node.alive = True
-        return {"registered": True}
+        return {"registered": True,
+                "n_nodes": sum(1 for n in self.nodes.values() if n.alive)}
 
     async def list_nodes(self):
         return {nid: n.snapshot() for nid, n in self.nodes.items()}
@@ -326,6 +328,10 @@ class Controller:
         node.alive = False
         if node.client is not None:
             await node.client.notify_async("shutdown")
+        # same observable event as a health-sweep death: owners with
+        # spilled tasks on this node fail them over on this signal
+        await self._publish("node",
+                            {"event": "node_dead", "node": node.snapshot()})
         await self._handle_node_death(node)
         return True
 

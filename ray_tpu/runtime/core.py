@@ -153,7 +153,7 @@ class _RemoteShm:
 
 class _PendingTask:
     __slots__ = ("spec", "return_ids", "retries_left", "arg_refs",
-                 "submitted_at", "stream_received")
+                 "submitted_at", "stream_received", "node_hint")
 
     def __init__(self, spec, return_ids, retries_left, arg_refs):
         self.spec = spec
@@ -162,6 +162,7 @@ class _PendingTask:
         self.arg_refs = arg_refs  # pin args for the task's lifetime
         self.submitted_at = time.time()
         self.stream_received = 0  # streaming generators: items seen
+        self.node_hint = None  # node executing it, when known (spills)
 
 
 _END_OF_STREAM = object()  # streaming-generator terminator marker
@@ -242,6 +243,7 @@ class CoreWorker:
         self._recovering: Dict[TaskID, asyncio.Future] = {}
         self._actor_arg_pins: list = []  # creation-arg blobs, actor lifetime
         self._kill_when_drained: set = set()  # actor ids awaiting drain-kill
+        self._node_sub = False  # node-death subscription (lazy, on spill)
 
         self._clients: Dict[str, RpcClient] = {}
         self._actor_addr: Dict[str, str] = {}
@@ -260,6 +262,7 @@ class CoreWorker:
     def start(self, extra_handlers: Optional[dict] = None):
         handlers = {
             "task_result": self._h_task_result,
+            "task_spilled": self._h_task_spilled,
             "task_stream_item": self._h_task_stream_item,
             "fetch_object": self._h_fetch_object,
             "borrow_inc": self._h_borrow_inc,
@@ -979,6 +982,41 @@ class CoreWorker:
             # mutated only on the io loop (no lock needed)
             self._actor_inflight.setdefault(actor_id, set()).add(spec["task_id"])
 
+    # handler: the local nodelet spilled our task to another node; track
+    # the placement so that node's death fails the task over (ref: the
+    # owner-side lease in normal_task_submitter.cc observes raylet death;
+    # the push model needs this one notification instead)
+    async def _h_task_spilled(self, task_id: bytes, node_id: str):
+        pending = self.pending_tasks.get(TaskID(task_id))
+        if pending is not None:
+            pending.node_hint = node_id
+            await self._ensure_node_sub()
+        return True
+
+    async def _ensure_node_sub(self):
+        if self._node_sub:
+            return
+        self._node_sub = True  # once: a retried append would double-fail
+        self._pubsub_handlers.setdefault("node", []).append(
+            self._on_node_event)
+        while not self._shutting_down:
+            try:
+                await self.controller.call_async("subscribe", channel="node")
+                return
+            except Exception:
+                await asyncio.sleep(1.0)
+
+    def _on_node_event(self, msg: dict):
+        if msg.get("event") != "node_dead":
+            return
+        dead = msg["node"]["node_id"]
+        for tid, pending in list(self.pending_tasks.items()):
+            if getattr(pending, "node_hint", None) == dead:
+                asyncio.ensure_future(self._h_task_result(
+                    tid.binary() if hasattr(tid, "binary") else tid,
+                    "system_error",
+                    error=f"node {dead[:8]} died with the task in flight"))
+
     # handler: streaming task pushed one yielded item to us (the owner)
     async def _h_task_stream_item(self, task_id: bytes, index: int,
                                   kind: str, payload=None):
@@ -1061,6 +1099,9 @@ class CoreWorker:
             return True
         if status == "ok":
             self.pending_tasks.pop(tid, None)
+            # record BEFORE resolving: once a caller observes the result,
+            # a timeline dump must already include this completion
+            self._record_event(tid, pending.spec.get("name", ""), "FINISHED")
             shm_any = False
             for oid, (kind, payload) in zip(pending.return_ids, results):
                 if kind == "inline":
@@ -1070,7 +1111,6 @@ class CoreWorker:
                     self._resolve(oid, self._shm_marker(payload))
             if shm_any and pending.spec.get("type") == "task":
                 self._remember_lineage(pending)
-            self._record_event(tid, pending.spec.get("name", ""), "FINISHED")
         elif status == "app_error":
             err = serialization.loads_inline(error)
             if pending.spec.get("retry_exceptions") and pending.retries_left > 0:
@@ -1095,6 +1135,7 @@ class CoreWorker:
         return True
 
     async def _resubmit(self, pending: _PendingTask):
+        pending.node_hint = None  # re-placed from scratch
         await asyncio.sleep(get_config().task_retry_delay_s)
         try:
             await self.nodelet.call_async("submit_task", spec=pending.spec)

@@ -117,13 +117,20 @@ class Nodelet:
         self._stopping = False
         self.object_bytes = 0
         self._owner_clients: Dict[str, RpcClient] = {}
+        self.cluster_nodes = 1  # refreshed from heartbeat replies
+        self._respill_tick = 0
         self._factory_proc = None
         self._factory_path = os.path.join(
             session_dir, "sock", f"factory-{node_id[:8]}.sock")
         self._store = None  # lazy: object-manager reads only
         from .object_store import host_id as _host_id
+        from .topology import detect_host_tpu
 
         self.host_id = _host_id()
+        # TPU slice attachment labels (slice name, worker index, topology)
+        # feed the controller's slice-aware gang scheduler
+        for key, value in detect_host_tpu().items():
+            self.labels.setdefault(key, value)
 
     def _handlers(self):
         from .object_store import om_handlers
@@ -155,10 +162,11 @@ class Nodelet:
         await self._server.start()
         self.address = self._server.address  # ephemeral tcp port resolved
         self._start_factory()
-        await self.controller.call_async(
+        reply = await self.controller.call_async(
             "register_node", node_id=self.node_id, address=self.address,
             resources=self.total_resources,
             labels=dict(self.labels, **{"rtpu.host_id": self.host_id}))
+        self.cluster_nodes = reply.get("n_nodes", 1)
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._bg.append(asyncio.ensure_future(self._reap_loop()))
         for _ in range(get_config().prestart_workers):
@@ -193,12 +201,13 @@ class Nodelet:
         while True:
             await asyncio.sleep(cfg.heartbeat_interval_s)
             try:
-                await self.controller.call_async(
+                reply = await self.controller.call_async(
                     "heartbeat", node_id=self.node_id,
                     available_resources=self.available,
                     load={"queued": len(self.queue),
                           "workers": len(self.workers),
                           "object_bytes": self.object_bytes})
+                self.cluster_nodes = reply.get("n_nodes", 1)
             except Exception:
                 pass
 
@@ -221,6 +230,20 @@ class Nodelet:
             if (self.queue or self.pending_actor_leases) and not self.idle \
                     and self.starting == 0:
                 self._dispatch()
+            # periodic respill: backlogged work re-enters placement when
+            # the cluster has other nodes (ref: the reference re-runs
+            # ScheduleAndDispatchTasks on every heartbeat/lease event)
+            self._respill_tick += 1
+            if self._respill_tick >= 3 and self.cluster_nodes > 1:
+                self._respill_tick = 0
+                for spec in [s for s in self.queue
+                             if not s.get("_spilled")
+                             and not self._feasible_now(s)]:
+                    try:
+                        self.queue.remove(spec)
+                    except ValueError:
+                        continue
+                    asyncio.ensure_future(self.submit_task(spec))
 
     # ------------------------------------------------------------ worker pool
     def _start_worker(self, force: bool = False):
@@ -449,6 +472,11 @@ class Nodelet:
     def _feasible_ever(self, spec) -> bool:
         pg_id = spec.get("placement_group_id")
         if pg_id:
+            idx = spec.get("bundle_index", -1)
+            if idx >= 0:
+                # the SPECIFIC bundle must be reserved here — another
+                # bundle of the same group may live on another node
+                return (pg_id, idx) in self.bundles
             return any(k[0] == pg_id for k in self.bundles)
         return _leq(spec.get("resources", {}), self.total_resources)
 
@@ -508,21 +536,51 @@ class Nodelet:
         affinity_elsewhere = (
             strategy.startswith("NODE_AFFINITY:")
             and strategy.split(":")[1] != self.node_id)
-        if (affinity_elsewhere or not self._feasible_ever(spec)) \
+        # load-based spill: runnable here eventually, but busy NOW while
+        # other nodes exist — let the controller place it (ref: the
+        # hybrid policy spills past the local critical threshold,
+        # hybrid_scheduling_policy.h:50)
+        # capacity-based spill: local resources exhausted NOW while other
+        # nodes exist — let the controller place it (ref: the hybrid
+        # policy spills past the local critical threshold,
+        # hybrid_scheduling_policy.h:50). Backlogged-but-feasible work is
+        # handled by the periodic respill in the reap loop instead, so
+        # warm single-burst submissions stay local.
+        busy_spill = (self.cluster_nodes > 1
+                      and not strategy.startswith("NODE_AFFINITY:")
+                      and not self._feasible_now(spec))
+        if (affinity_elsewhere or busy_spill
+                or not self._feasible_ever(spec)) \
                 and not spec.get("_spilled"):
             # not runnable here (or pinned elsewhere): route via the
             # controller (ref: cluster_task_manager.cc:422 ScheduleOnNode)
-            target = await self.controller.call_async(
-                "pick_node", resources=spec.get("resources", {}),
-                strategy=strategy or "HYBRID",
-                placement_group_id=spec.get("placement_group_id"),
-                bundle_index=spec.get("bundle_index", -1))
+            try:
+                target = await self.controller.call_async(
+                    "pick_node", resources=spec.get("resources", {}),
+                    strategy=strategy or "HYBRID",
+                    placement_group_id=spec.get("placement_group_id"),
+                    bundle_index=spec.get("bundle_index", -1),
+                    _timeout=30)
+            except Exception:
+                target = None  # controller hiccup: keep the task local
             if target is not None and target["node_id"] != self.node_id:
-                spec["_spilled"] = True
                 client = RpcClient(target["address"])
                 try:
-                    await client.call_async("submit_task", spec=spec)
+                    spec["_spilled"] = True
+                    await client.call_async("submit_task", spec=spec,
+                                            _timeout=30)
+                    # tell the owner where the task went so it can fail
+                    # it over if that node dies (the owner only ever
+                    # talks to ITS nodelet; remote placement is the one
+                    # hop it cannot see)
+                    self._owner_client(spec["owner_addr"]).notify_nowait(
+                        "task_spilled", task_id=spec["task_id"],
+                        node_id=target["node_id"])
                     return True
+                except Exception:
+                    # target unreachable mid-spill: NEVER drop the task —
+                    # fall through to the local queue / retry paths
+                    spec.pop("_spilled", None)
                 finally:
                     client.close()
             if affinity_elsewhere and not strategy.endswith(":soft") and (

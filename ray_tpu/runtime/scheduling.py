@@ -174,10 +174,54 @@ def place_bundles(nodes: Sequence, bundles: List[Dict[str, float]],
         order = sorted(ids, key=lambda nid: -sum(avail[nid].values()))
         return try_place(lambda b, p: order, distinct=True)
     if strategy == SLICE_PACK:
-        # group nodes by TPU slice; require all bundles within one slice
+        # TPU gang placement: one bundle per host, all on ICI-adjacent
+        # hosts of ONE slice — the most compact contiguous host rectangle
+        # (exceeds ref accelerators/tpu.py's pod-name-affinity emulation).
+        from .topology import slice_from_nodes
+
+        tpu_nodes = [n for n in alive
+                     if (n.labels or {}).get("rtpu.slice")]
+        by_widx: Dict[str, Dict[int, str]] = {}
+        for n in tpu_nodes:
+            by_widx.setdefault(n.labels["rtpu.slice"], {})[
+                int(n.labels.get("rtpu.worker_index", 0))] = n.node_id
+        # conservative prefilter for (possibly heterogeneous) bundles:
+        # hosts must fit the element-wise max demand, so ANY bundle fits
+        # every gang host — may under-place skewed bundle lists, never
+        # mis-places
+        req_max: Dict[str, float] = {}
+        for b in bundles:
+            for k, v in b.items():
+                req_max[k] = max(req_max.get(k, 0.0), v)
+        for sname, tslice in slice_from_nodes(tpu_nodes).items():
+            feas = [h for h in tslice.hosts
+                    if _feasible(avail[by_widx[sname][h.worker_index]],
+                                 req_max)]
+            view = type(tslice)(name=tslice.name,
+                                accelerator_type=tslice.accelerator_type,
+                                chip_topology=tslice.chip_topology,
+                                hosts=feas)
+            gang = view.contiguous_hosts(len(bundles))
+            if gang is None:
+                continue
+            gang = sorted(gang, key=lambda h: h.worker_index)
+            placement = [by_widx[sname][h.worker_index] for h in gang]
+            ok = True
+            for nid, bundle in zip(placement, bundles):
+                if not _feasible(avail[nid], bundle):
+                    ok = False
+                    break
+                for k, v in bundle.items():
+                    avail[nid][k] = avail[nid].get(k, 0.0) - v
+            if ok:
+                return placement
+            avail.update({n.node_id: dict(n.available_resources)
+                          for n in alive})
+        # legacy fallback: nodes labelled with a bare slice_id
         slices = collections.defaultdict(list)
         for nid in ids:
-            slices[labels.get(nid, {}).get("slice_id", nid)].append(nid)
+            if "slice_id" in (labels.get(nid) or {}):
+                slices[labels[nid]["slice_id"]].append(nid)
         for slice_nodes in slices.values():
             trial = try_place(lambda b, p, s=slice_nodes: s, distinct=False)
             if trial is not None:

@@ -1,0 +1,267 @@
+"""TPU slice topology model: pod slices, host->chip maps, ICI adjacency.
+
+The scheduler's native differentiator (SURVEY §7): multi-host TPU work must
+be gang-scheduled onto ICI-adjacent hosts of ONE slice. The reference only
+approximates this with custom resources ("TPU-v4-16-head") and pod-name
+affinity (ref: python/ray/_private/accelerators/tpu.py:110-376 — chip
+detection :137, pod name :270, head resource :376); here the topology is a
+first-class object the scheduler can reason about: host grids, ICI
+neighborhoods, and contiguous-rectangle gang placement.
+
+Coordinates: a slice is a grid of chips (2D torus on v5e/v6e, 3D on
+v4/v5p); each host owns a contiguous block of chips. Host coordinates are
+the chip-grid coordinates divided by the per-host block shape; hosts whose
+coordinates differ by 1 on one axis share direct ICI links.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+# chips per host block (x, y[, z]) and chip grid defaults per generation.
+# v5e/v6e hosts own a 2x2 chip block of a 2D torus; v4/v5p hosts own a
+# 2x2x1 block of a 3D torus.
+_HOST_BLOCK = {
+    "v4": (2, 2, 1),
+    "v5p": (2, 2, 1),
+    "v5e": (2, 2),
+    "v5litepod": (2, 2),
+    "v6e": (2, 2),
+}
+
+
+def _parse_topology(topology: str) -> Tuple[int, ...]:
+    return tuple(int(p) for p in topology.lower().split("x"))
+
+
+def _gen_of(accelerator_type: str) -> str:
+    return accelerator_type.lower().split("-")[0]
+
+
+def _default_topology(accelerator_type: str) -> Tuple[int, ...]:
+    """Chip grid for an accelerator type like 'v5e-64' (64 chips -> 8x8)."""
+    gen = _gen_of(accelerator_type)
+    chips = int(accelerator_type.split("-")[1])
+    if len(_HOST_BLOCK.get(gen, (2, 2))) == 3:
+        # 3D torus: nearest cube-ish factorization
+        side = round(chips ** (1 / 3))
+        for x in range(side, 0, -1):
+            if chips % x == 0:
+                rest = chips // x
+                y = round(math.sqrt(rest))
+                while rest % y:
+                    y -= 1
+                return (x, y, rest // y)
+    side = int(math.isqrt(chips))
+    while chips % side:
+        side -= 1
+    return (side, chips // side)
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuHost:
+    """One host of a slice: its index and host-grid coordinates."""
+
+    worker_index: int
+    coords: Tuple[int, ...]
+    chips: int
+
+
+@dataclasses.dataclass
+class TpuSlice:
+    """A pod slice: the unit of ICI connectivity."""
+
+    name: str
+    accelerator_type: str  # e.g. "v5e-64"
+    chip_topology: Tuple[int, ...]  # chip grid, e.g. (8, 8)
+    hosts: List[TpuHost]
+
+    @property
+    def host_grid(self) -> Tuple[int, ...]:
+        gen = _gen_of(self.accelerator_type)
+        block = _HOST_BLOCK.get(gen, (2, 2))
+        return tuple(max(1, t // b)
+                     for t, b in zip(self.chip_topology, block))
+
+    @property
+    def num_chips(self) -> int:
+        return sum(h.chips for h in self.hosts)
+
+    def host_at(self, coords: Tuple[int, ...]) -> Optional[TpuHost]:
+        for h in self.hosts:
+            if h.coords == coords:
+                return h
+        return None
+
+    def ici_neighbors(self, host: TpuHost) -> List[TpuHost]:
+        """Hosts one hop away on the host grid (torus wraparound on full
+        rings: TPU ICI closes each full-length axis into a ring)."""
+        out = []
+        grid = self.host_grid
+        for axis, extent in enumerate(grid):
+            for delta in (-1, 1):
+                c = list(host.coords)
+                c[axis] += delta
+                if 0 <= c[axis] < extent:
+                    pass
+                elif extent > 2:  # wrap a full ring
+                    c[axis] %= extent
+                else:
+                    continue
+                n = self.host_at(tuple(c))
+                if n is not None and n is not host and n not in out:
+                    out.append(n)
+        return out
+
+    def contiguous_hosts(self, n: int) -> Optional[List[TpuHost]]:
+        """An ICI-contiguous gang of n hosts: the most compact axis-aligned
+        rectangle (minimal surface -> maximal intra-gang ICI bandwidth)
+        whose cells are all present. Falls back to a worker_index run."""
+        grid = self.host_grid
+        if n > len(self.hosts):
+            return None
+        best: Optional[List[TpuHost]] = None
+        for shape in _rect_shapes(n, grid):
+            for origin in _origins(shape, grid):
+                cells = _cells(origin, shape)
+                hosts = [self.host_at(c) for c in cells]
+                if all(h is not None for h in hosts):
+                    if best is None or _perimeter(shape) < best[0]:
+                        best = (_perimeter(shape), hosts)  # type: ignore
+        if best is not None:
+            return best[1]  # type: ignore
+        ordered = sorted(self.hosts, key=lambda h: h.worker_index)
+        for start in range(len(ordered) - n + 1):
+            run = ordered[start:start + n]
+            if run[-1].worker_index - run[0].worker_index == n - 1:
+                return run
+        return None
+
+
+def _rect_shapes(n: int, grid: Tuple[int, ...]) -> List[Tuple[int, ...]]:
+    """Axis-aligned box shapes with exactly n cells that fit the grid,
+    most compact (smallest perimeter) first."""
+    dims = len(grid)
+    shapes = []
+
+    def rec(remaining: int, axis: int, cur: List[int]):
+        if axis == dims - 1:
+            if remaining <= grid[axis]:
+                shapes.append(tuple(cur + [remaining]))
+            return
+        for d in range(1, min(remaining, grid[axis]) + 1):
+            if remaining % d == 0:
+                rec(remaining // d, axis + 1, cur + [d])
+
+    rec(n, 0, [])
+    shapes.sort(key=_perimeter)
+    return shapes
+
+
+def _perimeter(shape: Sequence[int]) -> int:
+    return sum(shape)
+
+
+def _origins(shape, grid):
+    ranges = [range(g - s + 1) for s, g in zip(shape, grid)]
+    out = [()]
+    for r in ranges:
+        out = [o + (v,) for o in out for v in r]
+    return out
+
+
+def _cells(origin, shape):
+    out = [()]
+    for o, s in zip(origin, shape):
+        out = [c + (o + v,) for c in out for v in range(s)]
+    return out
+
+
+def virtual_slice(accelerator_type: str = "v5e-64",
+                  name: str = "virtual-slice") -> TpuSlice:
+    """A fully-populated slice for tests/dry-runs (e.g. 'v5e-64' =
+    16 hosts x 4 chips on an 8x8 chip grid)."""
+    topo = _default_topology(accelerator_type)
+    gen = _gen_of(accelerator_type)
+    block = _HOST_BLOCK.get(gen, (2, 2))
+    grid = tuple(max(1, t // b) for t, b in zip(topo, block))
+    chips_per_host = 1
+    for t, g in zip(topo, grid):
+        chips_per_host *= t // g if g else t
+    hosts = []
+    coords_list = [()]
+    for g in grid:
+        coords_list = [c + (v,) for c in coords_list for v in range(g)]
+    for idx, coords in enumerate(sorted(coords_list)):
+        hosts.append(TpuHost(worker_index=idx, coords=coords,
+                             chips=chips_per_host))
+    return TpuSlice(name=name, accelerator_type=accelerator_type,
+                    chip_topology=topo, hosts=hosts)
+
+
+def detect_host_tpu() -> Dict[str, str]:
+    """Node labels describing this host's TPU attachment, from the
+    environment the TPU runtime provides (ref: accelerators/tpu.py —
+    TPU_ACCELERATOR_TYPE/TPU_WORKER_ID/TPU_NAME detection). Empty dict
+    off-TPU. Overridable for tests via the same variables."""
+    accel = os.environ.get("TPU_ACCELERATOR_TYPE")
+    if not accel:
+        return {}
+    labels = {
+        "rtpu.tpu_type": accel,
+        "rtpu.slice": os.environ.get("TPU_NAME")
+        or os.environ.get("TPU_POD_NAME", "slice-0"),
+        "rtpu.worker_index": os.environ.get("TPU_WORKER_ID", "0"),
+    }
+    topo = os.environ.get("TPU_TOPOLOGY")
+    if topo:
+        labels["rtpu.topology"] = topo
+    else:
+        labels["rtpu.topology"] = "x".join(
+            str(t) for t in _default_topology(accel))
+    return labels
+
+
+def slice_from_nodes(nodes: Sequence) -> Dict[str, TpuSlice]:
+    """Group registered nodes (objects with .labels/.node_id) into
+    TpuSlice views keyed by slice name; host coords derived from
+    worker_index over the slice's host grid (row-major, matching the TPU
+    runtime's worker numbering)."""
+    by_slice: Dict[str, list] = {}
+    for node in nodes:
+        labels = getattr(node, "labels", {}) or {}
+        s = labels.get("rtpu.slice")
+        if s:
+            by_slice.setdefault(s, []).append(node)
+    out: Dict[str, TpuSlice] = {}
+    for sname, members in by_slice.items():
+        labels = members[0].labels
+        accel = labels.get("rtpu.tpu_type", "v5e-4")
+        topo_s = labels.get("rtpu.topology")
+        topo = _parse_topology(topo_s) if topo_s else _default_topology(accel)
+        gen = _gen_of(accel)
+        block = _HOST_BLOCK.get(gen, (2, 2))
+        grid = tuple(max(1, t // b) for t, b in zip(topo, block))
+        hosts = []
+        for node in members:
+            widx = int(node.labels.get("rtpu.worker_index", 0))
+            coords = _coords_of(widx, grid)
+            chips = int(float(node.total_resources.get("TPU", 0))) \
+                if hasattr(node, "total_resources") else 0
+            hosts.append(TpuHost(worker_index=widx, coords=coords,
+                                 chips=chips))
+        out[sname] = TpuSlice(name=sname, accelerator_type=accel,
+                              chip_topology=topo, hosts=hosts)
+    return out
+
+
+def _coords_of(index: int, grid: Tuple[int, ...]) -> Tuple[int, ...]:
+    coords = []
+    for extent in reversed(grid):
+        coords.append(index % extent)
+        index //= extent
+    return tuple(reversed(coords))
