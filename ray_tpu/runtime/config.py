@@ -60,6 +60,14 @@ class RuntimeConfig:
     object_store_fraction: float = 0.3
     object_spill_dir: str = ""  # "" = <session>/spill
 
+    # --- memory monitor (ref: src/ray/common/memory_monitor.h:52 —
+    # cgroup/rss watcher; kill policy raylet/worker_killing_policy.cc) ---
+    memory_usage_threshold: float = 0.95
+    memory_monitor_interval_s: float = 2.0
+    # tests: a file whose content (a float in [0,1]) REPLACES the real
+    # host memory usage reading
+    memory_monitor_test_file: str = ""
+
     # --- task execution ---
     task_retry_delay_s: float = 0.1
     default_max_retries: int = 3

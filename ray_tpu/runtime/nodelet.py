@@ -169,6 +169,7 @@ class Nodelet:
         self.cluster_nodes = reply.get("n_nodes", 1)
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._bg.append(asyncio.ensure_future(self._reap_loop()))
+        self._bg.append(asyncio.ensure_future(self._memory_monitor_loop()))
         for _ in range(get_config().prestart_workers):
             self._start_worker()
 
@@ -244,6 +245,57 @@ class Nodelet:
                     except ValueError:
                         continue
                     asyncio.ensure_future(self.submit_task(spec))
+
+    # ------------------------------------------------------------ memory
+    def _memory_usage(self) -> float:
+        """Host memory usage fraction in [0, 1] (test file overrides)."""
+        cfg = get_config()
+        if cfg.memory_monitor_test_file:
+            try:
+                with open(cfg.memory_monitor_test_file) as f:
+                    return float(f.read().strip() or 0.0)
+            except (OSError, ValueError):  # torn/invalid content != dead
+                return 0.0
+        try:
+            import psutil
+
+            vm = psutil.virtual_memory()
+            return 1.0 - vm.available / vm.total
+        except Exception:
+            return 0.0
+
+    async def _memory_monitor_loop(self):
+        """OOM watcher (ref: memory_monitor.h:52 + the newest-task-first
+        worker killing policy, raylet/worker_killing_policy.cc): under
+        memory pressure, kill the most recently dispatched plain task —
+        its retry carries an OOM-attributed error, and killing newest
+        first preserves the oldest (most sunk-cost) work."""
+        cfg = get_config()
+        while True:
+            await asyncio.sleep(cfg.memory_monitor_interval_s)
+            usage = self._memory_usage()
+            if usage < cfg.memory_usage_threshold:
+                continue
+            victim = None
+            for task_id in reversed(list(self.running_tasks)):
+                worker_id = self.running_tasks[task_id]
+                ws = self.workers.get(worker_id)
+                if ws is not None and not ws.is_actor and \
+                        ws.current_task is not None:
+                    victim = ws
+                    break
+            if victim is None:
+                continue
+            spec = victim.current_task
+            self.running_tasks.pop(spec["task_id"], None)
+            self._kill_worker(victim)
+            self._release(spec)
+            await self._report_failure(
+                spec, f"task killed by the memory monitor: host memory "
+                      f"usage {usage:.0%} exceeded the "
+                      f"{cfg.memory_usage_threshold:.0%} threshold "
+                      "(newest-task-first policy)")
+            self._dispatch()
 
     # ------------------------------------------------------------ worker pool
     def _start_worker(self, force: bool = False):

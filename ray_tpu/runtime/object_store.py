@@ -25,6 +25,12 @@ from typing import Any, Dict, List, Optional, Tuple
 from .ids import ObjectID
 from . import serialization
 
+try:
+    from .._native import OutOfMemory
+except Exception:  # no toolchain: the native client is never built
+    class OutOfMemory(Exception):  # type: ignore[no-redef]
+        pass
+
 _HDR = struct.Struct(">Q")
 _ALIGN = 64
 
@@ -50,8 +56,20 @@ def _shm_dir(session_name: str) -> str:
     return os.path.join(root, f"rtpu_{session_name}")
 
 
-def _seg_path(session_name: str, oid: ObjectID) -> str:
-    return os.path.join(_shm_dir(session_name), oid.hex())
+def _spill_dir(session_name: str) -> str:
+    """Disk tier for objects that do not fit the shm pool (ref:
+    local_object_manager.h:112 SpillObjects — here a transparent
+    fallback tier instead of an explicit spill RPC protocol).
+    Resolution: object_spill_dir config > RTPU_SPILL_ROOT env > the
+    session directory (cleaned up with the session). Point it at real
+    disk — on distros where /tmp is tmpfs, the default spills into RAM.
+    """
+    from .config import get_config
+
+    cfg_dir = get_config().object_spill_dir
+    root = (cfg_dir or os.environ.get("RTPU_SPILL_ROOT")
+            or f"/tmp/ray_tpu/{session_name}/spill")
+    return os.path.join(root, f"rtpu_{session_name}")
 
 
 class _Segment:
@@ -100,9 +118,13 @@ class ObjectStoreClient:
     moral equivalent of plasma client Release; ref: plasma/client.cc).
     """
 
-    def __init__(self, session_name: str):
+    def __init__(self, session_name: str, root: Optional[str] = None):
         self.session_name = session_name
+        self._root = root or _shm_dir(session_name)
         self._pinned: Dict[ObjectID, _Segment] = {}
+
+    def _path(self, oid: ObjectID) -> str:
+        return os.path.join(self._root, oid.hex())
 
     # ---- write path ----
     def put_serialized(self, oid: ObjectID, sv: serialization.SerializedValue) -> int:
@@ -117,7 +139,7 @@ class ObjectStoreClient:
             header_tail += struct.pack(">QQ", cursor, len(raw))
             cursor = _aligned(cursor + len(raw))
         total = cursor
-        seg = _Segment.create(_seg_path(self.session_name, oid), max(total, 1))
+        seg = _Segment.create(self._path(oid), max(total, 1))
         mv = memoryview(seg.mm)
         pos = 0
         mv[pos:pos + _HDR.size] = _HDR.pack(len(meta)); pos += _HDR.size
@@ -135,14 +157,14 @@ class ObjectStoreClient:
 
     # ---- read path ----
     def contains(self, oid: ObjectID) -> bool:
-        return os.path.exists(_seg_path(self.session_name, oid))
+        return os.path.exists(self._path(oid))
 
     def get(self, oid: ObjectID) -> Any:
         """Zero-copy deserialize. The segment stays pinned in this process
         until `release(oid)` (views may alias the mmap)."""
         seg = self._pinned.get(oid)
         if seg is None:
-            seg = _Segment.open(_seg_path(self.session_name, oid))
+            seg = _Segment.open(self._path(oid))
             self._pinned[oid] = seg
         mv = memoryview(seg.mm)
         (meta_len,) = _HDR.unpack_from(mv, 0)
@@ -167,24 +189,24 @@ class ObjectStoreClient:
     def delete(self, oid: ObjectID):
         self.release(oid)
         try:
-            os.unlink(_seg_path(self.session_name, oid))
+            os.unlink(self._path(oid))
         except FileNotFoundError:
             pass
 
     def size_of(self, oid: ObjectID) -> Optional[int]:
         try:
-            return os.stat(_seg_path(self.session_name, oid)).st_size
+            return os.stat(self._path(oid)).st_size
         except FileNotFoundError:
             return None
 
     # ---- node-to-node transfer (object-manager tier; ref:
     # src/ray/object_manager/object_manager.h:119 chunked push/pull) ----
     def read_range(self, oid: ObjectID, offset: int, length: int) -> bytes:
-        with open(_seg_path(self.session_name, oid), "rb") as f:
+        with open(self._path(oid), "rb") as f:
             return os.pread(f.fileno(), length, offset)
 
     def create_for_ingest(self, oid: ObjectID, size: int) -> "_FileIngest":
-        return _FileIngest(_seg_path(self.session_name, oid), size)
+        return _FileIngest(self._path(oid), size)
 
 
 class _FileIngest:
@@ -223,6 +245,7 @@ class NativeObjectStoreClient:
     def __init__(self, session_name: str, pool):
         self.session_name = session_name
         self._pool = pool
+        self._spill: Optional[ObjectStoreClient] = None
         # reads map their own window over the pool file: buffer exports
         # (numpy zero-copy arrays, pickle out-of-band buffers) root at the
         # mmap object, so close() raising BufferError is the alias-liveness
@@ -236,6 +259,17 @@ class NativeObjectStoreClient:
 
     def _key(self, oid: ObjectID) -> bytes:
         return oid.binary() + self._KEY_PAD
+
+    @property
+    def spill(self) -> "ObjectStoreClient":
+        """Disk fallback tier (ref: local_object_manager.h:112
+        SpillObjects): objects that do not fit the pool — even after LRU
+        eviction of unreferenced entries — transparently land on disk, so
+        a working set larger than the pool degrades instead of failing."""
+        if self._spill is None:
+            self._spill = ObjectStoreClient(
+                self.session_name, root=_spill_dir(self.session_name))
+        return self._spill
 
     # ---- write path ----
     def put_serialized(self, oid: ObjectID,
@@ -256,6 +290,8 @@ class NativeObjectStoreClient:
             mv = self._pool.create(key, max(total, 1))
         except FileExistsError:
             return total  # idempotent double-put
+        except OutOfMemory:
+            return self.spill.put_serialized(oid, sv)
         pos = 0
         mv[pos:pos + _HDR.size] = _HDR.pack(len(meta)); pos += _HDR.size
         mv[pos:pos + len(meta)] = meta; pos += len(meta)
@@ -271,13 +307,13 @@ class NativeObjectStoreClient:
 
     # ---- read path ----
     def contains(self, oid: ObjectID) -> bool:
-        return self._pool.contains(self._key(oid))
+        return self._pool.contains(self._key(oid)) or self.spill.contains(oid)
 
     def get(self, oid: ObjectID) -> Any:
         self._sweep_zombies()
         raw = self._pool.get_raw(self._key(oid))
         if raw is None:
-            raise FileNotFoundError(oid.hex())
+            return self.spill.get(oid)  # raises FileNotFoundError if absent
         file_off, size = raw
         page = file_off & ~(mmap.ALLOCATIONGRANULARITY - 1)
         mm = mmap.mmap(self._fd, (file_off - page) + size, offset=page)
@@ -300,6 +336,8 @@ class NativeObjectStoreClient:
         self._sweep_zombies()
         entries = self._pinned.pop(oid, None)
         if entries is None:
+            if self._spill is not None:
+                self._spill.release(oid)
             return
         for mm in entries:
             try:
@@ -328,11 +366,13 @@ class NativeObjectStoreClient:
     def delete(self, oid: ObjectID):
         self.release(oid)
         self._pool.delete(self._key(oid))
+        # unconditionally: another process may have spilled this object
+        self.spill.delete(oid)
 
     def size_of(self, oid: ObjectID) -> Optional[int]:
         mv = self._pool.get(self._key(oid))
         if mv is None:
-            return None
+            return self.spill.size_of(oid)
         size = len(mv)
         mv.release()
         self._pool.release(self._key(oid))
@@ -346,7 +386,7 @@ class NativeObjectStoreClient:
         key = self._key(oid)
         raw = self._pool.get_raw(key)  # bumps refcount: pins across read
         if raw is None:
-            raise FileNotFoundError(oid.hex())
+            return self.spill.read_range(oid, offset, length)
         try:
             file_off, size = raw
             length = min(length, size - offset)
@@ -354,9 +394,12 @@ class NativeObjectStoreClient:
         finally:
             self._pool.release(key)
 
-    def create_for_ingest(self, oid: ObjectID, size: int) -> "_PoolIngest":
+    def create_for_ingest(self, oid: ObjectID, size: int):
         key = self._key(oid)
-        mv = self._pool.create(key, max(size, 1))
+        try:
+            mv = self._pool.create(key, max(size, 1))
+        except OutOfMemory:
+            return self.spill.create_for_ingest(oid, size)
         return _PoolIngest(self._pool, key, mv)
 
 
@@ -418,14 +461,14 @@ def om_handlers(get_store) -> dict:
 
 
 def cleanup_session(session_name: str):
-    d = _shm_dir(session_name)
-    if os.path.isdir(d):
-        for name in os.listdir(d):
+    for d in (_shm_dir(session_name), _spill_dir(session_name)):
+        if os.path.isdir(d):
+            for name in os.listdir(d):
+                try:
+                    os.unlink(os.path.join(d, name))
+                except OSError:
+                    pass
             try:
-                os.unlink(os.path.join(d, name))
+                os.rmdir(d)
             except OSError:
                 pass
-        try:
-            os.rmdir(d)
-        except OSError:
-            pass
