@@ -70,11 +70,13 @@ async def _ensure_proc_dead(proc, pid: int = -1, grace: float = 2.0):
 
 
 class WorkerState:
-    def __init__(self, worker_id: str, address: str, pid: int, proc=None):
+    def __init__(self, worker_id: str, address: str, pid: int, proc=None,
+                 env_key: str = ""):
         self.worker_id = worker_id
         self.address = address
         self.pid = pid
         self.proc = proc
+        self.env_key = env_key  # runtime-env pool this worker belongs to
         self.client: Optional[RpcClient] = None
         self.current_task: Optional[dict] = None
         self.actor_id: Optional[str] = None
@@ -104,8 +106,11 @@ class Nodelet:
         self.controller = RpcClient(controller_addr,
                                     notify_handlers={"shutdown": self._on_shutdown})
         self.workers: Dict[str, WorkerState] = {}
-        self.idle: collections.deque = collections.deque()
+        # idle pools keyed by runtime-env hash (ref: worker_pool.cc
+        # per-runtime-env pools); "" is the default pool
+        self.idle: Dict[str, collections.deque] = {}
         self.starting = 0
+        self.starting_by_key: Dict[str, int] = {}
         self.queue: collections.deque = collections.deque()
         self.pending_actor_leases: collections.deque = collections.deque()
         self.bundles: Dict[tuple, Dict[str, Dict[str, float]]] = {}
@@ -228,8 +233,8 @@ class Nodelet:
                       and now - w.idle_since > cfg.worker_idle_timeout_s):
                     self._kill_worker(w)
             # stall check: queued work, nothing running, nothing starting
-            if (self.queue or self.pending_actor_leases) and not self.idle \
-                    and self.starting == 0:
+            if (self.queue or self.pending_actor_leases) \
+                    and self._idle_any() is None and self.starting == 0:
                 self._dispatch()
             # periodic respill: backlogged work re-enters placement when
             # the cluster has other nodes (ref: the reference re-runs
@@ -298,7 +303,8 @@ class Nodelet:
             self._dispatch()
 
     # ------------------------------------------------------------ worker pool
-    def _start_worker(self, force: bool = False):
+    def _start_worker(self, force: bool = False, runtime_env: dict = None,
+                      env_key: str = ""):
         # the pool cap applies to TASK workers only: actor workers are
         # explicit user-created processes (force-started, resource-bounded)
         # and must not wedge task scheduling by filling the cap
@@ -307,9 +313,11 @@ class Nodelet:
         if not force and n_task_workers >= self.max_workers:
             return
         self.starting += 1
+        self.starting_by_key[env_key] = \
+            self.starting_by_key.get(env_key, 0) + 1
         worker_id = WorkerID.from_random().hex()
         # record a placeholder so death-before-register is detectable
-        ws = WorkerState(worker_id, "", -1, None)
+        ws = WorkerState(worker_id, "", -1, None, env_key=env_key)
         ws.current_task = {"placeholder": True}
         self.workers[worker_id] = ws
         # fork+exec takes single-digit milliseconds — never on the io loop
@@ -317,9 +325,10 @@ class Nodelet:
         # starved owner-fetches in round 1)
         try:
             loop = asyncio.get_running_loop()
-            loop.run_in_executor(None, self._spawn_worker_proc, ws, worker_id)
+            loop.run_in_executor(None, self._spawn_worker_proc, ws,
+                                 worker_id, runtime_env)
         except RuntimeError:
-            self._spawn_worker_proc(ws, worker_id)
+            self._spawn_worker_proc(ws, worker_id, runtime_env)
 
     def _start_factory(self):
         """Launch the prefork worker factory (pays the python+jax import
@@ -337,7 +346,8 @@ class Nodelet:
              "--controller-addr", self.controller_addr],
             stdout=out, stderr=subprocess.STDOUT)
 
-    def _fork_from_factory(self, worker_id: str) -> int:
+    def _fork_from_factory(self, worker_id: str,
+                           runtime_env: dict = None) -> int:
         """Ask the factory for a forked worker; returns the pid.
 
         Two phases with different retry rules: connecting retries until the
@@ -364,7 +374,9 @@ class Nodelet:
                 time.sleep(0.05)
         try:  # phase 2: exactly-once request
             sock.settimeout(60.0)  # covers the factory's warm import
-            sock.sendall((json.dumps({"worker_id": worker_id}) + "\n").encode())
+            sock.sendall((json.dumps(
+                {"worker_id": worker_id,
+                 "runtime_env": runtime_env}) + "\n").encode())
             data = b""
             while not data.endswith(b"\n"):
                 chunk = sock.recv(4096)
@@ -381,16 +393,28 @@ class Nodelet:
         finally:
             sock.close()
 
-    def _spawn_worker_proc(self, ws: WorkerState, worker_id: str):
+    def _dec_starting(self, env_key: str):
+        self.starting = max(0, self.starting - 1)
+        self.starting_by_key[env_key] = max(
+            0, self.starting_by_key.get(env_key, 0) - 1)
+
+    def _spawn_worker_proc(self, ws: WorkerState, worker_id: str,
+                           runtime_env: dict = None):
         try:
             try:
-                ws.pid = self._fork_from_factory(worker_id)
+                if runtime_env and runtime_env.get("pip"):
+                    # pip envs must COLD-start: a fork inherits the
+                    # factory's warm imports, and sys.path prepends
+                    # cannot evict already-imported base packages — a
+                    # pinned version would be silently ignored
+                    raise OSError("pip env requires cold start")
+                ws.pid = self._fork_from_factory(worker_id, runtime_env)
                 return
             except _SpawnAmbiguous:
                 # give up on this worker_id; the reap loop's stall check
                 # will start a fresh worker if the queue still needs one
                 self.workers.pop(worker_id, None)
-                self.starting = max(0, self.starting - 1)
+                self._dec_starting(ws.env_key)
                 return
             except OSError:
                 if self._stopping:
@@ -401,6 +425,10 @@ class Nodelet:
             out = open(os.path.join(log_dir, f"worker-{worker_id[:8]}.log"), "ab")
             env = dict(os.environ)
             env["RTPU_WORKER_ID"] = worker_id
+            if runtime_env:
+                import json as json_mod
+
+                env["RTPU_RUNTIME_ENV_JSON"] = json_mod.dumps(runtime_env)
             proc = subprocess.Popen(
                 [sys.executable, "-m", "ray_tpu.runtime.worker",
                  "--session-name", self.session_name,
@@ -415,31 +443,33 @@ class Nodelet:
             ws.pid = proc.pid
         except Exception:
             self.workers.pop(worker_id, None)
-            self.starting = max(0, self.starting - 1)
+            self._dec_starting(ws.env_key)
             traceback.print_exc()
 
-    async def worker_register(self, worker_id: str, address: str, pid: int):
+    async def worker_register(self, worker_id: str, address: str, pid: int,
+                              env_key: str = ""):
         ws = self.workers.get(worker_id)
         if ws is None:
             # unknown id: adopt it (e.g. a fork whose spawn reply was lost)
-            ws = WorkerState(worker_id, address, pid)
+            ws = WorkerState(worker_id, address, pid, env_key=env_key)
             self.workers[worker_id] = ws
         elif ws.current_task and ws.current_task.get("placeholder"):
-            self.starting = max(0, self.starting - 1)
+            self._dec_starting(ws.env_key)
         ws.pid = pid
         ws.address = address
         ws.current_task = None
         ws.client = RpcClient(address)
         ws.idle_since = time.monotonic()
-        self.idle.append(worker_id)
+        self._idle_pool(ws.env_key).append(worker_id)
         self._dispatch()
         return {"session_name": self.session_name}
 
     def _kill_worker(self, ws: WorkerState):
         self.workers.pop(ws.worker_id, None)
-        if ws.worker_id in self.idle:
+        pool = self.idle.get(ws.env_key)
+        if pool is not None:
             try:
-                self.idle.remove(ws.worker_id)
+                pool.remove(ws.worker_id)
             except ValueError:
                 pass
         if ws.proc is not None or ws.pid > 0:
@@ -476,7 +506,8 @@ class Nodelet:
     async def _on_worker_death(self, ws: WorkerState):
         self.workers.pop(ws.worker_id, None)
         try:
-            self.idle.remove(ws.worker_id)
+            self.idle.get(ws.env_key, collections.deque()).remove(
+                ws.worker_id)
         except ValueError:
             pass
         if ws.is_actor:
@@ -489,7 +520,7 @@ class Nodelet:
             except Exception:
                 pass
         elif ws.current_task and ws.current_task.get("placeholder"):
-            self.starting = max(0, self.starting - 1)
+            self._dec_starting(ws.env_key)
         elif ws.current_task is not None:
             spec = ws.current_task
             self._release(spec)
@@ -580,6 +611,10 @@ class Nodelet:
         # shallow-copy: with in-process dispatch the caller's spec dict
         # arrives by reference, and we annotate it (_spilled/_bundle_key)
         spec = dict(spec)
+        if "_env_key" not in spec:
+            from .runtime_env import env_key as _env_key
+
+            spec["_env_key"] = _env_key(spec.get("runtime_env"))
         if spec["task_id"] in self.cancelled:
             self.cancelled.discard(spec["task_id"])
             await self._report_cancelled(spec)
@@ -659,56 +694,105 @@ class Nodelet:
         self._dispatch()
         return True
 
+    def _idle_pool(self, key: str) -> collections.deque:
+        pool = self.idle.get(key)
+        if pool is None:
+            pool = self.idle[key] = collections.deque()
+        return pool
+
+    def _idle_any(self) -> Optional[str]:
+        """A pool key with an idle worker (default pool preferred), or
+        None."""
+        if self.idle.get(""):
+            return ""
+        for key, pool in self.idle.items():
+            if pool:
+                return key
+        return None
+
     def _dispatch(self):
-        """Local dispatch loop (ref: local_task_manager.cc:119)."""
+        """Local dispatch loop (ref: local_task_manager.cc:119), with
+        idle pools keyed by runtime-env hash: a task only runs on a
+        worker built for its environment."""
         if self._stopping:
             return
         made_progress = True
         while made_progress and self.queue:
             made_progress = False
+            blocked: List[dict] = []
             for _ in range(len(self.queue)):
+                if not self.queue:
+                    break
                 spec = self.queue.popleft()
                 if spec["task_id"] in self.cancelled:
                     self.cancelled.discard(spec["task_id"])
                     asyncio.ensure_future(self._report_cancelled(spec))
                     made_progress = True
                     continue
-                if not self.idle:
-                    self.queue.appendleft(spec)
-                    if self.starting == 0 or (
-                            self.starting + len(self.workers) < self.max_workers
-                            and len(self.queue) > self.starting):
-                        self._start_worker()
-                    break
-                if not self._acquire(spec):
-                    self.queue.append(spec)
+                key = spec.get("_env_key", "")
+                pool = self.idle.get(key)
+                if not pool:
+                    blocked.append(spec)
+                    self._request_worker(key, spec,
+                                         len(blocked) + len(self.queue))
                     continue
-                worker_id = self.idle.popleft()
+                if not self._acquire(spec):
+                    blocked.append(spec)
+                    continue
+                worker_id = pool.popleft()
                 ws = self.workers.get(worker_id)
                 if ws is None:
                     self._release(spec)
-                    self.queue.append(spec)
+                    blocked.append(spec)
                     continue
                 ws.current_task = spec
                 self.running_tasks[spec["task_id"]] = worker_id
                 made_progress = True
                 asyncio.ensure_future(self._push_to_worker(ws, spec))
-        # actor leases piggyback on the same pool
-        while self.pending_actor_leases and self.idle:
+            for spec in blocked:
+                self.queue.append(spec)
+        # actor leases take DEFAULT-pool workers only: an env-pool worker
+        # carries sys.path prepends and cached imports that would leak
+        # into the actor's process (its own env applies at takeover)
+        while self.pending_actor_leases and self.idle.get(""):
             actor_id, spec = self.pending_actor_leases.popleft()
             if not self._acquire(spec):
                 self.pending_actor_leases.appendleft((actor_id, spec))
                 break
-            worker_id = self.idle.popleft()
+            worker_id = self.idle[""].popleft()
             ws = self.workers[worker_id]
             ws.actor_id = actor_id
             ws.current_task = spec
             asyncio.ensure_future(self._push_actor_to_worker(ws, spec))
         # actor workers are demand-driven and bounded by resources, not by
         # the task-pool cap (each actor is an explicit user-created process)
-        if self.pending_actor_leases and not self.idle:
+        if self.pending_actor_leases and not self.idle.get(""):
             if self.starting < len(self.pending_actor_leases):
                 self._start_worker(force=True)
+
+    def _request_worker(self, key: str, spec: dict, demand: int):
+        """Start a worker for this env pool if the demand warrants it;
+        evicts an idle worker from ANOTHER pool when the cap is full
+        (ref: worker_pool.cc kills idle workers of other envs to make
+        room rather than stalling the lease)."""
+        starting_key = self.starting_by_key.get(key, 0)
+        if not (starting_key == 0 or (
+                self.starting + len(self.workers) < self.max_workers
+                and demand > starting_key)):
+            return
+        n_task_workers = self.starting + sum(
+            1 for w in self.workers.values() if not w.is_actor)
+        if n_task_workers >= self.max_workers:
+            for other_key, pool in self.idle.items():
+                if other_key != key and pool:
+                    victim = self.workers.get(pool[0])
+                    if victim is not None:
+                        self._kill_worker(victim)
+                        break
+            else:
+                return  # every slot is busy: wait for a finish
+        self._start_worker(runtime_env=spec.get("runtime_env"),
+                           env_key=key)
 
     async def _push_to_worker(self, ws: WorkerState, spec: dict):
         try:
@@ -754,7 +838,7 @@ class Nodelet:
             self._release(spec)
         ws.idle_since = time.monotonic()
         if not ws.is_actor:
-            self.idle.append(worker_id)
+            self._idle_pool(ws.env_key).append(worker_id)
         self._dispatch()
         return True
 

@@ -1,0 +1,151 @@
+"""Runtime environments: per-task/actor python environments.
+
+Equivalent of the reference's runtime-env plugin system (ref:
+python/ray/_private/runtime_env/agent/runtime_env_agent.py:164 — the
+per-node agent building envs; plugins runtime_env/{pip,uv,py_modules,
+working_dir}.py; URI caching runtime_env/uri_cache.py). Redesigned without
+the agent process: environments are content-addressed directories built
+on demand under an inter-process file lock, and workers prepend them to
+sys.path before loading user code. Worker pools are keyed by the env hash
+(ref: worker_pool.cc per-runtime-env pools), so processes are never shared
+across incompatible environments.
+
+Supported keys:
+- env_vars: {name: value}
+- working_dir: chdir + sys.path entry for the worker
+- pip: [requirement, ...] — installed with `pip install --target` into
+  the cached env dir. Local paths/wheels work offline; names need an
+  index (pass {"packages": [...], "pip_args": [...]} for flags like
+  --no-index --find-links). pip workers COLD-start (no prefork): a
+  forked worker inherits the factory's already-imported base packages,
+  which sys.path prepends cannot evict — version pins would silently
+  not apply. py_modules providing NEW module names fork fine; shadowing
+  a module the runtime itself imports (numpy, cloudpickle) will not
+  take effect in forked workers.
+- py_modules: [path, ...] — local modules/packages staged into the env
+  dir (the reference uploads to GCS; here hosts share a filesystem or
+  ship code through the function store instead)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def env_key(runtime_env: Optional[Dict[str, Any]]) -> str:
+    """Content hash of the ISOLATING parts of a runtime env (pip +
+    py_modules). env_vars/working_dir apply per task and do not require
+    a dedicated worker pool; '' means the default pool."""
+    if not runtime_env:
+        return ""
+    iso = {}
+    if runtime_env.get("pip"):
+        iso["pip"] = runtime_env["pip"]
+    if runtime_env.get("py_modules"):
+        # hash module paths + mtimes so edits invalidate the cache
+        mods = []
+        for path in runtime_env["py_modules"]:
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                mtime = 0
+            mods.append((os.path.abspath(path), mtime))
+        iso["py_modules"] = mods
+    if not iso:
+        return ""
+    blob = json.dumps(iso, sort_keys=True).encode()
+    return hashlib.blake2b(blob, digest_size=12).hexdigest()
+
+
+def _envs_root(session_dir: str) -> str:
+    return os.path.join(session_dir, "runtime_envs")
+
+
+def ensure_env(runtime_env: Dict[str, Any], session_dir: str) -> Optional[str]:
+    """Build (or reuse) the cached env dir for this runtime env; returns
+    its path, or None when no isolation is needed. Concurrent builders
+    coordinate through an exclusive file lock (URI-cache equivalent:
+    the env hash IS the URI)."""
+    key = env_key(runtime_env)
+    if not key:
+        return None
+    env_dir = os.path.join(_envs_root(session_dir), key)
+    ready = os.path.join(env_dir, ".ready")
+    if os.path.exists(ready):
+        return env_dir
+    os.makedirs(env_dir, exist_ok=True)
+    import fcntl
+
+    lock_path = os.path.join(env_dir, ".lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(ready):
+                return env_dir
+            # a previous builder may have died mid-install: start clean
+            # (pip refuses a non-empty --target without --upgrade)
+            for name in os.listdir(env_dir):
+                if name == ".lock":
+                    continue
+                path = os.path.join(env_dir, name)
+                if os.path.isdir(path):
+                    shutil.rmtree(path, ignore_errors=True)
+                else:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+            _build_env(runtime_env, env_dir)
+            with open(ready, "w") as f:
+                f.write("ok")
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+    return env_dir
+
+
+def _build_env(runtime_env: Dict[str, Any], env_dir: str) -> None:
+    pip_spec = runtime_env.get("pip")
+    if pip_spec:
+        if isinstance(pip_spec, dict):
+            packages: List[str] = list(pip_spec.get("packages", []))
+            pip_args: List[str] = list(pip_spec.get("pip_args", []))
+        else:
+            packages, pip_args = list(pip_spec), []
+        cmd = [sys.executable, "-m", "pip", "install",
+               "--target", env_dir, "--no-warn-script-location",
+               *pip_args, *packages]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"runtime_env pip install failed: {proc.stderr[-2000:]}")
+    for path in runtime_env.get("py_modules", []) or []:
+        path = os.path.abspath(path)
+        name = os.path.basename(path.rstrip("/"))
+        dest = os.path.join(env_dir, name)
+        if os.path.isdir(path):
+            shutil.copytree(path, dest, dirs_exist_ok=True)
+        else:
+            shutil.copy2(path, dest)
+
+
+def apply_to_process(runtime_env: Optional[Dict[str, Any]],
+                     env_dir: Optional[str]) -> None:
+    """Make this process run inside the env: sys.path prepend (so env
+    packages SHADOW the base site-packages), env_vars, working_dir."""
+    runtime_env = runtime_env or {}
+    if env_dir and env_dir not in sys.path:
+        sys.path.insert(0, env_dir)
+    for k, v in (runtime_env.get("env_vars") or {}).items():
+        os.environ[k] = str(v)
+    wd = runtime_env.get("working_dir")
+    if wd:
+        os.chdir(wd)
+        if wd not in sys.path:
+            sys.path.insert(1, wd)

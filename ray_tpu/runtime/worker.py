@@ -56,12 +56,17 @@ def _applied_runtime_env(runtime_env):
                 os.environ[k] = v
 
 
-def _apply_runtime_env_permanent(runtime_env):
+def _apply_runtime_env_permanent(runtime_env, session_dir: str = None):
+    """Actor takeover: the env owns the process for life — including
+    pip/py_modules isolation (built into the shared cache, prepended to
+    sys.path BEFORE the actor class loads)."""
     runtime_env = runtime_env or {}
-    for k, v in (runtime_env.get("env_vars") or {}).items():
-        os.environ[k] = str(v)
-    if runtime_env.get("working_dir"):
-        os.chdir(runtime_env["working_dir"])
+    from .runtime_env import apply_to_process, ensure_env, env_key
+
+    env_dir = None
+    if env_key(runtime_env) and session_dir:
+        env_dir = ensure_env(runtime_env, session_dir)
+    apply_to_process(runtime_env, env_dir)
 
 
 class _UserLoop:
@@ -87,6 +92,7 @@ class Executor:
         self.actor_id: Optional[str] = None
         self.actor_spec: Optional[dict] = None
         self.max_concurrency = 1
+        self.env_error: Optional[str] = None
         self.user_loop: Optional[_UserLoop] = None
         self._async_sem: Optional[asyncio.Semaphore] = None
         # per-caller in-order delivery (ref: actor_scheduling_queue.cc)
@@ -132,6 +138,17 @@ class Executor:
     def _run_task(self, spec: dict):
         task_id = spec["task_id"]
         done_sent = False
+        if self.env_error:
+            done_sent = self._send_error(
+                spec, exceptions.RuntimeEnvSetupError(self.env_error))
+            if not done_sent:
+                self.core.nodelet.notify_nowait(
+                    "task_finished", worker_id=self.core.worker_id.hex(),
+                    task_id=task_id)
+            # exit after reporting: a fresh worker retries the env build
+            # (a transient pip failure must not poison the pool)
+            self.shutdown_event.set()
+            return
         try:
             # the env context covers function load (module import time),
             # arg deserialization, the call, AND generator consumption
@@ -310,7 +327,8 @@ class Executor:
         try:
             # actors own their worker process: runtime env applies for
             # life, and BEFORE user code loads (import-time reads see it)
-            _apply_runtime_env_permanent(spec.get("runtime_env"))
+            _apply_runtime_env_permanent(spec.get("runtime_env"),
+                                         self.core.session_dir)
             cls = self.core.load_function(spec["cls_key"])
             args, kwargs = self._unpack_args(spec)
             self.actor_instance = cls(*args, **kwargs)
@@ -461,7 +479,24 @@ class Executor:
 
 
 def run_worker(*, session_name: str, session_dir: str, node_id: str,
-               nodelet_addr: str, controller_addr: str, worker_id: str):
+               nodelet_addr: str, controller_addr: str, worker_id: str,
+               runtime_env: Optional[dict] = None):
+    from .runtime_env import apply_to_process, ensure_env, env_key
+
+    key = env_key(runtime_env)
+    env_error = None
+    if key:
+        # build/reuse the cached env BEFORE loading any user code so env
+        # packages shadow base site-packages (ref: runtime_env_agent
+        # builds envs before handing the worker to the lease). Only the
+        # ISOLATING part (the env dir) applies process-wide — env_vars /
+        # working_dir are per TASK (the pool key excludes them, so other
+        # tasks share this process)
+        try:
+            env_dir = ensure_env(runtime_env, session_dir)
+            apply_to_process({}, env_dir)
+        except Exception as e:  # noqa: BLE001 — surfaced per task
+            env_error = f"runtime_env setup failed: {e!r}"
     core = CoreWorker(
         mode="worker", session_name=session_name,
         session_dir=session_dir, controller_addr=controller_addr,
@@ -469,9 +504,10 @@ def run_worker(*, session_name: str, session_dir: str, node_id: str,
         worker_id=WorkerID.from_hex(worker_id))
     set_core(core)
     executor = Executor(core)
+    executor.env_error = env_error
     core.start(extra_handlers=executor.handlers())
     core.nodelet.call("worker_register", worker_id=worker_id,
-                      address=core.address, pid=os.getpid())
+                      address=core.address, pid=os.getpid(), env_key=key)
     executor.shutdown_event.wait()
     core.flush_events()
     core.shutdown()
@@ -489,10 +525,16 @@ def main():
     parser.add_argument("--controller-addr", required=True)
     parser.add_argument("--worker-id", required=True)
     args = parser.parse_args()
+    renv = None
+    renv_json = os.environ.get("RTPU_RUNTIME_ENV_JSON")
+    if renv_json:
+        import json
+
+        renv = json.loads(renv_json)
     run_worker(session_name=args.session_name, session_dir=args.session_dir,
                node_id=args.node_id, nodelet_addr=args.nodelet_addr,
                controller_addr=args.controller_addr,
-               worker_id=args.worker_id)
+               worker_id=args.worker_id, runtime_env=renv)
 
 
 if __name__ == "__main__":

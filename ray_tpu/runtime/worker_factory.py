@@ -42,7 +42,8 @@ def _child_main(req: dict, args) -> None:
 
     run_worker(session_name=args.session_name, session_dir=args.session_dir,
                node_id=args.node_id, nodelet_addr=args.nodelet_addr,
-               controller_addr=args.controller_addr, worker_id=worker_id)
+               controller_addr=args.controller_addr, worker_id=worker_id,
+               runtime_env=req.get("runtime_env"))
     os._exit(0)
 
 

@@ -1,0 +1,149 @@
+"""Runtime environments: py_modules / pip isolation + per-env worker pools.
+
+Ref: python/ray/_private/runtime_env/ (agent :164, plugins pip.py /
+py_modules.py, uri_cache.py) and worker_pool.cc per-runtime-env pools.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def session():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    s = ray_tpu.init(num_cpus=2)
+    yield s
+    ray_tpu.shutdown()
+
+
+def _write_module(dirpath, name, value):
+    os.makedirs(dirpath, exist_ok=True)
+    with open(os.path.join(dirpath, f"{name}.py"), "w") as f:
+        f.write(f"VALUE = {value}\n")
+    return os.path.join(dirpath, f"{name}.py")
+
+
+def test_py_modules_isolation(session, tmp_path):
+    mod = _write_module(str(tmp_path / "mods"), "rtpu_testmod_a", 42)
+
+    @ray_tpu.remote(runtime_env={"py_modules": [mod]})
+    def read():
+        import rtpu_testmod_a
+
+        return rtpu_testmod_a.VALUE
+
+    with pytest.raises(ImportError):
+        import rtpu_testmod_a  # noqa: F401 — must NOT be importable here
+    assert ray_tpu.get(read.remote(), timeout=120) == 42
+
+
+def test_per_env_worker_pools_do_not_cross_contaminate(session, tmp_path):
+    """Two envs provide the SAME module name with different contents;
+    each task must see its own env's version (a shared worker would
+    leak the first import)."""
+    mod1 = _write_module(str(tmp_path / "v1"), "rtpu_testmod_b", 1)
+    mod2 = _write_module(str(tmp_path / "v2"), "rtpu_testmod_b", 2)
+
+    @ray_tpu.remote
+    def read():
+        import rtpu_testmod_b
+
+        return rtpu_testmod_b.VALUE
+
+    r1 = read.options(runtime_env={"py_modules": [mod1]}).remote()
+    r2 = read.options(runtime_env={"py_modules": [mod2]}).remote()
+    out = ray_tpu.get([r1, r2], timeout=180)
+    assert out == [1, 2]
+    # and interleaved again, exercising pool reuse
+    out = ray_tpu.get(
+        [read.options(runtime_env={"py_modules": [mod2]}).remote(),
+         read.options(runtime_env={"py_modules": [mod1]}).remote()],
+        timeout=180)
+    assert out == [2, 1]
+
+
+def test_pip_local_package_version_differs_from_driver(session, tmp_path):
+    """A task runs with a pip-installed package (from a local wheel —
+    offline) at a version the driver does not have."""
+    pkg = tmp_path / "pkg" / "rtpu_pipdemo"
+    os.makedirs(pkg)
+    (pkg / "__init__.py").write_text("__version__ = '9.9.9'\n")
+    (tmp_path / "pkg" / "pyproject.toml").write_text(textwrap.dedent("""
+        [build-system]
+        requires = ["setuptools"]
+        build-backend = "setuptools.build_meta"
+
+        [project]
+        name = "rtpu-pipdemo"
+        version = "9.9.9"
+    """))
+    build = subprocess.run(
+        [sys.executable, "-m", "pip", "wheel", "--no-deps",
+         "--no-build-isolation", "-w", str(tmp_path / "wheels"),
+         str(tmp_path / "pkg")],
+        capture_output=True, text=True, timeout=300)
+    if build.returncode != 0:
+        pytest.skip(f"cannot build wheels offline: {build.stderr[-300:]}")
+    wheel = next((tmp_path / "wheels").glob("*.whl"))
+
+    @ray_tpu.remote(runtime_env={"pip": {
+        "packages": [str(wheel)],
+        "pip_args": ["--no-index", "--no-deps"]}})
+    def version():
+        import rtpu_pipdemo
+
+        return rtpu_pipdemo.__version__
+
+    with pytest.raises(ImportError):
+        import rtpu_pipdemo  # noqa: F401
+    assert ray_tpu.get(version.remote(), timeout=300) == "9.9.9"
+
+
+def test_env_cache_reused_across_tasks(session, tmp_path):
+    """Same env hash -> one build, reused worker pool (URI cache)."""
+    mod = _write_module(str(tmp_path / "mods"), "rtpu_testmod_c", 7)
+    env = {"py_modules": [mod]}
+
+    @ray_tpu.remote(runtime_env=env)
+    def pid_and_value():
+        import rtpu_testmod_c
+
+        return (os.getpid(), rtpu_testmod_c.VALUE)
+
+    first = ray_tpu.get(pid_and_value.remote(), timeout=120)
+    second = ray_tpu.get(pid_and_value.remote(), timeout=120)
+    assert first[1] == second[1] == 7
+    assert first[0] == second[0], "env worker should be reused"
+
+
+def test_runtime_env_setup_failure_surfaces(session):
+    @ray_tpu.remote(runtime_env={"pip": {
+        "packages": ["definitely-not-a-real-package-xyz"],
+        "pip_args": ["--no-index"]}}, max_retries=0)
+    def never():
+        return 1
+
+    with pytest.raises(ray_tpu.exceptions.RuntimeEnvSetupError):
+        ray_tpu.get(never.remote(), timeout=300)
+
+
+def test_actor_runtime_env_pip_modules(session, tmp_path):
+    mod = _write_module(str(tmp_path / "amods"), "rtpu_testmod_d", 11)
+
+    @ray_tpu.remote(runtime_env={"py_modules": [mod]})
+    class Reader:
+        def read(self):
+            import rtpu_testmod_d
+
+            return rtpu_testmod_d.VALUE
+
+    r = Reader.remote()
+    assert ray_tpu.get(r.read.remote(), timeout=120) == 11
