@@ -47,6 +47,8 @@ class ActorMethod:
         refs = core.submit_actor_task(
             self._handle._actor_id, self._method_name, args, kwargs,
             {"num_returns": self._num_returns})
+        if self._num_returns in ("streaming", "dynamic"):
+            return refs  # an ObjectRefGenerator
         if self._num_returns == 1:
             return refs[0]
         return refs

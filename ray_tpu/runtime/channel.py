@@ -27,6 +27,7 @@ _HEADER = 64
 _SLOT_META = 5  # flag u8 + len u32
 FLAG_DATA = 0
 FLAG_SENTINEL = 1
+FLAG_ARRAY = 2  # DeviceChannel raw-buffer frames
 
 DEFAULT_ITEM_SIZE = 4 << 20
 DEFAULT_SLOTS = 2
@@ -146,8 +147,110 @@ class Channel:
         return pickle.loads(payload)
 
     def __reduce__(self):
-        return (Channel, (self.session_name, self.name, self.item_size,
-                          self.num_slots))
+        return (type(self), (self.session_name, self.name, self.item_size,
+                             self.num_slots))
 
     def __repr__(self):
         return f"Channel({self.name})"
+
+
+class DeviceChannel(Channel):
+    """Array channel for compiled-graph stage handoff (the TPU stand-in
+    for the reference's NCCL channels; ref: experimental/channel/
+    torch_tensor_nccl_channel.py:49).
+
+    On TPU, processes cannot share device buffers (each process owns its
+    chips; cross-process device-to-device is an ICI collective inside a
+    shared jit program — ops/pipeline.py does exactly that for pp
+    stages). What a host channel CAN do is make the staging hop as cheap
+    as possible: the array's buffer is memcpy'd straight into the ring
+    slot (no pickle of the data), and the reader reconstructs a
+    zero-copy view over the mapped ring, `jax.device_put`-ing it onto
+    its device — one DMA down, one memcpy, one DMA up, no serializer.
+    """
+
+    def write_array(self, array, timeout: Optional[float] = None) -> None:
+        import numpy as np
+
+        host = np.asarray(array)  # device->host DMA for jax arrays
+        if not host.flags.c_contiguous:
+            host = np.ascontiguousarray(host)
+        header = pickle.dumps((host.dtype.str, host.shape), protocol=5)
+        total = 4 + len(header) + host.nbytes
+        if total > self.item_size:
+            raise ChannelFull(
+                f"array of {host.nbytes} bytes exceeds channel item_size "
+                f"{self.item_size}")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spin = 0
+        while True:
+            write_count, read_count = self._get_counts()
+            if write_count - read_count < self.num_slots:
+                break
+            if self._closed():
+                raise ChannelClosed(self.name)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel {self.name} write timeout")
+            spin += 1
+            time.sleep(0 if spin < 100 else 0.0002)
+        slot = (write_count % self.num_slots) * self._slot_stride + _HEADER
+        struct.pack_into("<BI", self._mm, slot, FLAG_ARRAY, total)
+        base = slot + _SLOT_META
+        struct.pack_into("<I", self._mm, base, len(header))
+        self._mm[base + 4:base + 4 + len(header)] = header
+        dst = np.frombuffer(self._mm, dtype=np.uint8,
+                            count=host.nbytes,
+                            offset=base + 4 + len(header))
+        dst[:] = host.reshape(-1).view(np.uint8)  # single memcpy
+        struct.pack_into("<Q", self._mm, 0, write_count + 1)
+
+    def read_array(self, timeout: Optional[float] = None, *, device=None,
+                   copy: bool = True):
+        """Read the next array. With copy=False the result is a numpy
+        view over the ring slot — valid ONLY until the next read (the
+        slot is released to the writer lazily, at the next read call)."""
+        import numpy as np
+
+        if getattr(self, "_deferred_release", None) is not None:
+            struct.pack_into("<Q", self._mm, 8, self._deferred_release)
+            self._deferred_release = None
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spin = 0
+        while True:
+            write_count, read_count = self._get_counts()
+            if read_count < write_count:
+                break
+            if self._closed():
+                raise ChannelClosed(self.name)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel {self.name} read timeout")
+            spin += 1
+            time.sleep(0 if spin < 100 else 0.0002)
+        slot = (read_count % self.num_slots) * self._slot_stride + _HEADER
+        flag, total = struct.unpack_from("<BI", self._mm, slot)
+        if flag == FLAG_SENTINEL:
+            struct.pack_into("<Q", self._mm, 8, read_count + 1)
+            raise ChannelClosed(self.name)
+        base = slot + _SLOT_META
+        (hlen,) = struct.unpack_from("<I", self._mm, base)
+        dtype_str, shape = pickle.loads(
+            self._mm[base + 4:base + 4 + hlen])
+        nbytes = total - 4 - hlen
+        view = np.frombuffer(self._mm, dtype=np.uint8, count=nbytes,
+                             offset=base + 4 + hlen)
+        arr = view.view(np.dtype(dtype_str)).reshape(shape)
+        if device is not None:
+            import jax
+
+            out = jax.device_put(arr, device)  # DMA straight from the map
+            # the transfer may read the mmap'd slot asynchronously (and
+            # CPU backends can alias it): finish before releasing
+            jax.block_until_ready(out)
+        elif copy:
+            out = arr.copy()
+        else:
+            # zero-copy: hold the slot until the NEXT read releases it
+            self._deferred_release = read_count + 1
+            return arr
+        struct.pack_into("<Q", self._mm, 8, read_count + 1)
+        return out

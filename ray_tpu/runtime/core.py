@@ -1255,12 +1255,10 @@ class CoreWorker:
                           kwargs: dict, opts: Dict[str, Any]) -> List[ObjectRef]:
         task_id = TaskID.from_random()
         num_returns = opts.get("num_returns", 1)
-        if not isinstance(num_returns, int):
-            raise ValueError(
-                f"num_returns={num_returns!r} is not supported for actor "
-                "tasks (streaming generators are task-only for now)")
-        return_ids = [ObjectID.for_task_return(task_id, i)
-                      for i in range(num_returns)]
+        streaming = num_returns in ("streaming", "dynamic")
+        return_ids = [] if streaming else [
+            ObjectID.for_task_return(task_id, i)
+            for i in range(num_returns)]
         seq = self._actor_seq.get(actor_id, 0)
         self._actor_seq[actor_id] = seq + 1
         spec = {
@@ -1282,6 +1280,8 @@ class CoreWorker:
         loop = EventLoopThread.get().loop
         loop.call_soon_threadsafe(self._register_and_send_actor, task_id,
                                   spec, return_ids, arg_refs, actor_id)
+        if streaming:
+            return ObjectRefGenerator(task_id, self)
         return [ObjectRef(oid, owner_addr=self.address) for oid in return_ids]
 
     def _register_and_send_actor(self, task_id, spec, return_ids, arg_refs,

@@ -232,9 +232,10 @@ class Nodelet:
                       and len(self.workers) > get_config().prestart_workers
                       and now - w.idle_since > cfg.worker_idle_timeout_s):
                     self._kill_worker(w)
-            # stall check: queued work, nothing running, nothing starting
-            if (self.queue or self.pending_actor_leases) \
-                    and self._idle_any() is None and self.starting == 0:
+            # stall check: periodic re-dispatch while work is queued —
+            # per-pool gaps (e.g. an env worker whose spawn failed while
+            # another pool sits idle) self-heal here
+            if self.queue or self.pending_actor_leases:
                 self._dispatch()
             # periodic respill: backlogged work re-enters placement when
             # the cluster has other nodes (ref: the reference re-runs
@@ -720,6 +721,7 @@ class Nodelet:
         while made_progress and self.queue:
             made_progress = False
             blocked: List[dict] = []
+            key_demand = None  # per-env demand, computed on first miss
             for _ in range(len(self.queue)):
                 if not self.queue:
                     break
@@ -733,8 +735,12 @@ class Nodelet:
                 pool = self.idle.get(key)
                 if not pool:
                     blocked.append(spec)
-                    self._request_worker(key, spec,
-                                         len(blocked) + len(self.queue))
+                    if key_demand is None:
+                        key_demand = collections.Counter(
+                            s.get("_env_key", "") for s in self.queue)
+                        for b in blocked:
+                            key_demand[b.get("_env_key", "")] += 1
+                    self._request_worker(key, spec, key_demand[key])
                     continue
                 if not self._acquire(spec):
                     blocked.append(spec)
@@ -751,24 +757,37 @@ class Nodelet:
                 asyncio.ensure_future(self._push_to_worker(ws, spec))
             for spec in blocked:
                 self.queue.append(spec)
-        # actor leases take DEFAULT-pool workers only: an env-pool worker
-        # carries sys.path prepends and cached imports that would leak
-        # into the actor's process (its own env applies at takeover)
-        while self.pending_actor_leases and self.idle.get(""):
+        # actor leases take workers from their OWN env pool (default pool
+        # for env-less actors): an env-pool worker carries sys.path
+        # prepends and cached imports that would leak into a mismatched
+        # actor, and pip-env actors need the cold-started worker their
+        # pinned versions require
+        while self.pending_actor_leases:
             actor_id, spec = self.pending_actor_leases.popleft()
+            key = spec.get("_env_key", "")
+            pool = self.idle.get(key)
+            if not pool:
+                self.pending_actor_leases.appendleft((actor_id, spec))
+                break
             if not self._acquire(spec):
                 self.pending_actor_leases.appendleft((actor_id, spec))
                 break
-            worker_id = self.idle[""].popleft()
+            worker_id = pool.popleft()
             ws = self.workers[worker_id]
             ws.actor_id = actor_id
             ws.current_task = spec
             asyncio.ensure_future(self._push_actor_to_worker(ws, spec))
         # actor workers are demand-driven and bounded by resources, not by
         # the task-pool cap (each actor is an explicit user-created process)
-        if self.pending_actor_leases and not self.idle.get(""):
-            if self.starting < len(self.pending_actor_leases):
-                self._start_worker(force=True)
+        if self.pending_actor_leases:
+            actor_id, head = self.pending_actor_leases[0]
+            head_key = head.get("_env_key", "")
+            if not self.idle.get(head_key) and \
+                    self.starting_by_key.get(head_key, 0) < \
+                    len(self.pending_actor_leases):
+                self._start_worker(force=True,
+                                   runtime_env=head.get("runtime_env"),
+                                   env_key=head_key)
 
     def _request_worker(self, key: str, spec: dict, demand: int):
         """Start a worker for this env pool if the demand warrants it;
@@ -878,8 +897,11 @@ class Nodelet:
                                     "placement_group_id": spec.get("placement_group_id"),
                                     "bundle_index": spec.get("bundle_index", -1)}):
             return False
+        from .runtime_env import env_key as _env_key
+
         self.pending_actor_leases.append((actor_id, dict(
-            spec, type="actor_create", task_id=os.urandom(16))))
+            spec, type="actor_create", task_id=os.urandom(16),
+            _env_key=_env_key(spec.get("runtime_env")))))
         self._dispatch()
         return True
 

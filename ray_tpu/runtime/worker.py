@@ -215,31 +215,35 @@ class Executor:
         back incrementally, not buffered). A mid-stream exception
         propagates to the caller (-> _send_error; the owner terminates
         the stream with the error at the next slot)."""
-        task_id = TaskID(spec["task_id"])
         owner = self.core.client_for(spec["owner_addr"])
         index = 0
         for value in gen:
-            sv = serialization.serialize(value)
-            oid = ObjectID.for_task_return(task_id, index)
-            if sv.total_size() <= get_config().max_direct_call_object_size:
-                owner.notify("task_stream_item", task_id=spec["task_id"],
-                             index=index, kind="inline",
-                             payload=serialization.dumps_inline(value))
-            else:
-                size = self.core.store.put_serialized(oid, sv)
-                try:
-                    self.core.nodelet.notify_nowait(
-                        "object_sealed", oid=oid.binary(), size=size)
-                except Exception:
-                    pass
-                owner.notify("task_stream_item", task_id=spec["task_id"],
-                             index=index, kind="shm",
-                             payload={"host": self.core.host_id,
-                                      "node_addr": self.core.nodelet_addr,
-                                      "size": size})
+            self._send_stream_item(spec, index, value)
             index += 1
         owner.notify("task_result", task_id=spec["task_id"], status="ok",
                      results=[], stream_len=index)
+
+    def _send_stream_item(self, spec: dict, index: int, value: Any) -> None:
+        task_id = TaskID(spec["task_id"])
+        owner = self.core.client_for(spec["owner_addr"])
+        sv = serialization.serialize(value)
+        if sv.total_size() <= get_config().max_direct_call_object_size:
+            owner.notify("task_stream_item", task_id=spec["task_id"],
+                         index=index, kind="inline",
+                         payload=serialization.dumps_inline(value))
+        else:
+            oid = ObjectID.for_task_return(task_id, index)
+            size = self.core.store.put_serialized(oid, sv)
+            try:
+                self.core.nodelet.notify_nowait(
+                    "object_sealed", oid=oid.binary(), size=size)
+            except Exception:
+                pass
+            owner.notify("task_stream_item", task_id=spec["task_id"],
+                         index=index, kind="shm",
+                         payload={"host": self.core.host_id,
+                                  "node_addr": self.core.nodelet_addr,
+                                  "size": size})
 
     def _send_results(self, spec: dict, result: Any) -> bool:
         """Returns True if the combined task_done frame (result + worker
@@ -371,7 +375,8 @@ class Executor:
             return
         method = getattr(type(self.actor_instance), method_name, None) \
             if self.actor_instance is not None else None
-        if method is not None and inspect.iscoroutinefunction(method):
+        if method is not None and (inspect.iscoroutinefunction(method)
+                                   or inspect.isasyncgenfunction(method)):
             if self.user_loop is None:
                 self.user_loop = _UserLoop()
                 sem_conc = max(self.max_concurrency, 1000
@@ -394,6 +399,29 @@ class Executor:
                 loop = asyncio.get_event_loop()
                 args, kwargs = await loop.run_in_executor(
                     None, lambda: self._unpack_args(spec))
+                if spec.get("num_returns") in ("streaming", "dynamic") \
+                        and not inspect.isasyncgenfunction(method):
+                    raise TypeError(
+                        "num_returns='streaming' requires a generator "
+                        "method (got a plain coroutine)")
+                if inspect.isasyncgenfunction(method):
+                    if spec.get("num_returns") not in ("streaming",
+                                                       "dynamic"):
+                        raise TypeError(
+                            "async generator methods require "
+                            "num_returns='streaming'")
+                    agen = method(*args, **kwargs)
+                    index = 0
+                    async for item in agen:
+                        await loop.run_in_executor(
+                            None, self._send_stream_item, spec, index, item)
+                        index += 1
+                    owner = self.core.client_for(spec["owner_addr"])
+                    await loop.run_in_executor(None, lambda: owner.notify(
+                        "task_result", task_id=spec["task_id"],
+                        status="ok", results=[], stream_len=index))
+                    self._maybe_drain_exit()
+                    return
                 result = await method(*args, **kwargs)
                 await loop.run_in_executor(
                     None, lambda: self._send_results(spec, result))
@@ -429,6 +457,16 @@ class Executor:
             method = getattr(self.actor_instance, spec["method"])
             args, kwargs = self._unpack_args(spec)
             result = method(*args, **kwargs)
+            if spec.get("num_returns") in ("streaming", "dynamic"):
+                if not inspect.isgenerator(result):
+                    raise TypeError(
+                        "num_returns='streaming' requires a generator "
+                        "method")
+                # same item protocol as task generators; items ride the
+                # owner socket so they stay FIFO with the terminator
+                self._stream_results(spec, result)
+                self._maybe_drain_exit()
+                return
             if inspect.isgenerator(result):
                 result = list(result)
             self._send_results(spec, result)

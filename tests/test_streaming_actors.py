@@ -1,0 +1,136 @@
+"""Actor-task streaming generators + device channels.
+
+Lifts round 1's task-only restriction (VERDICT item 10; ref:
+_raylet.pyx:1113 streaming generator execution, which supports actor
+tasks) and covers the DeviceChannel array handoff (ref:
+experimental/channel/torch_tensor_nccl_channel.py:49 — TPU redesign:
+single-memcpy host staging + device_put, no serializer).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def session():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    s = ray_tpu.init(num_cpus=2)
+    yield s
+    ray_tpu.shutdown()
+
+
+def test_actor_streaming_generator(session):
+    @ray_tpu.remote
+    class Gen:
+        def counts(self, n):
+            for i in range(n):
+                yield i * 10
+
+    g = Gen.remote()
+    stream = g.counts.options(num_returns="streaming").remote(4)
+    values = [ray_tpu.get(ref, timeout=60) for ref in stream]
+    assert values == [0, 10, 20, 30]
+
+
+def test_actor_streaming_large_items(session):
+    @ray_tpu.remote
+    class Gen:
+        def blobs(self):
+            for i in range(3):
+                yield np.full(1 << 20, float(i))  # 8 MB: shm path
+
+    g = Gen.remote()
+    stream = g.blobs.options(num_returns="streaming").remote()
+    for i, ref in enumerate(stream):
+        assert ray_tpu.get(ref, timeout=60)[0] == float(i)
+    assert i == 2
+
+
+def test_actor_streaming_midstream_error(session):
+    @ray_tpu.remote
+    class Gen:
+        def bad(self):
+            yield 1
+            raise ValueError("boom")
+
+    g = Gen.remote()
+    stream = g.bad.options(num_returns="streaming").remote()
+    first = next(stream)
+    assert ray_tpu.get(first, timeout=60) == 1
+    failing = next(stream)
+    with pytest.raises(ray_tpu.exceptions.TaskError):
+        ray_tpu.get(failing, timeout=60)
+    with pytest.raises(StopIteration):
+        next(stream)
+
+
+def test_async_actor_streaming(session):
+    @ray_tpu.remote
+    class AsyncGen:
+        async def ticks(self, n):
+            import asyncio
+
+            for i in range(n):
+                await asyncio.sleep(0.01)
+                yield i
+
+    g = AsyncGen.remote()
+    stream = g.ticks.options(num_returns="streaming").remote(3)
+    assert [ray_tpu.get(r, timeout=60) for r in stream] == [0, 1, 2]
+
+
+def test_device_channel_roundtrip(session):
+    from ray_tpu.runtime.channel import DeviceChannel
+
+    ch = DeviceChannel(session.session_name, "devch-test",
+                       item_size=16 << 20)
+    arr = np.arange(1 << 20, dtype=np.float32).reshape(1024, 1024)
+    ch.write_array(arr)
+    out = ch.read_array(timeout=10)
+    assert out.dtype == np.float32 and out.shape == (1024, 1024)
+    assert np.array_equal(out, arr)
+    # zero-copy read path
+    ch.write_array(arr * 2)
+    view = ch.read_array(timeout=10, copy=False)
+    assert view[0, 1] == 2.0
+    # jax device placement path
+    import jax
+
+    ch.write_array(arr)
+    dev = ch.read_array(timeout=10, device=jax.devices("cpu")[0])
+    assert float(np.asarray(dev)[0, 2]) == 2.0
+    ch.unlink()
+
+
+def test_device_channel_across_actors(session):
+    from ray_tpu.runtime.channel import DeviceChannel
+
+    name = "devch-actors"
+
+    @ray_tpu.remote
+    class Producer:
+        def __init__(self, session_name):
+            self.ch = DeviceChannel(session_name, name,
+                                    item_size=16 << 20)
+
+        def send(self, k):
+            self.ch.write_array(np.full((256, 256), float(k)))
+            return True
+
+    @ray_tpu.remote
+    class Consumer:
+        def __init__(self, session_name):
+            self.ch = DeviceChannel(session_name, name,
+                                    item_size=16 << 20)
+
+        def recv(self):
+            return float(self.ch.read_array(timeout=30)[0, 0])
+
+    p = Producer.remote(session.session_name)
+    c = Consumer.remote(session.session_name)
+    fut = c.recv.remote()
+    assert ray_tpu.get(p.send.remote(7), timeout=60)
+    assert ray_tpu.get(fut, timeout=60) == 7.0
