@@ -33,20 +33,25 @@ def _build_actor_resources(opts: Dict[str, Any]) -> Dict[str, float]:
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str,
-                 num_returns: int = 1):
+                 num_returns: int = 1, concurrency_group: str = None):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
     def options(self, **opts) -> "ActorMethod":
-        return ActorMethod(self._handle, self._method_name,
-                           num_returns=opts.get("num_returns", self._num_returns))
+        return ActorMethod(
+            self._handle, self._method_name,
+            num_returns=opts.get("num_returns", self._num_returns),
+            concurrency_group=opts.get("concurrency_group",
+                                       self._concurrency_group))
 
     def remote(self, *args, **kwargs):
         core = get_core()
         refs = core.submit_actor_task(
             self._handle._actor_id, self._method_name, args, kwargs,
-            {"num_returns": self._num_returns})
+            {"num_returns": self._num_returns,
+             "concurrency_group": self._concurrency_group})
         if self._num_returns in ("streaming", "dynamic"):
             return refs  # an ObjectRefGenerator
         if self._num_returns == 1:
@@ -155,6 +160,7 @@ class ActorClass:
             "resources": _build_actor_resources(opts),
             "max_restarts": opts.get("max_restarts", 0),
             "max_concurrency": opts.get("max_concurrency", 1),
+            "concurrency_groups": opts.get("concurrency_groups"),
             "runtime_env": opts.get("runtime_env"),
         }
         spec_opts.update(resolve_strategy(opts.get("scheduling_strategy")))

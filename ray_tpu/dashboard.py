@@ -10,6 +10,7 @@ single JSON-over-HTTP server plus a minimal HTML overview page.
 from __future__ import annotations
 
 import json
+import os
 
 from typing import Optional, Tuple
 
@@ -51,5 +52,68 @@ def start_dashboard(port: int = 8265,
         "/api/jobs": _json(state.list_jobs),
         "/api/summary/tasks": _json(state.summarize_tasks),
         "/api/summary/actors": _json(state.summarize_actors),
+        "/api/logs": _json(_list_logs),
     }
-    return start_http(routes, port=port, host=host)
+    return start_http(routes, port=port, host=host,
+                      prefix_routes={"/api/logs/": _serve_log})
+
+
+def _session_log_dir():
+    from .runtime.node import current_session
+
+    session = current_session()
+    if session is None:
+        return None
+    return os.path.join(session.session_dir, "logs")
+
+
+def _list_logs():
+    """Names + sizes of this session's log files (ref: the dashboard
+    agent's log index, dashboard/modules/reporter + log serving)."""
+    log_dir = _session_log_dir()
+    if not log_dir or not os.path.isdir(log_dir):
+        return []
+    out = []
+    for name in sorted(os.listdir(log_dir)):
+        path = os.path.join(log_dir, name)
+        try:
+            out.append({"name": name, "bytes": os.path.getsize(path)})
+        except OSError:
+            pass
+    return out
+
+
+def _serve_log(path: str):
+    """GET /api/logs/<name>?tail=N — raw log content (tail by lines,
+    read backwards in blocks; full fetches cap at the last 16 MB)."""
+    from urllib.parse import parse_qs, urlparse
+
+    parsed = urlparse(path)
+    name = os.path.basename(parsed.path[len("/api/logs/"):])
+    log_dir = _session_log_dir()
+    full = os.path.join(log_dir, name) if log_dir and name else None
+    if not full or not os.path.isfile(full):
+        return b"log not found", "text/plain", 404
+    try:
+        n = int(parse_qs(parsed.query).get("tail", ["0"])[0])
+    except ValueError:
+        n = 0
+    size = os.path.getsize(full)
+    with open(full, "rb") as f:
+        if n <= 0:
+            cap = 16 << 20
+            if size > cap:
+                f.seek(size - cap)
+            return f.read(), "text/plain"
+        # walk backwards block by block until n newlines are seen
+        block = 64 << 10
+        data = b""
+        pos = size
+        while pos > 0 and data.count(b"\n") <= n:
+            step = min(block, pos)
+            pos -= step
+            f.seek(pos)
+            data = f.read(step) + data
+            if len(data) > (64 << 20):
+                break
+    return b"\n".join(data.splitlines()[-n:]), "text/plain"

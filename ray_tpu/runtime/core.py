@@ -279,6 +279,23 @@ class CoreWorker:
         self.address = self._server.address  # ephemeral tcp port resolved
         EventLoopThread.get().spawn(self._metrics_flush_loop())
         EventLoopThread.get().spawn(self._borrow_sweep_loop())
+        if self.mode == "driver" and get_config().log_to_driver:
+            # stream worker stdout/stderr to this driver (ref:
+            # log_monitor.py -> GcsLogSubscriber -> driver print)
+            try:
+                self.subscribe("logs", self._print_worker_logs)
+            except Exception:
+                pass
+
+    @staticmethod
+    def _print_worker_logs(msg):
+        import sys as sys_mod
+
+        for entry in msg or []:
+            prefix = f"({entry.get('worker', '?')[:8]} " \
+                     f"node={entry.get('node_id', '?')})"
+            for line in entry.get("lines", []):
+                print(f"{prefix} {line}", file=sys_mod.stderr)
 
     async def _metrics_flush_loop(self):
         """Ship this process's metric registry to the controller every few
@@ -1218,6 +1235,7 @@ class CoreWorker:
             "resources": opts.get("resources") or {},
             "max_restarts": opts.get("max_restarts", 0),
             "max_concurrency": opts.get("max_concurrency", 1),
+            "concurrency_groups": opts.get("concurrency_groups"),
             "placement_group_id": opts.get("placement_group_id"),
             "bundle_index": opts.get("bundle_index", -1),
             "scheduling_strategy": opts.get("scheduling_strategy"),
@@ -1272,6 +1290,7 @@ class CoreWorker:
             "caller_id": self.worker_id.hex(),
             "seq": seq,
             "max_retries": 0,
+            "concurrency_group": opts.get("concurrency_group"),
         }
         arg_refs = _collect_refs(args, kwargs)
         spec.update(self._pack_args(args, kwargs, arg_refs))

@@ -128,6 +128,7 @@ class Nodelet:
         self._factory_path = os.path.join(
             session_dir, "sock", f"factory-{node_id[:8]}.sock")
         self._store = None  # lazy: object-manager reads only
+        self._log_owned: set = set()  # worker log prefixes this node tails
         from .object_store import host_id as _host_id
         from .topology import detect_host_tpu
 
@@ -175,6 +176,7 @@ class Nodelet:
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._bg.append(asyncio.ensure_future(self._reap_loop()))
         self._bg.append(asyncio.ensure_future(self._memory_monitor_loop()))
+        self._bg.append(asyncio.ensure_future(self._log_monitor_loop()))
         for _ in range(get_config().prestart_workers):
             self._start_worker()
 
@@ -252,6 +254,66 @@ class Nodelet:
                         continue
                     asyncio.ensure_future(self.submit_task(spec))
 
+    # ------------------------------------------------------------ logs
+    async def _log_monitor_loop(self):
+        """Tail THIS node's worker log files and publish new lines to the
+        cluster log channel; drivers subscribed with log_to_driver print
+        them (ref: python/ray/_private/log_monitor.py tailing -> GCS log
+        pubsub). Logs are cluster-scoped (workers serve tasks from any
+        job); at most 200 lines per file per tick, with the offset only
+        advanced past what was actually published."""
+        offsets: Dict[str, int] = {}
+        log_dir = os.path.join(self.session_dir, "logs")
+        while True:
+            await asyncio.sleep(0.5)
+            batch = []
+            # only workers this nodelet started — session dirs are shared
+            # by every nodelet of a (multi-node-on-one-box) session
+            for prefix in list(self._log_owned):
+                path = os.path.join(log_dir, f"worker-{prefix}.log")
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    continue
+                pos = offsets.get(path, 0)
+                if size <= pos:
+                    continue
+                try:
+                    with open(path, "rb") as f:
+                        f.seek(pos)
+                        data = f.read(min(size - pos, 256 << 10))
+                except OSError:
+                    continue
+                # only whole lines; carry partials to the next tick, and
+                # only consume up to 200 lines so nothing is skipped
+                cut = data.rfind(b"\n")
+                if cut < 0:
+                    continue
+                lines = data[:cut].decode("utf-8", "replace").splitlines()
+                if len(lines) > 200:
+                    lines = lines[:200]
+                    consumed = 0
+                    seen = 0
+                    for i, b in enumerate(data):
+                        if b == 0x0A:  # \n
+                            seen += 1
+                            if seen == 200:
+                                consumed = i + 1
+                                break
+                    offsets[path] = pos + consumed
+                else:
+                    offsets[path] = pos + cut + 1
+                if lines:
+                    batch.append({"worker": prefix,
+                                  "node_id": self.node_id[:8],
+                                  "lines": lines})
+            if batch:
+                try:
+                    await self.controller.call_async(
+                        "publish", channel="logs", message=batch)
+                except Exception:
+                    pass
+
     # ------------------------------------------------------------ memory
     def _memory_usage(self) -> float:
         """Host memory usage fraction in [0, 1] (test file overrides)."""
@@ -317,6 +379,7 @@ class Nodelet:
         self.starting_by_key[env_key] = \
             self.starting_by_key.get(env_key, 0) + 1
         worker_id = WorkerID.from_random().hex()
+        self._log_owned.add(worker_id[:8])
         # record a placeholder so death-before-register is detectable
         ws = WorkerState(worker_id, "", -1, None, env_key=env_key)
         ws.current_task = {"placeholder": True}

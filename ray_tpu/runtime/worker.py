@@ -93,6 +93,7 @@ class Executor:
         self.actor_spec: Optional[dict] = None
         self.max_concurrency = 1
         self.env_error: Optional[str] = None
+        self.group_pools: Dict[str, ThreadPoolExecutor] = {}
         self.user_loop: Optional[_UserLoop] = None
         self._async_sem: Optional[asyncio.Semaphore] = None
         # per-caller in-order delivery (ref: actor_scheduling_queue.cc)
@@ -328,6 +329,13 @@ class Executor:
             self.exec_pool = ThreadPoolExecutor(
                 max_workers=self.max_concurrency,
                 thread_name_prefix="rtpu-actor")
+        # concurrency groups: independent thread pools per group so one
+        # group's saturation never blocks another (ref: transport/
+        # concurrency_group_manager.h; API actor.py concurrency_groups)
+        for group, width in (spec.get("concurrency_groups") or {}).items():
+            self.group_pools[group] = ThreadPoolExecutor(
+                max_workers=max(1, int(width)),
+                thread_name_prefix=f"rtpu-cg-{group}")
         try:
             # actors own their worker process: runtime env applies for
             # life, and BEFORE user code loads (import-time reads see it)
@@ -367,6 +375,13 @@ class Executor:
 
     def _start_actor_task(self, spec: dict):
         method_name = spec["method"]
+        group = spec.get("concurrency_group")
+        if group and group not in self.group_pools:
+            # an undeclared group must FAIL, not silently lose isolation
+            self._send_error(spec, ValueError(
+                f"concurrency group {group!r} was not declared on the "
+                f"actor (declared: {sorted(self.group_pools)})"))
+            return
         if method_name == "__rtpu_dag_loop__":
             # Compiled-graph loop (ray_tpu/dag): runs on its own daemon
             # thread for the DAG's lifetime; the call itself returns as
@@ -387,7 +402,9 @@ class Executor:
             asyncio.run_coroutine_threadsafe(
                 self._run_actor_coro(spec), self.user_loop.loop)
         else:
-            self.exec_pool.submit(self._run_actor_sync, spec)
+            pool = self.group_pools.get(spec.get("concurrency_group"),
+                                        self.exec_pool)
+            pool.submit(self._run_actor_sync, spec)
 
     async def _make_sem(self, n):
         self._async_sem = asyncio.Semaphore(n)

@@ -82,6 +82,10 @@ class RunConfig:
     # any reported metric reaches its threshold (training_iteration counts
     # reports). ref: air/config.py RunConfig.stop.
     stop: Optional[Dict[str, Any]] = None
+    # Experiment-tracking callbacks (ref: air/config.py RunConfig.callbacks;
+    # integrations air/integrations/{wandb,mlflow}.py) — objects with
+    # on_start(run_name) / on_result(metrics, iteration) / on_end(result).
+    callbacks: Optional[list] = None
 
 
 @dataclass

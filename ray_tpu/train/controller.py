@@ -111,6 +111,7 @@ class TrainController:
         self.poll_interval = poll_interval
 
         name = run_config.name or f"train_{int(time.time())}"
+        self.run_name = name  # callbacks get the RESOLVED name
         storage = run_config.storage_path or os.path.join(
             os.path.expanduser("~"), "rtpu_results")
         self.trial_dir = os.path.join(storage, name)
@@ -126,6 +127,11 @@ class TrainController:
 
     # ------------------------------------------------------------------ run
     def run(self) -> Result:
+        for cb in (self.run_config.callbacks or []):
+            try:
+                cb.on_start(self.run_name)
+            except Exception:
+                logger.exception("callback on_start failed")
         decision = self.scaling_policy.initial_decision()
         attempt_error: Optional[str] = None
         while True:
@@ -162,6 +168,12 @@ class TrainController:
         return self._build_result(None)
 
     def _build_result(self, error: Optional[BaseException]) -> Result:
+        for cb in (self.run_config.callbacks or []):
+            try:
+                cb.on_end(self.metrics_history[-1]
+                          if self.metrics_history else {}, error)
+            except Exception:
+                logger.exception("callback on_end failed")
         result = Result(
             metrics=self.metrics_history[-1] if self.metrics_history else {},
             checkpoint=self.checkpoint_manager.best_checkpoint,
@@ -230,6 +242,11 @@ class TrainController:
         for rep in by_rank.get(0, []):
             metrics = rep["metrics"]
             self.metrics_history.append(metrics)
+            for cb in (self.run_config.callbacks or []):
+                try:
+                    cb.on_result(metrics, len(self.metrics_history))
+                except Exception:
+                    logger.exception("callback on_result failed")
             if rep["checkpoint_path"]:
                 self.checkpoint_manager.register(
                     Checkpoint(rep["checkpoint_path"]), metrics)

@@ -1,0 +1,128 @@
+"""Experiment-tracking integrations: JSON / W&B / MLflow logger callbacks.
+
+Ref: python/ray/air/integrations/{wandb.py, mlflow.py} and the air logger
+callbacks. Attach via RunConfig(callbacks=[...]); each callback receives
+on_start(run_name), on_result(metrics, iteration), on_end(last_metrics,
+error). The W&B/MLflow callbacks degrade gracefully when the library is
+not installed (this image ships neither) — they raise at CONSTRUCTION
+with a clear message unless allow_missing=True, in which case they no-op.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+
+class LoggerCallback:
+    """Base experiment-tracking callback."""
+
+    def on_start(self, run_name: str) -> None:  # noqa: B027
+        pass
+
+    def on_result(self, metrics: Dict[str, Any], iteration: int) -> None:  # noqa: B027
+        pass
+
+    def on_end(self, last_metrics: Dict[str, Any],
+               error: Optional[BaseException]) -> None:  # noqa: B027
+        pass
+
+
+class JsonLoggerCallback(LoggerCallback):
+    """Append one JSON line per reported result (ref: the air
+    JsonLoggerCallback writing result.json per trial)."""
+
+    def __init__(self, log_dir: str = "."):
+        self.log_dir = log_dir
+        self._path: Optional[str] = None
+
+    def on_start(self, run_name: str) -> None:
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._path = os.path.join(self.log_dir, f"{run_name}_result.json")
+
+    def on_result(self, metrics: Dict[str, Any], iteration: int) -> None:
+        if self._path is None:
+            return
+        with open(self._path, "a") as f:
+            f.write(json.dumps(
+                {"training_iteration": iteration, "timestamp": time.time(),
+                 **{k: v for k, v in metrics.items()
+                    if isinstance(v, (int, float, str, bool))
+                    or v is None}}) + "\n")
+
+
+class WandbLoggerCallback(LoggerCallback):
+    """Weights & Biases logging (ref: air/integrations/wandb.py)."""
+
+    def __init__(self, project: str = "ray_tpu", allow_missing: bool = False,
+                 **wandb_init_kwargs):
+        try:
+            import wandb  # noqa: F401
+
+            self._wandb = wandb
+        except ImportError:
+            if not allow_missing:
+                raise ImportError(
+                    "WandbLoggerCallback requires the `wandb` package, "
+                    "which is not installed; pass allow_missing=True to "
+                    "no-op without it")
+            self._wandb = None
+        self.project = project
+        self.kwargs = wandb_init_kwargs
+        self._run = None
+
+    def on_start(self, run_name: str) -> None:
+        if self._wandb is not None:
+            self._run = self._wandb.init(project=self.project,
+                                         name=run_name, **self.kwargs)
+
+    def on_result(self, metrics: Dict[str, Any], iteration: int) -> None:
+        if self._run is not None:
+            self._run.log(metrics, step=iteration)
+
+    def on_end(self, last_metrics, error) -> None:
+        if self._run is not None:
+            self._run.finish(exit_code=1 if error else 0)
+
+
+class MLflowLoggerCallback(LoggerCallback):
+    """MLflow logging (ref: air/integrations/mlflow.py)."""
+
+    def __init__(self, tracking_uri: Optional[str] = None,
+                 experiment_name: str = "ray_tpu",
+                 allow_missing: bool = False):
+        try:
+            import mlflow
+
+            self._mlflow = mlflow
+        except ImportError:
+            if not allow_missing:
+                raise ImportError(
+                    "MLflowLoggerCallback requires the `mlflow` package, "
+                    "which is not installed; pass allow_missing=True to "
+                    "no-op without it")
+            self._mlflow = None
+        self.tracking_uri = tracking_uri
+        self.experiment_name = experiment_name
+
+    def on_start(self, run_name: str) -> None:
+        if self._mlflow is None:
+            return
+        if self.tracking_uri:
+            self._mlflow.set_tracking_uri(self.tracking_uri)
+        self._mlflow.set_experiment(self.experiment_name)
+        self._mlflow.start_run(run_name=run_name)
+
+    def on_result(self, metrics: Dict[str, Any], iteration: int) -> None:
+        if self._mlflow is None:
+            return
+        numeric = {k: v for k, v in metrics.items()
+                   if isinstance(v, (int, float))}
+        if numeric:
+            self._mlflow.log_metrics(numeric, step=iteration)
+
+    def on_end(self, last_metrics, error) -> None:
+        if self._mlflow is not None:
+            self._mlflow.end_run(status="FAILED" if error else "FINISHED")

@@ -10,13 +10,28 @@ from typing import Callable, Dict, Tuple
 
 
 def start_http(routes: Dict[str, Callable[[], Tuple[bytes, str]]],
-               port: int = 0, host: str = "127.0.0.1"):
-    """Returns (bound_port, server); server runs on a daemon thread."""
+               port: int = 0, host: str = "127.0.0.1",
+               prefix_routes: Dict[str, Callable[[str],
+                                                 Tuple[bytes, str]]] = None):
+    """Returns (bound_port, server); server runs on a daemon thread.
+    `prefix_routes` handlers receive the full request path (with query)
+    and serve everything under their prefix."""
+    prefix_routes = prefix_routes or {}
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):
-            handler = routes.get(self.path)
+            handler = routes.get(self.path.split("?", 1)[0])
             if handler is None:
+                for prefix, phandler in prefix_routes.items():
+                    if self.path.startswith(prefix):
+                        try:
+                            out = phandler(self.path)
+                            body, ctype = out[0], out[1]
+                            status = out[2] if len(out) > 2 else 200
+                            self._send(status, body, ctype)
+                        except Exception as e:
+                            self._send(500, repr(e).encode(), "text/plain")
+                        return
                 self._send(404, b"not found", "text/plain")
                 return
             try:

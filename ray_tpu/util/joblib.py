@@ -1,0 +1,84 @@
+"""joblib backend: scikit-learn parallelism over the cluster.
+
+Ref: python/ray/util/joblib/ (register_ray + the ray joblib backend).
+Usage:
+
+    from ray_tpu.util.joblib import register_ray_tpu
+    import joblib
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        Parallel(n_jobs=8)(delayed(f)(x) for x in data)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..remote_function import RemoteFunction
+
+
+def _invoke(batched_call):
+    return batched_call()
+
+
+_remote_invoke: Optional[RemoteFunction] = None
+
+
+def _get_remote():
+    global _remote_invoke
+    if _remote_invoke is None:
+        import ray_tpu
+
+        _remote_invoke = ray_tpu.remote(_invoke)
+    return _remote_invoke
+
+
+class _RefResult:
+    """joblib async-result wrapper over an ObjectRef."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        import ray_tpu
+
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+
+def register_ray_tpu() -> None:
+    """Register the 'ray_tpu' joblib parallel backend."""
+    from joblib.parallel import ParallelBackendBase, register_parallel_backend
+
+    class RayTpuBackend(ParallelBackendBase):
+        supports_timeout = True
+
+        def configure(self, n_jobs=1, parallel=None, **kwargs):
+            import ray_tpu
+
+            if not ray_tpu.is_initialized():
+                ray_tpu.init(ignore_reinit_error=True)
+            self.parallel = parallel
+            return self.effective_n_jobs(n_jobs)
+
+        def effective_n_jobs(self, n_jobs):
+            import ray_tpu
+
+            if not ray_tpu.is_initialized():
+                return 1
+            cpus = int(ray_tpu.cluster_resources().get("CPU", 1))
+            if n_jobs is None or n_jobs < 0:
+                return max(cpus, 1)
+            return max(min(n_jobs, cpus), 1)
+
+        def apply_async(self, func, callback=None):
+            ref = _get_remote().remote(func)
+            result = _RefResult(ref)
+            if callback is not None:
+                ref.future().add_done_callback(lambda _f: callback(result))
+            return result
+
+        def abort_everything(self, ensure_ready=True):
+            pass  # refs are dropped with the Parallel object
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)

@@ -151,3 +151,73 @@ def test_runtime_env_env_vars(shared_cluster):
     actor = EnvActor.options(
         runtime_env={"env_vars": {"RTPU_ACTOR_FLAG": "actor-on"}}).remote()
     assert ray_tpu.get(actor.read.remote(), timeout=60) == "actor-on"
+
+
+def test_joblib_backend(shared_cluster):
+    """joblib parallel_backend over the cluster (ref: util/joblib)."""
+    joblib = pytest.importorskip("joblib")
+    from joblib import Parallel, delayed
+
+    from ray_tpu.util.joblib import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        out = Parallel(n_jobs=2)(delayed(pow)(i, 2) for i in range(8))
+    assert out == [i * i for i in range(8)]
+
+
+def test_actor_concurrency_groups(shared_cluster):
+    """Per-group thread pools: a saturated group does not block another
+    (ref: transport/concurrency_group_manager.h)."""
+    import time as time_mod
+
+    import ray_tpu
+
+    @ray_tpu.remote(concurrency_groups={"io": 1, "compute": 1})
+    class Split:
+        def slow_io(self):
+            time_mod.sleep(3.0)
+            return "io"
+
+        def fast_compute(self):
+            return "compute"
+
+    s = Split.remote()
+    blocker = s.slow_io.options(concurrency_group="io").remote()
+    t0 = time_mod.monotonic()
+    fast = ray_tpu.get(
+        s.fast_compute.options(concurrency_group="compute").remote(),
+        timeout=60)
+    elapsed = time_mod.monotonic() - t0
+    assert fast == "compute"
+    assert elapsed < 2.0, "compute group was blocked behind the io group"
+    assert ray_tpu.get(blocker, timeout=60) == "io"
+
+
+def test_log_streaming_to_driver(capfd):
+    """Worker prints stream back to the driver (ref: log_monitor.py ->
+    driver log subscriber)."""
+    import time as time_mod
+
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def shout():
+            print("HELLO-FROM-WORKER-XYZ", flush=True)
+            return 1
+
+        assert ray_tpu.get(shout.remote(), timeout=60) == 1
+        deadline = time_mod.time() + 10
+        seen = ""
+        while time_mod.time() < deadline:
+            seen += capfd.readouterr().err
+            if "HELLO-FROM-WORKER-XYZ" in seen:
+                break
+            time_mod.sleep(0.3)
+        assert "HELLO-FROM-WORKER-XYZ" in seen
+    finally:
+        ray_tpu.shutdown()

@@ -5,6 +5,7 @@ test_state_api*, dashboard tests) at the surfaces this framework exposes.
 """
 
 import json
+import time
 import subprocess
 import sys
 import urllib.request
@@ -175,3 +176,35 @@ def test_tracing_spans_propagate(shared_cluster):
         assert all(e["ph"] == "X" for e in trace)
     finally:
         tracing.disable()
+
+
+def test_dashboard_log_endpoints(shared_cluster):
+    """Log index + serving via the dashboard (ref: the reference's
+    dashboard agent log endpoints)."""
+    from ray_tpu.dashboard import start_dashboard
+
+    @ray_tpu.remote
+    def noisy():
+        print("LOGLINE-FOR-DASHBOARD", flush=True)
+        return 1
+
+    assert ray_tpu.get(noisy.remote(), timeout=60) == 1
+    time.sleep(0.5)
+    port, server = start_dashboard(0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(f"{base}/api/logs", timeout=10) as r:
+            logs = json.loads(r.read())
+        names = [entry["name"] for entry in logs]
+        worker_logs = [n for n in names if n.startswith("worker-")]
+        assert worker_logs
+        found = False
+        for name in worker_logs:
+            with urllib.request.urlopen(
+                    f"{base}/api/logs/{name}?tail=50", timeout=10) as r:
+                if b"LOGLINE-FOR-DASHBOARD" in r.read():
+                    found = True
+                    break
+        assert found
+    finally:
+        server.shutdown()

@@ -349,3 +349,79 @@ def test_gbdt_trainers_gated_without_libs():
     if not has_lgb:
         with pytest.raises(ImportError, match="lightgbm"):
             LightGBMTrainer(params={})
+
+
+def test_logger_callbacks(tmp_path):
+    """RunConfig callbacks receive results (ref: air RunConfig.callbacks);
+    wandb/mlflow adapters gate cleanly on missing libraries."""
+    import json
+
+    import pytest as _pytest
+
+    from ray_tpu.train.integrations import (JsonLoggerCallback,
+                                            MLflowLoggerCallback,
+                                            WandbLoggerCallback)
+
+    cb = JsonLoggerCallback(str(tmp_path))
+    cb.on_start("demo")
+    cb.on_result({"loss": 1.5, "skip_me": object()}, 1)
+    cb.on_result({"loss": 1.2}, 2)
+    cb.on_end({"loss": 1.2}, None)
+    lines = [json.loads(line) for line in
+             open(tmp_path / "demo_result.json")]
+    assert [ln["loss"] for ln in lines] == [1.5, 1.2]
+    assert lines[0]["training_iteration"] == 1
+
+    for cls in (WandbLoggerCallback, MLflowLoggerCallback):
+        try:
+            import importlib
+
+            importlib.import_module(
+                "wandb" if cls is WandbLoggerCallback else "mlflow")
+            has_lib = True
+        except ImportError:
+            has_lib = False
+        if not has_lib:
+            with _pytest.raises(ImportError):
+                cls()
+            noop = cls(allow_missing=True)
+            noop.on_start("x")
+            noop.on_result({"a": 1}, 1)
+            noop.on_end({}, None)
+
+
+def test_trainer_runconfig_callbacks_end_to_end():
+    """Callbacks wired through TrainController.run."""
+    import ray_tpu
+    from ray_tpu import train
+    from ray_tpu.train import RunConfig, ScalingConfig
+    from ray_tpu.train.integrations import LoggerCallback
+    from ray_tpu.train.trainer import JaxTrainer
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+
+    events = []
+
+    class Probe(LoggerCallback):
+        def on_start(self, run_name):
+            events.append(("start", run_name))
+
+        def on_result(self, metrics, iteration):
+            events.append(("result", iteration, metrics.get("score")))
+
+        def on_end(self, last, error):
+            events.append(("end", error))
+
+    def loop(config):
+        for i in range(2):
+            train.report({"score": i})
+
+    trainer = JaxTrainer(
+        train_loop_per_worker=loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="cb_e2e", callbacks=[Probe()]))
+    trainer.fit()
+    kinds = [e[0] for e in events]
+    assert kinds[0] == "start" and kinds[-1] == "end"
+    assert ("result", 1, 0) in events and ("result", 2, 1) in events
