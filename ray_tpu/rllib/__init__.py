@@ -11,6 +11,7 @@ Tune-compatible trainables.
 
 from .algorithms.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
 from .algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig  # noqa: F401
+from .algorithms.cql import CQL, CQLConfig  # noqa: F401
 from .algorithms.dqn import DQN, DQNConfig  # noqa: F401
 from .algorithms.multi_agent_ppo import (MultiAgentPPO,  # noqa: F401
                                          MultiAgentPPOConfig)
@@ -27,7 +28,7 @@ from .env.multi_agent import (MultiAgentEnv,  # noqa: F401
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
-    "SAC", "SACConfig", "IMPALA", "IMPALAConfig", "APPO", "APPOConfig",
+    "SAC", "SACConfig", "CQL", "CQLConfig", "IMPALA", "IMPALAConfig", "APPO", "APPOConfig",
     "BC", "BCConfig", "MARWIL", "MARWILConfig",
     "MultiAgentPPO", "MultiAgentPPOConfig", "MultiAgentEnv",
     "MultiAgentEnvRunner",

@@ -41,6 +41,10 @@ class AlgorithmConfig:
         # forward on a remote-tunneled accelerator pays a round-trip each.
         self.jax_platform = "cpu"
         self.module_spec = RLModuleSpec()
+        # ConnectorV2 pipelines (ref: rllib/connectors/): lists of
+        # connector instances or zero-arg factories
+        self.env_to_module_connectors = None
+        self.module_to_env_connectors = None
 
     # fluent builders (ref: algorithm_config.py)
     def environment(self, env=None, *, env_config=None) -> "AlgorithmConfig":
@@ -52,11 +56,17 @@ class AlgorithmConfig:
 
     def env_runners(self, *, num_env_runners: Optional[int] = None,
                     num_envs_per_env_runner: Optional[int] = None,
+                    env_to_module_connectors=None,
+                    module_to_env_connectors=None,
                     **_ignored) -> "AlgorithmConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if num_envs_per_env_runner is not None:
             self.num_envs_per_env_runner = num_envs_per_env_runner
+        if env_to_module_connectors is not None:
+            self.env_to_module_connectors = env_to_module_connectors
+        if module_to_env_connectors is not None:
+            self.module_to_env_connectors = module_to_env_connectors
         return self
 
     def learners(self, *, num_learners: Optional[int] = None,
@@ -113,7 +123,9 @@ class Algorithm:
         self.env_runner_group = EnvRunnerGroup(
             config.env, config.module_spec,
             {"num_envs_per_env_runner": config.num_envs_per_env_runner,
-             "jax_platform": config.jax_platform},
+             "jax_platform": config.jax_platform,
+             "env_to_module_connectors": config.env_to_module_connectors,
+             "module_to_env_connectors": config.module_to_env_connectors},
             num_env_runners=config.num_env_runners, seed=config.seed)
         obs_space, act_space = self.env_runner_group.get_spaces()
         self.obs_space, self.act_space = obs_space, act_space

@@ -1,0 +1,168 @@
+"""ConnectorV2 pipelines: env <-> module data transforms.
+
+Ref: rllib/connectors/ (connector_v2.py base; env-to-module pipelines
+like FlattenObservations/mean-std filtering; module-to-env action
+connectors). TPU-native simplification: connectors are pure numpy
+transforms applied at the env-runner boundary — observations are
+transformed ONCE at ingestion (so episodes, GAE bootstraps, and learner
+batches all see the same representation), and action connectors run just
+before env.step.
+
+Stateful connectors (NormalizeObservations) keep per-runner running
+statistics; their state rides get_state/set_state for checkpointing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class ConnectorV2:
+    """One transform stage. Batched: input is [n_envs, ...]."""
+
+    def __call__(self, batch: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    # spaces: let downstream modules see the transformed shape
+    def recompute_observation_space(self, space):
+        return space
+
+    def get_state(self) -> Dict[str, Any]:
+        return {}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        pass
+
+
+class ConnectorPipelineV2(ConnectorV2):
+    """Ordered composition of connectors (ref: connector_pipeline_v2.py)."""
+
+    def __init__(self, connectors: Sequence[ConnectorV2]):
+        self.connectors = list(connectors)
+
+    def __call__(self, batch):
+        for c in self.connectors:
+            batch = c(batch)
+        return batch
+
+    def recompute_observation_space(self, space):
+        for c in self.connectors:
+            space = c.recompute_observation_space(space)
+        return space
+
+    def get_state(self):
+        return {i: c.get_state() for i, c in enumerate(self.connectors)}
+
+    def set_state(self, state):
+        for i, c in enumerate(self.connectors):
+            if i in state:
+                c.set_state(state[i])
+
+    def __len__(self):
+        return len(self.connectors)
+
+
+class FlattenObservations(ConnectorV2):
+    """Dict/tuple/nd observations -> flat float32 vectors (ref:
+    connectors/env_to_module/flatten_observations.py)."""
+
+    def __call__(self, batch):
+        if isinstance(batch, dict):
+            parts = [np.asarray(batch[k], np.float32).reshape(
+                len(next(iter(batch.values()))), -1)
+                for k in sorted(batch)]
+            return np.concatenate(parts, axis=1)
+        if isinstance(batch, (tuple, list)) and not isinstance(
+                batch, np.ndarray):
+            parts = [np.asarray(p, np.float32) for p in batch]
+            n = parts[0].shape[0]
+            return np.concatenate([p.reshape(n, -1) for p in parts], axis=1)
+        arr = np.asarray(batch, np.float32)
+        return arr.reshape(arr.shape[0], -1)
+
+    def recompute_observation_space(self, space):
+        import gymnasium as gym
+
+        size = int(np.prod(_space_shape(space)))
+        return gym.spaces.Box(-np.inf, np.inf, (size,), np.float32)
+
+
+def _space_shape(space):
+    import gymnasium as gym
+
+    if isinstance(space, gym.spaces.Dict):
+        return (sum(int(np.prod(_space_shape(s)))
+                    for s in space.spaces.values()),)
+    if isinstance(space, gym.spaces.Tuple):
+        return (sum(int(np.prod(_space_shape(s))) for s in space.spaces),)
+    return space.shape or (1,)
+
+
+class NormalizeObservations(ConnectorV2):
+    """Running mean/std observation filter (ref: the mean-std filter in
+    connectors/env_to_module + utils/filter.py MeanStdFilter). Stats are
+    per env-runner; they checkpoint through get_state/set_state."""
+
+    def __init__(self, epsilon: float = 1e-8, clip: Optional[float] = 10.0,
+                 update: bool = True):
+        self.eps = epsilon
+        self.clip = clip
+        self.update = update
+        self._count = 0.0
+        self._mean: Optional[np.ndarray] = None
+        self._m2: Optional[np.ndarray] = None
+
+    def __call__(self, batch):
+        batch = np.asarray(batch, np.float32)
+        if self._mean is None:
+            self._mean = np.zeros(batch.shape[1:], np.float64)
+            self._m2 = np.ones(batch.shape[1:], np.float64)
+        if self.update:
+            for row in batch:  # Welford
+                self._count += 1.0
+                delta = row - self._mean
+                self._mean += delta / self._count
+                self._m2 += delta * (row - self._mean)
+        var = self._m2 / max(self._count, 1.0)
+        out = (batch - self._mean) / np.sqrt(var + self.eps)
+        if self.clip is not None:
+            out = np.clip(out, -self.clip, self.clip)
+        return out.astype(np.float32)
+
+    def get_state(self):
+        return {"count": self._count,
+                "mean": None if self._mean is None else self._mean.copy(),
+                "m2": None if self._m2 is None else self._m2.copy()}
+
+    def set_state(self, state):
+        self._count = state["count"]
+        self._mean = state["mean"]
+        self._m2 = state["m2"]
+
+
+class ClipActions(ConnectorV2):
+    """Clip module actions into the env's Box bounds (ref:
+    module-to-env clip_actions connector)."""
+
+    def __init__(self, low=-1.0, high=1.0):
+        self.low = low
+        self.high = high
+
+    def __call__(self, batch):
+        return np.clip(np.asarray(batch), self.low, self.high)
+
+
+def build_pipeline(spec) -> Optional[ConnectorPipelineV2]:
+    """Build a pipeline from a config value: a pipeline, a list of
+    connectors, or a list of zero-arg factories."""
+    if not spec:
+        return None
+    if isinstance(spec, ConnectorPipelineV2):
+        return spec
+    connectors = []
+    for item in spec:
+        connectors.append(item() if callable(item)
+                          and not isinstance(item, ConnectorV2) else item)
+    return ConnectorPipelineV2(connectors)

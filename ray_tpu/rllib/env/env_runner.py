@@ -63,7 +63,21 @@ class SingleAgentEnvRunner:
                      for i in range(self.num_envs)]
         self.obs_space = self.envs[0].observation_space
         self.act_space = self.envs[0].action_space
-        self.module = module_spec.build(self.obs_space, self.act_space)
+        # ConnectorV2 pipelines (ref: rllib/connectors/): observations
+        # are transformed ONCE at ingestion so episodes, bootstraps, and
+        # learner batches all share the representation
+        from ..connectors import build_pipeline
+
+        self._env_to_module = build_pipeline(
+            config.get("env_to_module_connectors"))
+        self._module_to_env = build_pipeline(
+            config.get("module_to_env_connectors"))
+        self.module_obs_space = self.obs_space
+        if self._env_to_module is not None:
+            self.module_obs_space = self._env_to_module.\
+                recompute_observation_space(self.obs_space)
+        self.module = module_spec.build(self.module_obs_space,
+                                        self.act_space)
         self.params = self.module.init(jax.random.PRNGKey(base_seed))
         self._rng = jax.random.PRNGKey(base_seed + 1)
         self._np_rng = np.random.default_rng(base_seed + 2)
@@ -72,19 +86,32 @@ class SingleAgentEnvRunner:
         self._episodes: List[Episode] = []
         self._reset_all()
 
+    def _transform_obs(self, obs):
+        if self._env_to_module is None:
+            return np.asarray(obs, np.float32)
+        if isinstance(obs, dict):
+            batched = {k: np.asarray(v)[None] for k, v in obs.items()}
+        elif isinstance(obs, (tuple, list)):
+            batched = [np.asarray(v)[None] for v in obs]
+        else:
+            batched = np.asarray(obs, np.float32)[None]
+        return np.asarray(self._env_to_module(batched)[0], np.float32)
+
     def _reset_all(self):
         self._cur_obs = []
         self._episodes = []
         for env in self.envs:
             obs, _ = env.reset()
-            self._cur_obs.append(np.asarray(obs, np.float32))
+            self._cur_obs.append(self._transform_obs(obs))
             self._episodes.append(Episode())
 
     def set_weights(self, weights) -> None:
         self.params = weights
 
     def get_spaces(self) -> Tuple[Any, Any]:
-        return self.obs_space, self.act_space
+        # the MODULE-side observation space: the learner must build its
+        # module against what the connectors emit, not the raw env space
+        return self.module_obs_space, self.act_space
 
     def sample(self, num_timesteps: int, explore: bool = True,
                epsilon: float = 0.0, weights=None) -> List[Episode]:
@@ -157,6 +184,12 @@ class SingleAgentEnvRunner:
                         * (safe_high - safe_low)
                 else:
                     action = env_action = int(actions[i])
+                if self._module_to_env is not None:
+                    # transforms apply to what the ENV sees only; the
+                    # episode stores the module's raw action so stored
+                    # (action, logp) pairs stay consistent for learners
+                    env_action = self._module_to_env(
+                        np.asarray(env_action)[None])[0]
                 next_obs, reward, terminated, truncated, _ = env.step(
                     env_action)
                 episode.actions.append(action)
@@ -168,12 +201,13 @@ class SingleAgentEnvRunner:
                     episode.terminated = bool(terminated)
                     episode.truncated = bool(truncated)
                     if truncated:
-                        episode.last_value = self._value_of(next_obs)
-                        episode.last_obs = np.asarray(next_obs, np.float32)
+                        t_next = self._transform_obs(next_obs)
+                        episode.last_value = self._value_of(t_next)
+                        episode.last_obs = t_next
                     out.append(episode)
                     next_obs, _ = env.reset()
                     self._episodes[i] = Episode()
-                self._cur_obs[i] = np.asarray(next_obs, np.float32)
+                self._cur_obs[i] = self._transform_obs(next_obs)
         # Truncate in-flight fragments into the batch (bootstrapped).
         for i in range(self.num_envs):
             episode = self._episodes[i]

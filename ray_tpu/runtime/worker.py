@@ -382,6 +382,17 @@ class Executor:
                 f"concurrency group {group!r} was not declared on the "
                 f"actor (declared: {sorted(self.group_pools)})"))
             return
+        if group and self.actor_instance is not None:
+            m = getattr(type(self.actor_instance), spec["method"], None)
+            if m is not None and (inspect.iscoroutinefunction(m)
+                                  or inspect.isasyncgenfunction(m)):
+                # same principle: async methods share one user loop —
+                # a group there would be silently ignored, so reject
+                self._send_error(spec, ValueError(
+                    "concurrency groups apply to sync methods only; "
+                    "async methods share the actor's event loop "
+                    "(size it with max_concurrency)"))
+                return
         if method_name == "__rtpu_dag_loop__":
             # Compiled-graph loop (ray_tpu/dag): runs on its own daemon
             # thread for the DAG's lifetime; the call itself returns as

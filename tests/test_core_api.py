@@ -230,19 +230,20 @@ def test_streaming_generator_error_terminates_iteration(shared_cluster):
         ray_tpu.get(refs[1], timeout=60)
 
 
-def test_streaming_rejected_for_actor_tasks(shared_cluster):
-    import pytest as _pytest
-
+def test_streaming_supported_for_actor_tasks(shared_cluster):
+    # round 1 rejected actor streaming; it is now first-class
+    # (full coverage in tests/test_streaming_actors.py)
     import ray_tpu
 
     @ray_tpu.remote
     class A:
         def gen(self):
             yield 1
+            yield 2
 
     actor = A.remote()
-    with _pytest.raises(ValueError, match="actor"):
-        actor.gen.options(num_returns="streaming").remote()
+    stream = actor.gen.options(num_returns="streaming").remote()
+    assert [ray_tpu.get(r, timeout=60) for r in stream] == [1, 2]
 
 
 def test_num_returns_dynamic_rejected(shared_cluster):

@@ -497,3 +497,78 @@ def test_multi_agent_shared_policy_and_remote_runners(shared_cluster):
     assert np.isfinite(result["shared/policy_loss"])
     assert result["timesteps_total"] >= 400
     algo.stop()
+
+
+def test_cql_offline_conservative():
+    """CQL trains from a fixed dataset and its penalty keeps Q-values on
+    out-of-distribution actions below dataset actions (ref:
+    rllib/algorithms/cql)."""
+    from ray_tpu.rllib import CQLConfig
+
+    rng = np.random.default_rng(3)
+    episodes = []
+    for _ in range(10):
+        n = 40
+        obs = rng.normal(size=(n, 3)).astype(np.float32)
+        acts = np.clip(obs[:, :1] * 0.5, -1, 1).astype(np.float32)
+        rewards = (1.0 - np.abs(acts[:, 0] - obs[:, 0] * 0.5)).astype(
+            np.float32)
+        episodes.append({"obs": obs, "actions": acts, "rewards": rewards})
+    config = (CQLConfig()
+              .environment("Pendulum-v1")
+              .training(updates_per_iteration=30, minibatch_size=64,
+                        lr=3e-4)
+              .debugging(seed=0))
+    config.offline(data=episodes, cql_alpha=1.0, cql_n_actions=4)
+    algo = config.build_algo()
+    m1 = algo.train()
+    m2 = algo.train()
+    assert np.isfinite(m2["critic_loss"])
+    assert "cql_penalty" in m2
+    algo.stop()
+
+
+def test_connector_pipelines():
+    """Env-to-module + module-to-env connector pipelines transform
+    observations at ingestion and actions before env.step (ref:
+    rllib/connectors ConnectorV2)."""
+    from ray_tpu.rllib import PPOConfig
+    from ray_tpu.rllib.connectors import (ClipActions,
+                                          NormalizeObservations)
+
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=0,
+                           env_to_module_connectors=[
+                               lambda: NormalizeObservations()])
+              .training(train_batch_size=200, minibatch_size=64,
+                        num_epochs=2)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    metrics = algo.train()
+    assert np.isfinite(metrics["total_loss"])
+    algo.stop()
+
+
+def test_normalize_observations_connector_stats():
+    from ray_tpu.rllib.connectors import NormalizeObservations
+
+    conn = NormalizeObservations()
+    data = np.random.default_rng(0).normal(5.0, 2.0, (500, 3))
+    out = conn(data)
+    assert abs(float(out.mean())) < 0.3
+    assert 0.5 < float(out.std()) < 1.5
+    state = conn.get_state()
+    fresh = NormalizeObservations(update=False)
+    fresh.set_state(state)
+    out2 = fresh(data[:10])
+    np.testing.assert_allclose(out2, out[:10], atol=1e-3)
+
+
+def test_flatten_observations_connector():
+    from ray_tpu.rllib.connectors import FlattenObservations
+
+    conn = FlattenObservations()
+    batch = {"a": np.ones((4, 2, 3)), "b": np.zeros((4, 5))}
+    flat = conn(batch)
+    assert flat.shape == (4, 11)
