@@ -37,7 +37,8 @@ WAITING, RUNNING, FINISHED = "WAITING", "RUNNING", "FINISHED"
 class SamplingParams:
     max_tokens: int = 64
     temperature: float = 0.0  # 0 => greedy
-    top_k: int = 0            # 0 => full vocab
+    top_k: int = 0            # 0 => full vocab; bounded by 64 (on-device
+                              # top_k sampler width)
     stop_token_ids: tuple = ()
     seed: Optional[int] = None  # None => engine-level RNG
     # disaggregation: stop after the first token and stash the request's
@@ -59,7 +60,6 @@ class Request:
     last_page_hash: Optional[int] = None
     n_hashed: int = 0            # tokens already entered into prefix cache
     arrival_t: float = dataclasses.field(default_factory=time.monotonic)
-    rng: Any = None              # per-request RNG when sampling.seed is set
 
     @property
     def total_len(self) -> int:
@@ -86,6 +86,33 @@ class EngineConfig:
     eos_token_id: Optional[int] = None
     seed: int = 0
     dtype: str = "bfloat16"
+    # decode steps fused into ONE device dispatch (lax.scan): amortizes
+    # dispatch latency (dominant through remote-device tunnels; material
+    # even locally). Trade-off: token delivery is chunked and a request
+    # may compute up to K-1 tokens past its stop condition.
+    decode_steps_per_dispatch: int = 1
+
+
+_MAX_TOP_K = 64
+
+
+def _device_sample(rows, temperature, top_k, rng_keys):
+    """Batched in-jit sampler: greedy when temperature == 0, else
+    temperature + (clamped) top-k categorical. rows: [B, V]."""
+    import jax
+    import jax.numpy as jnp
+
+    b = rows.shape[0]
+    greedy = jnp.argmax(rows, axis=-1)
+    scaled = rows / jnp.maximum(temperature, 1e-6)[:, None]
+    topv, _ = jax.lax.top_k(scaled, min(_MAX_TOP_K, rows.shape[-1]))
+    k_idx = jnp.clip(top_k - 1, 0, topv.shape[-1] - 1)
+    kth = topv[jnp.arange(b), k_idx]
+    masked = jnp.where((top_k[:, None] > 0) & (scaled < kth[:, None]),
+                       -jnp.inf, scaled)
+    sampled = jax.vmap(
+        lambda key, lg: jax.random.categorical(key, lg))(rng_keys, masked)
+    return jnp.where(temperature <= 0, greedy, sampled).astype(jnp.int32)
 
 
 def _bucket(n: int, buckets) -> int:
@@ -146,7 +173,6 @@ class LLMEngine:
         self.running: List[Request] = []
         self.requests: Dict[str, Request] = {}
         self._jit_cache: Dict[tuple, Any] = {}
-        self._rng = np.random.default_rng(config.seed)
 
     # ----------------------------------------------------------- intake
 
@@ -157,6 +183,10 @@ class LLMEngine:
             raise ValueError(
                 f"prompt of {len(prompt_ids)} tokens exceeds max_model_len "
                 f"{self.config.max_model_len}")
+        if sampling.top_k > _MAX_TOP_K:
+            raise ValueError(
+                f"top_k={sampling.top_k} exceeds the on-device sampler "
+                f"bound of {_MAX_TOP_K}")
         req = Request(request_id, list(prompt_ids), sampling)
         with self._intake_lock:
             self._intake.append(req)
@@ -195,7 +225,23 @@ class LLMEngine:
         deltas: List[OutputDelta] = []
         self._drain_intake(deltas)
         injected = self._try_admit_injection()
-        admitted = self._try_admit(deltas)
+        admitted = []
+        burst_prefixes: set = set()
+        while len(self.running) < self.config.max_batch:
+            req = self._admit_one(burst_prefixes)
+            if req is None:
+                break
+            admitted.append(req)
+        if admitted:
+            # batched prefill: every same-bucket prompt rides ONE device
+            # dispatch (a per-prompt dispatch made TTFT queue-linear)
+            by_bucket: Dict[int, List[Request]] = {}
+            for req in admitted:
+                n_new = len(req.prompt_ids) - req.n_cached
+                sb = _bucket(n_new, self.config.prefill_buckets)
+                by_bucket.setdefault(sb, []).append(req)
+            for sb, group in by_bucket.items():
+                self._prefill_batch(sb, group, deltas)
         if not (injected or admitted) and self.running:
             self._decode_step(deltas)
         return deltas
@@ -213,18 +259,29 @@ class LLMEngine:
                 self._finish(req, "aborted")
                 deltas.append(OutputDelta(rid, [], True, "aborted"))
 
-    def _try_admit(self, deltas: List[OutputDelta]) -> bool:
+    def _admit_one(self, burst_prefixes: set = None) -> Optional[Request]:
+        """Admit the head of the waiting queue (pages permitting) WITHOUT
+        prefilling; returns the request or None. A request whose leading
+        page matches one already admitted THIS step is deferred: next
+        step its prefix pages are computed and cached, so it shares them
+        instead of prefilling the same content in parallel."""
         if not self.waiting or len(self.running) >= self.config.max_batch:
-            return False
+            return None
         req = self.waiting[0]
         page = self.config.page_size
+        if burst_prefixes is not None and len(req.prompt_ids) >= page:
+            first_hash = self.allocator.chain_hash(
+                None, req.prompt_ids[:page])
+            if first_hash in burst_prefixes:
+                return None  # wait one step; the prefix cache will hit
+            burst_prefixes.add(first_hash)
         cached_pages, n_cached = self.allocator.match_prefix(req.prompt_ids)
         need = (-(-(len(req.prompt_ids) + 1) // page)
                 - len(cached_pages))
         if self.allocator.num_free() < need:
             self.allocator.release(cached_pages)
             self.allocator.stats["cache_hits"] -= len(cached_pages)
-            return False
+            return None
         self.waiting.pop(0)
         new_pages = self.allocator.allocate(need)
         req.pages = cached_pages + new_pages
@@ -240,8 +297,7 @@ class LLMEngine:
             req.last_page_hash = h
         req.state = RUNNING
         self.running.append(req)
-        self._prefill(req, deltas)
-        return True
+        return req
 
     # ---------------------------------------------------------- compute
 
@@ -260,7 +316,8 @@ class LLMEngine:
         L = self.model_cfg.num_layers
 
         def run(params, k_pages, v_pages, block_tables, total_lens,
-                input_ids, positions):
+                input_ids, positions, gather_idx, temperature, top_k,
+                rng_keys):
             pc = PagedCache(
                 k_pages=k_pages, v_pages=v_pages,
                 block_tables=jnp.broadcast_to(
@@ -269,31 +326,83 @@ class LLMEngine:
                                             (L,) + total_lens.shape))
             logits, new_pc = model.apply({"params": params}, input_ids,
                                          positions=positions, kv_caches=pc)
-            return (logits.astype(jnp.float32), new_pc.k_pages,
-                    new_pc.v_pages)
+            # sample ON DEVICE: only B int32 tokens cross to the host per
+            # step — shipping [B, V] fp32 logits through a remote-device
+            # tunnel dominated TTFT before this
+            b = logits.shape[0]
+            rows = logits[jnp.arange(b), gather_idx].astype(jnp.float32)
+            tokens = _device_sample(rows, temperature, top_k, rng_keys)
+            return tokens, new_pc.k_pages, new_pc.v_pages
 
+        if kind == "decode_multi":
+            n_steps = shape_key[1]
+
+            def run_multi(params, k_pages, v_pages, block_tables,
+                          total_lens, input_ids, positions, temperature,
+                          top_k, keys_steps):
+                bt_b = jnp.broadcast_to(block_tables,
+                                        (L,) + block_tables.shape)
+
+                def body(carry, keys_k):
+                    ids, pos, kp, vp, tot = carry
+                    pc = PagedCache(
+                        k_pages=kp, v_pages=vp, block_tables=bt_b,
+                        total_lens=jnp.broadcast_to(tot, (L,) + tot.shape))
+                    logits, new_pc = model.apply(
+                        {"params": params}, ids, positions=pos,
+                        kv_caches=pc)
+                    rows = logits[:, 0].astype(jnp.float32)
+                    toks = _device_sample(rows, temperature, top_k, keys_k)
+                    # padding rows: pos == tot stays true step over step,
+                    # so their writes remain masked (paged_write drops
+                    # positions >= total_lens)
+                    return ((toks[:, None].astype(jnp.int32), pos + 1,
+                             new_pc.k_pages, new_pc.v_pages, tot + 1),
+                            toks)
+
+                carry = (input_ids, positions, k_pages, v_pages,
+                         total_lens)
+                (_, _, kp, vp, _), toks = jax.lax.scan(
+                    body, carry, keys_steps, length=n_steps)
+                return toks, kp, vp
+
+            fn = jax.jit(run_multi, donate_argnums=(1, 2))
+            self._jit_cache[key] = fn
+            return fn
         fn = jax.jit(run, donate_argnums=(1, 2))
         self._jit_cache[key] = fn
         return fn
 
-    def _prefill(self, req: Request, deltas: List[OutputDelta]) -> None:
+    def _prefill_batch(self, sb: int, group: List[Request],
+                       deltas: List[OutputDelta]) -> None:
         import jax.numpy as jnp
 
-        n_new = len(req.prompt_ids) - req.n_cached
-        sb = _bucket(n_new, self.config.prefill_buckets)
-        ids = np.zeros((1, sb), np.int32)
-        ids[0, :n_new] = req.prompt_ids[req.n_cached:]
-        positions = req.n_cached + np.arange(sb, dtype=np.int32)[None]
-        bt = np.zeros((1, self.max_pages_per_seq), np.int32)
-        bt[0, :len(req.pages)] = req.pages
-        total = np.array([len(req.prompt_ids)], np.int32)
-        fn = self._jit("prefill", (sb,))
-        logits, self.k_pages, self.v_pages = fn(
+        b = len(group)
+        rb = 1
+        while rb < b:
+            rb *= 2
+        ids = np.zeros((rb, sb), np.int32)
+        positions = np.zeros((rb, sb), np.int32)
+        bt = np.zeros((rb, self.max_pages_per_seq), np.int32)
+        total = np.zeros((rb,), np.int32)
+        gather = np.zeros((rb,), np.int32)
+        for i, req in enumerate(group):
+            n_new = len(req.prompt_ids) - req.n_cached
+            ids[i, :n_new] = req.prompt_ids[req.n_cached:]
+            positions[i] = req.n_cached + np.arange(sb, dtype=np.int32)
+            bt[i, :len(req.pages)] = req.pages
+            total[i] = len(req.prompt_ids)
+            gather[i] = n_new - 1
+        fn = self._jit("prefill", (sb, rb))
+        temp, topk, keys = self._sampling_arrays(group, rb)
+        tokens, self.k_pages, self.v_pages = fn(
             self.params, self.k_pages, self.v_pages, jnp.asarray(bt),
-            jnp.asarray(total), jnp.asarray(ids), jnp.asarray(positions))
-        token = self._sample(req, np.asarray(logits[0, n_new - 1]))
-        self._register_full_pages(req)
-        self._append_token(req, token, deltas)
+            jnp.asarray(total), jnp.asarray(ids), jnp.asarray(positions),
+            jnp.asarray(gather), temp, topk, keys)
+        tokens_np = np.asarray(tokens)
+        for i, req in enumerate(group):
+            self._register_full_pages(req)
+            self._append_token(req, int(tokens_np[i]), deltas)
 
     def _decode_step(self, deltas: List[OutputDelta]) -> None:
         import jax.numpy as jnp
@@ -303,8 +412,10 @@ class LLMEngine:
         # NEWEST running request is preempted (vLLM's recompute-style
         # preemption), so head-of-line requests always make progress.
         page = self.config.page_size
+        k_steps = max(1, int(self.config.decode_steps_per_dispatch))
         for req in sorted(self.running, key=lambda r: r.arrival_t):
-            required = (req.total_len - 1) // page + 1
+            required = min((req.total_len - 1 + (k_steps - 1)) // page + 1,
+                           self.max_pages_per_seq)
             while req in self.running and len(req.pages) < required:
                 try:
                     req.pages.extend(
@@ -334,13 +445,44 @@ class LLMEngine:
             positions[i, 0] = req.total_len - 1
             bt[i, :len(req.pages)] = req.pages
             total[i] = req.total_len
+        use_multi = (
+            k_steps > 1
+            and all((r.total_len - 1 + (k_steps - 1)) // page + 1
+                    <= min(len(r.pages), self.max_pages_per_seq)
+                    and r.total_len + k_steps <= self.config.max_model_len
+                    for r in batch))
+        temp, topk, keys = self._sampling_arrays(batch, rb)
+        if use_multi:
+            # K decode steps in ONE dispatch (lax.scan): dispatch latency
+            # amortizes K-fold; stop conditions apply on the host after
+            # the chunk, dropping any tokens past a stop
+            keys_steps = np.zeros((k_steps, rb, 2), np.uint32)
+            keys_steps[0] = keys
+            for k in range(1, k_steps):
+                _, _, keys_steps[k] = self._sampling_arrays(
+                    batch, rb, counter_offset=k)
+            fn = self._jit("decode_multi", (rb, k_steps))
+            toks, self.k_pages, self.v_pages = fn(
+                self.params, self.k_pages, self.v_pages, jnp.asarray(bt),
+                jnp.asarray(total), jnp.asarray(ids),
+                jnp.asarray(positions), temp, topk,
+                jnp.asarray(keys_steps))
+            toks_np = np.asarray(toks)  # [K, B]
+            for i, req in enumerate(list(batch)):
+                self._register_full_pages(req)
+                for k in range(k_steps):
+                    if req.state == FINISHED or req not in self.running:
+                        break
+                    self._append_token(req, int(toks_np[k, i]), deltas)
+            return
         fn = self._jit("decode", (rb,))
-        logits, self.k_pages, self.v_pages = fn(
+        tokens, self.k_pages, self.v_pages = fn(
             self.params, self.k_pages, self.v_pages, jnp.asarray(bt),
-            jnp.asarray(total), jnp.asarray(ids), jnp.asarray(positions))
-        logits_np = np.asarray(logits[:, 0])
+            jnp.asarray(total), jnp.asarray(ids), jnp.asarray(positions),
+            np.zeros(rb, np.int32), temp, topk, keys)
+        tokens_np = np.asarray(tokens)
         for i, req in enumerate(list(batch)):
-            token = self._sample(req, logits_np[i])
+            token = int(tokens_np[i])
             self._register_full_pages(req)
             self._append_token(req, token, deltas)
 
@@ -361,21 +503,30 @@ class LLMEngine:
 
     # ---------------------------------------------------------- sampling
 
-    def _sample(self, req: Request, logits: np.ndarray) -> int:
-        s = req.sampling
-        if s.temperature <= 0:
-            return int(np.argmax(logits))
-        if s.seed is not None and req.rng is None:
-            req.rng = np.random.default_rng(s.seed)
-        rng = req.rng if req.rng is not None else self._rng
-        logits = logits / s.temperature
-        if s.top_k > 0:
-            kth = np.partition(logits, -s.top_k)[-s.top_k]
-            logits = np.where(logits < kth, -np.inf, logits)
-        logits = logits - logits.max()
-        probs = np.exp(logits)
-        probs /= probs.sum()
-        return int(rng.choice(len(probs), p=probs))
+    def _sampling_arrays(self, batch, rb: int = None,
+                         counter_offset: int = 0):
+        """Per-row sampling params + PRNG keys for the on-device sampler.
+        Keys derive from (request seed, tokens-sampled-so-far) so results
+        are independent of batch composition — sequential and batched
+        execution of the same requests sample identically."""
+        import hashlib as hashlib_mod
+
+        rb = rb or len(batch)
+        temp = np.zeros((rb,), np.float32)
+        topk = np.zeros((rb,), np.int32)
+        keys = np.zeros((rb, 2), np.uint32)
+        for i, req in enumerate(batch):
+            s = req.sampling
+            temp[i] = s.temperature
+            topk[i] = min(s.top_k, _MAX_TOP_K) if s.top_k else 0
+            seed = s.seed if s.seed is not None else self.config.seed
+            digest = hashlib_mod.blake2b(
+                f"{req.request_id}:{seed}:"
+                f"{len(req.output_ids) + counter_offset}".encode(),
+                digest_size=8).digest()
+            keys[i, 0] = int.from_bytes(digest[:4], "little")
+            keys[i, 1] = int.from_bytes(digest[4:], "little")
+        return temp, topk, keys
 
     def _stop_reason(self, req: Request, token: int) -> Optional[str]:
         eos = self.config.eos_token_id

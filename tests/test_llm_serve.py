@@ -330,3 +330,37 @@ def test_pd_prefill_respects_stop_on_first_token():
     assert "r" not in engine.extracted
     # pages released (nothing leaked for a finished request)
     assert engine.allocator.num_free() == cfg.num_pages - 1
+
+
+def test_multi_step_decode_matches_single_step():
+    """decode_steps_per_dispatch fuses K decode steps into one dispatch;
+    greedy outputs must match single-step execution exactly."""
+    base = dict(ENGINE_CFG)
+    prompt = list(np.random.default_rng(7).integers(0, 500, 12))
+
+    outs = {}
+    for k in (1, 4):
+        engine = LLMEngine(EngineConfig(**base, decode_steps_per_dispatch=k))
+        engine.add_request("m", prompt, SamplingParams(max_tokens=9))
+        outs[k] = _collect(engine, ["m"])["m"]
+    assert outs[1] == outs[4], (outs[1], outs[4])
+
+
+def test_multi_step_decode_batched_prefill_concurrent():
+    """Concurrent requests through batched prefill + fused decode match
+    the sequential single-step reference."""
+    base = dict(ENGINE_CFG)
+    rng = np.random.default_rng(9)
+    prompts = {f"r{i}": list(rng.integers(0, 500, 10)) for i in range(3)}
+
+    seq = {}
+    for rid, p in prompts.items():
+        engine = LLMEngine(EngineConfig(**base))
+        engine.add_request(rid, p, SamplingParams(max_tokens=6))
+        seq.update(_collect(engine, [rid]))
+
+    engine = LLMEngine(EngineConfig(**base, decode_steps_per_dispatch=3))
+    for rid, p in prompts.items():
+        engine.add_request(rid, p, SamplingParams(max_tokens=6))
+    conc = _collect(engine, list(prompts))
+    assert conc == seq
