@@ -75,7 +75,7 @@ def get_proxy_url() -> str:
 
 def run(app: Application, *, name: str = DEFAULT_APP_NAME,
         route_prefix: str = "/", blocking: bool = False,
-        _start_http: bool = False, wait_timeout_s: float = 60.0,
+        _start_http: bool = False, wait_timeout_s: float = 180.0,
         ) -> DeploymentHandle:
     """Deploy an application and wait for it to be RUNNING
     (ref: serve/api.py:687)."""
