@@ -43,6 +43,8 @@ class PrefillServer(EngineDriverMixin):
     def __init__(self, llm_config: LLMConfig):
         self.config = llm_config
         self.engine = LLMEngine(llm_config.engine)
+        if getattr(llm_config, "warmup", True):
+            self.engine.warmup(include_decode=False)
         self._ids = itertools.count()
         self._init_driver()
 
@@ -80,6 +82,11 @@ class DecodeServer(EngineDriverMixin):
     def __init__(self, llm_config: LLMConfig):
         self.config = llm_config
         self.engine = LLMEngine(llm_config.engine)
+        if getattr(llm_config, "warmup", True):
+            # full warmup (not decode-only): page-pressure preemption
+            # re-prefills on THIS engine, so prefill shapes are hit in
+            # traffic too
+            self.engine.warmup()
         self._ids = itertools.count()
         self._init_driver()
 

@@ -4,19 +4,37 @@ Replaces the reference's external vLLM dependency (ref: llm/_internal/serve/
 deployments/llm/vllm/vllm_engine.py:181 — the reference only wraps
 `AsyncLLM`; scheduling, paging and kernels live outside its repo). Engine
 loop design follows the same contract a continuous-batching engine exposes:
-`add_request` enqueues, `step()` runs ONE scheduler iteration (either a
-prefill for the head of the waiting queue or a batched decode step over all
-running sequences) and returns per-request output deltas.
+`add_request` enqueues, `step()` runs ONE scheduler iteration and returns
+per-request output deltas.
 
 TPU-first mechanics:
-- all jitted shapes are bucketed (prefill length, decode batch) so each
-  bucket compiles once; page buffers are donated so the cache updates in
-  place without a copy
+- all jitted shapes are bucketed (prefill length; decode always runs the
+  full `max_batch` slot set) so each bucket compiles once; page buffers are
+  donated so the cache updates in place without a copy
 - the KV cache is paged ([L, P, page, Hkv, D]); the model scatters new
   tokens into pages and attends through block tables
   (ray_tpu/ops/paged_attention.py)
 - prefix caching: full pages are refcount-shared across requests keyed by
   rolling content hash (cache.py), so shared system prompts prefill once
+
+Latency model (measured through the remote-device tunnel this engine is
+deployed behind): ANY host-blocking fetch costs ~1 RTT (100-140 ms here)
+regardless of payload, uploads are asynchronous and ~free, and chained
+dispatches pipeline on the device without host involvement. Three design
+rules follow:
+1. NEVER run eager device ops on the driver thread (a `toks[-1]` slice
+   costs more than a fused 8-step decode dispatch);
+2. sampled tokens feed the next decode dispatch through a device-resident
+   `slot_ids` carry (donated through every dispatch), so the token values
+   never cross to the host on the critical path;
+3. results are pushed host-ward with `copy_to_host_async()` at dispatch
+   time and harvested FIFO behind a `pipeline_depth`-deep window — the
+   blocking `np.asarray` then completes in microseconds once landed.
+Prefill runs in waves of `prefill_wave_size` rows (one compiled row
+count per length bucket): the waves pipeline on-device, so a burst's
+total prefill compute is unchanged but the first wave's tokens surface
+after only its own share of it — chunked prefill, adapted to a link
+where adding a dispatch is free and adding a sync costs an RTT.
 """
 
 from __future__ import annotations
@@ -60,10 +78,21 @@ class Request:
     last_page_hash: Optional[int] = None
     n_hashed: int = 0            # tokens already entered into prefix cache
     arrival_t: float = dataclasses.field(default_factory=time.monotonic)
+    slot: int = -1               # decode slot while RUNNING
+    planned_out: int = 0         # tokens dispatched (>= len(output_ids))
+    decode_ready: bool = False   # prefill harvested; slot may decode
 
     @property
     def total_len(self) -> int:
         return len(self.prompt_ids) + len(self.output_ids)
+
+
+def _cap_total(req: Request, max_model_len: int) -> int:
+    """Hard ceiling on a request's cache-visible length: in-jit clamps
+    mask every write past it, so speculative decode chunks can run beyond
+    the stop without corrupting pages or block-table indexing."""
+    return min(len(req.prompt_ids) + req.sampling.max_tokens + 1,
+               max_model_len)
 
 
 @dataclasses.dataclass
@@ -91,6 +120,17 @@ class EngineConfig:
     # even locally). Trade-off: token delivery is chunked and a request
     # may compute up to K-1 tokens past its stop condition.
     decode_steps_per_dispatch: int = 1
+    # decode dispatches kept in flight ahead of the harvest point. Depth
+    # d hides d-1 round trips of fetch latency behind device compute;
+    # tokens/pages computed past a stop are dropped at harvest. 1 =
+    # fully synchronous (round-2 behavior).
+    pipeline_depth: int = 2
+    # rows per prefill dispatch (and the single compiled row count per
+    # length bucket). A burst larger than this prefills in waves: the
+    # waves pipeline on-device, so total compute is unchanged but the
+    # first wave's tokens surface after only its own share — chunked
+    # prefill, adapted to an RTT-dominated link. None => max_batch // 2.
+    prefill_wave_size: Optional[int] = None
 
 
 _MAX_TOP_K = 64
@@ -156,6 +196,9 @@ class LLMEngine:
         self.k_pages = jnp.zeros(shape, dtype)
         self.v_pages = jnp.zeros(shape, dtype)
         self.max_pages_per_seq = config.max_model_len // config.page_size
+        # device-resident last-sampled-token per slot: the decode chain's
+        # carry (design rule 2 in the module docstring)
+        self.slot_ids = jnp.zeros((config.max_batch, 1), jnp.int32)
 
         self.allocator = PageAllocator(config.num_pages, config.page_size)
         self._intake: List[Request] = []
@@ -173,6 +216,19 @@ class LLMEngine:
         self.running: List[Request] = []
         self.requests: Dict[str, Request] = {}
         self._jit_cache: Dict[tuple, Any] = {}
+        self._pending_deltas: List[OutputDelta] = []
+        # the single compiled prefill row count (and max rows per prefill
+        # dispatch) — one expression, used by dispatch, split and warmup
+        self._wave_rb: int = (config.prefill_wave_size
+                              or max(1, config.max_batch // 2))
+        # slots: fixed decode row assignment while a request is RUNNING
+        self._free_slots: List[int] = list(range(config.max_batch))
+        self._slot_req: Dict[int, Request] = {}
+        # pending-first-decode override: slot -> host-known pending token
+        # (set after prefill harvest / injection / re-admission)
+        self._slot_override: Dict[int, int] = {}
+        # FIFO of in-flight dispatches awaiting harvest
+        self._inflight: List[dict] = []
 
     # ----------------------------------------------------------- intake
 
@@ -214,37 +270,36 @@ class LLMEngine:
         with self._intake_lock:
             if self._intake or self._injections:
                 return True
-        return bool(self.waiting or self.running)
+        return bool(self.waiting or self.running or self._inflight
+                    or self._pending_deltas)
 
     # ------------------------------------------------------------- step
 
     def step(self) -> List[OutputDelta]:
-        """One scheduler iteration. Prefill-priority (like vLLM's default):
-        admit the head of the waiting queue if pages allow, else run one
-        batched decode step."""
-        deltas: List[OutputDelta] = []
+        """One scheduler iteration: admit + dispatch up to the pipeline
+        window, then harvest the oldest in-flight dispatch (blocking only
+        when its transfer has not landed yet). Prefill-priority, like
+        vLLM's default."""
+        deltas: List[OutputDelta] = list(self._pending_deltas)
+        self._pending_deltas.clear()
         self._drain_intake(deltas)
-        injected = self._try_admit_injection()
-        admitted = []
-        burst_prefixes: set = set()
-        while len(self.running) < self.config.max_batch:
-            req = self._admit_one(burst_prefixes)
-            if req is None:
-                break
-            admitted.append(req)
-        if admitted:
-            # batched prefill: every same-bucket prompt rides ONE device
-            # dispatch (a per-prompt dispatch made TTFT queue-linear)
-            by_bucket: Dict[int, List[Request]] = {}
-            for req in admitted:
-                n_new = len(req.prompt_ids) - req.n_cached
-                sb = _bucket(n_new, self.config.prefill_buckets)
-                by_bucket.setdefault(sb, []).append(req)
-            for sb, group in by_bucket.items():
-                self._prefill_batch(sb, group, deltas)
-        if not (injected or admitted) and self.running:
-            self._decode_step(deltas)
+        self._try_admit_injection(deltas)
+        self._dispatch_prefills()
+        depth = max(1, int(self.config.pipeline_depth))
+        while (len(self._inflight) < depth
+               and self._dispatch_decode_chunk()):
+            pass
+        if self._inflight:
+            self._harvest(self._inflight.pop(0), deltas)
         return deltas
+
+    def _drain_pipeline(self, deltas: List[OutputDelta]) -> None:
+        """Harvest every in-flight dispatch (no new dispatches). Needed
+        before any eager read/write of the page buffers (extract/inject):
+        an eager `.at[].set` forks the buffer, silently dropping writes
+        from dispatches still in flight."""
+        while self._inflight:
+            self._harvest(self._inflight.pop(0), deltas)
 
     def _drain_intake(self, deltas: List[OutputDelta]) -> None:
         with self._intake_lock:
@@ -260,12 +315,12 @@ class LLMEngine:
                 deltas.append(OutputDelta(rid, [], True, "aborted"))
 
     def _admit_one(self, burst_prefixes: set = None) -> Optional[Request]:
-        """Admit the head of the waiting queue (pages permitting) WITHOUT
-        prefilling; returns the request or None. A request whose leading
-        page matches one already admitted THIS step is deferred: next
-        step its prefix pages are computed and cached, so it shares them
-        instead of prefilling the same content in parallel."""
-        if not self.waiting or len(self.running) >= self.config.max_batch:
+        """Admit the head of the waiting queue (slot + pages permitting)
+        WITHOUT prefilling; returns the request or None. A request whose
+        leading page matches one already admitted THIS step is deferred:
+        next step its prefix pages are computed and cached, so it shares
+        them instead of prefilling the same content in parallel."""
+        if not self.waiting or not self._free_slots:
             return None
         req = self.waiting[0]
         page = self.config.page_size
@@ -296,6 +351,9 @@ class LLMEngine:
                     h, req.prompt_ids[i * page:(i + 1) * page])
             req.last_page_hash = h
         req.state = RUNNING
+        req.slot = self._free_slots.pop(0)
+        req.planned_out = 0
+        self._slot_req[req.slot] = req
         self.running.append(req)
         return req
 
@@ -315,72 +373,114 @@ class LLMEngine:
         model = self.model
         L = self.model_cfg.num_layers
 
-        def run(params, k_pages, v_pages, block_tables, total_lens,
-                input_ids, positions, gather_idx, temperature, top_k,
-                rng_keys):
-            pc = PagedCache(
-                k_pages=k_pages, v_pages=v_pages,
-                block_tables=jnp.broadcast_to(
-                    block_tables, (L,) + block_tables.shape),
-                total_lens=jnp.broadcast_to(total_lens,
-                                            (L,) + total_lens.shape))
-            logits, new_pc = model.apply({"params": params}, input_ids,
-                                         positions=positions, kv_caches=pc)
-            # sample ON DEVICE: only B int32 tokens cross to the host per
-            # step — shipping [B, V] fp32 logits through a remote-device
-            # tunnel dominated TTFT before this
-            b = logits.shape[0]
-            rows = logits[jnp.arange(b), gather_idx].astype(jnp.float32)
-            tokens = _device_sample(rows, temperature, top_k, rng_keys)
-            return tokens, new_pc.k_pages, new_pc.v_pages
+        if kind == "prefill":
+            def run_prefill(params, k_pages, v_pages, block_tables,
+                            total_lens, input_ids, positions, gather_idx,
+                            temperature, top_k, rng_keys):
+                pc = PagedCache(
+                    k_pages=k_pages, v_pages=v_pages,
+                    block_tables=jnp.broadcast_to(
+                        block_tables, (L,) + block_tables.shape),
+                    total_lens=jnp.broadcast_to(total_lens,
+                                                (L,) + total_lens.shape))
+                logits, new_pc = model.apply({"params": params}, input_ids,
+                                             positions=positions,
+                                             kv_caches=pc)
+                # sample ON DEVICE: only B int32 tokens cross to the host
+                # per step — shipping [B, V] fp32 logits through a
+                # remote-device tunnel dominated TTFT before this
+                b = logits.shape[0]
+                rows = logits[jnp.arange(b), gather_idx].astype(jnp.float32)
+                tokens = _device_sample(rows, temperature, top_k, rng_keys)
+                return tokens, new_pc.k_pages, new_pc.v_pages
 
-        if kind == "decode_multi":
-            n_steps = shape_key[1]
-
-            def run_multi(params, k_pages, v_pages, block_tables,
-                          total_lens, input_ids, positions, temperature,
-                          top_k, keys_steps):
-                bt_b = jnp.broadcast_to(block_tables,
-                                        (L,) + block_tables.shape)
-
-                def body(carry, keys_k):
-                    ids, pos, kp, vp, tot = carry
-                    pc = PagedCache(
-                        k_pages=kp, v_pages=vp, block_tables=bt_b,
-                        total_lens=jnp.broadcast_to(tot, (L,) + tot.shape))
-                    logits, new_pc = model.apply(
-                        {"params": params}, ids, positions=pos,
-                        kv_caches=pc)
-                    rows = logits[:, 0].astype(jnp.float32)
-                    toks = _device_sample(rows, temperature, top_k, keys_k)
-                    # padding rows: pos == tot stays true step over step,
-                    # so their writes remain masked (paged_write drops
-                    # positions >= total_lens)
-                    return ((toks[:, None].astype(jnp.int32), pos + 1,
-                             new_pc.k_pages, new_pc.v_pages, tot + 1),
-                            toks)
-
-                carry = (input_ids, positions, k_pages, v_pages,
-                         total_lens)
-                (_, _, kp, vp, _), toks = jax.lax.scan(
-                    body, carry, keys_steps, length=n_steps)
-                return toks, kp, vp
-
-            fn = jax.jit(run_multi, donate_argnums=(1, 2))
+            fn = jax.jit(run_prefill, donate_argnums=(1, 2))
             self._jit_cache[key] = fn
             return fn
-        fn = jax.jit(run, donate_argnums=(1, 2))
+
+        # decode: fixed slot-set [S] batch, K fused steps, device-carry ids
+        n_steps = shape_key[0]
+
+        def run_decode(params, k_pages, v_pages, slot_ids, block_tables,
+                       total_lens, caps, positions, override_mask,
+                       override_ids, temperature, top_k, keys_steps):
+            bt_b = jnp.broadcast_to(block_tables,
+                                    (L,) + block_tables.shape)
+            active = total_lens > 0
+            ids0 = jnp.where(override_mask[:, None], override_ids,
+                             slot_ids)
+
+            def body(carry, keys_k):
+                ids, pos, kp, vp, tot = carry
+                pc = PagedCache(
+                    k_pages=kp, v_pages=vp, block_tables=bt_b,
+                    total_lens=jnp.broadcast_to(tot, (L,) + tot.shape))
+                logits, new_pc = model.apply(
+                    {"params": params}, ids, positions=pos,
+                    kv_caches=pc)
+                rows = logits[:, 0].astype(jnp.float32)
+                toks = _device_sample(rows, temperature, top_k, keys_k)
+                # caps clamp: past a slot's ceiling, positions freeze at
+                # cap-1 and totals at cap, so no block-table index runs
+                # off the allocated range. NOTE the frozen row keeps
+                # re-writing position cap-1 with its (dropped-at-harvest)
+                # samples — safe only because every token a request KEEPS
+                # was appended before its cap was crossed, so no kept
+                # token's attention ever reads a post-cap overwrite.
+                # Inactive slots (total == 0) never write.
+                new_tot = jnp.where(active, jnp.minimum(tot + 1, caps),
+                                    tot)
+                new_pos = jnp.minimum(pos + 1, caps[:, None] - 1)
+                return ((toks[:, None].astype(jnp.int32), new_pos,
+                         new_pc.k_pages, new_pc.v_pages, new_tot),
+                        toks)
+
+            carry = (ids0, positions, k_pages, v_pages, total_lens)
+            (last_ids, _, kp, vp, _), toks = jax.lax.scan(
+                body, carry, keys_steps, length=n_steps)
+            # carry the last sampled token forward for ACTIVE slots only:
+            # dead rows keep their (irrelevant) values instead of being
+            # scribbled with garbage samples
+            new_slot_ids = jnp.where(active[:, None], last_ids, slot_ids)
+            return toks, new_slot_ids, kp, vp
+
+        fn = jax.jit(run_decode, donate_argnums=(1, 2, 3))
         self._jit_cache[key] = fn
         return fn
 
-    def _prefill_batch(self, sb: int, group: List[Request],
-                       deltas: List[OutputDelta]) -> None:
+    def _dispatch_prefills(self) -> None:
+        """Admit as many waiting requests as slots/pages allow and launch
+        one prefill dispatch per length-bucket (single dispatch per
+        bucket: with tunnel RTT >> prefill compute, per-prompt dispatch
+        made TTFT queue-linear for no win)."""
+        admitted = []
+        burst_prefixes: set = set()
+        while len(self.running) < self.config.max_batch:
+            req = self._admit_one(burst_prefixes)
+            if req is None:
+                break
+            admitted.append(req)
+        if not admitted:
+            return
+        wave = self._wave_rb
+        by_bucket: Dict[int, List[Request]] = {}
+        for req in admitted:
+            n_new = len(req.prompt_ids) - req.n_cached
+            sb = _bucket(n_new, self.config.prefill_buckets)
+            by_bucket.setdefault(sb, []).append(req)
+        for sb, group in by_bucket.items():
+            for i in range(0, len(group), wave):
+                self._dispatch_prefill_batch(sb, group[i:i + wave])
+
+    def _dispatch_prefill_batch(self, sb: int,
+                                group: List[Request]) -> None:
         import jax.numpy as jnp
 
-        b = len(group)
-        rb = 1
-        while rb < b:
-            rb *= 2
+        # rows always pad to the wave size: ONE compiled row count per
+        # length bucket (per-size row buckets would multiply the compile
+        # shapes, and an unwarmed shape hit mid-traffic is a
+        # multi-second TTFT spike)
+        rb = self._wave_rb
         ids = np.zeros((rb, sb), np.int32)
         positions = np.zeros((rb, sb), np.int32)
         bt = np.zeros((rb, self.max_pages_per_seq), np.int32)
@@ -399,98 +499,161 @@ class LLMEngine:
             self.params, self.k_pages, self.v_pages, jnp.asarray(bt),
             jnp.asarray(total), jnp.asarray(ids), jnp.asarray(positions),
             jnp.asarray(gather), temp, topk, keys)
-        tokens_np = np.asarray(tokens)
-        for i, req in enumerate(group):
-            self._register_full_pages(req)
-            self._append_token(req, int(tokens_np[i]), deltas)
+        try:
+            tokens.copy_to_host_async()
+        except Exception:  # noqa: BLE001 — CPU backends may not support it
+            pass
+        for req in group:
+            req.planned_out = 1
+        self._inflight.append({
+            "kind": "prefill", "toks": tokens,
+            "group": [(req.request_id, req.slot) for req in group],
+        })
 
-    def _decode_step(self, deltas: List[OutputDelta]) -> None:
+    def _dispatch_decode_chunk(self) -> bool:
+        """Launch one fused K-step decode dispatch over the full slot set,
+        reading last tokens from the device-resident carry. Returns False
+        when there is nothing safe to decode (no eligible slot, or a page
+        shortfall that needs the pipeline drained first)."""
         import jax.numpy as jnp
 
-        # Grow page tables for sequences whose next write crosses a page
-        # boundary. Oldest requests allocate first; on exhaustion the
-        # NEWEST running request is preempted (vLLM's recompute-style
-        # preemption), so head-of-line requests always make progress.
-        page = self.config.page_size
-        k_steps = max(1, int(self.config.decode_steps_per_dispatch))
-        for req in sorted(self.running, key=lambda r: r.arrival_t):
-            required = min((req.total_len - 1 + (k_steps - 1)) // page + 1,
-                           self.max_pages_per_seq)
-            while req in self.running and len(req.pages) < required:
+        cfg = self.config
+        page = cfg.page_size
+        k_steps = max(1, int(cfg.decode_steps_per_dispatch))
+        S = cfg.max_batch
+        # eligible: RUNNING, prefill harvested (decode_ready), and not
+        # already dispatched through its whole token budget — chunks past
+        # max_tokens are 100% waste; chunks past an unpredictable
+        # EOS/stop-token are the speculative waste we accept
+        elig = []
+        for req in self.running:
+            if req.slot < 0 or not req.decode_ready:
+                continue
+            cap = _cap_total(req, cfg.max_model_len)
+            if (req.planned_out >= req.sampling.max_tokens
+                    or len(req.prompt_ids) + req.planned_out >= cap):
+                continue
+            elig.append(req)
+        if not elig:
+            return False
+        # page horizon: every eligible slot needs pages covering its
+        # planned writes through this chunk (clamped by its cap). Oldest
+        # first; on exhaustion with an empty pipeline, preempt the NEWEST
+        # running request (vLLM's recompute-style preemption) — with work
+        # in flight, back off and let the harvest free pages instead.
+        for req in sorted(elig, key=lambda r: r.arrival_t):
+            cap = _cap_total(req, cfg.max_model_len)
+            # last position this chunk writes: the pending token sits at
+            # total-1 and each of the K steps advances one, clamped
+            last_pos = min(len(req.prompt_ids) + req.planned_out - 1
+                           + (k_steps - 1), cap - 1)
+            required = min(last_pos // page + 1, self.max_pages_per_seq)
+            while (req in self.running and req.state == RUNNING
+                   and len(req.pages) < required):
                 try:
                     req.pages.extend(
                         self.allocator.allocate(required - len(req.pages)))
                 except OutOfPages:
-                    victims = [r for r in self.running if r is not req]
+                    if self._inflight:
+                        return False
+                    victims = [r for r in self.running
+                               if r is not req and r.planned_out
+                               == len(r.output_ids)]
                     if not victims:
-                        self._preempt(req)
+                        if req.planned_out == len(req.output_ids):
+                            self._preempt(req)
                         break
                     self._preempt(max(victims, key=lambda r: r.arrival_t))
-        if not self.running:
-            return
-        batch = self.running
-        rb = 1
-        while rb < len(batch):
-            rb *= 2
-        rb = min(rb, self.config.max_batch)
-        ids = np.zeros((rb, 1), np.int32)
-        positions = np.zeros((rb, 1), np.int32)
-        bt = np.zeros((rb, self.max_pages_per_seq), np.int32)
-        total = np.zeros((rb,), np.int32)
-        for i, req in enumerate(batch):
-            # The pending token (sampled last step, not yet in the cache)
-            # is the model input; it writes at position total_len - 1.
-            ids[i, 0] = (req.output_ids[-1] if req.output_ids
-                         else req.prompt_ids[-1])
-            positions[i, 0] = req.total_len - 1
-            bt[i, :len(req.pages)] = req.pages
-            total[i] = req.total_len
-        use_multi = (
-            k_steps > 1
-            and all((r.total_len - 1 + (k_steps - 1)) // page + 1
-                    <= min(len(r.pages), self.max_pages_per_seq)
-                    and r.total_len + k_steps <= self.config.max_model_len
-                    for r in batch))
-        temp, topk, keys = self._sampling_arrays(batch, rb)
-        if use_multi:
-            # K decode steps in ONE dispatch (lax.scan): dispatch latency
-            # amortizes K-fold; stop conditions apply on the host after
-            # the chunk, dropping any tokens past a stop
-            keys_steps = np.zeros((k_steps, rb, 2), np.uint32)
-            keys_steps[0] = keys
-            for k in range(1, k_steps):
-                _, _, keys_steps[k] = self._sampling_arrays(
-                    batch, rb, counter_offset=k)
-            fn = self._jit("decode_multi", (rb, k_steps))
-            toks, self.k_pages, self.v_pages = fn(
-                self.params, self.k_pages, self.v_pages, jnp.asarray(bt),
-                jnp.asarray(total), jnp.asarray(ids),
-                jnp.asarray(positions), temp, topk,
-                jnp.asarray(keys_steps))
-            toks_np = np.asarray(toks)  # [K, B]
-            for i, req in enumerate(list(batch)):
+        elig = [r for r in elig
+                if r in self.running and r.state == RUNNING]
+        if not elig:
+            return False
+
+        bt = np.zeros((S, self.max_pages_per_seq), np.int32)
+        total = np.zeros((S,), np.int32)
+        caps = np.ones((S,), np.int32)
+        positions = np.zeros((S, 1), np.int32)
+        override_mask = np.zeros((S,), bool)
+        override_ids = np.zeros((S, 1), np.int32)
+        chunk_slots = {}
+        for req in elig:
+            s = req.slot
+            planned_total = len(req.prompt_ids) + req.planned_out
+            bt[s, :len(req.pages)] = req.pages
+            total[s] = planned_total
+            caps[s] = _cap_total(req, cfg.max_model_len)
+            positions[s, 0] = planned_total - 1
+            if s in self._slot_override:
+                override_mask[s] = True
+                override_ids[s, 0] = self._slot_override.pop(s)
+            chunk_slots[s] = (req.request_id, req.planned_out)
+        keys_steps = np.zeros((k_steps, S, 2), np.uint32)
+        temp = np.zeros((S,), np.float32)
+        topk = np.zeros((S,), np.int32)
+        for k in range(k_steps):
+            t_k, tk_k, keys_k = self._sampling_arrays(
+                elig, S, counter_offset=k, slot_layout=True,
+                base="planned")
+            keys_steps[k] = keys_k
+            if k == 0:
+                temp, topk = t_k, tk_k
+        for req in elig:
+            req.planned_out += k_steps
+        fn = self._jit("decode", (k_steps,))
+        toks, self.slot_ids, self.k_pages, self.v_pages = fn(
+            self.params, self.k_pages, self.v_pages, self.slot_ids,
+            jnp.asarray(bt), jnp.asarray(total), jnp.asarray(caps),
+            jnp.asarray(positions), jnp.asarray(override_mask),
+            jnp.asarray(override_ids), temp, topk,
+            jnp.asarray(keys_steps))
+        try:
+            toks.copy_to_host_async()
+        except Exception:  # noqa: BLE001
+            pass
+        self._inflight.append({
+            "kind": "decode", "toks": toks, "slots": chunk_slots,
+            "k": k_steps,
+        })
+        return True
+
+    # ---------------------------------------------------------- harvest
+
+    def _harvest(self, rec: dict, deltas: List[OutputDelta]) -> None:
+        toks_np = np.asarray(rec["toks"])
+        if rec["kind"] == "prefill":
+            for i, (rid, slot) in enumerate(rec["group"]):
+                req = self.requests.get(rid)
+                if req is None or req.state != RUNNING or req.slot != slot:
+                    continue  # aborted while in flight
                 self._register_full_pages(req)
-                for k in range(k_steps):
-                    if req.state == FINISHED or req not in self.running:
-                        break
-                    self._append_token(req, int(toks_np[k, i]), deltas)
+                token = int(toks_np[i])
+                # the decode chain reads this slot's first input from the
+                # host-side override (the prefill wrote pages, not the
+                # slot carry)
+                self._slot_override[slot] = token
+                req.decode_ready = True
+                self._append_token(req, token, deltas)
             return
-        fn = self._jit("decode", (rb,))
-        tokens, self.k_pages, self.v_pages = fn(
-            self.params, self.k_pages, self.v_pages, jnp.asarray(bt),
-            jnp.asarray(total), jnp.asarray(ids), jnp.asarray(positions),
-            np.zeros(rb, np.int32), temp, topk, keys)
-        tokens_np = np.asarray(tokens)
-        for i, req in enumerate(list(batch)):
-            token = int(tokens_np[i])
-            self._register_full_pages(req)
-            self._append_token(req, token, deltas)
+        # decode chunk: toks_np is [K, S]
+        k_steps = rec["k"]
+        for slot, (rid, start) in rec["slots"].items():
+            req = self.requests.get(rid)
+            if (req is None or req.state != RUNNING or req.slot != slot
+                    or len(req.output_ids) != start):
+                continue  # finished/aborted/preempted while in flight
+            for k in range(k_steps):
+                if req.state != RUNNING:
+                    break
+                self._append_token(req, int(toks_np[k, slot]), deltas)
 
     def _preempt(self, req: Request) -> None:
         """Return a running request to the waiting queue, dropping its
         pages (its KV is recomputed on re-admission; generated tokens are
-        folded into the prompt)."""
+        folded into the prompt). Only called with an empty pipeline, so
+        host bookkeeping is authoritative."""
+        assert not self._inflight
         self.running.remove(req)
+        self._release_slot(req)
         self.allocator.release(req.pages)
         req.prompt_ids = req.prompt_ids + req.output_ids
         req.sampling.max_tokens -= len(req.output_ids)
@@ -498,17 +661,31 @@ class LLMEngine:
         req.pages = []
         req.n_cached = 0
         req.n_hashed = 0
+        req.planned_out = 0
+        req.decode_ready = False
         req.state = WAITING
         self.waiting.insert(0, req)
+
+    def _release_slot(self, req: Request) -> None:
+        if req.slot >= 0:
+            self._slot_req.pop(req.slot, None)
+            self._slot_override.pop(req.slot, None)
+            self._free_slots.append(req.slot)
+            self._free_slots.sort()
+            req.slot = -1
 
     # ---------------------------------------------------------- sampling
 
     def _sampling_arrays(self, batch, rb: int = None,
-                         counter_offset: int = 0):
+                         counter_offset: int = 0, slot_layout: bool = False,
+                         base: str = "actual"):
         """Per-row sampling params + PRNG keys for the on-device sampler.
         Keys derive from (request seed, tokens-sampled-so-far) so results
-        are independent of batch composition — sequential and batched
-        execution of the same requests sample identically."""
+        are independent of batch composition — sequential, batched, and
+        speculatively-pipelined execution of the same requests sample
+        identically. With slot_layout, rows are decode slots; `base`
+        selects the token counter ('planned' for dispatch-ahead chunks,
+        whose counts are deterministic)."""
         import hashlib as hashlib_mod
 
         rb = rb or len(batch)
@@ -516,16 +693,19 @@ class LLMEngine:
         topk = np.zeros((rb,), np.int32)
         keys = np.zeros((rb, 2), np.uint32)
         for i, req in enumerate(batch):
+            row = req.slot if slot_layout else i
             s = req.sampling
-            temp[i] = s.temperature
-            topk[i] = min(s.top_k, _MAX_TOP_K) if s.top_k else 0
+            temp[row] = s.temperature
+            topk[row] = min(s.top_k, _MAX_TOP_K) if s.top_k else 0
             seed = s.seed if s.seed is not None else self.config.seed
+            count = (req.planned_out if base == "planned"
+                     else len(req.output_ids))
             digest = hashlib_mod.blake2b(
                 f"{req.request_id}:{seed}:"
-                f"{len(req.output_ids) + counter_offset}".encode(),
+                f"{count + counter_offset}".encode(),
                 digest_size=8).digest()
-            keys[i, 0] = int.from_bytes(digest[:4], "little")
-            keys[i, 1] = int.from_bytes(digest[4:], "little")
+            keys[row, 0] = int.from_bytes(digest[:4], "little")
+            keys[row, 1] = int.from_bytes(digest[4:], "little")
         return temp, topk, keys
 
     def _stop_reason(self, req: Request, token: int) -> Optional[str]:
@@ -583,6 +763,7 @@ class LLMEngine:
             self.running.remove(req)
         elif req in self.waiting:
             self.waiting.remove(req)
+        self._release_slot(req)
         req.state = FINISHED
         req.finish_reason = reason
         self.allocator.release(req.pages)
@@ -610,8 +791,17 @@ class LLMEngine:
         between engines as dense arrays). Synchronous-driver use only;
         concurrent servers use SamplingParams(prefill_only=True) +
         pop_extracted, which gathers inside step()."""
-        req = self.requests[request_id]
-        assert req.state == RUNNING, f"{request_id} not running"
+        self._drain_pipeline(self._pending_deltas)
+        req = self.requests.get(request_id)
+        if req is None or req.state != RUNNING:
+            # a speculative decode chunk drained above may have crossed
+            # the request's stop condition and finished it (pages are
+            # released then — there is nothing left to gather)
+            raise KeyError(
+                f"{request_id!r} is not running: it finished (possibly "
+                "while speculative decode chunks drained) or was never "
+                "added; extract_kv must be called before generation "
+                "completes")
         return self._gather_kv(req)
 
     def pop_extracted(self, request_id: str) -> Dict[str, Any]:
@@ -644,7 +834,7 @@ class LLMEngine:
             self._injections.append(
                 (request_id, handoff, sampling or SamplingParams()))
 
-    def _try_admit_injection(self) -> bool:
+    def _try_admit_injection(self, deltas: List[OutputDelta]) -> bool:
         """Admit the oldest queued injection if batch slots + pages allow
         (called from step(), before fresh-prompt admission — transferred
         requests already paid for their prefill)."""
@@ -655,11 +845,16 @@ class LLMEngine:
                 return False
             if len(self.running) >= self.config.max_batch:
                 return False
+            if not self._free_slots:
+                return False
             request_id, handoff, sampling = self._injections[0]
             n = handoff["k"].shape[1]
             if self.allocator.num_free() < n:
                 return False
             self._injections.pop(0)
+        # the eager page scatter below forks the page buffers; anything
+        # still in flight must land first or its writes are lost
+        self._drain_pipeline(deltas)
         pages = self.allocator.allocate(n)
         idx = jnp.asarray(np.asarray(pages, np.int32))
         self.k_pages = self.k_pages.at[:, idx].set(
@@ -675,9 +870,69 @@ class LLMEngine:
         page = self.config.page_size
         req.n_hashed = (len(req.prompt_ids) // page) * page
         req.n_cached = 0
+        req.slot = self._free_slots.pop(0)
+        req.planned_out = len(req.output_ids)
+        req.decode_ready = True
+        self._slot_req[req.slot] = req
+        # pending token (sampled by the prefill engine, not yet written)
+        pending = (req.output_ids[-1] if req.output_ids
+                   else req.prompt_ids[-1])
+        self._slot_override[req.slot] = pending
         self.requests[request_id] = req
         self.running.append(req)
         return True
+
+    # ----------------------------------------------------------- warmup
+
+    def warmup(self, prompt_buckets=None, include_decode=True) -> int:
+        """Compile every dispatch shape traffic can hit — one prefill per
+        length bucket (rows always pad to prefill_wave_size) plus the
+        fused decode chunk — by running masked dummy dispatches
+        (total_lens=0: every page write is masked, so engine state is
+        untouched). Serve replicas call this before reporting READY: an
+        unwarmed shape compiled under live traffic is a multi-second
+        TTFT spike. prompt_buckets=() skips prefill shapes (decode-only
+        replicas); include_decode=False skips the decode chunk
+        (prefill-only replicas). Returns the number of shapes compiled.
+        Must be called with an idle pipeline (no traffic yet)."""
+        import jax.numpy as jnp
+
+        assert not self._inflight, "warmup requires an idle engine"
+        S = self.config.max_batch
+        rb = self._wave_rb
+        k_steps = max(1, int(self.config.decode_steps_per_dispatch))
+        n = 0
+        if prompt_buckets is None:
+            prompt_buckets = self.config.prefill_buckets
+        for sb in prompt_buckets:
+            fn = self._jit("prefill", (sb, rb))
+            toks, self.k_pages, self.v_pages = fn(
+                self.params, self.k_pages, self.v_pages,
+                jnp.asarray(np.zeros((rb, self.max_pages_per_seq),
+                                     np.int32)),
+                jnp.asarray(np.zeros((rb,), np.int32)),
+                jnp.asarray(np.zeros((rb, sb), np.int32)),
+                jnp.asarray(np.zeros((rb, sb), np.int32)),
+                jnp.asarray(np.zeros((rb,), np.int32)),
+                np.zeros((rb,), np.float32), np.zeros((rb,), np.int32),
+                np.zeros((rb, 2), np.uint32))
+            np.asarray(toks)
+            n += 1
+        if not include_decode:
+            return n
+        fn = self._jit("decode", (k_steps,))
+        toks, self.slot_ids, self.k_pages, self.v_pages = fn(
+            self.params, self.k_pages, self.v_pages, self.slot_ids,
+            jnp.asarray(np.zeros((S, self.max_pages_per_seq), np.int32)),
+            jnp.asarray(np.zeros((S,), np.int32)),
+            jnp.asarray(np.ones((S,), np.int32)),
+            jnp.asarray(np.zeros((S, 1), np.int32)),
+            jnp.asarray(np.zeros((S,), bool)),
+            jnp.asarray(np.zeros((S, 1), np.int32)),
+            np.zeros((S,), np.float32), np.zeros((S,), np.int32),
+            jnp.asarray(np.zeros((k_steps, S, 2), np.uint32)))
+        np.asarray(toks)
+        return n + 1
 
     # ------------------------------------------------------------ stats
 
@@ -685,6 +940,7 @@ class LLMEngine:
         return {
             "running": len(self.running),
             "waiting": len(self.waiting),
+            "inflight": len(self._inflight),
             "free_pages": self.allocator.num_free(),
             **self.allocator.stats,
         }

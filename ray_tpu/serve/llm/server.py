@@ -31,6 +31,12 @@ class LLMConfig:
     tokenizer: Any = None
     num_replicas: int = 1
     max_ongoing_requests: int = 64
+    # compile every engine dispatch shape during replica construction, so
+    # a replica is only READY once warmed (ref: serve/_private/
+    # deployment_state.py initialization-health path — the reference
+    # warms replicas before marking them READY; an unwarmed bucket hit
+    # by live traffic is a multi-second TTFT spike)
+    warmup: bool = True
     # per-replica actor options (resources, runtime_env — e.g. pin
     # JAX_PLATFORMS for CPU smoke deployments)
     ray_actor_options: Dict[str, Any] = dataclasses.field(
@@ -99,6 +105,8 @@ class LLMServer(EngineDriverMixin):
             engine_cfg.eos_token_id = getattr(
                 self.tokenizer, "eos_token_id", None)
         self.engine = LLMEngine(engine_cfg)
+        if llm_config.warmup:
+            self.engine.warmup()
         self._ids = itertools.count()
         self._init_driver()
 
