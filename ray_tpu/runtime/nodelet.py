@@ -40,31 +40,63 @@ class _SpawnAmbiguous(Exception):
     reply): neither retrying nor cold-starting is safe for that id."""
 
 
-def _pid_alive(pid: int) -> bool:
+def _proc_start_time(pid: int) -> Optional[int]:
+    """starttime (field 22 of /proc/<pid>/stat, clock ticks since boot):
+    combined with the pid it identifies a process uniquely. Needed
+    because the worker factory runs with SIGCHLD=SIG_IGN (auto-reap), so
+    a dead fork's pid can be recycled by an unrelated process."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            data = f.read()
+        # comm (field 2) may itself contain spaces/parens: split after
+        # the LAST ')' — starttime is then the 20th remaining field
+        return int(data[data.rindex(b")") + 2:].split()[19])
+    except Exception:
+        return None
+
+
+def _pid_alive(pid: int, start_time: Optional[int] = None) -> bool:
     if pid <= 0:
         return False
     try:
         os.kill(pid, 0)
-        return True
     except OSError:
         return False
+    if start_time is not None:
+        now = _proc_start_time(pid)
+        if now is not None and now != start_time:
+            return False  # recycled pid: OUR process is dead
+    return True
 
 
-async def _ensure_proc_dead(proc, pid: int = -1, grace: float = 2.0):
+def _identity_signal(pid: int, sig: int,
+                     start_time: Optional[int]) -> None:
+    """Signal pid only while its identity matches the recorded start
+    time — never SIGTERM/SIGKILL an unrelated process that inherited a
+    recycled worker pid. Raises OSError like os.kill for a gone pid."""
+    if start_time is not None:
+        now = _proc_start_time(pid)
+        if now is not None and now != start_time:
+            return
+    os.kill(pid, sig)
+
+
+async def _ensure_proc_dead(proc, pid: int = -1, grace: float = 2.0,
+                            start_time: Optional[int] = None):
     """SIGKILL a terminated worker that ignores SIGTERM."""
     deadline = time.monotonic() + grace
     while time.monotonic() < deadline:
         if proc is not None:
             if proc.poll() is not None:
                 return
-        elif not _pid_alive(pid):
+        elif not _pid_alive(pid, start_time):
             return
         await asyncio.sleep(0.1)
     try:
         if proc is not None:
             proc.kill()
         elif pid > 0:
-            os.kill(pid, 9)
+            _identity_signal(pid, 9, start_time)
     except Exception:
         pass
 
@@ -74,13 +106,27 @@ class WorkerState:
                  env_key: str = ""):
         self.worker_id = worker_id
         self.address = address
-        self.pid = pid
+        self.set_pid(pid)
         self.proc = proc
         self.env_key = env_key  # runtime-env pool this worker belongs to
         self.client: Optional[RpcClient] = None
         self.current_task: Optional[dict] = None
         self.actor_id: Optional[str] = None
         self.idle_since = time.monotonic()
+
+    def set_pid(self, pid: int,
+                start_time: Optional[int] = None) -> None:
+        """Bind this state to a live process: pid + /proc start time
+        (identity), so later liveness checks and kill signals can detect
+        a recycled pid instead of acting on an unrelated process. Pass
+        start_time when a closer observer captured it (the factory reads
+        it immediately after fork; the worker self-reports at
+        registration) — sampling here is the fallback."""
+        self.pid = pid
+        if start_time is not None:
+            self.start_time = start_time
+        else:
+            self.start_time = _proc_start_time(pid) if pid > 0 else None
 
     @property
     def is_actor(self):
@@ -228,7 +274,8 @@ class Nodelet:
             now = time.monotonic()
             for w in list(self.workers.values()):
                 if (w.proc is not None and w.proc.poll() is not None) or \
-                        (w.proc is None and w.pid > 0 and not _pid_alive(w.pid)):
+                        (w.proc is None and w.pid > 0
+                         and not _pid_alive(w.pid, w.start_time)):
                     await self._on_worker_death(w)
                 elif (not w.is_actor and w.current_task is None
                       and len(self.workers) > get_config().prestart_workers
@@ -414,8 +461,9 @@ class Nodelet:
             stdout=out, stderr=subprocess.STDOUT)
 
     def _fork_from_factory(self, worker_id: str,
-                           runtime_env: dict = None) -> int:
-        """Ask the factory for a forked worker; returns the pid.
+                           runtime_env: dict = None) -> tuple:
+        """Ask the factory for a forked worker; returns (pid,
+        /proc start time captured by the factory right after fork).
 
         Two phases with different retry rules: connecting retries until the
         factory binds its socket; the spawn request itself is sent AT MOST
@@ -450,7 +498,8 @@ class Nodelet:
                 if not chunk:
                     raise _SpawnAmbiguous("factory closed mid-request")
                 data += chunk
-            return json.loads(data)["pid"]
+            reply = json.loads(data)
+            return reply["pid"], reply.get("start_time")
         except _SpawnAmbiguous:
             raise
         except OSError as e:
@@ -475,7 +524,9 @@ class Nodelet:
                     # cannot evict already-imported base packages — a
                     # pinned version would be silently ignored
                     raise OSError("pip env requires cold start")
-                ws.pid = self._fork_from_factory(worker_id, runtime_env)
+                pid, start = self._fork_from_factory(worker_id,
+                                                     runtime_env)
+                ws.set_pid(pid, start)
                 return
             except _SpawnAmbiguous:
                 # give up on this worker_id; the reap loop's stall check
@@ -507,14 +558,15 @@ class Nodelet:
                 stdout=out, stderr=subprocess.STDOUT, env=env,
                 start_new_session=True)
             ws.proc = proc
-            ws.pid = proc.pid
+            ws.set_pid(proc.pid)
         except Exception:
             self.workers.pop(worker_id, None)
             self._dec_starting(ws.env_key)
             traceback.print_exc()
 
     async def worker_register(self, worker_id: str, address: str, pid: int,
-                              env_key: str = ""):
+                              env_key: str = "",
+                              start_time: Optional[int] = None):
         ws = self.workers.get(worker_id)
         if ws is None:
             # unknown id: adopt it (e.g. a fork whose spawn reply was lost)
@@ -522,7 +574,7 @@ class Nodelet:
             self.workers[worker_id] = ws
         elif ws.current_task and ws.current_task.get("placeholder"):
             self._dec_starting(ws.env_key)
-        ws.pid = pid
+        ws.set_pid(pid, start_time)
         ws.address = address
         ws.current_task = None
         ws.client = RpcClient(address)
@@ -544,7 +596,7 @@ class Nodelet:
                 if ws.proc is not None:
                     ws.proc.terminate()
                 else:
-                    os.kill(ws.pid, 15)
+                    _identity_signal(ws.pid, 15, ws.start_time)
             except Exception:
                 pass
             # escalate to SIGKILL: user code may install SIGTERM handlers
@@ -552,7 +604,8 @@ class Nodelet:
             # process alive past terminate()
             try:
                 asyncio.get_running_loop().create_task(
-                    _ensure_proc_dead(ws.proc, ws.pid))
+                    _ensure_proc_dead(ws.proc, ws.pid,
+                                      start_time=ws.start_time))
             except RuntimeError:
                 if ws.proc is not None:
                     try:
@@ -562,11 +615,11 @@ class Nodelet:
                             ws.proc.kill()
                         except Exception:
                             pass
-                elif _pid_alive(ws.pid):
+                elif _pid_alive(ws.pid, ws.start_time):
                     time.sleep(0.2)
-                    if _pid_alive(ws.pid):
+                    if _pid_alive(ws.pid, ws.start_time):
                         try:
-                            os.kill(ws.pid, 9)
+                            _identity_signal(ws.pid, 9, ws.start_time)
                         except Exception:
                             pass
 
@@ -902,15 +955,18 @@ class Nodelet:
         return True
 
     def _owner_client(self, address: str) -> RpcClient:
-        client = self._owner_clients.get(address)
+        client = self._owner_clients.pop(address, None)
         if client is None:
-            # bound the cache: exited drivers leave dead entries behind
+            # bound the cache LRU (exited drivers leave dead entries
+            # behind); evicted clients close only after their queued
+            # result sends drain — a plain close() here swallowed
+            # task_results and hung the owner's get() forever
             while len(self._owner_clients) >= 64:
-                old_addr, old = next(iter(self._owner_clients.items()))
-                del self._owner_clients[old_addr]
-                old.close()
+                old_addr = next(iter(self._owner_clients))
+                self._owner_clients.pop(old_addr).close_when_drained()
             client = RpcClient(address)
-            self._owner_clients[address] = client
+        # re-insert at the back: most-recently-used ordering
+        self._owner_clients[address] = client
         return client
 
     async def task_finished(self, worker_id: str, task_id: bytes):

@@ -16,6 +16,7 @@ buffers are 64-byte aligned (TPU DMA and numpy both like alignment).
 
 from __future__ import annotations
 
+import itertools
 import mmap
 import os
 import pickle
@@ -72,36 +73,47 @@ def _spill_dir(session_name: str) -> str:
     return os.path.join(root, f"rtpu_{session_name}")
 
 
+_tmp_ids = itertools.count()
+
+
 class _Segment:
     """An mmap'ed shared-memory file."""
 
-    __slots__ = ("path", "mm", "fd", "size")
+    __slots__ = ("path", "tmp_path", "mm", "fd", "size")
 
-    def __init__(self, path: str, mm: mmap.mmap, fd: int, size: int):
+    def __init__(self, path: str, tmp_path: str, mm: mmap.mmap, fd: int,
+                 size: int):
         self.path = path
+        self.tmp_path = tmp_path
         self.mm = mm
         self.fd = fd
         self.size = size
 
     @classmethod
     def create(cls, path: str, size: int) -> "_Segment":
+        # unique per-writer tmp name: duplicate puts (lineage-recovery
+        # re-execution racing the original writer) each write their own
+        # file and the seal() renames are atomic last-writer-wins — no
+        # shared ".tmp" to collide on, unlink from under a live writer,
+        # or be permanently wedged by a crashed writer's leftover
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        fd = os.open(path + ".tmp", os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+        tmp = f"{path}.tmp.{os.getpid()}.{next(_tmp_ids)}"
+        fd = os.open(tmp, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
         os.ftruncate(fd, size)
         mm = mmap.mmap(fd, size)
-        return cls(path, mm, fd, size)
+        return cls(path, tmp, mm, fd, size)
 
     def seal(self):
         """Atomically publish: readers only ever see fully-written objects
         (the reference's plasma Seal; ref: plasma/store.cc seal path)."""
-        os.rename(self.path + ".tmp", self.path)
+        os.rename(self.tmp_path, self.path)
 
     @classmethod
     def open(cls, path: str) -> "_Segment":
         fd = os.open(path, os.O_RDONLY)
         size = os.fstat(fd).st_size
         mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
-        return cls(path, mm, fd, size)
+        return cls(path, path, mm, fd, size)
 
     def close(self):
         try:
@@ -239,10 +251,10 @@ class _FileIngest:
         self._seg.close()
 
     def abort(self) -> None:
-        path = self._seg.path
+        tmp = self._seg.tmp_path
         self._seg.close()
         try:
-            os.unlink(path + ".tmp")
+            os.unlink(tmp)
         except OSError:
             pass
 
@@ -403,6 +415,12 @@ class NativeObjectStoreClient:
             return self.spill.read_range(oid, offset, length)
         try:
             file_off, size = raw
+            if offset >= size:
+                # the puller's metadata disagrees with this copy (e.g. a
+                # re-put after eviction): surface as not-found so om_read
+                # returns None and the puller re-resolves via the owner,
+                # instead of os.pread raising on a negative length
+                raise FileNotFoundError(f"{key}: offset {offset} >= {size}")
             length = min(length, size - offset)
             return os.pread(self._fd, length, file_off + offset)
         finally:

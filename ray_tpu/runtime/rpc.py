@@ -399,6 +399,9 @@ class RpcClient:
         self._connect_lock: Optional[asyncio.Lock] = None
         self._closed = False
         self._local_conn: Optional[_LocalConn] = None
+        # queued-but-unsent notify_nowait coroutines (close_when_drained)
+        self._inflight_notifies = 0
+        self._idle_event: Optional[asyncio.Event] = None
 
     def _local_server(self) -> Optional["RpcServer"]:
         return _local_servers.get(self.address)
@@ -545,12 +548,22 @@ class RpcClient:
         bytes left the socket buys nothing)."""
         elt = EventLoopThread.get()
         if threading.current_thread() is elt.thread:
-            asyncio.ensure_future(self._notify_swallow(method, kwargs))
+            self._spawn_notify(method, kwargs)
         else:
             elt.loop.call_soon_threadsafe(self._spawn_notify, method, kwargs)
 
     def _spawn_notify(self, method: str, kwargs: dict):
-        asyncio.ensure_future(self._notify_swallow(method, kwargs))
+        # counted at ENQUEUE (synchronously on the loop): a drain that
+        # only counted running coroutines would close underneath a
+        # notify still sitting in the task queue
+        self._inflight_notifies += 1
+        try:
+            asyncio.ensure_future(self._notify_swallow(method, kwargs))
+        except BaseException:
+            # loop closing at shutdown: keep the counter honest or every
+            # later close_when_drained stalls out its full timeout
+            self._inflight_notifies -= 1
+            raise
 
     async def _notify_swallow(self, method: str, kwargs: dict):
         try:
@@ -559,6 +572,33 @@ class RpcClient:
             pass
         except Exception:
             traceback.print_exc()
+        finally:
+            self._inflight_notifies -= 1
+            if self._inflight_notifies == 0 and self._idle_event is not None:
+                self._idle_event.set()
+
+    def close_when_drained(self, timeout: float = 10.0):
+        """Close once every queued fire-and-forget notify has been sent
+        (or after `timeout`). A plain close() between notify_nowait() and
+        its scheduled coroutine running silently swallows the message —
+        for a cache-evicted owner client that lost message is a task
+        result, and the owner's get() hangs forever."""
+
+        async def _drain_then_close():
+            if self._inflight_notifies > 0:
+                self._idle_event = asyncio.Event()
+                try:
+                    await asyncio.wait_for(self._idle_event.wait(), timeout)
+                except asyncio.TimeoutError:
+                    pass
+            self.close()
+
+        elt = EventLoopThread.get()
+        if threading.current_thread() is elt.thread:
+            asyncio.ensure_future(_drain_then_close())
+        else:
+            elt.loop.call_soon_threadsafe(
+                lambda: asyncio.ensure_future(_drain_then_close()))
 
     def close(self):
         self._closed = True

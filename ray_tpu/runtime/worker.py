@@ -204,8 +204,12 @@ class Executor:
         spans = _tracing.drain()
         if spans:
             try:
+                # tight bound: this runs BEFORE result delivery on every
+                # traced task, so a slow/dead controller must cost the
+                # caller at most ~2s, not 10 (spans are droppable;
+                # results are not)
                 self.core.controller.call("add_trace_spans", spans=spans,
-                                          _timeout=10)
+                                          _timeout=2)
             except Exception:
                 pass
 
@@ -572,8 +576,13 @@ def run_worker(*, session_name: str, session_dir: str, node_id: str,
     executor = Executor(core)
     executor.env_error = env_error
     core.start(extra_handlers=executor.handlers())
+    from .nodelet import _proc_start_time
+
     core.nodelet.call("worker_register", worker_id=worker_id,
-                      address=core.address, pid=os.getpid(), env_key=key)
+                      address=core.address, pid=os.getpid(), env_key=key,
+                      # self-reported identity: /proc/self is immune to
+                      # the pid-recycling races a sampling observer has
+                      start_time=_proc_start_time(os.getpid()))
     executor.shutdown_event.wait()
     core.flush_events()
     core.shutdown()

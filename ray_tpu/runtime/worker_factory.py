@@ -95,7 +95,17 @@ def serve(args) -> None:
                     traceback.print_exc()
                 finally:
                     os._exit(1)
-            conn.sendall((json.dumps({"pid": pid}) + "\n").encode())
+            # the child's /proc start time, read at the narrowest
+            # possible window after fork: pid + start time is the
+            # identity the nodelet uses to never signal a recycled pid
+            try:
+                with open(f"/proc/{pid}/stat", "rb") as f:
+                    stat = f.read()
+                start = int(stat[stat.rindex(b")") + 2:].split()[19])
+            except Exception:
+                start = None
+            conn.sendall((json.dumps(
+                {"pid": pid, "start_time": start}) + "\n").encode())
         except Exception:
             import traceback
 
