@@ -34,6 +34,9 @@ def main():
     parser.add_argument("--out", default=None)
     parser.add_argument("--scale", type=float, default=1.0,
                         help="multiply iteration counts")
+    parser.add_argument("--clients", default="1,2,4",
+                        help="comma-separated client counts for the "
+                             "multi-client sections ('' to skip)")
     args = parser.parse_args()
 
     import ray_tpu
@@ -96,6 +99,62 @@ def main():
     results["put_get_small_per_s"] = round(
         timeit(lambda: ray_tpu.get(ray_tpu.put(1)),
                max(1, int(200 * args.scale))), 1)
+
+    # ---- multi-client sections (ref: ray_perf.py "multi client tasks
+    # async" :185-191, "multi client put calls" :126, "multi client put
+    # gigabytes" :148 — clients are actors/tasks submitting from worker
+    # processes, so N clients exercise the concurrent submit path).
+    # Reported at N = 1/2/4 so the scaling shape is visible even where a
+    # small host bounds the absolutes.
+    @ray_tpu.remote
+    class BenchClient:
+        def task_batch(self, n):
+            ray_tpu.get([nop.remote() for _ in range(n)])
+            return n
+
+        def put_small_batch(self, n):
+            for _ in range(n):
+                ray_tpu.put(0)
+            return n
+
+        def put_big_batch(self, n, mb):
+            data = np.zeros(mb << 20, dtype=np.uint8)
+            for _ in range(n):
+                ray_tpu.put(data)
+            return n * data.nbytes
+
+    n_clients = [int(c) for c in args.clients.split(",") if c]
+    clients = {m: [BenchClient.remote() for _ in range(m)]
+               for m in n_clients}
+    for m in n_clients:  # spawn + warm every client before any timing
+        ray_tpu.get([c.task_batch.remote(2) for c in clients[m]])
+
+    for m in n_clients:
+        cs = clients[m]
+        n = max(1, int(100 * args.scale))
+
+        def tasks_multi():
+            ray_tpu.get([c.task_batch.remote(n) for c in cs])
+
+        per_s = timeit(tasks_multi, max(1, int(3 * args.scale)),
+                       warmup=1) * n * m
+        results[f"multi_tasks_per_s_c{m}"] = round(per_s, 1)
+
+        def put_small_multi():
+            ray_tpu.get([c.put_small_batch.remote(n) for c in cs])
+
+        per_s = timeit(put_small_multi, max(1, int(3 * args.scale)),
+                       warmup=1) * n * m
+        results[f"multi_put_calls_per_s_c{m}"] = round(per_s, 1)
+
+        nbig, mb = max(1, int(6 * args.scale)), 8
+
+        def put_big_multi():
+            ray_tpu.get([c.put_big_batch.remote(nbig, mb) for c in cs])
+
+        per_s = timeit(put_big_multi, 2, warmup=1)
+        results[f"multi_put_gb_per_s_c{m}"] = round(
+            per_s * nbig * m * (mb << 20) / 1e9, 3)
 
     print(json.dumps(results))
     if args.out:

@@ -32,27 +32,13 @@ from .. import exceptions
 from . import serialization
 from .config import get_config
 from .ids import NodeID, ObjectID, TaskID, WorkerID
+from .procutil import proc_start_time as _proc_start_time
 from .rpc import RpcClient, RpcServer, ServerConn
 
 
 class _SpawnAmbiguous(Exception):
     """A factory spawn request whose outcome is unknown (sent but no
     reply): neither retrying nor cold-starting is safe for that id."""
-
-
-def _proc_start_time(pid: int) -> Optional[int]:
-    """starttime (field 22 of /proc/<pid>/stat, clock ticks since boot):
-    combined with the pid it identifies a process uniquely. Needed
-    because the worker factory runs with SIGCHLD=SIG_IGN (auto-reap), so
-    a dead fork's pid can be recycled by an unrelated process."""
-    try:
-        with open(f"/proc/{pid}/stat", "rb") as f:
-            data = f.read()
-        # comm (field 2) may itself contain spaces/parens: split after
-        # the LAST ')' — starttime is then the 20th remaining field
-        return int(data[data.rindex(b")") + 2:].split()[19])
-    except Exception:
-        return None
 
 
 def _pid_alive(pid: int, start_time: Optional[int] = None) -> bool:

@@ -21,6 +21,7 @@ import mmap
 import os
 import pickle
 import struct
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from .ids import ObjectID
@@ -241,6 +242,21 @@ class _FileIngest:
     invisible to readers until seal() (same .tmp+rename publish as put)."""
 
     def __init__(self, path: str, size: int):
+        # concurrent-ingest dedup (the shared-".tmp" O_EXCL used to do
+        # this implicitly): a FRESH sibling tmp means another process is
+        # already pulling this object — raise so the caller waits for its
+        # seal instead of running a duplicate network transfer. Stale
+        # tmps (crashed ingests) are taken over, not waited on.
+        import glob as _glob
+
+        now = time.time()
+        for sibling in _glob.glob(path + ".tmp.*"):
+            try:
+                if now - os.stat(sibling).st_mtime < 120.0:
+                    raise FileExistsError(path)
+                os.unlink(sibling)  # crashed writer's leftover
+            except FileNotFoundError:
+                pass
         self._seg = _Segment.create(path, max(size, 1))
 
     def write_at(self, offset: int, data: bytes) -> None:

@@ -1,0 +1,24 @@
+"""Process-identity helpers shared by the nodelet, worker, and factory.
+
+A pid alone is not an identity: the worker factory runs with
+SIGCHLD=SIG_IGN (auto-reap), so a dead fork's pid can be recycled by an
+unrelated process. (pid, /proc/<pid>/stat starttime) is unique for the
+machine's uptime and is what liveness checks and kill signals compare.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def proc_start_time(pid: int) -> Optional[int]:
+    """starttime (field 22 of /proc/<pid>/stat, clock ticks since boot),
+    or None when unreadable (process gone, or a non-procfs platform)."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            data = f.read()
+        # comm (field 2) may itself contain spaces/parens: split after
+        # the LAST ')' — starttime is then the 20th remaining field
+        return int(data[data.rindex(b")") + 2:].split()[19])
+    except Exception:
+        return None

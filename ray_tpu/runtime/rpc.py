@@ -402,6 +402,9 @@ class RpcClient:
         # queued-but-unsent notify_nowait coroutines (close_when_drained)
         self._inflight_notifies = 0
         self._idle_event: Optional[asyncio.Event] = None
+        # one-way frames awaiting the coalesced flush (notify_async)
+        self._wbuf: List[bytes] = []
+        self._wbuf_fut: Optional[asyncio.Future] = None
 
     def _local_server(self) -> Optional["RpcServer"]:
         return _local_servers.get(self.address)
@@ -527,10 +530,47 @@ class RpcClient:
             await self._call_local(server, method, kwargs, None, one_way=True)
             return
         await self._ensure_connected()
-        payload = serialization.dumps_inline((NTF, method, kwargs))
-        async with self._wlock:
-            self._writer.write(_frame(payload))
-            await self._writer.drain()
+        # write-coalescing: frames enqueued in the same event-loop pass
+        # ride ONE socket write (a 100-call submit burst or a batch of
+        # task_result pushes was 100 separate send() syscalls). Order is
+        # the buffer order, so per-connection FIFO (streaming items +
+        # terminator, actor-call order) is preserved; the shared flush
+        # future propagates write failures to every caller in the batch,
+        # keeping retry-on-stale-address semantics intact.
+        payload = _frame(serialization.dumps_inline((NTF, method, kwargs)))
+        self._wbuf.append(payload)
+        if self._wbuf_fut is None:
+            loop = asyncio.get_event_loop()
+            self._wbuf_fut = loop.create_future()
+            loop.call_soon(self._schedule_flush)
+        await asyncio.shield(self._wbuf_fut)
+
+    def _schedule_flush(self):
+        asyncio.ensure_future(self._flush_wbuf())
+
+    async def _flush_wbuf(self):
+        buf, fut = self._wbuf, self._wbuf_fut
+        self._wbuf, self._wbuf_fut = [], None
+        if not buf or fut is None:
+            if fut is not None and not fut.done():
+                fut.set_result(None)
+            return
+        try:
+            async with self._wlock:
+                if self._writer is None:
+                    # connection dropped between enqueue and this flush:
+                    # surface the RETRYABLE error type — an
+                    # AttributeError here would skip every caller's
+                    # reconnect/re-resolve handling and hang their gets
+                    raise ConnectionLost(
+                        f"connection to {self.address} lost")
+                self._writer.write(b"".join(buf))
+                await self._writer.drain()
+            if not fut.done():
+                fut.set_result(None)
+        except BaseException as e:  # noqa: BLE001 — deliver to callers
+            if not fut.done():
+                fut.set_exception(e)
 
     # -- sync interface (from any non-io thread) --
     def call(self, method: str, _timeout: Optional[float] = None, **kwargs):

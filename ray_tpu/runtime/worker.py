@@ -576,13 +576,13 @@ def run_worker(*, session_name: str, session_dir: str, node_id: str,
     executor = Executor(core)
     executor.env_error = env_error
     core.start(extra_handlers=executor.handlers())
-    from .nodelet import _proc_start_time
+    from .procutil import proc_start_time
 
     core.nodelet.call("worker_register", worker_id=worker_id,
                       address=core.address, pid=os.getpid(), env_key=key,
                       # self-reported identity: /proc/self is immune to
                       # the pid-recycling races a sampling observer has
-                      start_time=_proc_start_time(os.getpid()))
+                      start_time=proc_start_time(os.getpid()))
     executor.shutdown_event.wait()
     core.flush_events()
     core.shutdown()

@@ -98,14 +98,11 @@ def serve(args) -> None:
             # the child's /proc start time, read at the narrowest
             # possible window after fork: pid + start time is the
             # identity the nodelet uses to never signal a recycled pid
-            try:
-                with open(f"/proc/{pid}/stat", "rb") as f:
-                    stat = f.read()
-                start = int(stat[stat.rindex(b")") + 2:].split()[19])
-            except Exception:
-                start = None
+            from .procutil import proc_start_time
+
             conn.sendall((json.dumps(
-                {"pid": pid, "start_time": start}) + "\n").encode())
+                {"pid": pid, "start_time": proc_start_time(pid)})
+                + "\n").encode())
         except Exception:
             import traceback
 
