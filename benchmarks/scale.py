@@ -1,0 +1,146 @@
+"""Scale stress tier: many queued tasks / many actors / many PGs.
+
+Mirrors the reference's release-scale benchmarks (ref:
+release/benchmarks/README.md:5-31 — many_nodes/many_actors/many_tasks/
+many_pgs record creation throughput and time-to-drain at cluster scale)
+at a size this box can host: 100k queued tasks, 2k registered actors,
+200 placement groups. The point is the SHAPE — submission and drain must
+stay linear in queue depth (the nodelet queue is a deque with O(1)
+dispatch pops; the controller's pick_node is O(nodes) per spillback
+decision, O(1) amortized dispatch otherwise) — not the absolutes of a
+1-vCPU container.
+
+Run: `python benchmarks/scale.py [--tasks N] [--actors N] [--pgs N]
+[--out scale.json]`. Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def bench_many_tasks(n: int) -> dict:
+    """Submit n no-op tasks as one burst (queue depth ~n beyond worker
+    capacity), then drain. Records submit rate, drain rate, and the
+    per-10%-chunk drain rates so quadratic queue behavior is visible."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def nop():
+        return 0
+
+    ray_tpu.get(nop.remote())  # warm a worker + function cache
+    t0 = time.perf_counter()
+    refs = [nop.remote() for _ in range(n)]
+    t_submit = time.perf_counter() - t0
+    chunk = max(1, n // 10)
+    chunk_rates = []
+    t1 = time.perf_counter()
+    for i in range(0, n, chunk):
+        tc = time.perf_counter()
+        ray_tpu.get(refs[i:i + chunk], timeout=600)
+        chunk_rates.append(round(chunk / (time.perf_counter() - tc), 1))
+    t_drain = time.perf_counter() - t1
+    return {
+        "n": n,
+        "submit_per_s": round(n / t_submit, 1),
+        "drain_per_s": round(n / t_drain, 1),
+        "drain_s": round(t_drain, 2),
+        "chunk_drain_rates": chunk_rates,
+    }
+
+
+def bench_many_actors(n: int, batch: int = 100) -> dict:
+    """Register n lightweight actors (factory-forked processes), ping
+    every one, then release them. Creation is batched so the factory's
+    backlog, not the driver, is the limiter being measured."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0)
+    class Ping:
+        def ping(self):
+            return os.getpid()
+
+    actors = []
+    t0 = time.perf_counter()
+    for i in range(0, n, batch):
+        group = [Ping.remote() for _ in range(min(batch, n - i))]
+        # barrier per batch: bounds concurrent spawns so the box survives
+        ray_tpu.get([a.ping.remote() for a in group], timeout=600)
+        actors.extend(group)
+    t_create = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    pids = ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+    t_ping = time.perf_counter() - t1
+    alive = len(set(pids))
+    t2 = time.perf_counter()
+    del actors
+    import gc
+
+    gc.collect()
+    t_release = time.perf_counter() - t2
+    return {
+        "n": n,
+        "create_per_s": round(n / t_create, 1),
+        "ping_all_per_s": round(n / t_ping, 1),
+        "distinct_pids": alive,
+        "release_s": round(t_release, 2),
+    }
+
+
+def bench_many_pgs(n: int) -> dict:
+    """Create, ready-wait, and remove n placement groups (controller
+    bookkeeping; no worker processes involved)."""
+    import ray_tpu
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    t0 = time.perf_counter()
+    pgs = [placement_group([{"CPU": 0.001}]) for _ in range(n)]
+    for pg in pgs:
+        pg.wait(timeout=300)
+    t_create = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    for pg in pgs:
+        remove_placement_group(pg)
+    t_remove = time.perf_counter() - t1
+    return {
+        "n": n,
+        "create_ready_per_s": round(n / t_create, 1),
+        "remove_per_s": round(n / t_remove, 1),
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--tasks", type=int, default=100_000)
+    parser.add_argument("--actors", type=int, default=2_000)
+    parser.add_argument("--pgs", type=int, default=200)
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args()
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    results = {}
+    results["many_tasks"] = bench_many_tasks(args.tasks)
+    results["many_pgs"] = bench_many_pgs(args.pgs)
+    results["many_actors"] = bench_many_actors(args.actors)
+    print(json.dumps(results))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=2)
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
+
+

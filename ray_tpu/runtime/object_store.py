@@ -243,24 +243,41 @@ class _FileIngest:
 
     def __init__(self, path: str, size: int):
         # concurrent-ingest dedup (the shared-".tmp" O_EXCL used to do
-        # this implicitly): a FRESH sibling tmp means another process is
-        # already pulling this object — raise so the caller waits for its
-        # seal instead of running a duplicate network transfer. Stale
-        # tmps (crashed ingests) are taken over, not waited on.
+        # this implicitly): create OUR tmp first, then scan siblings —
+        # a FRESH sibling with a lexically smaller name wins and we
+        # raise so the caller waits for its seal instead of running a
+        # duplicate transfer (creating before scanning makes two
+        # simultaneous starts see each other and pick the same winner).
+        # Stale tmps (crashed ingests) are unlinked, not waited on;
+        # live ingests stay fresh via the periodic utime in write_at.
         import glob as _glob
 
-        now = time.time()
+        self._seg = _Segment.create(path, max(size, 1))
+        self._last_touch = time.time()
+        now = self._last_touch
         for sibling in _glob.glob(path + ".tmp.*"):
+            if sibling == self._seg.tmp_path:
+                continue
             try:
-                if now - os.stat(sibling).st_mtime < 120.0:
+                if now - os.stat(sibling).st_mtime >= 120.0:
+                    os.unlink(sibling)  # crashed writer's leftover
+                elif sibling < self._seg.tmp_path:
+                    self.abort()
                     raise FileExistsError(path)
-                os.unlink(sibling)  # crashed writer's leftover
             except FileNotFoundError:
                 pass
-        self._seg = _Segment.create(path, max(size, 1))
 
     def write_at(self, offset: int, data: bytes) -> None:
         _bulk_copy(memoryview(self._seg.mm), [(offset, len(data))], [data])
+        # mmap stores never update mtime: refresh it so a slow (>120s)
+        # ingest is not misread as crashed and unlinked by a peer
+        now = time.time()
+        if now - self._last_touch > 30.0:
+            self._last_touch = now
+            try:
+                os.utime(self._seg.tmp_path)
+            except OSError:
+                pass
 
     def seal(self) -> None:
         self._seg.seal()
