@@ -105,7 +105,7 @@ def bench_many_pgs(n: int) -> dict:
     t0 = time.perf_counter()
     pgs = [placement_group([{"CPU": 0.001}]) for _ in range(n)]
     for pg in pgs:
-        pg.wait(timeout=300)
+        assert pg.wait(timeout=300), "placement group never became ready"
     t_create = time.perf_counter() - t0
     t1 = time.perf_counter()
     for pg in pgs:

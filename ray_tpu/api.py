@@ -20,12 +20,27 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
          num_tpus: Optional[int] = None, resources: Optional[dict] = None,
          labels: Optional[dict] = None, namespace: str = "",
          ignore_reinit_error: bool = False, **kwargs) -> "_node.Session":
-    """Start (or connect to) a cluster session."""
+    """Start (or connect to) a cluster session. An ``rtpu://host:port``
+    address connects through the cluster's client proxy instead of
+    joining as an in-cluster driver (ref: the reference's Ray Client
+    ``ray://`` scheme, python/ray/util/client/worker.py:81)."""
     if _node.current_session() is not None:
         if ignore_reinit_error:
             return _node.current_session()
         raise RuntimeError("ray_tpu.init() called twice; "
                            "pass ignore_reinit_error=True to allow")
+    if address is not None and address.startswith("rtpu://"):
+        if (num_cpus is not None or num_tpus is not None or resources
+                or labels or kwargs):
+            raise ValueError(
+                "resource/label/extra arguments configure a cluster "
+                "node and have no effect over an rtpu:// client "
+                "connection — drop them or start an in-cluster driver")
+        from .client import connect
+
+        session = connect(address, namespace=namespace)
+        _node.set_session(session)
+        return session
     session = _node.Session(address=address, num_cpus=num_cpus,
                             num_tpus=num_tpus, resources=resources,
                             labels=labels, namespace=namespace)

@@ -1,0 +1,267 @@
+"""Remote-connect client: `ray_tpu.init("rtpu://host:port")`.
+
+Equivalent of the reference's Ray Client (ref: python/ray/util/client/
+worker.py:81 Worker — a laptop driver attaches to a running cluster over
+one connection; API calls proxy through the server, which holds real
+refs on the client's behalf). Here the client installs a `ClientCore`
+that implements exactly the interface the public API layer already uses
+(`get_core()`), so `@remote`, ActorHandle, ObjectRef, placement groups
+and the state API all work unchanged — one code path, two transports.
+
+Not supported over the client link (use an in-cluster driver):
+`num_returns='streaming'` generators and zero-copy gets (values are
+pickled across the link).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Sequence
+
+from . import exceptions
+from .runtime import serialization
+from .runtime.ids import JobID, ObjectID
+
+
+class _ControllerProxy:
+    """`core.controller` stand-in: forwards typed calls through the
+    proxy's generic c_controller pass-through."""
+
+    def __init__(self, client_core: "ClientCore"):
+        self._cc = client_core
+
+    def call(self, method: str, _timeout: Optional[float] = None, **kwargs):
+        return self._cc._call("c_controller", _timeout=_timeout,
+                              meth=method,
+                              payload=serialization.dumps_inline(kwargs))
+
+    async def call_async(self, method: str,
+                         _timeout: Optional[float] = None, **kwargs):
+        return self._cc._unwrap(await self._cc._client.call_async(
+            "c_controller", _timeout=_timeout, client_id=self._cc.client_id,
+            meth=method, payload=serialization.dumps_inline(kwargs)))
+
+
+class ClientCore:
+    """Drop-in for CoreWorker on the far side of one multiplexed
+    connection. Implements the members the API layer and ObjectRef
+    touch; everything else stays server-side."""
+
+    def __init__(self, address: str, namespace: str = ""):
+        from .runtime.rpc import RpcClient
+
+        self.client_id = uuid.uuid4().hex
+        self.namespace = namespace
+        self.job_id = JobID.from_random()
+        self._client = RpcClient(address)
+        self._client.call("ping", _timeout=30)
+        self.controller = _ControllerProxy(self)
+        self._shutting_down = False
+        self._fn_keys: Dict[bytes, str] = {}
+        # local ref counts; zero -> server unpins its real ref
+        self._local_refs: collections.Counter = collections.Counter()
+        self._refs_lock = threading.Lock()
+        # liveness lease: the proxy reaps sessions (unpinning refs,
+        # releasing owned actors) when heartbeats stop — a crashed
+        # laptop or dropped link must not pin cluster memory forever
+        self._hb_stop = threading.Event()
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           name="rtpu-client-hb",
+                                           daemon=True)
+        self._hb_thread.start()
+
+    def _heartbeat_loop(self):
+        while not self._hb_stop.wait(10.0):
+            try:
+                self._client.notify_nowait("c_heartbeat",
+                                           client_id=self.client_id)
+            except Exception:
+                pass
+
+    def flush_events(self) -> None:
+        """No buffered events client-side (the proxy's driver core owns
+        event flushing)."""
+
+    # ------------------------------------------------------------ plumbing
+
+    def _unwrap(self, reply: dict):
+        if "err" in reply:
+            raise serialization.loads_inline(reply["err"])
+        return serialization.loads_inline(reply["ok"])
+
+    def _call(self, _op: str, _timeout: Optional[float] = None,
+              **kwargs):
+        return self._unwrap(self._client.call(
+            _op, _timeout=_timeout, client_id=self.client_id, **kwargs))
+
+    def _make_refs(self, pairs) -> list:
+        from .runtime.core import ObjectRef
+
+        return [ObjectRef(ObjectID(b), owner_addr=owner)
+                for b, owner in pairs]
+
+    def _ref_pairs(self, refs) -> list:
+        return [(r.binary(), r.owner_address) for r in refs]
+
+    # ------------------------------------------------------ ObjectRef hooks
+
+    def _add_local_ref(self, oid: ObjectID) -> None:
+        with self._refs_lock:
+            self._local_refs[oid.binary()] += 1
+
+    def _remove_local_ref(self, oid: ObjectID) -> None:
+        if self._shutting_down:
+            return
+        with self._refs_lock:
+            self._local_refs[oid.binary()] -= 1
+            if self._local_refs[oid.binary()] > 0:
+                return
+            del self._local_refs[oid.binary()]
+        try:
+            self._client.notify_nowait("c_decref", client_id=self.client_id,
+                                       oid=oid.binary())
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- tasks
+
+    def export_function(self, blob: bytes) -> str:
+        import hashlib
+
+        digest = hashlib.blake2b(blob, digest_size=16).digest()
+        key = self._fn_keys.get(digest)
+        if key is None:
+            key = self._call("c_export", blob=blob)
+            self._fn_keys[digest] = key
+        return key
+
+    def submit_task(self, fn_key: str, args, kwargs, spec_opts) -> list:
+        if spec_opts.get("num_returns") in ("streaming", "dynamic"):
+            raise NotImplementedError(
+                "streaming generators are not supported over the client "
+                "link; run the driver inside the cluster")
+        pairs = self._call("c_submit", fn_key=fn_key,
+                           payload=serialization.dumps_inline(
+                               (args, kwargs, spec_opts)))
+        return self._make_refs(pairs)
+
+    def create_actor(self, cls_key: str, name: str, args, kwargs,
+                     spec_opts) -> str:
+        return self._call("c_create_actor", cls_key=cls_key, name=name,
+                          payload=serialization.dumps_inline(
+                              (args, kwargs, spec_opts)))
+
+    def submit_actor_task(self, actor_id: str, method: str, args, kwargs,
+                          opts) -> list:
+        if opts.get("num_returns") in ("streaming", "dynamic"):
+            raise NotImplementedError(
+                "streaming generators are not supported over the client "
+                "link; run the driver inside the cluster")
+        pairs = self._call("c_actor_call", actor_id=actor_id, meth=method,
+                           payload=serialization.dumps_inline(
+                               (args, kwargs, opts)))
+        return self._make_refs(pairs)
+
+    def release_actor_handle(self, actor_id: str) -> None:
+        try:
+            self._client.notify_nowait("c_release_actor",
+                                       client_id=self.client_id,
+                                       actor_id=actor_id)
+        except Exception:
+            pass
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        self._call("c_kill_actor", actor_id=actor_id, no_restart=no_restart)
+
+    # ----------------------------------------------------------- objects
+
+    def put(self, value: Any):
+        pair = self._call("c_put",
+                          payload=serialization.dumps_inline(value))
+        return self._make_refs([pair])[0]
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = not isinstance(refs, (list, tuple))
+        ref_list = [refs] if single else list(refs)
+        values = self._call("c_get", oids=self._ref_pairs(ref_list),
+                            timeout=timeout)
+        return values[0] if single else values
+
+    async def get_async(self, ref):
+        reply = await self._client.call_async(
+            "c_get", client_id=self.client_id,
+            oids=self._ref_pairs([ref]), timeout=None)
+        return self._unwrap(reply)[0]
+
+    def wait(self, refs: Sequence, num_returns: int = 1,
+             timeout: Optional[float] = None, fetch_local: bool = True):
+        pairs = self._ref_pairs(refs)
+        by_bin = {p[0]: r for p, r in zip(pairs, refs)}
+        ready, not_ready = self._call(
+            "c_wait", oids=pairs, num_returns=num_returns, timeout=timeout,
+            fetch_local=fetch_local)
+        return ([by_bin[b] for b, _ in ready],
+                [by_bin[b] for b, _ in not_ready])
+
+    def cancel(self, ref, force: bool = False):
+        self._call("c_cancel", oid=(ref.binary(), ref.owner_address),
+                   force=force)
+
+    def free(self, refs: List) -> None:
+        self._call("c_free", oids=self._ref_pairs(refs))
+
+    # ----------------------------------------------------------- session
+
+    def shutdown(self) -> None:
+        self._shutting_down = True
+        self._hb_stop.set()
+        try:
+            self._client.call("c_disconnect", _timeout=10,
+                              client_id=self.client_id)
+        except Exception:
+            pass
+        self._client.close()
+
+
+class ClientSession:
+    """`_node.Session`-shaped wrapper so api.init/shutdown/is_initialized
+    work unchanged in client mode."""
+
+    def __init__(self, address: str, namespace: str = ""):
+        import atexit
+
+        from .runtime.core import set_core
+
+        self.address = address
+        self.core = ClientCore(address, namespace=namespace)
+        self.namespace = namespace
+        self.session_name = f"client_{self.core.client_id[:8]}"
+        set_core(self.core)
+        atexit.register(self._atexit)
+
+    def _atexit(self) -> None:
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+    def shutdown(self) -> None:
+        import atexit
+
+        atexit.unregister(self._atexit)
+        from .runtime.core import set_core
+
+        set_core(None)
+        self.core.shutdown()
+
+
+def connect(address: str, namespace: str = "") -> ClientSession:
+    """Connect to a cluster's client proxy. `address` may be
+    'rtpu://host:port', 'tcp:host:port', or 'host:port'."""
+    if address.startswith("rtpu://"):
+        address = address[len("rtpu://"):]
+    if not address.startswith(("tcp:", "unix:")):
+        address = f"tcp:{address}"
+    return ClientSession(address, namespace=namespace)

@@ -1,0 +1,326 @@
+"""Client proxy server: drive a cluster from outside it.
+
+Equivalent of the reference's Ray Client server (ref: python/ray/util/
+client/server/server.py:118 RayletServicer — gRPC servicer holding real
+refs on behalf of remote clients; proxy entry python/ray/util/client/
+server/proxier.py). Here the transport is the framework's own RPC layer:
+ONE multiplexed connection per client carries every op, and the proxy —
+a normal driver-mode process inside the cluster — executes them against
+its CoreWorker, pinning returned ObjectRefs per client session so the
+distributed refcount survives the client's (possibly NATed, laptop-grade)
+link.
+
+Run inside the head: `Session.start_client_proxy(port)` (tests, single
+host) or `python -m ray_tpu.client_proxy --controller tcp:HOST:PORT
+--port 10001` (clusters; `ray_tpu start --head --client-port 10001` does
+this for you).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional
+
+from .runtime import serialization
+from .runtime.ids import ObjectID
+
+
+class _ClientSession:
+    """Server-side state for one connected client."""
+
+    def __init__(self):
+        import time
+
+        self.refs: Dict[bytes, object] = {}      # pinned ObjectRefs
+        self.actors: Dict[str, object] = {}      # owning ActorHandles
+        self.last_seen: float = time.monotonic()
+
+
+class ClientProxy:
+    """RPC handlers for remote clients, executed against the local
+    (driver) CoreWorker. One instance serves many clients; per-client
+    state is keyed by the connection (ref: server.py:118 holds
+    per-client object/actor tables)."""
+
+    # a session with no op or heartbeat for this long is reaped: its
+    # pinned refs drop and its owned actors are released (clients
+    # heartbeat every 10s; a crashed laptop must not pin cluster memory)
+    SESSION_TIMEOUT_S = 60.0
+
+    def __init__(self, core):
+        import time as _time
+
+        self.core = core
+        self._sessions: Dict[str, _ClientSession] = {}
+        self._time = _time
+        self._reaper_task = None
+
+    def _session(self, client_id: str) -> _ClientSession:
+        # called from BOTH the io loop and executor threads (inside
+        # _in_executor bodies) — must not touch asyncio state
+        sess = self._sessions.get(client_id)
+        if sess is None:
+            sess = self._sessions[client_id] = _ClientSession()
+        sess.last_seen = self._time.monotonic()
+        return sess
+
+    def start_reaper(self):
+        """Start the session reaper (io loop only; serve_proxy calls it)."""
+        if self._reaper_task is None or self._reaper_task.done():
+            self._reaper_task = asyncio.ensure_future(self._reap_loop())
+
+    async def _reap_loop(self):
+        while True:
+            await asyncio.sleep(10.0)
+            now = self._time.monotonic()
+            for client_id, sess in list(self._sessions.items()):
+                if now - sess.last_seen > self.SESSION_TIMEOUT_S:
+                    await self.c_disconnect(client_id)
+
+    async def c_heartbeat(self, client_id: str):
+        self._session(client_id)
+        return True
+
+    def handlers(self):
+        return {
+            "c_export": self.c_export,
+            "c_submit": self.c_submit,
+            "c_create_actor": self.c_create_actor,
+            "c_actor_call": self.c_actor_call,
+            "c_release_actor": self.c_release_actor,
+            "c_get": self.c_get,
+            "c_put": self.c_put,
+            "c_wait": self.c_wait,
+            "c_cancel": self.c_cancel,
+            "c_free": self.c_free,
+            "c_kill_actor": self.c_kill_actor,
+            "c_decref": self.c_decref,
+            "c_controller": self.c_controller,
+            "c_disconnect": self.c_disconnect,
+            "c_heartbeat": self.c_heartbeat,
+            "ping": self.ping,
+        }
+
+    async def ping(self):
+        return "ok"
+
+    # every handler returns {"ok": blob} or {"err": blob}: typed
+    # exceptions (GetTimeoutError, ObjectLostError, user errors) must
+    # cross the wire as themselves, not as RemoteHandlerError strings
+    def _wrap(self, value):
+        return {"ok": serialization.dumps_inline(value)}
+
+    def _wrap_err(self, e: BaseException):
+        try:
+            return {"err": serialization.dumps_inline(e)}
+        except Exception:
+            return {"err": serialization.dumps_inline(
+                RuntimeError(repr(e)))}
+
+    def _refs_out(self, client_id: str, refs) -> list:
+        """Pin refs for this client and ship (oid, owner) pairs."""
+        sess = self._session(client_id)
+        out = []
+        for ref in refs:
+            sess.refs[ref.binary()] = ref
+            out.append((ref.binary(), ref.owner_address))
+        return out
+
+    def _refs_in(self, oids) -> list:
+        """Rehydrate client oids into this driver's (borrowed) refs."""
+        from .runtime.core import ObjectRef
+
+        return [ObjectRef(ObjectID(b), owner_addr=owner, borrowed=True)
+                for b, owner in oids]
+
+    async def _in_executor(self, fn):
+        """Core-worker sync methods use the sync RPC bridge internally,
+        which deadlocks on the io loop — every core-touching op runs on
+        an executor thread (the public API's normal calling mode)."""
+        loop = asyncio.get_event_loop()
+        try:
+            return self._wrap(await loop.run_in_executor(None, fn))
+        except BaseException as e:  # noqa: BLE001
+            return self._wrap_err(e)
+
+    async def c_export(self, client_id: str, blob: bytes):
+        return await self._in_executor(
+            lambda: self.core.export_function(blob))
+
+    async def c_submit(self, client_id: str, fn_key: str, payload: bytes):
+        def run():
+            args, kwargs, spec_opts = serialization.loads_inline(payload)
+            refs = self.core.submit_task(fn_key, args, kwargs, spec_opts)
+            return self._refs_out(client_id, refs)
+
+        return await self._in_executor(run)
+
+    async def c_create_actor(self, client_id: str, cls_key: str,
+                             name: str, payload: bytes):
+        def run():
+            from .actor import ActorHandle
+
+            args, kwargs, spec_opts = serialization.loads_inline(payload)
+            actor_id = self.core.create_actor(cls_key, name, args, kwargs,
+                                              spec_opts)
+            sess = self._session(client_id)
+            # the proxy holds the owning handle: the actor fate-shares
+            # with the client SESSION, not with any in-proxy GC
+            sess.actors[actor_id] = ActorHandle(
+                actor_id, owning=not spec_opts.get("name"))
+            return actor_id
+
+        return await self._in_executor(run)
+
+    async def c_actor_call(self, client_id: str, actor_id: str,
+                           meth: str, payload: bytes):
+        def run():
+            args, kwargs, opts = serialization.loads_inline(payload)
+            refs = self.core.submit_actor_task(actor_id, meth, args,
+                                               kwargs, opts)
+            return self._refs_out(client_id, refs)
+
+        return await self._in_executor(run)
+
+    async def c_release_actor(self, client_id: str, actor_id: str):
+        sess = self._session(client_id)
+        handle = sess.actors.pop(actor_id, None)
+        if handle is not None:
+            handle._owning = False  # the release below is the real one
+            loop = asyncio.get_event_loop()
+            try:
+                await loop.run_in_executor(
+                    None, lambda: self.core.release_actor_handle(actor_id))
+            except Exception:
+                pass
+        return True
+
+    async def c_get(self, client_id: str, oids, timeout):
+        def run():
+            refs = self._refs_in(oids)
+            return self.core.get(refs, timeout=timeout)
+
+        return await self._in_executor(run)
+
+    async def c_put(self, client_id: str, payload: bytes):
+        def run():
+            value = serialization.loads_inline(payload)
+            ref = self.core.put(value)
+            return self._refs_out(client_id, [ref])[0]
+
+        return await self._in_executor(run)
+
+    async def c_wait(self, client_id: str, oids, num_returns, timeout,
+                     fetch_local):
+        def run():
+            refs = self._refs_in(oids)
+            by_bin = {r.binary(): o for r, o in zip(refs, oids)}
+            ready, not_ready = self.core.wait(
+                refs, num_returns=num_returns, timeout=timeout,
+                fetch_local=fetch_local)
+            return ([by_bin[r.binary()] for r in ready],
+                    [by_bin[r.binary()] for r in not_ready])
+
+        return await self._in_executor(run)
+
+    async def c_cancel(self, client_id: str, oid, force):
+        def run():
+            (ref,) = self._refs_in([oid])
+            self.core.cancel(ref, force=force)
+            return True
+
+        return await self._in_executor(run)
+
+    async def c_free(self, client_id: str, oids):
+        def run():
+            self.core.free(self._refs_in(oids))
+            sess = self._session(client_id)
+            for b, _ in oids:
+                sess.refs.pop(b, None)
+            return True
+
+        return await self._in_executor(run)
+
+    async def c_kill_actor(self, client_id: str, actor_id: str,
+                           no_restart: bool):
+        def run():
+            self.core.kill_actor(actor_id, no_restart=no_restart)
+            return True
+
+        return await self._in_executor(run)
+
+    async def c_decref(self, client_id: str, oid: bytes):
+        self._session(client_id).refs.pop(oid, None)
+        return True
+
+    async def c_controller(self, client_id: str, meth: str,
+                           payload: bytes):
+        """Generic controller pass-through: placement groups, named
+        actors, state API, job submission — every control-plane feature
+        a driver has works over the client link unchanged."""
+        try:
+            kwargs = serialization.loads_inline(payload)
+            result = await self.core.controller.call_async(meth, **kwargs)
+            return self._wrap(result)
+        except BaseException as e:  # noqa: BLE001
+            return self._wrap_err(e)
+
+    async def c_disconnect(self, client_id: str):
+        sess = self._sessions.pop(client_id, None)
+        if sess is not None:
+            loop = asyncio.get_event_loop()
+            for actor_id, handle in sess.actors.items():
+                if getattr(handle, "_owning", False):
+                    handle._owning = False
+                    try:
+                        await loop.run_in_executor(
+                            None,
+                            lambda a=actor_id:
+                            self.core.release_actor_handle(a))
+                    except Exception:
+                        pass
+            sess.refs.clear()
+        return True
+
+
+def serve_proxy(core, address: str):
+    """Start the proxy RPC server on `address`; returns the RpcServer."""
+    from .runtime.rpc import EventLoopThread, RpcServer
+
+    proxy = ClientProxy(core)
+    server = RpcServer(address, proxy.handlers())
+
+    async def _start():
+        await server.start()
+        proxy.start_reaper()
+
+    EventLoopThread.get().run(_start())
+    return server
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--controller", required=True,
+                        help="controller address, e.g. tcp:HOST:PORT")
+    parser.add_argument("--port", type=int, default=10001)
+    args = parser.parse_args()
+
+    from .runtime import node as _node
+
+    session = _node.Session(address=args.controller)
+    serve_proxy(session.core, f"tcp:0.0.0.0:{args.port}")
+    print(f"client proxy serving on port {args.port}", flush=True)
+    import signal
+    import threading
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    session.shutdown()
+
+
+if __name__ == "__main__":
+    main()

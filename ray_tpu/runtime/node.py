@@ -166,8 +166,24 @@ class Session:
         except Exception:
             pass
 
+    def start_client_proxy(self, port: int = 0) -> str:
+        """Serve a client proxy (ray_tpu.client) from this driver; returns
+        the rtpu:// address remote clients connect to."""
+        from ..client_proxy import serve_proxy
+
+        server = serve_proxy(self.core, f"tcp:127.0.0.1:{port}")
+        self._client_proxy = server
+        host, p = server.address.split(":")[1:]
+        return f"rtpu://{host}:{p}"
+
     def shutdown(self):
         atexit.unregister(self._atexit)
+        proxy = getattr(self, "_client_proxy", None)
+        if proxy is not None:
+            try:
+                EventLoopThread.get().run(proxy.stop(), timeout=3)
+            except Exception:
+                pass
         core = get_core(required=False)
         if core is not None:
             try:

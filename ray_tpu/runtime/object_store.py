@@ -244,24 +244,30 @@ class _FileIngest:
     def __init__(self, path: str, size: int):
         # concurrent-ingest dedup (the shared-".tmp" O_EXCL used to do
         # this implicitly): create OUR tmp first, then scan siblings —
-        # a FRESH sibling with a lexically smaller name wins and we
-        # raise so the caller waits for its seal instead of running a
-        # duplicate transfer (creating before scanning makes two
-        # simultaneous starts see each other and pick the same winner).
-        # Stale tmps (crashed ingests) are unlinked, not waited on;
-        # live ingests stay fresh via the periodic utime in write_at.
+        # the OLDEST fresh sibling wins (it is the transfer already in
+        # progress; name breaks mtime ties for simultaneous starts) and
+        # we raise so the caller waits for its seal instead of running a
+        # duplicate transfer. Stale tmps (crashed ingests) are unlinked,
+        # not waited on; live ingests stay fresh via the periodic utime
+        # in write_at.
         import glob as _glob
 
         self._seg = _Segment.create(path, max(size, 1))
         self._last_touch = time.time()
         now = self._last_touch
+        try:
+            ours = (os.stat(self._seg.tmp_path).st_mtime,
+                    self._seg.tmp_path)
+        except OSError:
+            ours = (now, self._seg.tmp_path)
         for sibling in _glob.glob(path + ".tmp.*"):
             if sibling == self._seg.tmp_path:
                 continue
             try:
-                if now - os.stat(sibling).st_mtime >= 120.0:
+                mtime = os.stat(sibling).st_mtime
+                if now - mtime >= 120.0:
                     os.unlink(sibling)  # crashed writer's leftover
-                elif sibling < self._seg.tmp_path:
+                elif (mtime, sibling) < ours:
                     self.abort()
                     raise FileExistsError(path)
             except FileNotFoundError:

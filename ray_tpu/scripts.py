@@ -171,11 +171,29 @@ def cmd_start(args):
                            else f"node-{node_id[:8]}.pids"), "a") as f:
         f.write(f"{proc.pid}\n")
     _wait_node(public_addr, node_id, 60)
+    if args.head and getattr(args, "client_port", None):
+        # client proxy: lets drivers OUTSIDE the cluster attach over one
+        # connection (ref: ray start's --ray-client-server-port)
+        log = open(os.path.join(session_dir, "logs", "client-proxy.log"),
+                   "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.client_proxy",
+             "--controller", public_addr,
+             "--port", str(args.client_port)],
+            stdout=log, stderr=subprocess.STDOUT, start_new_session=True)
+        pids.append(proc.pid)
+        with open(os.path.join(session_dir, "head.pids"), "a") as f:
+            f.write(f"{proc.pid}\n")
     print(f"ray_tpu {'head' if args.head else 'node'} started.")
     print(f"  address: {public_addr}")
     if args.head:
         print(f"  connect: ray_tpu.init(address={public_addr!r})")
         print(f"  add workers: python -m ray_tpu start --address {public_addr}")
+        if getattr(args, "client_port", None):
+            from .runtime.rpc import advertise_ip
+
+            print(f"  remote clients: ray_tpu.init("
+                  f"'rtpu://{advertise_ip()}:{args.client_port}')")
 
 
 def _wait_ping(address, timeout):
@@ -254,6 +272,9 @@ def main(argv=None):
     p_start.add_argument("--num-cpus", type=float, default=None)
     p_start.add_argument("--num-tpus", type=float, default=None)
     p_start.add_argument("--resources", default=None, help="JSON dict")
+    p_start.add_argument("--client-port", type=int, default=None,
+                         help="also serve a client proxy for "
+                              "rtpu:// remote drivers (head only)")
     p_start.add_argument("--persist-dir", default=None,
                          help="controller FT journal directory")
     p_start.set_defaults(func=cmd_start)

@@ -1,0 +1,145 @@
+"""Remote-connect client (rtpu://) test matrix.
+
+Mirrors the reference's Ray Client coverage (ref: python/ray/util/client/
+worker.py:81; tests python/ray/tests/test_client.py — tasks, actors,
+objects, PGs through the proxy). The client runs in a SUBPROCESS: client
+mode replaces the process-global core, so client and in-cluster driver
+cannot share a process.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+
+CLIENT_SCRIPT = textwrap.dedent("""
+    import sys
+    import ray_tpu
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    ray_tpu.init(sys.argv[1])
+
+    # ---- objects
+    ref = ray_tpu.put({"k": [1, 2, 3]})
+    assert ray_tpu.get(ref, timeout=60) == {"k": [1, 2, 3]}
+
+    # ---- tasks (incl. a ref argument crossing the link)
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2), timeout=60) == 3
+    assert ray_tpu.get(add.remote(ray_tpu.put(10), 5), timeout=60) == 15
+    refs = [add.remote(i, i) for i in range(8)]
+    assert ray_tpu.get(refs, timeout=60) == [2 * i for i in range(8)]
+
+    # ---- wait
+    ready, not_ready = ray_tpu.wait(refs, num_returns=8, timeout=60)
+    assert len(ready) == 8 and not not_ready
+
+    # ---- task errors propagate typed
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("boom over the link")
+
+    try:
+        ray_tpu.get(boom.remote(), timeout=60)
+        raise AssertionError("expected failure")
+    except Exception as e:
+        assert "boom over the link" in str(e)
+
+    # ---- actors
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def add(self, k):
+            self.n += k
+            return self.n
+
+    c = Counter.remote(100)
+    assert ray_tpu.get([c.add.remote(1) for _ in range(3)],
+                       timeout=60) == [101, 102, 103]
+
+    # named actor via the controller pass-through
+    named = Counter.options(name="client-counter").remote(0)
+    assert ray_tpu.get(named.add.remote(5), timeout=60) == 5
+    again = ray_tpu.get_actor("client-counter")
+    assert ray_tpu.get(again.add.remote(5), timeout=60) == 10
+    ray_tpu.kill(named)
+
+    # ---- placement groups
+    pg = placement_group([{"CPU": 0.1}])
+    assert pg.wait(timeout=60)
+    remove_placement_group(pg)
+
+    ray_tpu.shutdown()
+    print("CLIENT-OK")
+""")
+
+
+@pytest.fixture
+def head_with_proxy():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    session = ray_tpu.init(num_cpus=2)
+    address = session.start_client_proxy()
+    yield address
+    ray_tpu.shutdown()
+
+
+def test_client_core_api_matrix(head_with_proxy):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-c", CLIENT_SCRIPT, head_with_proxy],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "CLIENT-OK" in out.stdout
+
+
+def test_client_disconnect_releases_actor(head_with_proxy):
+    """An unnamed actor created over the link dies with the client
+    session (owner-based lifetime crosses the proxy)."""
+    script = textwrap.dedent("""
+        import sys
+        import ray_tpu
+
+        ray_tpu.init(sys.argv[1])
+
+        @ray_tpu.remote
+        class A:
+            def pid(self):
+                import os
+                return os.getpid()
+
+        a = A.remote()
+        print("PID", ray_tpu.get(a.pid.remote(), timeout=60))
+        ray_tpu.shutdown()
+    """)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, "-c", script, head_with_proxy],
+                         capture_output=True, text=True, timeout=240,
+                         env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    pid = int(out.stdout.split("PID", 1)[1].split()[0])
+    # the actor's worker process exits once the client disconnected
+    import time
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+        except OSError:
+            return  # gone
+        time.sleep(0.25)
+    raise AssertionError(f"actor worker {pid} outlived its client session")
