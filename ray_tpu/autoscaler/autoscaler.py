@@ -89,17 +89,47 @@ class Autoscaler:
         # 2. unmet demand -> smallest fitting node type under max_workers
         demands = [d["resources"] for d in status.get(
             "recent_unschedulable", [])]
-        demands += [p["resources"] for p in status.get("pending_actors", [])]
-        unmet = self._dedupe(demands)
+        # PG-targeted pending actors run inside their bundle's
+        # reservation — counting both the actor AND its gang's bundles
+        # double-scales (the reference's resource_demand_scheduler
+        # excludes PG-targeted demand the same way)
+        demands += [p["resources"] for p in status.get("pending_actors", [])
+                    if not p.get("placement_group_id")]
+        unmet = [(d, 1) for d in self._dedupe(demands)]
+        # pending gangs are strategy-aware multi-node demand:
+        # - STRICT_PACK needs ONE node fitting the bundle SUM;
+        # - spread/pack gangs need one node PER bundle (multiplicity
+        #   preserved past dedupe, launched together — one-per-cooldown
+        #   would livelock against idle termination of the early nodes);
+        # - SLICE_PACK launches whole slices, so one row is the create
+        #   unit and the provider fans it out to every host.
+        for pg in status.get("pending_placement_groups", []):
+            bundles = list(pg["bundles"])
+            strategy = pg.get("strategy", "PACK")
+            if strategy == "STRICT_PACK":
+                total: Dict[str, float] = {}
+                for b in bundles:
+                    for k, v in b.items():
+                        total[k] = total.get(k, 0.0) + v
+                unmet.append((total, 1))
+            elif strategy == "SLICE_PACK":
+                for d in self._dedupe(bundles):
+                    unmet.append((d, 1))
+            else:
+                for d in self._dedupe(bundles):
+                    unmet.append((d, sum(1 for b in bundles if b == d)))
         now = time.time()
-        for demand in unmet:
+        for demand, count in unmet:
             if not any(v > 0 for v in demand.values()):
                 continue  # zero-resource requests fit anywhere already
             cfg = self._pick_type(demand)
-            if (cfg is not None
-                    and self._counts[cfg.name] < cfg.max_workers
-                    and now - self._last_launch.get(cfg.name, 0.0)
-                    >= self.launch_cooldown_s):
+            if (cfg is None
+                    or now - self._last_launch.get(cfg.name, 0.0)
+                    < self.launch_cooldown_s):
+                continue
+            for _ in range(count):
+                if self._counts[cfg.name] >= cfg.max_workers:
+                    break
                 self._launch(cfg)
                 actions["launched"] += 1
 

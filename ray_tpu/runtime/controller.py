@@ -696,12 +696,24 @@ class Controller:
             "num_placement_groups": len(self.placement_groups),
             "pending_actors": [
                 {"actor_id": a.actor_id,
-                 "resources": a.spec.get("resources", {})}
+                 "resources": a.spec.get("resources", {}),
+                 # PG-targeted actors run inside their bundle's
+                 # reservation: the autoscaler must count the BUNDLE,
+                 # not the actor, or every pending gang double-scales
+                 "placement_group_id":
+                     a.spec.get("placement_group_id")}
                 for a in self.actors.values()
                 if a.state in (ACTOR_PENDING, ACTOR_RESTARTING)],
             "recent_unschedulable": [
                 d for d in self.unschedulable
                 if time.time() - d["ts"] < 30.0],
+            # unplaceable gangs are scaling demand too (the autoscaler
+            # launches a slice sized to the whole bundle set)
+            "pending_placement_groups": [
+                {"pg_id": pg_id, "bundles": pg["bundles"],
+                 "strategy": pg.get("strategy", "PACK")}
+                for pg_id, pg in self.placement_groups.items()
+                if pg.get("state") == "PENDING"],
         }
 
     async def ping(self):
