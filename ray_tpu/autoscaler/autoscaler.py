@@ -133,24 +133,45 @@ class Autoscaler:
                 self._launch(cfg)
                 actions["launched"] += 1
 
-        # 3. idle autoscaled nodes above min -> terminate after timeout
+        # 3. reconcile launch counts with the provider (when it reports
+        # per-instance types): a create that ended permanently FAILED
+        # must release its max_workers budget
+        if hasattr(self.provider, "instance_types"):
+            live = self.provider.instance_types()
+            for type_name in self._counts:
+                self._counts[type_name] = sum(
+                    1 for t in live.values() if t == type_name)
+            self._node_type = {iid: t for iid, t in live.items()}
+
+        # 4. idle autoscaled instances above min -> terminate after a
+        # timeout. Cluster nodes group by owning provider instance (a
+        # slice's hosts map to ONE instance via rtpu.slice labels);
+        # an instance is idle only when EVERY one of its nodes is.
         now = time.time()
+        by_instance: Dict[str, List[Dict]] = {}
         for node_id, info in status.get("nodes", {}).items():
-            if node_id not in self._node_type or not info.get("alive", True):
+            if not info.get("alive", True):
                 continue
-            if self._is_idle(info):
-                self._idle_since.setdefault(node_id, now)
-                if now - self._idle_since[node_id] >= self.idle_timeout_s:
-                    type_name = self._node_type[node_id]
+            iid = node_id
+            if hasattr(self.provider, "instance_for"):
+                iid = self.provider.instance_for(
+                    node_id, info.get("labels", {}) or {}) or node_id
+            if iid in self._node_type:
+                by_instance.setdefault(iid, []).append(info)
+        for iid, infos in by_instance.items():
+            if all(self._is_idle(i) for i in infos):
+                self._idle_since.setdefault(iid, now)
+                if now - self._idle_since[iid] >= self.idle_timeout_s:
+                    type_name = self._node_type[iid]
                     cfg = self.node_types[type_name]
                     if self._counts[type_name] > cfg.min_workers:
-                        if self.provider.terminate_node(node_id):
+                        if self.provider.terminate_node(iid):
                             self._counts[type_name] -= 1
-                            del self._node_type[node_id]
-                            self._idle_since.pop(node_id, None)
+                            self._node_type.pop(iid, None)
+                            self._idle_since.pop(iid, None)
                             actions["terminated"] += 1
             else:
-                self._idle_since.pop(node_id, None)
+                self._idle_since.pop(iid, None)
         return actions
 
     # ---------------------------------------------------------- helpers

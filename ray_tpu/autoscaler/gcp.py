@@ -43,7 +43,10 @@ _TRANSITIONS = {
     RUNNING: {DRAINING, FAILED},
     DRAINING: {TERMINATED, FAILED},
     TERMINATED: set(),
-    FAILED: {REQUESTED},   # retry re-enters the pipeline
+    # retries re-enter the pipeline: failed creates re-request; failures
+    # with a live cloud resource re-drain (a transient delete error or a
+    # PREEMPTED poll must never strand a billing TPU slice)
+    FAILED: {REQUESTED, DRAINING},
 }
 
 
@@ -253,6 +256,27 @@ class GCPTPUNodeProvider(NodeProvider):
         return [i.instance_id for i in self.instances.by_status(
             REQUESTED, LAUNCHING, RUNNING, DRAINING)]
 
+    def instance_types(self) -> Dict[str, str]:
+        """Live (non-terminal) instances by node type — the autoscaler
+        reconciles its launch counts from this, so permanently-FAILED
+        creates stop consuming the max_workers budget."""
+        return {i.instance_id: i.node_type for i in self.instances.by_status(
+            REQUESTED, LAUNCHING, RUNNING, DRAINING)}
+
+    def instance_for(self, node_id: str,
+                     labels: Dict[str, str]) -> Optional[str]:
+        """Map a CLUSTER node (a joined host) to the provider instance
+        that owns it: hosts carry their slice's cloud id in rtpu.slice.
+        The autoscaler's idle scale-down terminates instances, and a
+        slice's hosts never share the instance_id it was created under."""
+        slice_name = labels.get("rtpu.slice")
+        if not slice_name:
+            return None
+        for inst in self.instances.all():
+            if inst.cloud_id == slice_name:
+                return inst.instance_id
+        return None
+
     # ------------------------------------------------------- reconciler
 
     def _ensure_reconciler(self):
@@ -317,11 +341,19 @@ class GCPTPUNodeProvider(NodeProvider):
             except Exception as e:  # noqa: BLE001
                 self.instances.transition(inst.instance_id, FAILED,
                                           error=repr(e))
-        # FAILED creates retry (bounded by the audit trail length); the
-        # last error stays on the record for the audit
+        # FAILED retries (bounded by the audit trail length); the last
+        # error stays on the record for the audit. With a cloud_id the
+        # resource may still exist (failed delete, PREEMPTED poll) — the
+        # delete is reissued via DRAINING so a slice never leaks.
         for inst in self.instances.by_status(FAILED):
-            if inst.cloud_id is None and len(inst.history) < 8:
-                self.instances.transition(inst.instance_id, REQUESTED,
+            if len(inst.history) >= 16:
+                continue
+            if inst.cloud_id is None:
+                if len(inst.history) < 8:
+                    self.instances.transition(inst.instance_id, REQUESTED,
+                                              error=inst.error)
+            else:
+                self.instances.transition(inst.instance_id, DRAINING,
                                           error=inst.error)
 
 

@@ -19,7 +19,8 @@ import numpy as np
 
 from .block import Block, BlockAccessor, rows_to_block
 from .executor import StreamingExecutor
-from .plan import (AllToAll, Filter, FlatMap, InputData, Limit, LogicalPlan,
+from .plan import (AllToAll, Filter, FlatMap, InputData,
+                   Join as JoinOp, Limit, LogicalPlan,
                    MapBatches, MapRows, Read, Union as UnionOp, Zip,
                    compile_plan)
 
@@ -102,21 +103,42 @@ class Dataset:
         return self._append(Zip(other=other._plan))
 
     def join(self, other: "Dataset", on: Union[str, List[str]],
-             how: str = "inner", *, suffix: str = "_right") -> "Dataset":
-        """Broadcast hash join (ref: python/ray/data/dataset.py join; the
-        reference's join is a shuffle join — here the RIGHT side is
-        materialized and broadcast to the left's map tasks, the standard
-        plan for a small dimension table joined onto a large fact side).
+             how: str = "inner", *, suffix: str = "_right",
+             shuffle: Optional[bool] = None,
+             num_blocks: Optional[int] = None) -> "Dataset":
+        """Join with `other` on key column(s).
 
-        Lazy like every other transform: the right side executes only when
-        the joined dataset is consumed (once per worker process, memoized
-        by join id).
+        Two physical plans (ref: python/ray/data/dataset.py join;
+        shuffle planner _internal/planner/plan_join_op.py):
+        - broadcast (shuffle=False; default for inner/left): the RIGHT
+          side is materialized once per worker and probed by the left's
+          map tasks — the standard plan for a small dimension table.
+        - shuffle hash join (shuffle=True; default for right/full):
+          BOTH sides hash-partition on the keys and one reducer joins
+          each partition pair — the big-big plan where neither side fits
+          a single worker.
 
-        how: "inner" | "left". Right columns colliding with left names get
-        `suffix`.
+        how: "inner" | "left" | "right" | "full". Right columns
+        colliding with left names get `suffix`.
         """
-        if how not in ("inner", "left"):
+        if how not in ("inner", "left", "right", "full"):
             raise ValueError(f"unsupported join type {how!r}")
+        if shuffle is None:
+            shuffle = how in ("right", "full")
+        if not shuffle and how in ("right", "full"):
+            raise ValueError(
+                f"how={how!r} requires the shuffle join (the broadcast "
+                "plan cannot see unmatched right rows); pass shuffle=True")
+        if not shuffle and num_blocks is not None:
+            raise ValueError(
+                "num_blocks only applies to the shuffle join (the "
+                "broadcast plan keeps the left side's blocking); pass "
+                "shuffle=True or drop num_blocks")
+        if shuffle:
+            keys = [on] if isinstance(on, str) else list(on)
+            return self._append(JoinOp(other=other._plan, keys=keys,
+                                       how=how, suffix=suffix,
+                                       num_blocks=num_blocks))
         keys = [on] if isinstance(on, str) else list(on)
         join_id = uuid.uuid4().hex
         right_plan = other._plan

@@ -33,6 +33,18 @@ def _default_max_in_flight() -> int:
     return max(2 * cpus, 8)
 
 
+def _store_used_fraction() -> float:
+    """Object-store fill fraction on this host (0.0 when unknown)."""
+    try:
+        from ..runtime.core import get_core
+
+        stats = get_core().store.stats()
+        cap = stats.get("capacity") or 0
+        return (stats.get("used_bytes", 0) / cap) if cap else 0.0
+    except Exception:
+        return 0.0
+
+
 # ---------------------------------------------------------- remote helpers
 def _apply_chain(fns: List[Callable[[Block], Block]], block: Block) -> Block:
     for fn in fns:
@@ -64,7 +76,7 @@ def _partition_block(block: Block, n: int, kind: str, args: Dict[str, Any]):
             k = _sort_key(r, key)
             idx = int(np.searchsorted(bounds, _orderable(k), side="right"))
             parts[idx].append(r)
-    elif kind == "aggregate":
+    elif kind in ("aggregate", "join_key"):
         keys = args["keys"]
         part_ids = _hash_partition_rows(rows, keys, n)
         for r, pid in zip(rows, part_ids):
@@ -139,6 +151,72 @@ def _hash_partition_rows(rows, keys, n: int):
             for r in rows]
 
 
+def _join_partition(args: Dict[str, Any], n_left: int, *parts: Block) -> Block:
+    """Reduce phase of the shuffle join: the first n_left parts are the
+    left side's i-th partitions, the rest the right side's. Hash
+    partitioning guarantees every occurrence of a key lands in one
+    reducer, so a local hash join per partition is exact for all four
+    join types (ref: _internal/planner/plan_join_op.py)."""
+    keys: List[str] = args["keys"]
+    how: str = args["how"]
+    suffix: str = args["suffix"]
+    left_rows: List[dict] = []
+    for p in parts[:n_left]:
+        left_rows.extend(BlockAccessor(p).iter_rows())
+    right_rows: List[dict] = []
+    for p in parts[n_left:]:
+        right_rows.extend(BlockAccessor(p).iter_rows())
+
+    lookup: Dict[tuple, List[dict]] = {}
+    for row in right_rows:
+        lookup.setdefault(tuple(row[k] for k in keys), []).append(row)
+    left_cols = list(left_rows[0].keys()) if left_rows else []
+    right_extra = [c for c in (right_rows[0].keys() if right_rows else [])
+                   if c not in keys]
+    renamed = {}
+    for c in right_extra:
+        name = c + suffix if c in left_cols else c
+        if name in left_cols:
+            # same contract as the broadcast path: never silently
+            # overwrite a left column with a suffixed right one
+            raise ValueError(
+                f"join output column {name!r} collides with an existing "
+                f"left column even after suffixing; pass a different "
+                f"suffix=")
+        renamed[c] = name
+
+    out: List[dict] = []
+    matched_keys: set = set()
+    for row in left_rows:
+        key = tuple(row[k] for k in keys)
+        matches = lookup.get(key)
+        if matches is None:
+            if how in ("left", "full"):
+                rec = dict(row)
+                for c in right_extra:
+                    rec[renamed[c]] = None
+                out.append(rec)
+            continue
+        matched_keys.add(key)
+        for m in matches:
+            rec = dict(row)
+            for c in right_extra:
+                rec[renamed[c]] = m[c]
+            out.append(rec)
+    if how in ("right", "full"):
+        for key, matches in lookup.items():
+            if key in matched_keys:
+                continue
+            for m in matches:
+                rec = {c: None for c in left_cols}
+                for k, v in zip(keys, key):
+                    rec[k] = v
+                for c in right_extra:
+                    rec[renamed[c]] = m[c]
+                out.append(rec)
+    return rows_to_block(out)
+
+
 def _sort_key(row, key):
     if isinstance(row, dict):
         if isinstance(key, (list, tuple)):
@@ -193,8 +271,8 @@ class StreamingExecutor:
     # -------------------------------------------------------------- public
     def execute(self, stages: List[Any]) -> List[Any]:
         """Run all stages; returns ObjectRefs of the final blocks."""
-        from .plan import (AllToAllStage, LimitStage, MapStage, SourceStage,
-                           UnionStage, ZipStage)
+        from .plan import (AllToAllStage, JoinStage, LimitStage, MapStage,
+                           SourceStage, UnionStage, ZipStage)
         import ray_tpu
 
         refs: List[Any] = []
@@ -205,6 +283,8 @@ class StreamingExecutor:
                 refs = self._run_map(stage, refs)
             elif isinstance(stage, AllToAllStage):
                 refs = self._run_all_to_all(stage, refs)
+            elif isinstance(stage, JoinStage):
+                refs = self._run_join(stage, refs)
             elif isinstance(stage, UnionStage):
                 from .dataset import Dataset  # noqa: avoid cycle at import
 
@@ -238,22 +318,63 @@ class StreamingExecutor:
         apply_ = ray_tpu.remote(_apply_chain)
         return self._bounded_submit([(apply_, (stage.fns, r)) for r in refs])
 
+    def _admission_limit(self) -> int:
+        """Memory-aware admission (ref: python/ray/data/_internal/
+        execution/resource_manager.py — the reference budgets operator
+        admission by object-store headroom). A map stage producing 10x
+        its input must throttle BEFORE the store overruns into
+        eviction/spill thrash, so the in-flight cap shrinks as the store
+        fills: full speed below 60%%, quarter speed to 85%%, serial
+        above."""
+        frac = _store_used_fraction()
+        if frac >= 0.85:
+            return 1
+        if frac >= 0.6:
+            return max(2, self.max_in_flight // 4)
+        return self.max_in_flight
+
     def _bounded_submit(self, calls) -> List[Any]:
-        """Submit keeping at most max_in_flight outstanding."""
+        """Submit keeping at most the (store-pressure-derived) admission
+        limit outstanding."""
         import ray_tpu
 
         out: List[Any] = []
         in_flight: List[Any] = []
         for fn, args in calls:
-            if len(in_flight) >= self.max_in_flight:
+            while len(in_flight) >= self._admission_limit():
                 ready, in_flight = ray_tpu.wait(
                     in_flight, num_returns=1, timeout=300)
+                if not ready:
+                    break  # timeout: avoid deadlock, let submit proceed
             ref = fn.remote(*args)
             out.append(ref)
             in_flight.append(ref)
         return out
 
     # ---------------------------------------------------------- all-to-all
+    def _partition_fanout(self, refs, n_out: int, kind: str,
+                          args: Dict[str, Any]) -> List[List[Any]]:
+        """Hash/range-partition every block, bounded by the same
+        store-pressure admission as map submission (each partition task
+        materializes n_out output objects — an unbounded wave here blows
+        the store exactly when a big shuffle needs the headroom most)."""
+        import ray_tpu
+
+        part = ray_tpu.remote(_partition_block).options(num_returns=n_out)
+        outs: List[List[Any]] = []
+        in_flight: List[Any] = []
+        for r in refs:
+            while len(in_flight) >= self._admission_limit():
+                ready, in_flight = ray_tpu.wait(
+                    in_flight, num_returns=1, timeout=300)
+                if not ready:
+                    break
+            res = part.remote(r, n_out, kind, args)
+            lst = res if isinstance(res, list) else [res]
+            outs.append(lst)
+            in_flight.append(lst[0])
+        return outs
+
     def _run_all_to_all(self, stage, refs: List[Any]) -> List[Any]:
         import ray_tpu
 
@@ -263,11 +384,7 @@ class StreamingExecutor:
             args["bounds"] = self._sample_sort_bounds(refs, args, n_out)
         if not refs:
             return []
-        part = ray_tpu.remote(_partition_block).options(num_returns=n_out)
-        map_outs: List[List[Any]] = []
-        for r in refs:
-            res = part.remote(r, n_out, kind, args)
-            map_outs.append(res if isinstance(res, list) else [res])
+        map_outs = self._partition_fanout(refs, n_out, kind, args)
         reduce_ = ray_tpu.remote(_reduce_partition)
         out = self._bounded_submit(
             [(reduce_, (kind, args) + tuple(m[i] for m in map_outs))
@@ -290,6 +407,30 @@ class StreamingExecutor:
         idx = [int(len(all_keys) * (i + 1) / n_out)
                for i in range(n_out - 1)]
         return [all_keys[min(i, len(all_keys) - 1)] for i in idx]
+
+    # --------------------------------------------------------------- join
+    def _run_join(self, stage, refs: List[Any]) -> List[Any]:
+        """Shuffle hash join: both sides hash-partition on the keys, one
+        reducer per partition joins its pair. Neither side is ever
+        materialized whole in one worker — this is the big-big plan
+        (broadcast join stays the Dataset.join default for small right
+        sides)."""
+        import ray_tpu
+
+        right_refs = self.execute(_compile(stage.other))
+        n_out = (stage.num_blocks
+                 or max(len(refs), len(right_refs), 1))
+        args = {"keys": list(stage.keys), "how": stage.how,
+                "suffix": stage.suffix}
+        left_parts = self._partition_fanout(refs, n_out, "join_key", args)
+        right_parts = self._partition_fanout(right_refs, n_out,
+                                             "join_key", args)
+        join_ = ray_tpu.remote(_join_partition)
+        return self._bounded_submit(
+            [(join_, (args, len(left_parts))
+              + tuple(m[i] for m in left_parts)
+              + tuple(m[i] for m in right_parts))
+             for i in range(n_out)])
 
     # ---------------------------------------------------------------- zip
     def _run_zip(self, stage, refs: List[Any]) -> List[Any]:

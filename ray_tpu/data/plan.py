@@ -109,6 +109,23 @@ class Limit(LogicalOp):
         self.name = "Limit"
 
 
+@dataclass
+class Join(LogicalOp):
+    """Shuffle hash join: BOTH sides hash-partition on the key columns
+    and each reducer joins one partition pair (ref: python/ray/data/
+    _internal/logical/operators/join_operator.py + planner/
+    plan_join_op.py — big-big joins that neither side can broadcast)."""
+
+    other: "LogicalPlan" = None
+    keys: List[str] = field(default_factory=list)
+    how: str = "inner"          # inner | left | right | full
+    suffix: str = "_right"
+    num_blocks: Optional[int] = None
+
+    def __post_init__(self):
+        self.name = f"Join[{self.how}]"
+
+
 class LogicalPlan:
     def __init__(self, ops: List[LogicalOp]):
         self.ops = ops
@@ -197,6 +214,15 @@ class ZipStage:
 
 
 @dataclass
+class JoinStage:
+    other: "LogicalPlan"
+    keys: List[str]
+    how: str
+    suffix: str
+    num_blocks: Optional[int]
+
+
+@dataclass
 class LimitStage:
     n: int
 
@@ -240,6 +266,9 @@ def compile_plan(plan: LogicalPlan) -> List[Any]:
             stages.append(UnionStage(op.others))
         elif isinstance(op, Zip):
             stages.append(ZipStage(op.other))
+        elif isinstance(op, Join):
+            stages.append(JoinStage(op.other, op.keys, op.how, op.suffix,
+                                    op.num_blocks))
         elif isinstance(op, Limit):
             stages.append(LimitStage(op.n))
         else:
