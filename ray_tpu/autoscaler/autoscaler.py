@@ -47,6 +47,7 @@ class Autoscaler:
         self._counts: Dict[str, int] = {t: 0 for t in self.node_types}
         self._node_type: Dict[str, str] = {}  # node_id -> type
         self._idle_since: Dict[str, float] = {}
+        self._draining: set = set()  # instances we already terminated
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -142,6 +143,7 @@ class Autoscaler:
                 self._counts[type_name] = sum(
                     1 for t in live.values() if t == type_name)
             self._node_type = {iid: t for iid, t in live.items()}
+            self._draining &= set(live)  # terminated ones fell out
 
         # 4. idle autoscaled instances above min -> terminate after a
         # timeout. Cluster nodes group by owning provider instance (a
@@ -159,15 +161,23 @@ class Autoscaler:
             if iid in self._node_type:
                 by_instance.setdefault(iid, []).append(info)
         for iid, infos in by_instance.items():
+            if iid in self._draining:
+                continue  # already on its way out; not a candidate
             if all(self._is_idle(i) for i in infos):
                 self._idle_since.setdefault(iid, now)
                 if now - self._idle_since[iid] >= self.idle_timeout_s:
                     type_name = self._node_type[iid]
                     cfg = self.node_types[type_name]
-                    if self._counts[type_name] > cfg.min_workers:
+                    # the floor compares ACTIVE capacity: instances
+                    # already draining still appear in the provider's
+                    # live counts but are no longer capacity
+                    active = self._counts[type_name] - sum(
+                        1 for d in self._draining
+                        if self._node_type.get(d) == type_name)
+                    if active > cfg.min_workers:
                         if self.provider.terminate_node(iid):
+                            self._draining.add(iid)
                             self._counts[type_name] -= 1
-                            self._node_type.pop(iid, None)
                             self._idle_since.pop(iid, None)
                             actions["terminated"] += 1
             else:

@@ -101,6 +101,10 @@ class InstanceManager:
                 inst.cloud_id = cloud_id
             inst.error = error
             inst.history.append((new_status, time.monotonic()))
+            if len(inst.history) > 64:
+                # bound the audit trail (a long delete-retry loop would
+                # grow it forever) while keeping the creation record
+                inst.history = inst.history[:1] + inst.history[-63:]
         for fn in self._subscribers:
             try:
                 fn(inst, old)
@@ -256,12 +260,22 @@ class GCPTPUNodeProvider(NodeProvider):
         return [i.instance_id for i in self.instances.by_status(
             REQUESTED, LAUNCHING, RUNNING, DRAINING)]
 
+    def _will_retry(self, inst: Instance) -> bool:
+        if inst.cloud_id is not None:
+            return True  # the delete is always reissued (never leak)
+        return len(inst.history) < 8
+
     def instance_types(self) -> Dict[str, str]:
-        """Live (non-terminal) instances by node type — the autoscaler
-        reconciles its launch counts from this, so permanently-FAILED
-        creates stop consuming the max_workers budget."""
-        return {i.instance_id: i.node_type for i in self.instances.by_status(
+        """Live instances by node type — the autoscaler reconciles its
+        launch counts from this. FAILED instances that WILL retry (or
+        still hold a cloud resource) stay counted: releasing their
+        budget early would launch a replacement alongside the retry."""
+        out = {i.instance_id: i.node_type for i in self.instances.by_status(
             REQUESTED, LAUNCHING, RUNNING, DRAINING)}
+        for inst in self.instances.by_status(FAILED):
+            if self._will_retry(inst):
+                out[inst.instance_id] = inst.node_type
+        return out
 
     def instance_for(self, node_id: str,
                      labels: Dict[str, str]) -> Optional[str]:
@@ -341,13 +355,12 @@ class GCPTPUNodeProvider(NodeProvider):
             except Exception as e:  # noqa: BLE001
                 self.instances.transition(inst.instance_id, FAILED,
                                           error=repr(e))
-        # FAILED retries (bounded by the audit trail length); the last
-        # error stays on the record for the audit. With a cloud_id the
-        # resource may still exist (failed delete, PREEMPTED poll) — the
-        # delete is reissued via DRAINING so a slice never leaks.
+        # FAILED retries; the last error stays on the record for the
+        # audit. Creates retry a bounded number of times; with a
+        # cloud_id the resource may still exist (failed delete,
+        # PREEMPTED poll) and the delete is reissued UNBOUNDED — a
+        # transient API outage must never strand a billing slice.
         for inst in self.instances.by_status(FAILED):
-            if len(inst.history) >= 16:
-                continue
             if inst.cloud_id is None:
                 if len(inst.history) < 8:
                     self.instances.transition(inst.instance_id, REQUESTED,

@@ -151,12 +151,22 @@ def _hash_partition_rows(rows, keys, n: int):
             for r in rows]
 
 
+def _block_columns(block: Block) -> List[str]:
+    acc = BlockAccessor(block)
+    for row in acc.iter_rows():
+        return list(row.keys())
+    return []
+
+
 def _join_partition(args: Dict[str, Any], n_left: int, *parts: Block) -> Block:
     """Reduce phase of the shuffle join: the first n_left parts are the
     left side's i-th partitions, the rest the right side's. Hash
     partitioning guarantees every occurrence of a key lands in one
     reducer, so a local hash join per partition is exact for all four
-    join types (ref: _internal/planner/plan_join_op.py)."""
+    join types (ref: _internal/planner/plan_join_op.py). Column schemas
+    come in through args (computed once, globally): a partition holding
+    rows from only ONE side must still emit the full joined schema, or
+    blocks diverge and downstream row['col'] raises for some rows."""
     keys: List[str] = args["keys"]
     how: str = args["how"]
     suffix: str = args["suffix"]
@@ -170,9 +180,8 @@ def _join_partition(args: Dict[str, Any], n_left: int, *parts: Block) -> Block:
     lookup: Dict[tuple, List[dict]] = {}
     for row in right_rows:
         lookup.setdefault(tuple(row[k] for k in keys), []).append(row)
-    left_cols = list(left_rows[0].keys()) if left_rows else []
-    right_extra = [c for c in (right_rows[0].keys() if right_rows else [])
-                   if c not in keys]
+    left_cols = list(args["left_cols"])
+    right_extra = [c for c in args["right_cols"] if c not in keys]
     renamed = {}
     for c in right_extra:
         name = c + suffix if c in left_cols else c
@@ -333,19 +342,25 @@ class StreamingExecutor:
             return max(2, self.max_in_flight // 4)
         return self.max_in_flight
 
+    def _throttle(self, in_flight: List[Any]) -> List[Any]:
+        """Block while the in-flight set exceeds the store-pressure
+        admission limit; returns the updated in-flight list."""
+        import ray_tpu
+
+        while len(in_flight) >= self._admission_limit():
+            ready, in_flight = ray_tpu.wait(
+                in_flight, num_returns=1, timeout=300)
+            if not ready:
+                break  # timeout: avoid deadlock, let submit proceed
+        return in_flight
+
     def _bounded_submit(self, calls) -> List[Any]:
         """Submit keeping at most the (store-pressure-derived) admission
         limit outstanding."""
-        import ray_tpu
-
         out: List[Any] = []
         in_flight: List[Any] = []
         for fn, args in calls:
-            while len(in_flight) >= self._admission_limit():
-                ready, in_flight = ray_tpu.wait(
-                    in_flight, num_returns=1, timeout=300)
-                if not ready:
-                    break  # timeout: avoid deadlock, let submit proceed
+            in_flight = self._throttle(in_flight)
             ref = fn.remote(*args)
             out.append(ref)
             in_flight.append(ref)
@@ -364,11 +379,7 @@ class StreamingExecutor:
         outs: List[List[Any]] = []
         in_flight: List[Any] = []
         for r in refs:
-            while len(in_flight) >= self._admission_limit():
-                ready, in_flight = ray_tpu.wait(
-                    in_flight, num_returns=1, timeout=300)
-                if not ready:
-                    break
+            in_flight = self._throttle(in_flight)
             res = part.remote(r, n_out, kind, args)
             lst = res if isinstance(res, list) else [res]
             outs.append(lst)
@@ -420,8 +431,24 @@ class StreamingExecutor:
         right_refs = self.execute(_compile(stage.other))
         n_out = (stage.num_blocks
                  or max(len(refs), len(right_refs), 1))
+        # global column schemas (first non-empty block per side): every
+        # reducer emits the same joined schema even for one-sided
+        # partitions
+        cols = ray_tpu.remote(_block_columns)
+        left_cols: List[str] = []
+        for c in ray_tpu.get([cols.remote(r) for r in refs], timeout=600):
+            if c:
+                left_cols = c
+                break
+        right_cols: List[str] = []
+        for c in ray_tpu.get([cols.remote(r) for r in right_refs],
+                             timeout=600):
+            if c:
+                right_cols = c
+                break
         args = {"keys": list(stage.keys), "how": stage.how,
-                "suffix": stage.suffix}
+                "suffix": stage.suffix, "left_cols": left_cols,
+                "right_cols": right_cols}
         left_parts = self._partition_fanout(refs, n_out, "join_key", args)
         right_parts = self._partition_fanout(right_refs, n_out,
                                              "join_key", args)

@@ -218,6 +218,39 @@ class CheckpointManager:
                 return None
             return max(self._ckpts, key=lambda e: e[1])[2]
 
+    def restore_from_disk(self) -> int:
+        """Rebuild the retention table from storage_dir after a driver
+        restart (the in-memory table dies with the process; the
+        checkpoint directories persist). Returns the number found."""
+        import glob
+        import re
+
+        with self._lock:
+            self._ckpts = []
+            for path in sorted(glob.glob(
+                    os.path.join(self.storage_dir, "checkpoint_*"))):
+                m = re.match(r".*checkpoint_(\d+)$", path)
+                if not m or not os.path.isdir(path):
+                    continue
+                if not os.path.exists(os.path.join(path,
+                                                   "_metadata.json")):
+                    # register() writes metadata LAST: its absence marks
+                    # a torn copy from a killed driver — resuming from
+                    # it would crash the trial; the previous intact
+                    # checkpoint resumes fine
+                    continue
+                seq = int(m.group(1))
+                ckpt = Checkpoint(path)
+                score = None
+                if self.score_attribute:
+                    metrics = ckpt.get_metadata().get("metrics", {})
+                    if self.score_attribute in metrics:
+                        score = float(metrics[self.score_attribute])
+                self._ckpts.append((score, seq, ckpt))
+            self._seq = (max(e[1] for e in self._ckpts) + 1
+                         if self._ckpts else 0)
+            return len(self._ckpts)
+
     def list_checkpoints(self) -> List[Checkpoint]:
         with self._lock:
             return [c for _, _, c in sorted(self._ckpts, key=lambda e: e[1])]

@@ -179,6 +179,47 @@ class Tuner:
         trials = controller.run()
         return ResultGrid(trials, tc.metric, tc.mode, experiment_dir)
 
+    @classmethod
+    def restore(cls, path: str,
+                tune_config: Optional[TuneConfig] = None) -> "_RestoredTuner":
+        """Resume an interrupted experiment from its directory (ref:
+        tune/tuner.py:312 Tuner.restore). Trials that were PENDING or
+        RUNNING when the driver died resume from their latest
+        checkpoint; completed trials keep their recorded results.
+        `path` is the experiment directory (RunConfig storage_path/name).
+        """
+        return _RestoredTuner(path, tune_config)
+
+    @staticmethod
+    def can_restore(path: str) -> bool:
+        return os.path.exists(os.path.join(path,
+                                           TuneController.STATE_FILE))
+
+
+class _RestoredTuner:
+    """fit() continuation for Tuner.restore."""
+
+    def __init__(self, experiment_dir: str,
+                 tune_config: Optional[TuneConfig]):
+        self.experiment_dir = experiment_dir
+        self.tune_config = tune_config or TuneConfig()
+
+    def fit(self) -> ResultGrid:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        controller = TuneController.restore(self.experiment_dir)
+        tc = self.tune_config
+        metric = tc.metric
+        mode = tc.mode
+        sched = controller.scheduler
+        if metric is None and sched is not None:
+            metric = getattr(sched, "metric", None)
+            mode = getattr(sched, "mode", mode) or mode
+        trials = controller.run()
+        return ResultGrid(trials, metric, mode, self.experiment_dir)
+
 
 def with_parameters(fn: Callable, **kwargs) -> Callable:
     """ref: tune/trainable/util.py with_parameters — bind large objects

@@ -83,6 +83,95 @@ class TuneController:
         self.num_trials = num_trials if num_trials is not None else 10**9
         self.trials: List[Trial] = []
         self._created = 0
+        self._last_save = 0.0
+
+    # ------------------------------------------------------ persistence
+
+    STATE_FILE = "experiment_state.pkl"
+
+    def _save_state(self) -> None:
+        """Atomically persist the experiment: trial table + searcher +
+        scheduler + trainable (ref: tune/execution/tune_controller.py
+        experiment checkpointing feeding Tuner.restore, tuner.py:312).
+        Actors are process state and excluded; a restore resumes their
+        trials from each trial's latest checkpoint."""
+        import pickle
+
+        trial_rows = []
+        for t in self.trials:
+            trial_rows.append({
+                "trial_id": t.trial_id, "config": t.config,
+                "status": t.status,
+                "metrics_history": t.metrics_history,
+                "error": t.error, "num_failures": t.num_failures,
+                "stopped_by_scheduler": t.stopped_by_scheduler,
+                "stop_reason": t.stop_reason,
+            })
+        state = {
+            "trials": trial_rows, "created": self._created,
+            "num_trials": self.num_trials,
+            "stop_criteria": self.stop_criteria,
+            "resources": self.resources,
+            "max_failures": self.max_failures,
+            "trainable_blob": self.trainable_blob,
+            "searcher": self.searcher, "scheduler": self.scheduler,
+        }
+        path = os.path.join(self.experiment_dir, self.STATE_FILE)
+        tmp = path + f".tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(state, f)
+            os.replace(tmp, path)
+        except Exception:
+            logger.exception("experiment state save failed")
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        self._last_save = time.monotonic()
+
+    @classmethod
+    def restore(cls, experiment_dir: str,
+                poll_interval: float = 0.1) -> "TuneController":
+        """Rebuild a controller from a saved experiment. Trials that were
+        PENDING or RUNNING when the driver died become PENDING and resume
+        from their latest checkpoint; completed trials keep their results
+        (ref: tune/tuner.py:312 Tuner.restore)."""
+        import pickle
+
+        with open(os.path.join(experiment_dir, cls.STATE_FILE), "rb") as f:
+            state = pickle.load(f)
+        self = cls.__new__(cls)
+        self.trainable_blob = state["trainable_blob"]
+        self.stop_criteria = state["stop_criteria"]
+        self.scheduler = state["scheduler"]
+        self.searcher = state["searcher"]
+        self.experiment_dir = experiment_dir
+        self.max_concurrent = _default_concurrency()
+        self.max_failures = state["max_failures"]
+        self.resources = state["resources"]
+        self.poll_interval = poll_interval
+        self.num_trials = state["num_trials"]
+        self._created = state["created"]
+        self._last_save = 0.0
+        self.trials = []
+        for row in state["trials"]:
+            manager = CheckpointManager(os.path.join(
+                experiment_dir, row["trial_id"], "checkpoints"))
+            manager.restore_from_disk()
+            trial = Trial(
+                trial_id=row["trial_id"], config=row["config"],
+                status=row["status"],
+                metrics_history=row["metrics_history"],
+                error=row["error"], num_failures=row["num_failures"],
+                stopped_by_scheduler=row["stopped_by_scheduler"],
+                stop_reason=row["stop_reason"],
+                checkpoint_manager=manager)
+            if trial.status in (PENDING, RUNNING):
+                trial.status = PENDING
+                trial.resume_checkpoint = manager.latest_checkpoint
+            self.trials.append(trial)
+        return self
 
     # ------------------------------------------------------------------ run
     def _make_trial(self) -> Optional[Trial]:
@@ -102,9 +191,12 @@ class TuneController:
         return trial
 
     def run(self) -> List[Trial]:
-        pending: List[Trial] = []
+        # restored experiments re-queue their interrupted trials
+        pending: List[Trial] = [t for t in self.trials
+                                if t.status == PENDING]
         running: List[Trial] = []
         exhausted = False
+        self._save_state()
         while True:
             while pending and len(running) < self.max_concurrent:
                 trial = pending.pop(0)
@@ -126,9 +218,11 @@ class TuneController:
             if not pending and not running and exhausted:
                 break
             time.sleep(self.poll_interval)
+            changed = False
             for trial in list(running):
                 done = self._poll_trial(trial)
                 if done:
+                    changed = True
                     running.remove(trial)
                     if (trial.status == ERRORED
                             and trial.num_failures <= self.max_failures):
@@ -140,6 +234,11 @@ class TuneController:
                     else:
                         self.searcher.on_trial_complete(
                             trial.trial_id, trial.last_metrics)
+            # persist on every completion and at least every 5s while
+            # trials report (a killed driver resumes from here)
+            if changed or time.monotonic() - self._last_save > 5.0:
+                self._save_state()
+        self._save_state()
         return self.trials
 
     # ------------------------------------------------------------ internals

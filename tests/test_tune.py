@@ -242,3 +242,116 @@ def test_optuna_adapter_gated():
 
         with _pytest.raises(ImportError, match="TPESearcher"):
             tune.OptunaSearch({"x": tune.uniform(0, 1)}, metric="m")
+
+
+_RESTORE_DRIVER = """
+import sys
+import ray_tpu
+from ray_tpu import tune
+
+def trainable(config):
+    import json
+    import os
+    import tempfile
+    import time
+
+    from ray_tpu import tune
+    from ray_tpu.train.checkpoint import Checkpoint
+
+    start = 0
+    ckpt = tune.get_checkpoint()
+    if ckpt is not None:
+        with open(os.path.join(ckpt.path, "iter.json")) as f:
+            start = json.load(f)["iter"]
+    for i in range(start, 12):
+        time.sleep(0.25)
+        d = tempfile.mkdtemp()
+        with open(os.path.join(d, "iter.json"), "w") as f:
+            json.dump({"iter": i + 1}, f)
+        tune.report({"score": config["x"] * (i + 1),
+                     "training_iteration": i + 1},
+                    checkpoint=Checkpoint.from_directory(d))
+
+ray_tpu.init(num_cpus=2)
+scheduler = SCHEDULER
+tuner = tune.Tuner(
+    trainable,
+    param_space={"x": tune.grid_search([1, 2, 3])},
+    tune_config=tune.TuneConfig(metric="score", mode="max",
+                                scheduler=scheduler,
+                                max_concurrent_trials=2),
+    run_config=tune.RunConfig(name="restore_exp",
+                              storage_path=sys.argv[1]),
+)
+tuner.fit()
+print("SWEEP-DONE")
+"""
+
+
+def _run_restore_cycle(tmp_path, scheduler_src):
+    """Start the sweep in a driver subprocess, kill it mid-flight, then
+    restore in THIS process and finish (ref: tune/tuner.py:312
+    Tuner.restore; tests: python/ray/tune/tests/test_tuner_restore.py)."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time as time_mod
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    script = _RESTORE_DRIVER.replace("SCHEDULER", scheduler_src)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script, str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    exp_dir = os.path.join(str(tmp_path), "restore_exp")
+    state = os.path.join(exp_dir, "experiment_state.pkl")
+    deadline = time_mod.monotonic() + 120
+    # wait until the sweep is genuinely mid-flight (state saved + at
+    # least one checkpoint on disk), then kill the driver hard
+    while time_mod.monotonic() < deadline:
+        if os.path.exists(state) and any(
+                "checkpoint_" in str(p)
+                for p in __import__("glob").glob(
+                    os.path.join(exp_dir, "trial_*", "checkpoints", "*"))):
+            break
+        if proc.poll() is not None:
+            raise AssertionError(
+                "driver exited early:\n" +
+                proc.stdout.read().decode()[-2000:])
+        time_mod.sleep(0.25)
+    else:
+        raise AssertionError("sweep never reached mid-flight")
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=30)
+
+    from ray_tpu import tune
+
+    assert tune.Tuner.can_restore(exp_dir)
+    grid = tune.Tuner.restore(exp_dir).fit()
+    assert len(grid) == 3
+    by_id = {t.trial_id: t for t in grid._trials}
+    for t in grid._trials:
+        assert t.status in ("FINISHED", "TERMINATED"), (
+            t.trial_id, t.status, t.error)
+    return grid
+
+
+def test_tuner_restore_after_driver_kill_asha(shared_cluster, tmp_path):
+    grid = _run_restore_cycle(
+        tmp_path,
+        "tune.ASHAScheduler(metric='score', mode='max', max_t=12, "
+        "grace_period=3)")
+    # the best surviving trial ran to completion with resumed iterations
+    best = grid.get_best_result()
+    assert best.metrics["score"] == 36  # x=3 * 12 iterations
+
+
+def test_tuner_restore_after_driver_kill_pbt(shared_cluster, tmp_path):
+    grid = _run_restore_cycle(
+        tmp_path,
+        "tune.PopulationBasedTraining(metric='score', mode='max', "
+        "perturbation_interval=4, "
+        "hyperparam_mutations={'x': [1, 2, 3]})")
+    assert grid.num_terminated() == 3
