@@ -1,0 +1,20 @@
+import time
+import ray_tpu
+from ray_tpu.dashboard import start_dashboard
+
+ray_tpu.init(num_cpus=2)
+
+@ray_tpu.remote
+class Worker:
+    def ping(self): return 1
+
+actors = [Worker.options(name=f"w{i}").remote() for i in range(3)]
+ray_tpu.get([a.ping.remote() for a in actors])
+
+@ray_tpu.remote
+def tick(): return 1
+ray_tpu.get([tick.remote() for _ in range(5)])
+
+port, server = start_dashboard(port=8799)
+print("DASH READY", port, flush=True)
+time.sleep(600)

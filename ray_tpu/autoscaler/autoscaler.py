@@ -176,10 +176,16 @@ class Autoscaler:
                         if self._node_type.get(d) == type_name)
                     if active > cfg.min_workers:
                         if self.provider.terminate_node(iid):
-                            self._draining.add(iid)
                             self._counts[type_name] -= 1
                             self._idle_since.pop(iid, None)
                             actions["terminated"] += 1
+                            if hasattr(self.provider, "instance_types"):
+                                # pruned when it leaves the live set
+                                self._draining.add(iid)
+                            else:
+                                # synchronous providers terminate
+                                # immediately: keep no draining state
+                                self._node_type.pop(iid, None)
             else:
                 self._idle_since.pop(iid, None)
         return actions

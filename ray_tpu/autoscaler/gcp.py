@@ -193,8 +193,17 @@ class TPUVMClient:
             "GET", f"{self.API}/{self._parent}/nodes/{node_id}")
 
     def delete_node(self, node_id: str) -> dict:
-        return self._request(
-            "DELETE", f"{self.API}/{self._parent}/nodes/{node_id}")
+        import urllib.error
+
+        try:
+            return self._request(
+                "DELETE", f"{self.API}/{self._parent}/nodes/{node_id}")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return {}  # already gone: delete is idempotent — a
+                # PREEMPTED slice GC'd by the cloud must not loop
+                # DRAINING->404->FAILED forever
+            raise
 
     def list_nodes(self) -> List[dict]:
         return self._request(

@@ -14,18 +14,15 @@ import os
 
 from typing import Optional, Tuple
 
-_PAGE = """<!doctype html><html><head><title>ray_tpu dashboard</title>
-<style>body{font-family:monospace;margin:2em}pre{background:#f5f5f5;
-padding:1em;overflow:auto}</style></head><body>
-<h2>ray_tpu dashboard</h2>
-<p>endpoints: <a href="/api/cluster">/api/cluster</a> ·
-<a href="/api/nodes">/api/nodes</a> · <a href="/api/actors">/api/actors</a> ·
-<a href="/api/tasks">/api/tasks</a> · <a href="/api/jobs">/api/jobs</a> ·
-<a href="/metrics">/metrics</a></p>
-<pre id="out">loading…</pre>
-<script>fetch('/api/cluster').then(r=>r.json()).then(d=>{
-document.getElementById('out').textContent=JSON.stringify(d,null,2)})
-</script></body></html>"""
+def _ui_page() -> bytes:
+    """The single-file frontend (ref: the reference's React client,
+    python/ray/dashboard/client/src/App.tsx — here one static HTML file
+    over the same JSON endpoints, no build toolchain): cluster tiles,
+    nodes/actors/tasks/jobs/logs tables, 5s auto-refresh."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "dashboard_ui.html")
+    with open(path, "rb") as f:
+        return f.read()
 
 
 def start_dashboard(port: int = 8265,
@@ -41,8 +38,8 @@ def start_dashboard(port: int = 8265,
                         "application/json")
 
     routes = {
-        "/": lambda: (_PAGE.encode(), "text/html"),
-        "/index.html": lambda: (_PAGE.encode(), "text/html"),
+        "/": lambda: (_ui_page(), "text/html"),
+        "/index.html": lambda: (_ui_page(), "text/html"),
         "/metrics": lambda: (metrics_mod.prometheus_text().encode(),
                              "text/plain; version=0.0.4"),
         "/api/cluster": _json(state.cluster_status),

@@ -433,19 +433,19 @@ class StreamingExecutor:
                  or max(len(refs), len(right_refs), 1))
         # global column schemas (first non-empty block per side): every
         # reducer emits the same joined schema even for one-sided
-        # partitions
+        # partitions. Probed one block at a time — the first almost
+        # always answers, and a full fan-out would bypass admission
         cols = ray_tpu.remote(_block_columns)
-        left_cols: List[str] = []
-        for c in ray_tpu.get([cols.remote(r) for r in refs], timeout=600):
-            if c:
-                left_cols = c
-                break
-        right_cols: List[str] = []
-        for c in ray_tpu.get([cols.remote(r) for r in right_refs],
-                             timeout=600):
-            if c:
-                right_cols = c
-                break
+
+        def first_cols(side_refs):
+            for r in side_refs:
+                c = ray_tpu.get(cols.remote(r), timeout=600)
+                if c:
+                    return c
+            return []
+
+        left_cols: List[str] = first_cols(refs)
+        right_cols: List[str] = first_cols(right_refs)
         args = {"keys": list(stage.keys), "how": stage.how,
                 "suffix": stage.suffix, "left_cols": left_cols,
                 "right_cols": right_cols}

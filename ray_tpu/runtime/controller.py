@@ -105,6 +105,15 @@ class Controller:
         self.session_name = session_name
         self.address = address
         self.persist_dir = persist_dir
+        # pluggable journal target: a local directory, or "tcp:host:port"
+        # of a standalone store server (ray_tpu.runtime.storage) so a
+        # standby head machine can replay the same state (ref:
+        # redis_store_client.h:111 — external-store GCS FT)
+        self._store_backend = None
+        if persist_dir:
+            from .storage import backend_for
+
+            self._store_backend = backend_for(persist_dir)
         self.nodes: Dict[str, NodeInfo] = {}
         self.actors: Dict[str, ActorInfo] = {}
         self.named_actors: Dict[tuple, str] = {}  # (namespace, name) -> actor_id
@@ -119,8 +128,7 @@ class Controller:
         self._server = RpcServer(address, self._handlers(), on_disconnect=self._on_disconnect)
         self._health_task: Optional[asyncio.Task] = None
         self.start_time = time.time()
-        if persist_dir:
-            os.makedirs(persist_dir, exist_ok=True)
+        if self._store_backend is not None:
             self._replay_persisted()
 
     # ------------------------------------------------------- persistence
@@ -133,17 +141,10 @@ class Controller:
     #   every control RPC O(total state)); compacted into kv.pkl on
     #   restart replay
 
-    def _meta_path(self) -> str:
-        return os.path.join(self.persist_dir, "meta.pkl")
-
-    def _kv_paths(self):
-        return (os.path.join(self.persist_dir, "kv.pkl"),
-                os.path.join(self.persist_dir, "kv.journal"))
-
     def _persist(self) -> None:
         """Atomic snapshot of the small metadata tables (jobs, PG specs,
         named actors). KV mutations go through _journal_kv instead."""
-        if not self.persist_dir:
+        if self._store_backend is None:
             return
         state = {
             "jobs": dict(self.jobs),
@@ -158,29 +159,22 @@ class Controller:
                 for info in self.actors.values()
                 if info.spec.get("name") and info.state != ACTOR_DEAD},
         }
-        path = self._meta_path()
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(state, f)
-        os.replace(tmp, path)
+        self._store_backend.save_meta(pickle.dumps(state))
 
     def _journal_kv(self, op: str, ns: str, key: str,
                     value: Optional[bytes] = None) -> None:
         """Append one KV mutation record — O(record), not O(store)."""
-        if not self.persist_dir:
+        if self._store_backend is None:
             return
-        _, journal = self._kv_paths()
-        with open(journal, "ab") as f:
-            pickle.dump((op, ns, key, value), f)
+        self._store_backend.append_kv((op, ns, key, value))
 
     def _replay_persisted(self) -> None:
         """Replay snapshot + journal into fresh tables (ref:
         gcs_init_data.cc — the restarted GCS reloads its tables before
         serving), then compact the journal."""
-        meta_path = self._meta_path()
-        if os.path.exists(meta_path):
-            with open(meta_path, "rb") as f:
-                state = pickle.load(f)
+        meta_blob = self._store_backend.load_meta()
+        if meta_blob:
+            state = pickle.loads(meta_blob)
             self.jobs.update(state.get("jobs", {}))
             for pg_id, pg in state.get("placement_groups", {}).items():
                 # bundles must be re-reserved on live nodes; mark pending
@@ -193,33 +187,24 @@ class Controller:
                 info = ActorInfo(actor_id, spec)
                 info.state = ACTOR_RESTARTING
                 self.actors[actor_id] = info
-        snap, journal = self._kv_paths()
-        if os.path.exists(snap):
-            with open(snap, "rb") as f:
-                for ns, kvs in pickle.load(f).items():
-                    self.kv[ns].update(kvs)
-        if os.path.exists(journal):
-            with open(journal, "rb") as f:
-                while True:
-                    try:
-                        op, ns, key, value = pickle.load(f)
-                    except EOFError:
-                        break
-                    except pickle.UnpicklingError:
-                        # torn tail: the previous controller died
-                        # mid-append; everything before it is intact
-                        break
-                    if op == "put":
-                        self.kv[ns][key] = value
-                    else:
-                        self.kv[ns].pop(key, None)
-            # compact: fold the journal into the snapshot
-            tmp = snap + ".tmp"
-            with open(tmp, "wb") as f:
-                pickle.dump({ns: dict(kvs)
-                             for ns, kvs in self.kv.items()}, f)
-            os.replace(tmp, snap)
-            os.unlink(journal)
+        snap_blob, records, had_journal = self._store_backend.load_kv()
+        if snap_blob:
+            for ns, kvs in pickle.loads(snap_blob).items():
+                self.kv[ns].update(kvs)
+        for record in records:
+            try:
+                op, ns, key, value = record
+            except Exception:
+                break  # malformed record; prefix is intact
+            if op == "put":
+                self.kv[ns][key] = value
+            else:
+                self.kv[ns].pop(key, None)
+        if had_journal:
+            # compact even when only a torn tail was found: appends
+            # after uncleared garbage would be unreadable next replay
+            self._store_backend.compact_kv(pickle.dumps(
+                {ns: dict(kvs) for ns, kvs in self.kv.items()}))
         # actor/PG rescheduling kicks off in start() (needs the loop)
 
     def _handlers(self):
@@ -281,6 +266,11 @@ class Controller:
                 asyncio.ensure_future(self._retry_pg(pg))
 
     async def stop(self):
+        if self._store_backend is not None:
+            try:
+                self._store_backend.close()
+            except Exception:
+                pass
         if self._health_task:
             self._health_task.cancel()
         for node in self.nodes.values():
@@ -727,8 +717,10 @@ def main():
     parser.add_argument("--session-name", required=True)
     parser.add_argument("--address", required=True)
     parser.add_argument("--persist-dir", default=None,
-                        help="journal durable tables here; restarting "
-                             "over the same dir replays them (GCS FT)")
+                        help="journal durable tables here: a local dir, "
+                             "or tcp:HOST:PORT of a store server "
+                             "(python -m ray_tpu.runtime.storage) for "
+                             "head failover to another machine")
     args = parser.parse_args()
 
     async def run():

@@ -1,0 +1,228 @@
+"""Pluggable controller storage backends (GCS store clients).
+
+The controller journals its durable tables through a StoreBackend (ref:
+src/ray/gcs/store_client/ — InMemoryStoreClient vs RedisStoreClient
+redis_store_client.h:111, which decouples GCS fault tolerance from the
+head machine's disk). Two backends:
+
+- FileBackend: snapshot + append-journal on a local directory (the
+  round-2 behavior; head FT tied to that disk).
+- TCPBackend: the same verbs against a standalone store server
+  (``python -m ray_tpu.runtime.storage --port 6399 --dir /data``) over
+  the framework's RPC layer — a controller restarted on a DIFFERENT
+  machine replays from the store server, the Redis-class failover the
+  reference gets from external Redis.
+
+Select by address: ``persist_dir="/path"`` -> FileBackend;
+``persist_dir="tcp:host:port"`` -> TCPBackend.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Iterator, List, Optional, Tuple
+
+
+class StoreBackend:
+    """Verbs the controller's persistence tiers need: an atomic META
+    snapshot (small tables, rewritten per mutation), an append-only KV
+    journal (function blobs; O(record) per put), and a KV snapshot the
+    journal compacts into on replay."""
+
+    def save_meta(self, blob: bytes) -> None:
+        raise NotImplementedError
+
+    def load_meta(self) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def append_kv(self, record) -> None:
+        """Append one journal record (any picklable object)."""
+        raise NotImplementedError
+
+    def load_kv(self) -> Tuple[Optional[bytes], List, bool]:
+        """(snapshot blob or None, journal records in append order,
+        journal-file-existed). The flag drives compaction even when the
+        journal held only a torn tail — leaving the garbage in place
+        would make every LATER append unreadable on the next replay."""
+        raise NotImplementedError
+
+    def compact_kv(self, snapshot: bytes) -> None:
+        """Replace the snapshot with `snapshot` and clear the journal."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class FileBackend(StoreBackend):
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _p(self, name: str) -> str:
+        return os.path.join(self.dir, name)
+
+    def save_meta(self, blob: bytes) -> None:
+        tmp = self._p("meta.pkl.tmp")
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, self._p("meta.pkl"))
+
+    def load_meta(self) -> Optional[bytes]:
+        try:
+            with open(self._p("meta.pkl"), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def append_kv(self, record) -> None:
+        # consecutive pickle.dump records: byte-compatible with the
+        # journals round-2 controllers wrote
+        with open(self._p("kv.journal"), "ab") as f:
+            pickle.dump(record, f)
+
+    def load_kv(self) -> Tuple[Optional[bytes], List, bool]:
+        snap = None
+        try:
+            with open(self._p("kv.pkl"), "rb") as f:
+                snap = f.read()
+        except FileNotFoundError:
+            pass
+        records: List = []
+        had_journal = os.path.exists(self._p("kv.journal"))
+        if had_journal:
+            with open(self._p("kv.journal"), "rb") as f:
+                while True:
+                    try:
+                        records.append(pickle.load(f))
+                    except EOFError:
+                        break
+                    except Exception:
+                        # torn tail: the writer died mid-append;
+                        # everything before it is intact
+                        break
+        return snap, records, had_journal
+
+    def compact_kv(self, snapshot: bytes) -> None:
+        tmp = self._p("kv.pkl.tmp")
+        with open(tmp, "wb") as f:
+            f.write(snapshot)
+        os.replace(tmp, self._p("kv.pkl"))
+        try:
+            os.unlink(self._p("kv.journal"))
+        except FileNotFoundError:
+            pass
+
+
+class TCPBackend(StoreBackend):
+    """The FileBackend verbs forwarded to a store server over RPC. Meta
+    saves and journal appends are one-way sends (coalesced per loop
+    pass); replay reads are synchronous calls."""
+
+    def __init__(self, address: str):
+        from .rpc import RpcClient
+
+        if not address.startswith(("tcp:", "unix:")):
+            address = f"tcp:{address}"
+        self.client = RpcClient(address)
+        self.client.call("ping", _timeout=15)
+
+    def save_meta(self, blob: bytes) -> None:
+        self.client.notify_nowait("st_save_meta", blob=blob)
+
+    def load_meta(self) -> Optional[bytes]:
+        return self.client.call("st_load_meta", _timeout=60)
+
+    def append_kv(self, record) -> None:
+        self.client.notify_nowait("st_append_kv", record=record)
+
+    def load_kv(self) -> Tuple[Optional[bytes], List, bool]:
+        snap, records, had = self.client.call("st_load_kv", _timeout=120)
+        return snap, records, had
+
+    def compact_kv(self, snapshot: bytes) -> None:
+        self.client.call("st_compact_kv", snapshot=snapshot, _timeout=120)
+
+    def close(self) -> None:
+        # BLOCKING drain: queued one-way appends must reach the store
+        # before the connection dies (a clean controller shutdown must
+        # not lose journal records)
+        import time
+
+        deadline = time.time() + 5.0
+        while (getattr(self.client, "_inflight_notifies", 0) > 0
+               and time.time() < deadline):
+            time.sleep(0.01)
+        self.client.close()
+
+
+def backend_for(persist_dir: str) -> StoreBackend:
+    if persist_dir.startswith(("tcp:", "unix:")) or (
+            ":" in persist_dir and not os.path.isabs(persist_dir)
+            and not persist_dir.startswith(".")):
+        return TCPBackend(persist_dir)
+    return FileBackend(persist_dir)
+
+
+# ------------------------------------------------------- the store server
+
+
+def serve_store(directory: str, address: str):
+    """Store server: FileBackend fronted by RPC handlers. Returns the
+    RpcServer (already started on the shared loop thread)."""
+    from .rpc import EventLoopThread, RpcServer
+
+    backend = FileBackend(directory)
+
+    async def st_save_meta(blob: bytes):
+        backend.save_meta(blob)
+        return True
+
+    async def st_load_meta():
+        return backend.load_meta()
+
+    async def st_append_kv(record):
+        backend.append_kv(record)
+        return True
+
+    async def st_load_kv():
+        return backend.load_kv()
+
+    async def st_compact_kv(snapshot: bytes):
+        backend.compact_kv(snapshot)
+        return True
+
+    async def ping():
+        return "pong"
+
+    server = RpcServer(address, {
+        "st_save_meta": st_save_meta, "st_load_meta": st_load_meta,
+        "st_append_kv": st_append_kv, "st_load_kv": st_load_kv,
+        "st_compact_kv": st_compact_kv, "ping": ping,
+    })
+    EventLoopThread.get().run(server.start())
+    return server
+
+
+def main():
+    import argparse
+    import signal
+    import threading
+
+    parser = argparse.ArgumentParser(
+        description="standalone controller store server")
+    parser.add_argument("--dir", required=True)
+    parser.add_argument("--port", type=int, default=6399)
+    parser.add_argument("--host", default="0.0.0.0")
+    args = parser.parse_args()
+    server = serve_store(args.dir, f"tcp:{args.host}:{args.port}")
+    print(f"store server on {server.address} -> {args.dir}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -206,10 +206,11 @@ class Executor:
             try:
                 # tight bound: this runs BEFORE result delivery on every
                 # traced task, so a slow/dead controller must cost the
-                # caller at most ~2s, not 10 (spans are droppable;
-                # results are not)
+                # caller at most ~3s, not 10 (spans are droppable;
+                # results are not — and a fully-loaded 1-core box can
+                # push an honest flush past 2s)
                 self.core.controller.call("add_trace_spans", spans=spans,
-                                          _timeout=2)
+                                          _timeout=3)
             except Exception:
                 pass
 

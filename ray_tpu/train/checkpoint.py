@@ -236,8 +236,12 @@ class CheckpointManager:
                                                    "_metadata.json")):
                     # register() writes metadata LAST: its absence marks
                     # a torn copy from a killed driver — resuming from
-                    # it would crash the trial; the previous intact
-                    # checkpoint resumes fine
+                    # it would crash the trial, and leaving the dir
+                    # would collide with the next register() reusing
+                    # its sequence number
+                    import shutil as _shutil
+
+                    _shutil.rmtree(path, ignore_errors=True)
                     continue
                 seq = int(m.group(1))
                 ckpt = Checkpoint(path)

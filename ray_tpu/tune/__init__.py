@@ -211,6 +211,8 @@ class _RestoredTuner:
             ray_tpu.init()
         controller = TuneController.restore(self.experiment_dir)
         tc = self.tune_config
+        if tc.max_concurrent_trials:
+            controller.max_concurrent = tc.max_concurrent_trials
         metric = tc.metric
         mode = tc.mode
         sched = controller.scheduler

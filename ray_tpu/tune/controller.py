@@ -110,6 +110,7 @@ class TuneController:
         state = {
             "trials": trial_rows, "created": self._created,
             "num_trials": self.num_trials,
+            "max_concurrent": self.max_concurrent,
             "stop_criteria": self.stop_criteria,
             "resources": self.resources,
             "max_failures": self.max_failures,
@@ -147,7 +148,8 @@ class TuneController:
         self.scheduler = state["scheduler"]
         self.searcher = state["searcher"]
         self.experiment_dir = experiment_dir
-        self.max_concurrent = _default_concurrency()
+        self.max_concurrent = state.get("max_concurrent",
+                                        _default_concurrency())
         self.max_failures = state["max_failures"]
         self.resources = state["resources"]
         self.poll_interval = poll_interval

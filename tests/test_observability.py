@@ -128,7 +128,13 @@ def test_dashboard_endpoints(shared_cluster):
         with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
             assert b"dash_hits" in r.read()
         with urllib.request.urlopen(base, timeout=10) as r:
-            assert b"dashboard" in r.read()
+            page = r.read()
+        # the static frontend (tables + tabs over the JSON endpoints),
+        # not just an endpoint index
+        assert b"ray_tpu dashboard" in page
+        for tab in (b"nodes", b"actors", b"jobs", b"logs"):
+            assert tab in page
+        assert b"/api/cluster" in page  # fetches the state API
     finally:
         server.shutdown()
 
