@@ -587,12 +587,15 @@ class Nodelet:
                            runtime_env: dict = None):
         try:
             try:
-                if runtime_env and runtime_env.get("pip"):
-                    # pip envs must COLD-start: a fork inherits the
+                from .runtime_env import needs_cold_start
+
+                if needs_cold_start(runtime_env):
+                    # pip/uv envs must COLD-start: a fork inherits the
                     # factory's warm imports, and sys.path prepends
                     # cannot evict already-imported base packages — a
-                    # pinned version would be silently ignored
-                    raise OSError("pip env requires cold start")
+                    # pinned version would be silently ignored. conda
+                    # envs bring their OWN interpreter.
+                    raise OSError("isolated env requires cold start")
                 pid, start = self._fork_from_factory(worker_id,
                                                      runtime_env)
                 ws.set_pid(pid, start)
@@ -616,8 +619,24 @@ class Nodelet:
                 import json as json_mod
 
                 env["RTPU_RUNTIME_ENV_JSON"] = json_mod.dumps(runtime_env)
+            from .runtime_env import ensure_env, env_python
+
+            python = sys.executable
+            if runtime_env and runtime_env.get("conda"):
+                # the conda env's own interpreter runs the worker; build
+                # the env here (worker startup would be too late to pick
+                # the executable). A build failure still starts a BASE
+                # worker carrying the error, so the requesting task gets
+                # RuntimeEnvSetupError instead of hanging while the
+                # stall-check rebuilds forever.
+                try:
+                    env_dir = ensure_env(runtime_env, self.session_dir)
+                    python = env_python(runtime_env, env_dir)
+                except Exception as e:  # noqa: BLE001
+                    env["RTPU_RUNTIME_ENV_ERROR"] = (
+                        f"conda env setup failed: {e!r}")
             proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu.runtime.worker",
+                [python, "-m", "ray_tpu.runtime.worker",
                  "--session-name", self.session_name,
                  "--session-dir", self.session_dir,
                  "--node-id", self.node_id,

@@ -25,6 +25,17 @@ Supported keys:
 - py_modules: [path, ...] — local modules/packages staged into the env
   dir (the reference uploads to GCS; here hosts share a filesystem or
   ship code through the function store instead)
+- uv: [requirement, ...] or {"packages": [...], "uv_args": [...]} —
+  like pip but installed with the (much faster) `uv pip install`
+  resolver (ref: runtime_env/uv.py). Requires a `uv` binary on PATH.
+- conda: {"dependencies": [...]} env spec or a prebuilt env path/name —
+  builds a FULL conda env (own interpreter) under the cache dir and
+  cold-starts workers on ITS python (ref: runtime_env/conda.py; like
+  the reference, the env must provide the framework's own
+  dependencies). Requires a `conda` binary on PATH.
+- image_uri/container: NOT supported (documented wontfix: this runtime
+  does not manage container images; use the cluster launcher's VM image
+  instead).
 """
 
 from __future__ import annotations
@@ -47,6 +58,10 @@ def env_key(runtime_env: Optional[Dict[str, Any]]) -> str:
     iso = {}
     if runtime_env.get("pip"):
         iso["pip"] = runtime_env["pip"]
+    if runtime_env.get("uv"):
+        iso["uv"] = runtime_env["uv"]
+    if runtime_env.get("conda"):
+        iso["conda"] = runtime_env["conda"]
     if runtime_env.get("py_modules"):
         # hash module paths + mtimes so edits invalidate the cache
         mods = []
@@ -94,7 +109,15 @@ def ensure_env(runtime_env: Dict[str, Any], session_dir: str) -> Optional[str]:
                 if name == ".lock":
                     continue
                 path = os.path.join(env_dir, name)
-                if os.path.isdir(path):
+                if os.path.islink(path):
+                    # rmtree refuses symlinks (prebuilt conda envs are
+                    # linked in); a leftover link must not wedge every
+                    # future build of this env key
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                elif os.path.isdir(path):
                     shutil.rmtree(path, ignore_errors=True)
                 else:
                     try:
@@ -109,7 +132,94 @@ def ensure_env(runtime_env: Dict[str, Any], session_dir: str) -> Optional[str]:
     return env_dir
 
 
+def needs_cold_start(runtime_env: Optional[Dict[str, Any]]) -> bool:
+    """Envs whose packages must not be shadowed by the factory's warm
+    imports (pip/uv), or that bring their own interpreter (conda),
+    cannot be forked from the prefork factory."""
+    if not runtime_env:
+        return False
+    return bool(runtime_env.get("pip") or runtime_env.get("uv")
+                or runtime_env.get("conda"))
+
+
+def env_python(runtime_env: Optional[Dict[str, Any]],
+               env_dir: Optional[str]) -> str:
+    """The interpreter workers of this env run on: conda envs carry
+    their own python; everything else uses this one. A conda env with
+    no interpreter is an ERROR — silently falling back to the base
+    python would run the task without the env it asked for."""
+    if runtime_env and runtime_env.get("conda") and env_dir:
+        for name in ("python", "python3"):
+            candidate = os.path.join(env_dir, "conda", "bin", name)
+            if os.path.exists(candidate):
+                return candidate
+        raise RuntimeError(
+            f"conda env at {env_dir}/conda has no bin/python — the "
+            "build produced no interpreter (or the prebuilt path is "
+            "not a conda env)")
+    return sys.executable
+
+
+def _binary_or_raise(name: str, feature: str) -> str:
+    path = shutil.which(name)
+    if not path:
+        raise RuntimeError(
+            f"runtime_env {feature!r} requires a `{name}` binary on "
+            f"PATH (not found); install it on every node or use the "
+            f"pip/py_modules plugins")
+    return path
+
+
 def _build_env(runtime_env: Dict[str, Any], env_dir: str) -> None:
+    conda_spec = runtime_env.get("conda")
+    if conda_spec and (runtime_env.get("pip") or runtime_env.get("uv")):
+        # the reference rejects this combination too: pip/uv would
+        # install wheels resolved for the BASE interpreter into an env
+        # whose conda python may be a different version
+        raise ValueError(
+            "runtime_env cannot combine 'conda' with 'pip'/'uv'; put "
+            "pip dependencies inside the conda spec instead")
+    if conda_spec:
+        conda = _binary_or_raise("conda", "conda")
+        target = os.path.join(env_dir, "conda")
+        if isinstance(conda_spec, str) and os.path.isdir(conda_spec):
+            # prebuilt env path: link it into the cache (ref: conda.py
+            # accepts an existing env name/path)
+            os.symlink(os.path.abspath(conda_spec), target)
+        else:
+            if isinstance(conda_spec, dict):
+                spec_file = os.path.join(env_dir, "environment.yaml")
+                with open(spec_file, "w") as f:
+                    json.dump(conda_spec, f)  # YAML accepts JSON
+                cmd = [conda, "env", "create", "-p", target,
+                       "-f", spec_file]
+            else:  # named env: clone it so mutations stay isolated
+                cmd = [conda, "create", "-y", "-p", target,
+                       "--clone", str(conda_spec)]
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=1800)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"runtime_env conda build failed: "
+                    f"{proc.stderr[-2000:]}")
+    uv_spec = runtime_env.get("uv")
+    if uv_spec:
+        uv = _binary_or_raise("uv", "uv")
+        if isinstance(uv_spec, dict):
+            packages = list(uv_spec.get("packages", []))
+            uv_args = list(uv_spec.get("uv_args", []))
+        else:
+            packages, uv_args = list(uv_spec), []
+        # pin the resolver to THIS interpreter: without --python, uv
+        # resolves against whatever environment it discovers (or errors
+        # with no venv active)
+        cmd = [uv, "pip", "install", "--python", sys.executable,
+               "--target", env_dir, *uv_args, *packages]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"runtime_env uv install failed: {proc.stderr[-2000:]}")
     pip_spec = runtime_env.get("pip")
     if pip_spec:
         if isinstance(pip_spec, dict):

@@ -555,8 +555,10 @@ def run_worker(*, session_name: str, session_dir: str, node_id: str,
     from .runtime_env import apply_to_process, ensure_env, env_key
 
     key = env_key(runtime_env)
-    env_error = None
-    if key:
+    # a spawn-time env failure (conda build in the nodelet) rides in by
+    # env var so it surfaces per-task like worker-side build failures
+    env_error = os.environ.get("RTPU_RUNTIME_ENV_ERROR") or None
+    if key and not env_error:
         # build/reuse the cached env BEFORE loading any user code so env
         # packages shadow base site-packages (ref: runtime_env_agent
         # builds envs before handing the worker to the lease). Only the

@@ -147,3 +147,77 @@ def test_actor_runtime_env_pip_modules(session, tmp_path):
 
     r = Reader.remote()
     assert ray_tpu.get(r.read.remote(), timeout=120) == 11
+
+
+def _fake_binary(tmp_path, name, script_body):
+    """Drop an executable fake on PATH (zero-egress image: the plugins'
+    subprocess contracts are what's under test, not pypi/anaconda)."""
+    bindir = tmp_path / "bin"
+    os.makedirs(bindir, exist_ok=True)
+    path = bindir / name
+    with open(path, "w") as f:
+        f.write("#!/bin/bash\n" + script_body)
+    os.chmod(path, 0o755)
+    return str(bindir)
+
+
+def test_uv_env_installs_via_uv_binary(session, tmp_path, monkeypatch):
+    """uv plugin (ref: _private/runtime_env/uv.py): packages install
+    through `uv pip install --target` and the task imports them."""
+    # fake uv: parse --target and drop a module there
+    bindir = _fake_binary(tmp_path, "uv", """
+args=("$@")
+target=""
+for ((i=0;i<${#args[@]};i++)); do
+  if [ "${args[$i]}" == "--target" ]; then target="${args[$((i+1))]}"; fi
+done
+echo "VALUE = 'uv-installed'" > "$target/rtpu_uvmod.py"
+""")
+    monkeypatch.setenv("PATH", bindir + os.pathsep + os.environ["PATH"])
+
+    @ray_tpu.remote(runtime_env={"uv": ["rtpu-uvmod==1.0"]})
+    def use():
+        import rtpu_uvmod
+
+        return rtpu_uvmod.VALUE
+
+    assert ray_tpu.get(use.remote(), timeout=120) == "uv-installed"
+
+
+def test_uv_env_missing_binary_errors(session, tmp_path, monkeypatch):
+    from ray_tpu.runtime.runtime_env import ensure_env
+
+    monkeypatch.setenv("PATH", str(tmp_path / "empty"))
+    with pytest.raises(RuntimeError, match="requires a `uv` binary"):
+        ensure_env({"uv": ["anything"]}, str(tmp_path / "sess"))
+
+
+def test_conda_env_builds_and_uses_env_python(session, tmp_path,
+                                              monkeypatch):
+    """conda plugin (ref: _private/runtime_env/conda.py): the env is
+    created with its own interpreter and workers run on it. The fake
+    conda 'creates' an env whose python is a wrapper around ours with a
+    marker env var, so the task can prove which interpreter ran it."""
+    bindir = _fake_binary(tmp_path, "conda", f"""
+# conda env create -p <target> -f <spec>
+target=""
+args=("$@")
+for ((i=0;i<${{#args[@]}};i++)); do
+  if [ "${{args[$i]}}" == "-p" ]; then target="${{args[$((i+1))]}}"; fi
+done
+mkdir -p "$target/bin"
+cat > "$target/bin/python" <<PYEOF
+#!/bin/bash
+export RTPU_CONDA_MARKER=conda-python
+exec {sys.executable} "\\$@"
+PYEOF
+chmod +x "$target/bin/python"
+""")
+    monkeypatch.setenv("PATH", bindir + os.pathsep + os.environ["PATH"])
+
+    @ray_tpu.remote(runtime_env={"conda": {"dependencies": ["python"]}})
+    def which_python():
+        return os.environ.get("RTPU_CONDA_MARKER", "base")
+
+    assert ray_tpu.get(which_python.remote(),
+                       timeout=120) == "conda-python"
