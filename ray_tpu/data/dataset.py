@@ -278,6 +278,30 @@ class Dataset:
             else:
                 yield {k: jax.numpy.asarray(v) for k, v in batch.items()}
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False,
+                           device: Optional[str] = None,
+                           dtypes=None) -> Iterator[Dict[str, Any]]:
+        """Torch-tensor batches (ref: data/iterator.py
+        iter_torch_batches) — interop for torch-side consumers; TPU
+        training uses iter_jax_batches."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            out = {}
+            for k, v in batch.items():
+                t = torch.as_tensor(np.ascontiguousarray(v))
+                if dtypes is not None:
+                    want = dtypes.get(k) if isinstance(dtypes, dict)                         else dtypes
+                    if want is not None:
+                        t = t.to(want)
+                if device:
+                    t = t.to(device)
+                out[k] = t
+            yield out
+
     def take(self, n: int = 20) -> List[Any]:
         out = []
         for row in self.iter_rows():
@@ -517,6 +541,9 @@ class DataIterator:
 
     def iter_jax_batches(self, **kwargs) -> Iterator[Any]:
         return self._ds.iter_jax_batches(**kwargs)
+
+    def iter_torch_batches(self, **kwargs) -> Iterator[Any]:
+        return self._ds.iter_torch_batches(**kwargs)
 
     def materialize(self) -> Dataset:
         return self._ds.materialize()

@@ -1,0 +1,505 @@
+"""DreamerV3: model-based RL with an RSSM world model, re-derived in JAX.
+
+Parity target: the reference's DreamerV3 family (ref: rllib/algorithms/
+dreamerv3/dreamerv3.py; world model rllib/algorithms/dreamerv3/tf/models/
+world_model.py, actor-critic in imagination dreamer_model.py) — the one
+reference algorithm family round 2 lacked. This is a re-derivation, not a
+port: the whole update (world-model learning + imagination + actor +
+critic) compiles to ONE jitted program built from two `lax.scan`s
+(observation scan over real sequences, imagination scan over latent
+rollouts), with the SAC-style stop-gradient discipline separating the
+three optimization problems inside a single value_and_grad.
+
+The DreamerV3 signatures are kept: symlog/symexp targets, twohot
+distributional reward/value heads, KL balancing with free bits,
+straight-through discrete latents, lambda-returns over predicted
+continues, EMA-regularized critic, and percentile return normalization
+for the actor.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.learner import Learner
+from ..core.rl_module import RLModuleSpec, RLModule
+from .algorithm import Algorithm, AlgorithmConfig
+
+# ------------------------------------------------------------ primitives
+
+
+def symlog(x):
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x):
+    return jnp.sign(x) * (jnp.expm1(jnp.abs(x)))
+
+
+def twohot(x, bins):
+    """Two-hot encoding of scalar targets over `bins` [K] (ref:
+    dreamerv3 utils — distributional regression robust to scale)."""
+    x = jnp.clip(x, bins[0], bins[-1])
+    idx_hi = jnp.clip(jnp.searchsorted(bins, x), 1, len(bins) - 1)
+    idx_lo = idx_hi - 1
+    lo, hi = bins[idx_lo], bins[idx_hi]
+    w_hi = jnp.where(hi > lo, (x - lo) / jnp.maximum(hi - lo, 1e-8), 1.0)
+    onehot_lo = jax.nn.one_hot(idx_lo, len(bins))
+    onehot_hi = jax.nn.one_hot(idx_hi, len(bins))
+    return onehot_lo * (1 - w_hi)[..., None] + onehot_hi * w_hi[..., None]
+
+
+def twohot_mean(logits, bins):
+    return (jax.nn.softmax(logits, axis=-1) * bins).sum(-1)
+
+
+def _st_sample(rng, logits):
+    """Straight-through sample of discrete latents: one-hot forward,
+    softmax gradients (ref: dreamerv3 categorical latents)."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    idx = jax.random.categorical(rng, logits, axis=-1)
+    hot = jax.nn.one_hot(idx, logits.shape[-1])
+    return hot + probs - jax.lax.stop_gradient(probs)
+
+
+def _kl_categorical(p_logits, q_logits):
+    """KL(p || q) for [.., stoch, classes] categorical stacks, summed
+    over latent dims."""
+    p = jax.nn.log_softmax(p_logits, axis=-1)
+    q = jax.nn.log_softmax(q_logits, axis=-1)
+    return (jnp.exp(p) * (p - q)).sum(-1).sum(-1)
+
+
+# ----------------------------------------------------------------- nets
+
+
+class _Nets:
+    """Flax module bundle built lazily (import-light like the rest of
+    rllib)."""
+
+    def __init__(self, obs_dim: int, act_dim: int, cfg: Dict[str, Any]):
+        import flax.linen as nn
+
+        hidden = cfg.get("hidden", 128)
+        deter = cfg.get("deter", 128)
+        stoch = cfg.get("stoch", 8)
+        classes = cfg.get("classes", 8)
+        bins = cfg.get("bins", 41)
+        self.deter, self.stoch, self.classes = deter, stoch, classes
+        self.act_dim = act_dim
+        self.bins = jnp.linspace(-10.0, 10.0, bins)  # symlog space
+
+        def mlp(out, name):
+            return nn.Sequential([nn.Dense(hidden), nn.silu,
+                                  nn.Dense(out)], name=name)
+
+        class Bundle(nn.Module):
+            def setup(self):
+                self.enc = mlp(hidden, "enc")
+                self.gru = nn.GRUCell(features=deter, name="gru")
+                self.prior = mlp(stoch * classes, "prior")
+                self.post = mlp(stoch * classes, "post")
+                self.dec = mlp(obs_dim, "dec")
+                self.rew = mlp(bins, "rew")
+                self.cont = mlp(1, "cont")
+                self.actor = mlp(act_dim, "actor")
+                self.critic = mlp(bins, "critic")
+
+            # one RSSM transition: advance h with (z_prev, a_prev)
+            def step_h(self, h, z_prev, a_prev):
+                x = jnp.concatenate(
+                    [z_prev.reshape(z_prev.shape[:-2] + (-1,)),
+                     jax.nn.one_hot(a_prev, act_dim)], -1)
+                new_h, _ = self.gru(h, x)
+                return new_h
+
+            def prior_logits(self, h):
+                return self.prior(h).reshape(h.shape[:-1]
+                                             + (stoch, classes))
+
+            def post_logits(self, h, embed):
+                x = jnp.concatenate([h, embed], -1)
+                return self.post(x).reshape(h.shape[:-1]
+                                            + (stoch, classes))
+
+            def embed(self, obs):
+                return self.enc(symlog(obs))
+
+            def heads(self, h, z):
+                feat = jnp.concatenate(
+                    [h, z.reshape(z.shape[:-2] + (-1,))], -1)
+                return {
+                    "recon": self.dec(feat),
+                    "reward": self.rew(feat),
+                    "cont": self.cont(feat)[..., 0],
+                    "actor": self.actor(feat),
+                    "critic": self.critic(feat),
+                }
+
+        self.bundle = Bundle()
+
+    def apply(self, params, method, *args):
+        return self.bundle.apply({"params": params}, *args,
+                                 method=getattr(self.bundle, method))
+
+
+class DreamerV3Module(RLModule):
+    """World-model RLModule. Stateful acting: the env runner carries the
+    deterministic RSSM state and (previous z, a) across steps."""
+
+    def __init__(self, obs_space, act_space, spec: RLModuleSpec):
+        self.obs_dim = int(np.prod(obs_space.shape))
+        self.act_dim = int(getattr(act_space, "n"))
+        self.cfg = dict(spec.config or {})
+        self.nets = _Nets(self.obs_dim, self.act_dim, self.cfg)
+
+    def init(self, rng):
+        n = self.nets
+        h = jnp.zeros((1, n.deter))
+        z = jnp.zeros((1, n.stoch, n.classes))
+        obs = jnp.zeros((1, self.obs_dim))
+
+        def touch(bundle):
+            e = bundle.embed(obs)
+            h2 = bundle.step_h(h, z, jnp.zeros((1,), jnp.int32))
+            pr = bundle.prior_logits(h2)
+            po = bundle.post_logits(h2, e)
+            hd = bundle.heads(h2, z)
+            return pr, po, hd
+
+        return n.bundle.init(rng, method=touch)["params"]
+
+    # ----------------------------------------------------- stateful act
+
+    def initial_state(self, n_envs: int):
+        n = self.nets
+        return {"h": jnp.zeros((n_envs, n.deter)),
+                "z": jnp.zeros((n_envs, n.stoch, n.classes)),
+                "a": jnp.zeros((n_envs,), jnp.int32)}
+
+    def reset_state_row(self, state, i: int):
+        return jax.tree.map(lambda s: s.at[i].set(0), state)
+
+    def forward_inference(self, params, obs, state, rng):
+        """One acting step: advance h with the previous (z, a), infer the
+        posterior from the new observation, sample an action."""
+        n = self.nets
+        h = n.apply(params, "step_h", state["h"], state["z"], state["a"])
+        embed = n.apply(params, "embed", obs)
+        post = n.apply(params, "post_logits", h, embed)
+        r_z, r_a = jax.random.split(rng)
+        z = _st_sample(r_z, post)
+        heads = n.apply(params, "heads", h, z)
+        action = jax.random.categorical(r_a, heads["actor"], axis=-1)
+        return {"logits": heads["actor"],
+                "state": {"h": h, "z": z, "a": action.astype(jnp.int32)}}
+
+    def forward_train(self, params, obs):  # parity with the base API
+        raise NotImplementedError("DreamerV3 trains on sequences")
+
+
+# ---------------------------------------------------------------- learner
+
+
+class DreamerV3Learner(Learner):
+    """World model + actor + critic in one jitted update."""
+
+    def __init__(self, module, config: Dict[str, Any], seed: int = 0):
+        super().__init__(module, config, seed=seed)
+        self._host_rng = jax.random.PRNGKey(seed + 13)
+        # EMA critic (regularizer toward a slow copy, ref: dreamerv3
+        # critic EMA) + percentile return scale
+        self.slow_critic = jax.tree.map(jnp.array, self.params["critic"])
+        self._jit_polyak = jax.jit(lambda t, o: jax.tree.map(
+            lambda a, b: 0.98 * a + 0.02 * b, t, o))
+        self._ret_scale = 1.0
+
+    # --------------------------------------------------------- the loss
+
+    def loss(self, params, batch):
+        cfg = self.config
+        nets = self.module.nets
+        B, T = batch["obs"].shape[:2]
+        H = cfg.get("imagine_horizon", 8)
+        gamma = cfg.get("gamma", 0.99)
+        lam = cfg.get("lambda_", 0.95)
+        entropy_coef = cfg.get("entropy_coef", 3e-3)
+
+        # ---------------- observation scan (world-model learning)
+        rngs = jax.random.split(batch["rng"], T + 1)
+        h0 = jnp.zeros((B, nets.deter))
+        z0 = jnp.zeros((B, nets.stoch, nets.classes))
+        a_prev = jnp.concatenate(
+            [jnp.zeros((B, 1), jnp.int32), batch["actions"][:, :-1]], 1)
+
+        def obs_step(carry, inp):
+            h, z = carry
+            obs_t, a_p, first_t, rng_t = inp
+            keep = (1.0 - first_t)[:, None]
+            h = h * keep
+            z = z * keep[..., None]
+            a_p = (a_p * (1 - first_t).astype(jnp.int32))
+            h = nets.apply(params, "step_h", h, z, a_p)
+            prior = nets.apply(params, "prior_logits", h)
+            embed = nets.apply(params, "embed", obs_t)
+            post = nets.apply(params, "post_logits", h, embed)
+            z = _st_sample(rng_t, post)
+            return (h, z), (h, z, prior, post)
+
+        (_, _), (hs, zs, priors, posts) = jax.lax.scan(
+            obs_step, (h0, z0),
+            (batch["obs"].swapaxes(0, 1), a_prev.swapaxes(0, 1),
+             batch["is_first"].swapaxes(0, 1), rngs[:T]))
+        # [T, B, ...] -> flatten heads once
+        heads = nets.apply(params, "heads", hs, zs)
+        obs_t = batch["obs"].swapaxes(0, 1)
+        recon_loss = jnp.square(heads["recon"] - symlog(obs_t)).sum(-1)
+        rew_target = twohot(symlog(batch["rewards"].swapaxes(0, 1)),
+                            nets.bins)
+        rew_loss = -(rew_target * jax.nn.log_softmax(
+            heads["reward"], -1)).sum(-1)
+        cont_target = 1.0 - batch["dones"].swapaxes(0, 1)
+        cont_loss = -(cont_target * jax.nn.log_sigmoid(heads["cont"])
+                      + (1 - cont_target)
+                      * jax.nn.log_sigmoid(-heads["cont"]))
+        free = cfg.get("free_bits", 1.0)
+        dyn_kl = jnp.maximum(_kl_categorical(
+            jax.lax.stop_gradient(posts), priors), free)
+        rep_kl = jnp.maximum(_kl_categorical(
+            posts, jax.lax.stop_gradient(priors)), free)
+        wm_loss = (recon_loss + rew_loss + cont_loss
+                   + 1.0 * dyn_kl + 0.1 * rep_kl).mean()
+
+        # ---------------- imagination (actor-critic learning)
+        # world model FROZEN here: actor gradients flow only through
+        # action log-probs (reinforce), critic only through its head
+        frozen = jax.lax.stop_gradient(params)
+        h_flat = jax.lax.stop_gradient(hs.reshape(B * T, -1))
+        z_flat = jax.lax.stop_gradient(
+            zs.reshape(B * T, nets.stoch, nets.classes))
+        im_rngs = jax.random.split(rngs[T], H)
+
+        def im_step(carry, rng_t):
+            h, z = carry
+            r_a, r_z = jax.random.split(rng_t)
+            feats = nets.apply(frozen, "heads", h, z)
+            # actor logits from LIVE actor params on frozen features
+            live = nets.apply(
+                {**frozen, "actor": params["actor"]}, "heads", h, z)
+            act = jax.random.categorical(r_a, live["actor"], axis=-1)
+            logp = jax.nn.log_softmax(live["actor"], -1)[
+                jnp.arange(h.shape[0]), act]
+            ent = -(jax.nn.softmax(live["actor"], -1)
+                    * jax.nn.log_softmax(live["actor"], -1)).sum(-1)
+            h2 = nets.apply(frozen, "step_h", h, z, act)
+            prior = nets.apply(frozen, "prior_logits", h2)
+            z2 = _st_sample(r_z, prior)
+            nxt = nets.apply(frozen, "heads", h2, z2)
+            reward = symexp(twohot_mean(nxt["reward"], nets.bins))
+            cont = jax.nn.sigmoid(nxt["cont"])
+            return (h2, z2), (h2, z2, reward, cont, logp, ent)
+
+        (_, _), (im_h, im_z, im_r, im_c, im_logp, im_ent) = jax.lax.scan(
+            im_step, (h_flat, z_flat), im_rngs)
+
+        # values along the imagined trajectory (LIVE critic on frozen
+        # features) + slow-critic regularizer targets
+        def critic_logits(crit_params, h, z):
+            return nets.apply({**frozen, "critic": crit_params},
+                              "heads", h, z)["critic"]
+
+        v_logits = critic_logits(params["critic"], im_h, im_z)
+        values = symexp(twohot_mean(v_logits, nets.bins))  # [H, N]
+        disc = gamma * im_c
+
+        def lam_step(nxt, t):
+            ret = im_r[t] + disc[t] * ((1 - lam) * values[t] + lam * nxt)
+            return ret, ret
+
+        last = values[-1]
+        _, lam_rets = jax.lax.scan(lam_step, last,
+                                   jnp.arange(H - 1, -1, -1))
+        lam_rets = lam_rets[::-1]  # [H, N]
+
+        # critic: twohot CE toward sg(lambda returns) + EMA regularizer
+        ret_t = jax.lax.stop_gradient(symlog(lam_rets))
+        ce = -(twohot(ret_t, nets.bins)
+               * jax.nn.log_softmax(v_logits, -1)).sum(-1)
+        slow_logits = jax.lax.stop_gradient(critic_logits(
+            batch["slow_critic"], im_h, im_z))
+        reg = -(jax.nn.softmax(slow_logits, -1)
+                * jax.nn.log_softmax(v_logits, -1)).sum(-1)
+        critic_loss = (ce + 0.3 * reg).mean()
+
+        # actor: reinforce on normalized advantages (percentile scale
+        # passed from the host EMA) + entropy bonus
+        adv = jax.lax.stop_gradient(
+            (lam_rets - values) / jnp.maximum(batch["ret_scale"], 1.0))
+        actor_loss = (-adv * im_logp - entropy_coef * im_ent).mean()
+
+        # return spread for the host-side percentile EMA
+        spread = jnp.percentile(lam_rets, 95) - jnp.percentile(lam_rets, 5)
+
+        total = wm_loss + critic_loss + actor_loss
+        return total, {
+            "wm_loss": wm_loss, "critic_loss": critic_loss,
+            "actor_loss": actor_loss, "kl": dyn_kl.mean(),
+            "recon": recon_loss.mean(), "entropy": im_ent.mean(),
+            "ret_spread": spread, "value_mean": values.mean(),
+        }
+
+    # ------------------------------------------------------------ hooks
+
+    def prepare_batch(self, batch):
+        self._host_rng, sub = jax.random.split(self._host_rng)
+        return {**batch, "rng": sub, "slow_critic": self.slow_critic,
+                "ret_scale": jnp.float32(self._ret_scale)}
+
+    def update(self, batch):
+        metrics = super().update(batch)
+        # percentile return normalization (ref: dreamerv3 return EMA)
+        self._ret_scale = 0.99 * self._ret_scale + 0.01 * max(
+            metrics.get("ret_spread", 1.0), 1.0)
+        return metrics
+
+    def after_update(self):
+        self.slow_critic = self._jit_polyak(self.slow_critic,
+                                            self.params["critic"])
+
+    def set_weights(self, weights):
+        super().set_weights(weights)
+        self.slow_critic = jax.tree.map(jnp.array, self.params["critic"])
+
+
+# ----------------------------------------------------------------- buffer
+
+
+class SequenceReplayBuffer:
+    """Episode store sampling fixed-length subsequences [B, T] with
+    is_first flags (ref: dreamerv3's EpisodeReplayBuffer use)."""
+
+    def __init__(self, capacity_steps: int, seq_len: int, seed: int = 0):
+        self.capacity = capacity_steps
+        self.seq_len = seq_len
+        self._episodes: List[Dict[str, np.ndarray]] = []
+        self._steps = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self):
+        return self._steps
+
+    def add_episode(self, ep: Dict[str, np.ndarray]) -> None:
+        n = len(ep["rewards"])
+        if n == 0:
+            return
+        self._episodes.append(ep)
+        self._steps += n
+        while self._steps > self.capacity and len(self._episodes) > 1:
+            gone = self._episodes.pop(0)
+            self._steps -= len(gone["rewards"])
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        T = self.seq_len
+        out: Dict[str, List[np.ndarray]] = {
+            "obs": [], "actions": [], "rewards": [], "dones": [],
+            "is_first": []}
+        for _ in range(batch_size):
+            ep = self._episodes[self._rng.integers(len(self._episodes))]
+            n = len(ep["rewards"])
+            start = int(self._rng.integers(0, max(n - T, 0) + 1))
+            sl = slice(start, start + T)
+            obs = ep["obs"][sl]
+            acts = ep["actions"][sl]
+            rews = ep["rewards"][sl]
+            dones = ep["dones"][sl]
+            first = np.zeros(len(obs), np.float32)
+            if start == 0:
+                first[0] = 1.0
+            pad = T - len(obs)
+            if pad:
+                obs = np.concatenate([obs, np.repeat(obs[-1:], pad, 0)])
+                acts = np.concatenate([acts, np.repeat(acts[-1:], pad)])
+                rews = np.concatenate([rews, np.zeros(pad, np.float32)])
+                dones = np.concatenate([dones, np.ones(pad, np.float32)])
+                first = np.concatenate([first, np.zeros(pad, np.float32)])
+            out["obs"].append(obs)
+            out["actions"].append(acts)
+            out["rewards"].append(rews)
+            out["dones"].append(dones)
+            out["is_first"].append(first)
+        return {
+            "obs": np.stack(out["obs"]).astype(np.float32),
+            "actions": np.stack(out["actions"]).astype(np.int32),
+            "rewards": np.stack(out["rewards"]).astype(np.float32),
+            "dones": np.stack(out["dones"]).astype(np.float32),
+            "is_first": np.stack(out["is_first"]).astype(np.float32),
+        }
+
+
+# -------------------------------------------------------------- algorithm
+
+
+class DreamerV3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = DreamerV3
+        self.module_spec = RLModuleSpec(
+            module_class=DreamerV3Module,
+            config={"hidden": 128, "deter": 128, "stoch": 8,
+                    "classes": 8, "bins": 41})
+        self.lr = 4e-4
+        self.buffer_size = 100_000
+        self.learning_starts = 1000
+        self.rollout_fragment_length = 200
+        self.batch_size_B = 8
+        self.batch_length_T = 32
+        self.updates_per_iteration = 8
+        self.imagine_horizon = 8
+        self.lambda_ = 0.95
+        self.entropy_coef = 3e-3
+        self.free_bits = 1.0
+
+    def learner_config(self) -> Dict[str, Any]:
+        cfg = super().learner_config()
+        cfg.update(imagine_horizon=self.imagine_horizon,
+                   lambda_=self.lambda_, entropy_coef=self.entropy_coef,
+                   free_bits=self.free_bits)
+        return cfg
+
+
+class DreamerV3(Algorithm):
+    learner_class = DreamerV3Learner
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.buffer = SequenceReplayBuffer(
+            config.buffer_size, config.batch_length_T, seed=config.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        weights = self.learner_group.get_weights()
+        episodes = self.env_runner_group.sample(
+            cfg.rollout_fragment_length, weights=weights, explore=True)
+        self._record_episodes(episodes)
+        for ep in episodes:
+            n = len(ep.rewards)
+            if n == 0:
+                continue
+            self.buffer.add_episode({
+                "obs": np.asarray(ep.obs[:n], np.float32),
+                "actions": np.asarray(ep.actions, np.int32),
+                "rewards": np.asarray(ep.rewards, np.float32),
+                "dones": np.asarray(
+                    [0.0] * (n - 1)
+                    + [1.0 if ep.terminated else 0.0], np.float32),
+            })
+        metrics: Dict[str, float] = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iteration):
+                metrics.update(self.learner_group.update(
+                    self.buffer.sample(cfg.batch_size_B)))
+        return metrics

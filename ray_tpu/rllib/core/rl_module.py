@@ -26,6 +26,7 @@ class RLModuleSpec:
     module_class: Any = None
     hidden: Tuple[int, ...] = (64, 64)
     dueling: bool = False  # DQN: separate value/advantage streams
+    config: Any = None     # module-specific kwargs (e.g. DreamerV3 sizes)
 
     def build(self, obs_space, act_space) -> "RLModule":
         cls = self.module_class or DiscreteMLPModule

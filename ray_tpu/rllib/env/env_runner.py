@@ -82,6 +82,12 @@ class SingleAgentEnvRunner:
         self._rng = jax.random.PRNGKey(base_seed + 1)
         self._np_rng = np.random.default_rng(base_seed + 2)
         self._jit_fwd = jax.jit(self.module.forward_train)
+        # stateful modules (recurrent world models: DreamerV3) carry an
+        # acting state across steps; rows reset on episode boundaries
+        self._stateful = hasattr(self.module, "initial_state")
+        if self._stateful:
+            self._jit_fwd_state = jax.jit(self.module.forward_inference)
+            self._act_state = self.module.initial_state(self.num_envs)
         self._cur_obs: List[np.ndarray] = []
         self._episodes: List[Episode] = []
         self._reset_all()
@@ -126,7 +132,13 @@ class SingleAgentEnvRunner:
         steps = 0
         while steps < num_timesteps:
             obs = np.stack(self._cur_obs)
-            fwd = self._jit_fwd(self.params, obs)
+            if self._stateful:
+                self._rng, sub = jax.random.split(self._rng)
+                fwd = self._jit_fwd_state(self.params, obs,
+                                          self._act_state, sub)
+                self._act_state = fwd["state"]
+            else:
+                fwd = self._jit_fwd(self.params, obs)
             continuous = "mean" in fwd
             if continuous:
                 # tanh-squashed gaussian (Box action spaces). Canonical
@@ -198,6 +210,9 @@ class SingleAgentEnvRunner:
                 episode.vf_preds.append(float(vf[i]))
                 steps += 1
                 if terminated or truncated:
+                    if self._stateful:
+                        self._act_state = self.module.reset_state_row(
+                            self._act_state, i)
                     episode.terminated = bool(terminated)
                     episode.truncated = bool(truncated)
                     if truncated:
@@ -224,6 +239,10 @@ class SingleAgentEnvRunner:
         return out
 
     def _value_of(self, obs) -> float:
+        if self._stateful:
+            # world-model modules bootstrap inside their own imagined
+            # rollouts, not from a GAE value head
+            return 0.0
         fwd = self._jit_fwd(self.params,
                             np.asarray(obs, np.float32)[None])
         if "vf" in fwd:

@@ -377,3 +377,21 @@ def test_expanding_map_bounded_store(shared_cluster):
     for row in ds.map_batches(expand).iter_rows():
         total += 1
     assert total == 240
+
+
+def test_iter_torch_batches(shared_cluster):
+    """Torch interop iterator (ref: data/iterator.py iter_torch_batches)."""
+    import torch
+
+    from ray_tpu import data as rdata
+
+    ds = rdata.from_items([{"x": float(i), "y": i} for i in range(10)])
+    batches = list(ds.iter_torch_batches(batch_size=4))
+    assert len(batches) == 3
+    assert isinstance(batches[0]["x"], torch.Tensor)
+    assert batches[0]["x"].shape == (4,)
+    total = torch.cat([b["y"] for b in batches]).sum().item()
+    assert total == sum(range(10))
+    typed = next(iter(ds.iter_torch_batches(
+        batch_size=4, dtypes={"x": torch.float64})))
+    assert typed["x"].dtype == torch.float64

