@@ -286,7 +286,6 @@ class DreamerV3Learner(Learner):
         def im_step(carry, rng_t):
             h, z = carry
             r_a, r_z = jax.random.split(rng_t)
-            feats = nets.apply(frozen, "heads", h, z)
             # actor logits from LIVE actor params on frozen features
             live = nets.apply(
                 {**frozen, "actor": params["actor"]}, "heads", h, z)
@@ -306,39 +305,48 @@ class DreamerV3Learner(Learner):
         (_, _), (im_h, im_z, im_r, im_c, im_logp, im_ent) = jax.lax.scan(
             im_step, (h_flat, z_flat), im_rngs)
 
-        # values along the imagined trajectory (LIVE critic on frozen
-        # features) + slow-critic regularizer targets
+        # state alignment: s_0 is the (stop-gradient) start state; step i
+        # takes action a_i AT s_i and yields (s_{i+1}, r_{i+1}, c_{i+1}).
+        # Values cover s_0..s_H; lambda-return R_i belongs to s_i:
+        #   R_H = v(s_H);  R_i = r_{i+1} + g*c_{i+1}*((1-lam)*v(s_{i+1})
+        #                                             + lam*R_{i+1})
+        # so the critic trains v(s_i) toward R_i and the actor baselines
+        # a_i with v(s_i) — the action-INDEPENDENT value of its state.
+        all_h = jnp.concatenate([h_flat[None], im_h], 0)       # [H+1, N]
+        all_z = jnp.concatenate([z_flat[None], im_z], 0)
+
         def critic_logits(crit_params, h, z):
             return nets.apply({**frozen, "critic": crit_params},
                               "heads", h, z)["critic"]
 
-        v_logits = critic_logits(params["critic"], im_h, im_z)
-        values = symexp(twohot_mean(v_logits, nets.bins))  # [H, N]
+        v_logits = critic_logits(params["critic"], all_h, all_z)
+        values = symexp(twohot_mean(v_logits, nets.bins))  # [H+1, N]
         disc = gamma * im_c
 
         def lam_step(nxt, t):
-            ret = im_r[t] + disc[t] * ((1 - lam) * values[t] + lam * nxt)
+            ret = im_r[t] + disc[t] * (
+                (1 - lam) * values[t + 1] + lam * nxt)
             return ret, ret
 
-        last = values[-1]
-        _, lam_rets = jax.lax.scan(lam_step, last,
+        _, lam_rets = jax.lax.scan(lam_step, values[H],
                                    jnp.arange(H - 1, -1, -1))
-        lam_rets = lam_rets[::-1]  # [H, N]
+        lam_rets = lam_rets[::-1]  # [H, N]: returns of s_0..s_{H-1}
 
         # critic: twohot CE toward sg(lambda returns) + EMA regularizer
         ret_t = jax.lax.stop_gradient(symlog(lam_rets))
         ce = -(twohot(ret_t, nets.bins)
-               * jax.nn.log_softmax(v_logits, -1)).sum(-1)
+               * jax.nn.log_softmax(v_logits[:H], -1)).sum(-1)
         slow_logits = jax.lax.stop_gradient(critic_logits(
-            batch["slow_critic"], im_h, im_z))
+            batch["slow_critic"], all_h[:H], all_z[:H]))
         reg = -(jax.nn.softmax(slow_logits, -1)
-                * jax.nn.log_softmax(v_logits, -1)).sum(-1)
+                * jax.nn.log_softmax(v_logits[:H], -1)).sum(-1)
         critic_loss = (ce + 0.3 * reg).mean()
 
         # actor: reinforce on normalized advantages (percentile scale
         # passed from the host EMA) + entropy bonus
         adv = jax.lax.stop_gradient(
-            (lam_rets - values) / jnp.maximum(batch["ret_scale"], 1.0))
+            (lam_rets - values[:H]) / jnp.maximum(batch["ret_scale"],
+                                                  1.0))
         actor_loss = (-adv * im_logp - entropy_coef * im_ent).mean()
 
         # return spread for the host-side percentile EMA
@@ -359,12 +367,22 @@ class DreamerV3Learner(Learner):
         return {**batch, "rng": sub, "slow_critic": self.slow_critic,
                 "ret_scale": jnp.float32(self._ret_scale)}
 
-    def update(self, batch):
-        metrics = super().update(batch)
+    def _note_spread(self, metrics):
         # percentile return normalization (ref: dreamerv3 return EMA)
         self._ret_scale = 0.99 * self._ret_scale + 0.01 * max(
             metrics.get("ret_spread", 1.0), 1.0)
+
+    def update(self, batch):
+        metrics = super().update(batch)
+        self._note_spread(metrics)
         return metrics
+
+    def compute_gradients(self, batch):
+        # the data-parallel path (num_learners > 1) never calls
+        # update(); the scale EMA must advance there too
+        grads, metrics = super().compute_gradients(batch)
+        self._note_spread(metrics)
+        return grads, metrics
 
     def after_update(self):
         self.slow_critic = self._jit_polyak(self.slow_critic,

@@ -140,7 +140,16 @@ class SingleAgentEnvRunner:
             else:
                 fwd = self._jit_fwd(self.params, obs)
             continuous = "mean" in fwd
-            if continuous:
+            if self._stateful:
+                # the module already sampled an action INTO its acting
+                # state (h advances conditioned on it); the env must
+                # receive that same action, not an independent re-sample
+                actions = np.asarray(fwd["state"]["a"])
+                logits = np.asarray(fwd["logits"], np.float32)
+                logp_all = logits - _logsumexp(logits)
+                logps = logp_all[np.arange(len(actions)), actions]
+                vf = np.zeros(len(actions), np.float32)
+            elif continuous:
                 # tanh-squashed gaussian (Box action spaces). Canonical
                 # actions in [-1, 1] are what learners consume; the env
                 # sees them rescaled to its [low, high].
