@@ -221,6 +221,12 @@ class LLMEngine:
         # dispatch) — one expression, used by dispatch, split and warmup
         self._wave_rb: int = (config.prefill_wave_size
                               or max(1, config.max_batch // 2))
+        # decode block-table width buckets: TWO compile shapes (half and
+        # full model length) — short sequences (the common case) skip
+        # half the attention gather, and warmup stays two decode
+        # compiles, not a compile per power of two
+        mp = self.max_pages_per_seq
+        self._mp_buckets = sorted({max(1, mp // 2), mp})
         # slots: fixed decode row assignment while a request is RUNNING
         self._free_slots: List[int] = list(range(config.max_batch))
         self._slot_req: Dict[int, Request] = {}
@@ -358,6 +364,12 @@ class LLMEngine:
         return req
 
     # ---------------------------------------------------------- compute
+
+    def _mp_bucket(self, n: int) -> int:
+        for b in self._mp_buckets:
+            if n <= b:
+                return b
+        return self.max_pages_per_seq
 
     def _jit(self, kind: str, shape_key: tuple):
         """Build (once per bucketed shape) the jitted prefill/decode fns."""
@@ -569,7 +581,12 @@ class LLMEngine:
         if not elig:
             return False
 
-        bt = np.zeros((S, self.max_pages_per_seq), np.int32)
+        # kv-length bucket: the attention gather costs O(block-table
+        # width); sizing it to the batch's actual page usage (bucketed
+        # so shapes stay compiled) instead of max_model_len's worst case
+        # trims decode compute for typical short sequences
+        mp = self._mp_bucket(max(len(r.pages) for r in elig))
+        bt = np.zeros((S, mp), np.int32)
         total = np.zeros((S,), np.int32)
         caps = np.ones((S,), np.int32)
         positions = np.zeros((S, 1), np.int32)
@@ -599,7 +616,7 @@ class LLMEngine:
                 temp, topk = t_k, tk_k
         for req in elig:
             req.planned_out += k_steps
-        fn = self._jit("decode", (k_steps,))
+        fn = self._jit("decode", (k_steps, mp))
         toks, self.slot_ids, self.k_pages, self.v_pages = fn(
             self.params, self.k_pages, self.v_pages, self.slot_ids,
             jnp.asarray(bt), jnp.asarray(total), jnp.asarray(caps),
@@ -920,19 +937,21 @@ class LLMEngine:
             n += 1
         if not include_decode:
             return n
-        fn = self._jit("decode", (k_steps,))
-        toks, self.slot_ids, self.k_pages, self.v_pages = fn(
-            self.params, self.k_pages, self.v_pages, self.slot_ids,
-            jnp.asarray(np.zeros((S, self.max_pages_per_seq), np.int32)),
-            jnp.asarray(np.zeros((S,), np.int32)),
-            jnp.asarray(np.ones((S,), np.int32)),
-            jnp.asarray(np.zeros((S, 1), np.int32)),
-            jnp.asarray(np.zeros((S,), bool)),
-            jnp.asarray(np.zeros((S, 1), np.int32)),
-            np.zeros((S,), np.float32), np.zeros((S,), np.int32),
-            jnp.asarray(np.zeros((k_steps, S, 2), np.uint32)))
-        np.asarray(toks)
-        return n + 1
+        for mp in self._mp_buckets:
+            fn = self._jit("decode", (k_steps, mp))
+            toks, self.slot_ids, self.k_pages, self.v_pages = fn(
+                self.params, self.k_pages, self.v_pages, self.slot_ids,
+                jnp.asarray(np.zeros((S, mp), np.int32)),
+                jnp.asarray(np.zeros((S,), np.int32)),
+                jnp.asarray(np.ones((S,), np.int32)),
+                jnp.asarray(np.zeros((S, 1), np.int32)),
+                jnp.asarray(np.zeros((S,), bool)),
+                jnp.asarray(np.zeros((S, 1), np.int32)),
+                np.zeros((S,), np.float32), np.zeros((S,), np.int32),
+                jnp.asarray(np.zeros((k_steps, S, 2), np.uint32)))
+            np.asarray(toks)
+            n += 1
+        return n
 
     # ------------------------------------------------------------ stats
 
