@@ -17,7 +17,11 @@ import threading
 from typing import Optional
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SO = os.path.join(_HERE, "librtpu.so")
+# RTPU_NATIVE_SO selects an alternate build of the native core — the
+# sanitizer tier sets librtpu_asan.so (`make -C csrc asan`) so the same
+# Python tests drive the store/sched/dataio under ASan+UBSan
+_SO = os.path.join(_HERE, os.environ.get("RTPU_NATIVE_SO",
+                                         "librtpu.so"))
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "csrc")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -44,7 +48,8 @@ def ensure_built() -> bool:
         if _build_failed:
             return False
         try:
-            subprocess.run(["make", "-C", _CSRC], check=True,
+            target = (["asan"] if _SO.endswith("_asan.so") else [])
+            subprocess.run(["make", "-C", _CSRC, *target], check=True,
                            capture_output=True, timeout=120)
             return True
         except Exception:
