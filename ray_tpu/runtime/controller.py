@@ -41,6 +41,9 @@ class NodeInfo:
         self.alive = True
         self.last_heartbeat = time.monotonic()
         self.client: Optional[RpcClient] = None
+        # last applied resource-view version (ref: ray_syncer.h:83):
+        # views with version <= this are stale/reordered and dropped
+        self.resource_version = 0
 
     def snapshot(self):
         return {
@@ -292,17 +295,37 @@ class Controller:
         return {"session_name": self.session_name,
                 "n_nodes": sum(1 for n in self.nodes.values() if n.alive)}
 
-    async def heartbeat(self, node_id: str, available_resources: Dict[str, float],
-                        load: Dict[str, Any] = None):
+    async def heartbeat(self, node_id: str,
+                        available_resources: Optional[Dict[str, float]],
+                        load: Dict[str, Any] = None,
+                        resource_version: int = 0):
         node = self.nodes.get(node_id)
         if node is None:
             return {"registered": False}
         node.last_heartbeat = time.monotonic()
-        node.available_resources = available_resources
+        want_full = False
+        if available_resources is not None:
+            # versioned merge: apply a newer OR equal-version view (a
+            # full view is authoritative and idempotent — the periodic
+            # refresh must be able to heal content divergence); only a
+            # strictly OLDER view (reconnect after partition, reordered
+            # transport) is dropped, so it cannot roll back the table
+            if resource_version >= node.resource_version:
+                node.available_resources = available_resources
+                node.resource_version = resource_version
+        elif resource_version > node.resource_version:
+            # delta beat claims a version we have not seen (e.g. this
+            # controller restarted and lost the table): ask for a full
+            # view instead of scheduling against stale numbers
+            want_full = True
         if not node.alive:
             node.alive = True
-        return {"registered": True,
-                "n_nodes": sum(1 for n in self.nodes.values() if n.alive)}
+        reply = {"registered": True,
+                 "n_nodes": sum(1 for n in self.nodes.values()
+                                if n.alive)}
+        if want_full:
+            reply["want_full"] = True
+        return reply
 
     async def list_nodes(self):
         return {nid: n.snapshot() for nid, n in self.nodes.items()}

@@ -238,6 +238,11 @@ class Nodelet:
         self.object_bytes = 0
         self._owner_clients: Dict[str, RpcClient] = {}
         self.cluster_nodes = 1  # refreshed from heartbeat replies
+        # versioned resource view (ref: ray_syncer.h:83 — every update
+        # carries a monotonically increasing per-node version; receivers
+        # drop stale/reordered views and deltas only ship on change)
+        self._resource_version = 1
+        self._resource_version_sent = 0
         self._respill_tick = 0
         self._factory_proc = None
         self._factory_path = os.path.join(
@@ -321,15 +326,31 @@ class Nodelet:
 
     async def _heartbeat_loop(self):
         cfg = get_config()
+        beats = 0
         while True:
             await asyncio.sleep(cfg.heartbeat_interval_s)
+            beats += 1
             try:
+                # delta semantics: the resource view ships only when its
+                # version moved (plus a periodic full refresh as the
+                # staleness self-heal); liveness beats stay tiny
+                version = self._resource_version
+                send_view = (version != self._resource_version_sent
+                             or beats % 10 == 0)
                 reply = await self.controller.call_async(
                     "heartbeat", node_id=self.node_id,
-                    available_resources=self.available,
+                    available_resources=(dict(self.available)
+                                         if send_view else None),
+                    resource_version=version,
                     load={"queued": len(self.queue),
                           "workers": len(self.workers),
                           "object_bytes": self.object_bytes})
+                if send_view and reply.get("registered"):
+                    self._resource_version_sent = version
+                if reply.get("want_full"):
+                    # controller restarted or detected staleness: push
+                    # the authoritative full view on the next beat
+                    self._resource_version_sent = 0
                 self.cluster_nodes = reply.get("n_nodes", 1)
             except Exception:
                 pass
@@ -793,6 +814,7 @@ class Nodelet:
         if not _leq(req, self.available):
             return False
         _sub(self.available, req)
+        self._resource_version += 1
         return True
 
     def _key_of(self, pool, pg_id):
@@ -813,6 +835,7 @@ class Nodelet:
         for k in list(self.available):
             if self.available[k] > self.total_resources.get(k, 0):
                 self.available[k] = self.total_resources[k]
+        self._resource_version += 1
 
     # ------------------------------------------------------------ task path
     async def submit_task(self, spec: dict):
@@ -1130,6 +1153,7 @@ class Nodelet:
         if not _leq(resources, self.available):
             return False
         _sub(self.available, resources)
+        self._resource_version += 1
         self.bundles[(pg_id, bundle_index)] = {
             "total": dict(resources), "available": dict(resources)}
         return True
@@ -1138,6 +1162,7 @@ class Nodelet:
         pool = self.bundles.pop((pg_id, bundle_index), None)
         if pool is not None:
             _add(self.available, pool["total"])
+            self._resource_version += 1
         return True
 
     # ------------------------------------------------------------ objects

@@ -208,3 +208,39 @@ def test_rpc_chaos_drop_budget(tmp_path):
         elt.run(server.stop())
         cfg.testing_rpc_failure = saved
         rpc_mod._chaos = None
+
+
+def test_versioned_resource_views_drop_stale(cluster):
+    """RaySyncer-style merge semantics (ref: src/ray/common/ray_syncer/
+    ray_syncer.h:83): a resource view arriving with an old version
+    (reordered transport, post-partition replay) must not roll back the
+    controller's table; a delta beat claiming an unseen version makes
+    the controller request a full view."""
+    from ray_tpu.runtime.rpc import EventLoopThread
+
+    session, add = cluster
+    controller = session.controller_inproc
+    loop = EventLoopThread.get()
+    node_id = session.node_id
+
+    def beat(avail, version):
+        return loop.run(controller.heartbeat(
+            node_id, avail, load={}, resource_version=version))
+
+    node = controller.nodes[node_id]
+    base = node.resource_version
+    r = beat({"CPU": 1.0}, base + 10)
+    assert r["registered"]
+    assert node.available_resources == {"CPU": 1.0}
+    assert node.resource_version == base + 10
+    # stale full view: dropped
+    beat({"CPU": 99.0}, base + 5)
+    assert node.available_resources == {"CPU": 1.0}
+    # newer view: applied
+    beat({"CPU": 2.0}, base + 11)
+    assert node.available_resources == {"CPU": 2.0}
+    # delta beat (no view) with an unseen version: controller asks for
+    # the full view instead of scheduling on stale numbers
+    r = beat(None, base + 50)
+    assert r.get("want_full") is True
+    assert node.available_resources == {"CPU": 2.0}
