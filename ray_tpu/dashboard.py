@@ -30,6 +30,7 @@ def start_dashboard(port: int = 8265,
     """Serve the dashboard over the CURRENT session; returns (port, server).
     Runs on a daemon thread (no event-loop coupling)."""
     from .util import metrics as metrics_mod
+    from .util import profiling
     from .util import state
     from .util.httpserve import start_http
 
@@ -50,6 +51,15 @@ def start_dashboard(port: int = 8265,
         "/api/summary/tasks": _json(state.summarize_tasks),
         "/api/summary/actors": _json(state.summarize_actors),
         "/api/logs": _json(_list_logs),
+        # profiling (ref: dashboard/modules/reporter — py-spy/memray
+        # endpoints; here stdlib-based, see util/profiling.py)
+        "/api/profile/stacks": _json(profiling.stack_dump),
+        "/api/profile/workers": _json(profiling.worker_stacks),
+        "/api/profile/memory/start": _json(
+            lambda: {"started": profiling.memory_start()}),
+        "/api/profile/memory": _json(profiling.memory_snapshot),
+        "/api/profile/memory/stop": _json(
+            lambda: {"stopped": profiling.memory_stop()}),
     }
     return start_http(routes, port=port, host=host,
                       prefix_routes={"/api/logs/": _serve_log})

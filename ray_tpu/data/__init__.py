@@ -72,6 +72,41 @@ def read_parquet(paths, *, parallelism: int = -1,
     return _from_read_tasks(parquet_read_tasks(paths, parallelism, columns))
 
 
+def read_lance(uri: str, *, parallelism: int = -1,
+               columns: Optional[List[str]] = None) -> Dataset:
+    """ref: read_api.py read_lance (requires 'pylance')."""
+    from .datasource import lance_read_tasks
+
+    return _from_read_tasks(lance_read_tasks(uri, parallelism, columns))
+
+
+def read_iceberg(table_identifier: str, *, parallelism: int = -1,
+                 row_filter=None, catalog_kwargs=None) -> Dataset:
+    """ref: read_api.py read_iceberg (requires 'pyiceberg')."""
+    from .datasource import iceberg_read_tasks
+
+    return _from_read_tasks(iceberg_read_tasks(
+        table_identifier, parallelism, row_filter, catalog_kwargs))
+
+
+def read_bigquery(project_id: str, *, dataset: str = None,
+                  query: str = None, parallelism: int = -1) -> Dataset:
+    """ref: read_api.py read_bigquery (requires google-cloud-bigquery)."""
+    from .datasource import bigquery_read_tasks
+
+    return _from_read_tasks(bigquery_read_tasks(
+        project_id, dataset, query, parallelism))
+
+
+def read_mongo(uri: str, database: str, collection: str, *,
+               parallelism: int = -1, pipeline=None) -> Dataset:
+    """ref: read_api.py read_mongo (requires 'pymongo')."""
+    from .datasource import mongo_read_tasks
+
+    return _from_read_tasks(mongo_read_tasks(
+        uri, database, collection, parallelism, pipeline))
+
+
 def read_csv(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
     from .datasource import csv_read_tasks
 

@@ -231,3 +231,149 @@ def write_blocks(blocks, path: str, fmt: str, column: str = None) -> None:
             np.save(base + ".npy", acc.to_numpy()[column])
         else:
             raise ValueError(f"unknown format {fmt}")
+
+
+# ------------------------------------------------- cloud datasources
+#
+# ref: python/ray/data/_internal/datasource/{lance,iceberg,bigquery,
+# mongo}_datasource.py — each builds per-fragment read tasks through the
+# service's client library. The client libraries are imported lazily so
+# the framework carries no hard dependency; when one is absent the
+# reader raises an ImportError naming the package (tests drive the task
+# construction through injected fake clients).
+
+
+def lance_read_tasks(uri: str, parallelism: int = -1, columns=None):
+    """Lance fragments -> one read task per fragment group (ref:
+    _internal/datasource/lance_datasource.py)."""
+    try:
+        import lance
+    except ImportError as e:
+        raise ImportError(
+            "read_lance requires the 'pylance' package") from e
+    ds = lance.dataset(uri)
+    fragments = list(ds.get_fragments())
+    groups = _group([f.fragment_id for f in fragments],
+                    parallelism if parallelism > 0 else len(fragments))
+
+    def make_task(frag_ids):
+        def task():
+            out = []
+            dataset = lance.dataset(uri)
+            for fragment in dataset.get_fragments():
+                if fragment.fragment_id in frag_ids:
+                    table = fragment.to_table(columns=columns)
+                    out.append(table)
+            return out
+
+        return task
+
+    return [make_task(g) for g in groups if g]
+
+
+def iceberg_read_tasks(table_identifier: str, parallelism: int = -1,
+                       row_filter=None, catalog_kwargs=None):
+    """Iceberg scan tasks -> read tasks (ref: _internal/datasource/
+    iceberg_datasource.py — plan_files() partitions the scan)."""
+    try:
+        from pyiceberg.catalog import load_catalog
+    except ImportError as e:
+        raise ImportError(
+            "read_iceberg requires the 'pyiceberg' package") from e
+    catalog = load_catalog(**(catalog_kwargs or {}))
+    table = catalog.load_table(table_identifier)
+    scan = (table.scan(row_filter=row_filter) if row_filter is not None
+            else table.scan())
+    files = list(scan.plan_files())
+    groups = _group(list(range(len(files))),
+                    parallelism if parallelism > 0 else len(files))
+
+    def make_task(idxs):
+        def task():
+            import pyarrow.parquet as pq
+
+            out = []
+            for i in idxs:
+                out.append(pq.read_table(files[i].file.file_path))
+            return out
+
+        return task
+
+    return [make_task(g) for g in groups if g]
+
+
+def bigquery_read_tasks(project_id: str, dataset: str = None,
+                        query: str = None, parallelism: int = -1):
+    """BigQuery Storage read streams -> read tasks (ref: _internal/
+    datasource/bigquery_datasource.py)."""
+    try:
+        from google.cloud import bigquery, bigquery_storage
+    except ImportError as e:
+        raise ImportError(
+            "read_bigquery requires 'google-cloud-bigquery' and "
+            "'google-cloud-bigquery-storage'") from e
+    if query is not None:
+        client = bigquery.Client(project=project_id)
+        job = client.query(query)
+        job.result()
+        dest = job.destination
+        table_path = (f"projects/{dest.project}/datasets/"
+                      f"{dest.dataset_id}/tables/{dest.table_id}")
+    else:
+        table_path = f"projects/{project_id}/{dataset}"
+    bqs = bigquery_storage.BigQueryReadClient()
+    n = parallelism if parallelism > 0 else 8
+    session = bqs.create_read_session(
+        parent=f"projects/{project_id}",
+        read_session={"table": table_path, "data_format": "ARROW"},
+        max_stream_count=n)
+
+    def make_task(stream_name):
+        def task():
+            reader = bigquery_storage.BigQueryReadClient().read_rows(
+                stream_name)
+            return [reader.to_arrow()]
+
+        return task
+
+    return [make_task(s.name) for s in session.streams]
+
+
+def mongo_read_tasks(uri: str, database: str, collection: str,
+                     parallelism: int = -1, pipeline=None):
+    """Mongo collection -> one read task per _id range partition (ref:
+    _internal/datasource/mongo_datasource.py)."""
+    try:
+        import pymongo
+    except ImportError as e:
+        raise ImportError("read_mongo requires the 'pymongo' package") \
+            from e
+    client = pymongo.MongoClient(uri)
+    coll = client[database][collection]
+    n = parallelism if parallelism > 0 else 8
+    count = coll.estimated_document_count()
+    if count == 0:
+        return []
+    # partition by sorted _id boundaries so tasks scan disjoint ranges
+    step = max(count // n, 1)
+    bounds = []
+    cursor = coll.find({}, {"_id": 1}).sort("_id", 1)
+    for i, doc in enumerate(cursor):
+        if i % step == 0:
+            bounds.append(doc["_id"])
+    bounds.append(None)  # open upper bound
+
+    def make_task(lo, hi):
+        def task():
+            c = pymongo.MongoClient(uri)[database][collection]
+            match = {"_id": {"$gte": lo}}
+            if hi is not None:
+                match["_id"]["$lt"] = hi
+            stages = [{"$match": match}] + list(pipeline or [])
+            rows = list(c.aggregate(stages))
+            return [rows] if rows else []
+
+        return task
+
+    return [make_task(bounds[i], bounds[i + 1])
+            for i in range(len(bounds) - 1)]

@@ -1,0 +1,102 @@
+"""In-process profiling hooks for the dashboard (reporter equivalent).
+
+The reference's dashboard reporter shells out to py-spy / memray for
+stack and memory profiles (ref: python/ray/dashboard/modules/reporter/
+reporter_agent.py — `py-spy dump`/`memray` endpoints). Here the same
+observation points come from the interpreter itself, so they work in
+any process with zero extra dependencies:
+
+- stack_dump(): every thread's current Python stack (py-spy-dump
+  style), via sys._current_frames.
+- memory_profile(start/stop/snapshot): tracemalloc top allocation
+  sites, grouped by file:line.
+- worker_stacks(): the same stack dump executed ON a worker/actor
+  process through the task runtime (profile any cluster process from
+  the driver or dashboard).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+from typing import Any, Dict, List
+
+
+def stack_dump() -> Dict[str, Any]:
+    """Current Python stacks of every thread in THIS process."""
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    threads: List[Dict[str, Any]] = []
+    for ident, frame in frames.items():
+        stack = traceback.format_stack(frame)
+        threads.append({
+            "thread_id": ident,
+            "name": names.get(ident, f"thread-{ident}"),
+            "daemon": next((t.daemon for t in threading.enumerate()
+                            if t.ident == ident), None),
+            "stack": [line.rstrip() for line in stack],
+        })
+    import os
+
+    return {"pid": os.getpid(), "threads": threads}
+
+
+def memory_start(n_frames: int = 5) -> bool:
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(n_frames)
+        return True
+    return False
+
+
+def memory_snapshot(top: int = 30) -> Dict[str, Any]:
+    """Top allocation sites since memory_start() (memray-lite)."""
+    import os
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        return {"tracing": False,
+                "hint": "POST /api/profile/memory/start first"}
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics("lineno")[:top]
+    current, peak = tracemalloc.get_traced_memory()
+    return {
+        "tracing": True, "pid": os.getpid(),
+        "current_bytes": current, "peak_bytes": peak,
+        "top": [{
+            "site": str(stat.traceback[0]) if stat.traceback else "?",
+            "bytes": stat.size, "count": stat.count,
+        } for stat in stats],
+    }
+
+
+def memory_stop() -> bool:
+    import tracemalloc
+
+    if tracemalloc.is_tracing():
+        tracemalloc.stop()
+        return True
+    return False
+
+
+def worker_stacks(timeout_s: float = 30.0) -> List[Dict[str, Any]]:
+    """Stack-dump every live worker process through the runtime (the
+    reference profiles raylet-managed workers by pid via py-spy; here
+    the dump runs in-process as a task on each worker)."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0)
+    def _dump():
+        return stack_dump()
+
+    # one probe per idle worker is not guaranteed to hit EVERY worker;
+    # this mirrors the reporter's best-effort sampling
+    refs = [_dump.remote() for _ in range(4)]
+    out, seen = [], set()
+    for dump in ray_tpu.get(refs, timeout=timeout_s):
+        if dump["pid"] not in seen:
+            seen.add(dump["pid"])
+            out.append(dump)
+    return out

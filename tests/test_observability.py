@@ -214,3 +214,36 @@ def test_dashboard_log_endpoints(shared_cluster):
         assert found
     finally:
         server.shutdown()
+
+
+def test_profiling_endpoints(shared_cluster):
+    """Stack + memory profiling through the dashboard (ref: dashboard/
+    modules/reporter py-spy/memray endpoints — stdlib-based here)."""
+    from ray_tpu.dashboard import start_dashboard
+
+    port, server = start_dashboard(0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(f"{base}/api/profile/stacks",
+                                    timeout=10) as r:
+            dump = json.loads(r.read())
+        assert dump["threads"], dump
+        assert any("MainThread" in t["name"] for t in dump["threads"])
+        assert any("test_profiling_endpoints" in line
+                   for t in dump["threads"] for line in t["stack"])
+        urllib.request.urlopen(f"{base}/api/profile/memory/start",
+                               timeout=10).read()
+        blob = [bytearray(1 << 20) for _ in range(4)]  # noqa: F841
+        with urllib.request.urlopen(f"{base}/api/profile/memory",
+                                    timeout=10) as r:
+            mem = json.loads(r.read())
+        assert mem["tracing"] and mem["current_bytes"] > (1 << 20)
+        assert mem["top"]
+        urllib.request.urlopen(f"{base}/api/profile/memory/stop",
+                               timeout=10).read()
+        with urllib.request.urlopen(f"{base}/api/profile/workers",
+                                    timeout=60) as r:
+            workers = json.loads(r.read())
+        assert workers and all(w["threads"] for w in workers)
+    finally:
+        server.shutdown()
