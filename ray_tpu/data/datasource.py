@@ -284,17 +284,31 @@ def iceberg_read_tasks(table_identifier: str, parallelism: int = -1,
     table = catalog.load_table(table_identifier)
     scan = (table.scan(row_filter=row_filter) if row_filter is not None
             else table.scan())
-    files = list(scan.plan_files())
-    groups = _group(list(range(len(files))),
-                    parallelism if parallelism > 0 else len(files))
+    # resolve to plain file paths at PLANNING time: tasks ship strings,
+    # not pyiceberg scan-task objects (which may not pickle). plan_files
+    # prunes at file/partition granularity; the residual row filter is
+    # re-applied per fragment below so rows a kept file contains beyond
+    # the filter do not leak through.
+    paths = [t.file.file_path for t in scan.plan_files()]
+    groups = _group(paths, parallelism if parallelism > 0 else len(paths))
+    arrow_filter = None
+    if row_filter is not None:
+        try:
+            from pyiceberg.expressions import \
+                expression_to_pyarrow as _to_pa
 
-    def make_task(idxs):
+            arrow_filter = _to_pa(row_filter)
+        except Exception:
+            arrow_filter = None  # metadata pruning only
+
+    def make_task(file_paths):
         def task():
-            import pyarrow.parquet as pq
+            import pyarrow.dataset as pads
 
             out = []
-            for i in idxs:
-                out.append(pq.read_table(files[i].file.file_path))
+            for p in file_paths:
+                ds = pads.dataset(p, format="parquet")
+                out.append(ds.to_table(filter=arrow_filter))
             return out
 
         return task
@@ -312,6 +326,9 @@ def bigquery_read_tasks(project_id: str, dataset: str = None,
         raise ImportError(
             "read_bigquery requires 'google-cloud-bigquery' and "
             "'google-cloud-bigquery-storage'") from e
+    if (dataset is None) == (query is None):
+        raise ValueError("read_bigquery requires exactly one of "
+                         "dataset='ds.table' or query=...")
     if query is not None:
         client = bigquery.Client(project=project_id)
         job = client.query(query)
@@ -320,7 +337,11 @@ def bigquery_read_tasks(project_id: str, dataset: str = None,
         table_path = (f"projects/{dest.project}/datasets/"
                       f"{dest.dataset_id}/tables/{dest.table_id}")
     else:
-        table_path = f"projects/{project_id}/{dataset}"
+        ds_id, _, tbl_id = dataset.partition(".")
+        if not tbl_id:
+            raise ValueError("dataset must be 'dataset.table'")
+        table_path = (f"projects/{project_id}/datasets/{ds_id}"
+                      f"/tables/{tbl_id}")
     bqs = bigquery_storage.BigQueryReadClient()
     n = parallelism if parallelism > 0 else 8
     session = bqs.create_read_session(
@@ -349,29 +370,42 @@ def mongo_read_tasks(uri: str, database: str, collection: str,
         raise ImportError("read_mongo requires the 'pymongo' package") \
             from e
     client = pymongo.MongoClient(uri)
-    coll = client[database][collection]
-    n = parallelism if parallelism > 0 else 8
-    count = coll.estimated_document_count()
-    if count == 0:
-        return []
-    # partition by sorted _id boundaries so tasks scan disjoint ranges
-    step = max(count // n, 1)
-    bounds = []
-    cursor = coll.find({}, {"_id": 1}).sort("_id", 1)
-    for i, doc in enumerate(cursor):
-        if i % step == 0:
-            bounds.append(doc["_id"])
-    bounds.append(None)  # open upper bound
+    try:
+        coll = client[database][collection]
+        n = parallelism if parallelism > 0 else 8
+        count = coll.estimated_document_count()
+        if count == 0:
+            return []
+        # partition by sorted _id boundaries so tasks scan disjoint
+        # ranges; boundaries come from skip+limit probes (index-backed),
+        # NOT a full scan of every _id on the driver
+        step = max(count // n, 1)
+        bounds = []
+        for i in range(0, count, step):
+            probe = list(coll.find({}, {"_id": 1}).sort("_id", 1)
+                         .skip(i).limit(1))
+            if not probe:
+                break
+            bound = probe[0]["_id"]
+            if not bounds or bound != bounds[-1]:
+                bounds.append(bound)
+        bounds.append(None)  # open upper bound
+    finally:
+        client.close()
 
     def make_task(lo, hi):
         def task():
-            c = pymongo.MongoClient(uri)[database][collection]
-            match = {"_id": {"$gte": lo}}
-            if hi is not None:
-                match["_id"]["$lt"] = hi
-            stages = [{"$match": match}] + list(pipeline or [])
-            rows = list(c.aggregate(stages))
-            return [rows] if rows else []
+            cl = pymongo.MongoClient(uri)
+            try:
+                c = cl[database][collection]
+                match = {"_id": {"$gte": lo}}
+                if hi is not None:
+                    match["_id"]["$lt"] = hi
+                stages = [{"$match": match}] + list(pipeline or [])
+                rows = list(c.aggregate(stages))
+                return [rows] if rows else []
+            finally:
+                cl.close()
 
         return task
 

@@ -26,15 +26,15 @@ from typing import Any, Dict, List
 def stack_dump() -> Dict[str, Any]:
     """Current Python stacks of every thread in THIS process."""
     frames = sys._current_frames()
-    names = {t.ident: t.name for t in threading.enumerate()}
+    by_id = {t.ident: t for t in threading.enumerate()}
     threads: List[Dict[str, Any]] = []
     for ident, frame in frames.items():
         stack = traceback.format_stack(frame)
+        thread = by_id.get(ident)
         threads.append({
             "thread_id": ident,
-            "name": names.get(ident, f"thread-{ident}"),
-            "daemon": next((t.daemon for t in threading.enumerate()
-                            if t.ident == ident), None),
+            "name": thread.name if thread else f"thread-{ident}",
+            "daemon": thread.daemon if thread else None,
             "stack": [line.rstrip() for line in stack],
         })
     import os
@@ -58,7 +58,7 @@ def memory_snapshot(top: int = 30) -> Dict[str, Any]:
 
     if not tracemalloc.is_tracing():
         return {"tracing": False,
-                "hint": "POST /api/profile/memory/start first"}
+                "hint": "GET /api/profile/memory/start first"}
     snap = tracemalloc.take_snapshot()
     stats = snap.statistics("lineno")[:top]
     current, peak = tracemalloc.get_traced_memory()

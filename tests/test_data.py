@@ -413,8 +413,27 @@ def test_read_mongo_partitions_by_id_ranges(monkeypatch):
 
         def find(self, _q, _proj):
             class Cur:
+                def __init__(self):
+                    self._skip = 0
+                    self._limit = None
+
                 def sort(self, *_a):
-                    return iter([{"_id": d["_id"]} for d in docs])
+                    return self
+
+                def skip(self, n):
+                    self._skip = n
+                    return self
+
+                def limit(self, n):
+                    self._limit = n
+                    return self
+
+                def __iter__(self):
+                    ids = [{"_id": d["_id"]} for d in docs]
+                    out = ids[self._skip:]
+                    if self._limit is not None:
+                        out = out[:self._limit]
+                    return iter(out)
 
             return Cur()
 
@@ -434,6 +453,9 @@ def test_read_mongo_partitions_by_id_ranges(monkeypatch):
 
         def __getitem__(self, _name):
             return FakeDB()
+
+        def close(self):
+            pass
 
     fake = types.ModuleType("pymongo")
     fake.MongoClient = FakeClient
