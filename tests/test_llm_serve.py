@@ -170,13 +170,16 @@ def test_openai_app_over_serve(shared_cluster):
     from ray_tpu.serve.llm import LLMConfig, build_openai_app
     from ray_tpu.serve.replica import Request
 
+    # two prefill buckets: replica warmup compiles every shape before
+    # READY, and a fully-loaded 1-core CI box pays ~3x per compile
     cfg = LLMConfig(
         model_id="tiny-llm",
         engine=EngineConfig(**{**ENGINE_CFG,
+                               "prefill_buckets": (32, 64),
                                "model_overrides": {"vocab_size": 512}}))
     app = build_openai_app(cfg)
     handle = serve.run(app, name="llm", route_prefix="/llm",
-                       wait_timeout_s=120)
+                       wait_timeout_s=240)
     try:
         import json
 
@@ -267,10 +270,11 @@ def test_pd_disaggregated_app_over_serve(shared_cluster):
     cfg = LLMConfig(
         model_id="tiny-pd",
         engine=EngineConfig(**{**ENGINE_CFG,
+                               "prefill_buckets": (32, 64),
                                "model_overrides": {"vocab_size": 512}}))
     app = build_pd_openai_app(cfg)
     handle = serve.run(app, name="pdllm", route_prefix="/pdllm",
-                       wait_timeout_s=120)
+                       wait_timeout_s=240)
     try:
         import json
 
