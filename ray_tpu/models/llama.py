@@ -27,7 +27,8 @@ from flax import struct
 from jax.ad_checkpoint import checkpoint_name
 
 from ..ops.attention import attention
-from ..ops.paged_attention import paged_attention, paged_write
+from ..ops.paged_attention import (paged_attention_decode,
+                                   paged_prefill_attention, paged_write)
 
 
 def _remat_policy(name: str):
@@ -140,10 +141,12 @@ class PagedCache:
     the scan's xs axis).
     """
 
-    k_pages: jax.Array      # [P, page, Hkv, D]
-    v_pages: jax.Array      # [P, page, Hkv, D]
+    kv_pages: jax.Array      # [P, Hkv, page, 2*D] (K | V in lanes)
     block_tables: jax.Array  # [B, MP] int32 page ids
     total_lens: jax.Array    # [B] int32, length INCLUDING new tokens
+    # STATIC number of block-table columns a cached prefix may span during
+    # prefill (0 = no prefix part compiled in); decode ignores it
+    ctx_pages: int = struct.field(pytree_node=False, default=0)
 
 
 class Attention(nn.Module):
@@ -168,15 +171,22 @@ class Attention(nn.Module):
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
         if isinstance(kv_cache, PagedCache):
-            # Serving path: scatter new K/V into pages, attend via block
-            # tables (write-then-attend so new tokens see themselves).
+            # Serving path: scatter new K/V into pages, then attend.
+            # Decode (S == 1) streams only the used pages through the
+            # Pallas kernel; prefill attends to itself (causal flash, no
+            # page reads) merged with the cached prefix by log-sum-exp.
             pc = kv_cache
-            k_pages, v_pages = paged_write(
-                pc.k_pages, pc.v_pages, k, v, pc.block_tables, positions,
-                pc.total_lens)
-            out = paged_attention(q, k_pages, v_pages, pc.block_tables,
-                                  positions)
-            new_cache = pc.replace(k_pages=k_pages, v_pages=v_pages)
+            kv_pages = paged_write(pc.kv_pages, k, v, pc.block_tables,
+                                   positions, pc.total_lens)
+            if s == 1:
+                out = paged_attention_decode(
+                    q[:, 0], kv_pages, pc.block_tables,
+                    pc.total_lens)[:, None]
+            else:
+                out = paged_prefill_attention(
+                    q, k, v, kv_pages, pc.block_tables, positions,
+                    pc.total_lens, ctx_pages=pc.ctx_pages)
+            new_cache = pc.replace(kv_pages=kv_pages)
         else:
             if kv_cache is not None:
                 # decode path: append to cache (serving engine manages layout)

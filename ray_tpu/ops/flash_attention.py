@@ -388,12 +388,18 @@ def flash_attention(
     scale: Optional[float] = None,
     block_q: int = BLOCK, block_k: int = BLOCK,
     interpret: Optional[bool] = None,
-) -> jax.Array:
+    return_lse: bool = False,
+):
     """Flash attention. q: [B,Sq,Hq,D]; k/v: [B,Sk,Hkv,D] -> [B,Sq,Hq,D].
 
     segment_ids: one [B,S] array (requires Sq == Sk), or a
     (q_segment_ids [B,Sq], kv_segment_ids [B,Sk]) pair for cached decode /
     chunked prefill of packed sequences.
+
+    return_lse: also return the log-sum-exp [B,Sq,Hq] (fp32) — the hook
+    for merging attention partials over disjoint kv sets (paged prefill
+    with a cached prefix, ops/paged_attention.py). The lse path is
+    forward-only (no custom VJP through the merge).
     """
     b, sq, hq, d = q.shape
     _, sk, hkv, _ = k.shape
@@ -429,6 +435,12 @@ def flash_attention(
         q_seg = pad(q_seg.astype(jnp.int32), sq_p, 1)[..., None]
         kv_seg = pad(kv_seg.astype(jnp.int32), sk_p, 1)[..., None]
 
+    if return_lse:
+        # forward-only: bypass the custom_vjp (no bwd through the merge)
+        o, lse = _fwd(qt, kt, vt, q_seg, kv_seg, causal, scale, bq, bk,
+                      interpret, sq, sk)
+        return (o[:, :, :sq, :].transpose(0, 2, 1, 3),
+                lse[:, :, :sq, 0].transpose(0, 2, 1))
     o = _flash(qt, kt, vt, q_seg, kv_seg, causal, scale, bq, bk, interpret,
                sq, sk)
     return o[:, :, :sq, :].transpose(0, 2, 1, 3)

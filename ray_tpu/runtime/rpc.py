@@ -401,6 +401,11 @@ class RpcClient:
         self._local_conn: Optional[_LocalConn] = None
         # queued-but-unsent notify_nowait coroutines (close_when_drained)
         self._inflight_notifies = 0
+        # optional hook (method, kwargs, exc) invoked on the io loop when
+        # a fire-and-forget notify fails — lets persistence-critical
+        # callers (controller storage) detect and replay lost sends
+        # instead of silently diverging
+        self.on_notify_error = None
         self._idle_event: Optional[asyncio.Event] = None
         # one-way frames awaiting the coalesced flush (notify_async)
         self._wbuf: List[bytes] = []
@@ -517,7 +522,17 @@ class RpcClient:
         fut = asyncio.get_event_loop().create_future()
         self._pending[msg_id] = fut
         payload = serialization.dumps_inline((REQ, msg_id, method, kwargs))
+        if self._wbuf:
+            # flush coalesced one-way frames enqueued earlier on this
+            # connection BEFORE the request frame: a request overtaking a
+            # buffered notify breaks per-connection FIFO (e.g. a
+            # cancel_task arriving ahead of the submit_task it cancels)
+            await self._flush_wbuf()
         async with self._wlock:
+            if self._writer is None:
+                # dropped during the flush above: surface the RETRYABLE
+                # type (AttributeError would skip reconnect handling)
+                raise ConnectionLost(f"connection to {self.address} lost")
             self._writer.write(_frame(payload))
             await self._writer.drain()
         if _timeout is not None:
@@ -608,14 +623,24 @@ class RpcClient:
     async def _notify_swallow(self, method: str, kwargs: dict):
         try:
             await self.notify_async(method, **kwargs)
-        except (ConnectionLost, ConnectionError, OSError):
-            pass
-        except Exception:
+        except (ConnectionLost, ConnectionError, OSError) as e:
+            self._report_notify_error(method, kwargs, e)
+        except Exception as e:  # noqa: BLE001 — hook decides, then log
             traceback.print_exc()
+            self._report_notify_error(method, kwargs, e)
         finally:
             self._inflight_notifies -= 1
             if self._inflight_notifies == 0 and self._idle_event is not None:
                 self._idle_event.set()
+
+    def _report_notify_error(self, method: str, kwargs: dict, exc):
+        cb = self.on_notify_error
+        if cb is None:
+            return
+        try:
+            cb(method, kwargs, exc)
+        except Exception:
+            traceback.print_exc()
 
     def close_when_drained(self, timeout: float = 10.0):
         """Close once every queued fire-and-forget notify has been sent

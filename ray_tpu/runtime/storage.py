@@ -118,7 +118,18 @@ class FileBackend(StoreBackend):
 class TCPBackend(StoreBackend):
     """The FileBackend verbs forwarded to a store server over RPC. Meta
     saves and journal appends are one-way sends (coalesced per loop
-    pass); replay reads are synchronous calls."""
+    pass); replay reads are synchronous calls.
+
+    Lost sends are NOT silent: a notify that fails (store connection
+    down) is recorded on an ordered backlog and the backend flips
+    ``degraded``; the next verb replays the backlog first (the RPC layer
+    reconnects underneath), and close() makes a final synchronous replay
+    attempt so a head failover can tell whether the store is complete.
+    """
+
+    # bound the loss backlog: past this we keep degraded=True but stop
+    # buffering (an unreachable store should not OOM the controller)
+    BACKLOG_CAP = 100_000
 
     def __init__(self, address: str):
         from .rpc import RpcClient
@@ -127,33 +138,106 @@ class TCPBackend(StoreBackend):
             address = f"tcp:{address}"
         self.client = RpcClient(address)
         self.client.call("ping", _timeout=15)
+        self.degraded = False
+        self._backlog: List[Tuple[str, dict]] = []  # send order preserved
+        self._dropped = 0
+        self.client.on_notify_error = self._on_lost
+
+    def _on_lost(self, method: str, kwargs: dict, exc) -> None:
+        # runs on the io loop, in completion order of the failed sends
+        if not self.degraded:
+            print(f"[storage] store server send failed ({exc!r}); "
+                  "buffering journal records for replay", flush=True)
+        self.degraded = True
+        if method == "ping":
+            return
+        if len(self._backlog) < self.BACKLOG_CAP:
+            self._backlog.append((method, kwargs))
+        else:
+            self._dropped += 1
+
+    def _replay_backlog(self) -> None:
+        """Re-send recorded losses ahead of new records (journal order
+        matters). Still-failing sends land back on the backlog via the
+        error hook."""
+        backlog, self._backlog = self._backlog, []
+        for method, kwargs in backlog:
+            self.client.notify_nowait(method, **kwargs)
+
+    def _maybe_recover(self) -> None:
+        """Clear `degraded` once the backlog has fully drained (checked
+        after any successful synchronous verb — notifies carry no ack, so
+        a sync round-trip is the recovery signal)."""
+        if (self.degraded and not self._backlog and self._dropped == 0
+                and getattr(self.client, "_inflight_notifies", 0) == 0):
+            self.degraded = False
 
     def save_meta(self, blob: bytes) -> None:
+        if self._backlog:
+            self._replay_backlog()
         self.client.notify_nowait("st_save_meta", blob=blob)
 
     def load_meta(self) -> Optional[bytes]:
-        return self.client.call("st_load_meta", _timeout=60)
+        blob = self.client.call("st_load_meta", _timeout=60)
+        self._maybe_recover()
+        return blob
 
     def append_kv(self, record) -> None:
+        if self._backlog:
+            self._replay_backlog()
         self.client.notify_nowait("st_append_kv", record=record)
 
     def load_kv(self) -> Tuple[Optional[bytes], List, bool]:
         snap, records, had = self.client.call("st_load_kv", _timeout=120)
+        self._maybe_recover()
         return snap, records, had
 
     def compact_kv(self, snapshot: bytes) -> None:
         self.client.call("st_compact_kv", snapshot=snapshot, _timeout=120)
+        # a successful synchronous compact supersedes any lost journal
+        # appends recorded before it — the snapshot carries their state
+        self._backlog.clear()
+        self._dropped = 0
+        self._maybe_recover()
 
     def close(self) -> None:
-        # BLOCKING drain: queued one-way appends must reach the store
-        # before the connection dies (a clean controller shutdown must
-        # not lose journal records)
+        import threading
         import time
 
+        from .rpc import EventLoopThread
+
+        elt = EventLoopThread.get()
+        if threading.current_thread() is elt.thread:
+            # on the io loop: a blocking wait here would deadlock the
+            # very loop that must flush the buffered notifies — replay
+            # the backlog as one-ways, hand the drain to the loop, and
+            # report (a sync last-chance replay is impossible here)
+            backlog, self._backlog = self._backlog, []
+            for method, kwargs in backlog:
+                self.client.notify_nowait(method, **kwargs)
+            if backlog or self._dropped:
+                print(f"[storage] WARNING: closing with "
+                      f"{len(backlog) + self._dropped} journal/meta "
+                      "records in async best-effort replay; a failover "
+                      "may replay stale state", flush=True)
+            self.client.close_when_drained(timeout=5.0)
+            return
         deadline = time.time() + 5.0
         while (getattr(self.client, "_inflight_notifies", 0) > 0
                and time.time() < deadline):
             time.sleep(0.01)
+        # last chance for recorded losses: synchronous, so a clean
+        # shutdown either persists them or reports exactly what it lost
+        for method, kwargs in self._backlog:
+            try:
+                self.client.call(method, _timeout=5, **kwargs)
+            except Exception:
+                self._dropped += 1
+        if self._dropped:
+            print(f"[storage] WARNING: {self._dropped} journal/meta "
+                  "records could not be persisted to the store server; "
+                  "a failover will replay stale state", flush=True)
+        self._backlog = []
         self.client.close()
 
 

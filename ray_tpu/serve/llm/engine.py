@@ -191,10 +191,12 @@ class LLMEngine:
 
         cfg_m = self.model_cfg
         L = cfg_m.num_layers
-        shape = (L, config.num_pages, config.page_size,
-                 cfg_m.num_kv_heads, cfg_m.head_dim_)
-        self.k_pages = jnp.zeros(shape, dtype)
-        self.v_pages = jnp.zeros(shape, dtype)
+        # page-major combined layout [L, P, Hkv, page, 2*D]: one decode
+        # DMA per page moves K and V for every head together; the Hkv
+        # axis remains the tensor-parallel shard (ops/paged_attention.py)
+        shape = (L, config.num_pages, cfg_m.num_kv_heads,
+                 config.page_size, 2 * cfg_m.head_dim_)
+        self.kv_pages = jnp.zeros(shape, dtype)
         self.max_pages_per_seq = config.max_model_len // config.page_size
         # device-resident last-sampled-token per slot: the decode chain's
         # carry (design rule 2 in the module docstring)
@@ -221,12 +223,10 @@ class LLMEngine:
         # dispatch) — one expression, used by dispatch, split and warmup
         self._wave_rb: int = (config.prefill_wave_size
                               or max(1, config.max_batch // 2))
-        # decode block-table width buckets: TWO compile shapes (half and
-        # full model length) — short sequences (the common case) skip
-        # half the attention gather, and warmup stays two decode
-        # compiles, not a compile per power of two
-        mp = self.max_pages_per_seq
-        self._mp_buckets = sorted({max(1, mp // 2), mp})
+        # decode runs ONE compile shape: the full-width block table. The
+        # Pallas decode kernel walks only the pages a sequence actually
+        # uses, so block-table width no longer costs compute (the round-3
+        # mp buckets existed to shrink the gather; the gather is gone)
         # slots: fixed decode row assignment while a request is RUNNING
         self._free_slots: List[int] = list(range(config.max_batch))
         self._slot_req: Dict[int, Request] = {}
@@ -365,12 +365,6 @@ class LLMEngine:
 
     # ---------------------------------------------------------- compute
 
-    def _mp_bucket(self, n: int) -> int:
-        for b in self._mp_buckets:
-            if n <= b:
-                return b
-        return self.max_pages_per_seq
-
     def _jit(self, kind: str, shape_key: tuple):
         """Build (once per bucketed shape) the jitted prefill/decode fns."""
         import jax
@@ -386,15 +380,22 @@ class LLMEngine:
         L = self.model_cfg.num_layers
 
         if kind == "prefill":
-            def run_prefill(params, k_pages, v_pages, block_tables,
+            # ctx_pages buckets to {0, full}: a fresh-prompt wave (the
+            # common case) compiles with NO prefix part — zero page
+            # gathers — while any wave containing a prefix-cache hit uses
+            # the full-width variant (two shapes per length bucket)
+            cp = shape_key[2]
+
+            def run_prefill(params, kv_pages, block_tables,
                             total_lens, input_ids, positions, gather_idx,
                             temperature, top_k, rng_keys):
                 pc = PagedCache(
-                    k_pages=k_pages, v_pages=v_pages,
+                    kv_pages=kv_pages,
                     block_tables=jnp.broadcast_to(
                         block_tables, (L,) + block_tables.shape),
                     total_lens=jnp.broadcast_to(total_lens,
-                                                (L,) + total_lens.shape))
+                                                (L,) + total_lens.shape),
+                    ctx_pages=cp)
                 logits, new_pc = model.apply({"params": params}, input_ids,
                                              positions=positions,
                                              kv_caches=pc)
@@ -404,16 +405,16 @@ class LLMEngine:
                 b = logits.shape[0]
                 rows = logits[jnp.arange(b), gather_idx].astype(jnp.float32)
                 tokens = _device_sample(rows, temperature, top_k, rng_keys)
-                return tokens, new_pc.k_pages, new_pc.v_pages
+                return tokens, new_pc.kv_pages
 
-            fn = jax.jit(run_prefill, donate_argnums=(1, 2))
+            fn = jax.jit(run_prefill, donate_argnums=(1,))
             self._jit_cache[key] = fn
             return fn
 
         # decode: fixed slot-set [S] batch, K fused steps, device-carry ids
         n_steps = shape_key[0]
 
-        def run_decode(params, k_pages, v_pages, slot_ids, block_tables,
+        def run_decode(params, kv_pages, slot_ids, block_tables,
                        total_lens, caps, positions, override_mask,
                        override_ids, temperature, top_k, keys_steps):
             bt_b = jnp.broadcast_to(block_tables,
@@ -423,9 +424,9 @@ class LLMEngine:
                              slot_ids)
 
             def body(carry, keys_k):
-                ids, pos, kp, vp, tot = carry
+                ids, pos, kvp, tot = carry
                 pc = PagedCache(
-                    k_pages=kp, v_pages=vp, block_tables=bt_b,
+                    kv_pages=kvp, block_tables=bt_b,
                     total_lens=jnp.broadcast_to(tot, (L,) + tot.shape))
                 logits, new_pc = model.apply(
                     {"params": params}, ids, positions=pos,
@@ -444,19 +445,19 @@ class LLMEngine:
                                     tot)
                 new_pos = jnp.minimum(pos + 1, caps[:, None] - 1)
                 return ((toks[:, None].astype(jnp.int32), new_pos,
-                         new_pc.k_pages, new_pc.v_pages, new_tot),
+                         new_pc.kv_pages, new_tot),
                         toks)
 
-            carry = (ids0, positions, k_pages, v_pages, total_lens)
-            (last_ids, _, kp, vp, _), toks = jax.lax.scan(
+            carry = (ids0, positions, kv_pages, total_lens)
+            (last_ids, _, kvp, _), toks = jax.lax.scan(
                 body, carry, keys_steps, length=n_steps)
             # carry the last sampled token forward for ACTIVE slots only:
             # dead rows keep their (irrelevant) values instead of being
             # scribbled with garbage samples
             new_slot_ids = jnp.where(active[:, None], last_ids, slot_ids)
-            return toks, new_slot_ids, kp, vp
+            return toks, new_slot_ids, kvp
 
-        fn = jax.jit(run_decode, donate_argnums=(1, 2, 3))
+        fn = jax.jit(run_decode, donate_argnums=(1, 2))
         self._jit_cache[key] = fn
         return fn
 
@@ -505,10 +506,12 @@ class LLMEngine:
             bt[i, :len(req.pages)] = req.pages
             total[i] = len(req.prompt_ids)
             gather[i] = n_new - 1
-        fn = self._jit("prefill", (sb, rb))
+        cp = (self.max_pages_per_seq
+              if any(req.n_cached for req in group) else 0)
+        fn = self._jit("prefill", (sb, rb, cp))
         temp, topk, keys = self._sampling_arrays(group, rb)
-        tokens, self.k_pages, self.v_pages = fn(
-            self.params, self.k_pages, self.v_pages, jnp.asarray(bt),
+        tokens, self.kv_pages = fn(
+            self.params, self.kv_pages, jnp.asarray(bt),
             jnp.asarray(total), jnp.asarray(ids), jnp.asarray(positions),
             jnp.asarray(gather), temp, topk, keys)
         try:
@@ -581,11 +584,9 @@ class LLMEngine:
         if not elig:
             return False
 
-        # kv-length bucket: the attention gather costs O(block-table
-        # width); sizing it to the batch's actual page usage (bucketed
-        # so shapes stay compiled) instead of max_model_len's worst case
-        # trims decode compute for typical short sequences
-        mp = self._mp_bucket(max(len(r.pages) for r in elig))
+        # full-width block table, single compile shape: the decode kernel
+        # streams only the pages covered by total_lens, so width is free
+        mp = self.max_pages_per_seq
         bt = np.zeros((S, mp), np.int32)
         total = np.zeros((S,), np.int32)
         caps = np.ones((S,), np.int32)
@@ -617,8 +618,8 @@ class LLMEngine:
         for req in elig:
             req.planned_out += k_steps
         fn = self._jit("decode", (k_steps, mp))
-        toks, self.slot_ids, self.k_pages, self.v_pages = fn(
-            self.params, self.k_pages, self.v_pages, self.slot_ids,
+        toks, self.slot_ids, self.kv_pages = fn(
+            self.params, self.kv_pages, self.slot_ids,
             jnp.asarray(bt), jnp.asarray(total), jnp.asarray(caps),
             jnp.asarray(positions), jnp.asarray(override_mask),
             jnp.asarray(override_ids), temp, topk,
@@ -794,8 +795,9 @@ class LLMEngine:
     def _gather_kv(self, req: Request) -> Dict[str, Any]:
         idx = np.asarray(req.pages, np.int32)
         return {
-            "k": np.asarray(self.k_pages[:, idx]),
-            "v": np.asarray(self.v_pages[:, idx]),
+            # [L, n_pages, Hkv, page, 2*D] — page axis 1 in the combined
+            # page-major layout; both disagg engines must agree on it
+            "kv": np.asarray(self.kv_pages[:, idx]),
             "prompt_ids": list(req.prompt_ids),
             "output_ids": list(req.output_ids),
         }
@@ -865,7 +867,7 @@ class LLMEngine:
             if not self._free_slots:
                 return False
             request_id, handoff, sampling = self._injections[0]
-            n = handoff["k"].shape[1]
+            n = handoff["kv"].shape[1]
             if self.allocator.num_free() < n:
                 return False
             self._injections.pop(0)
@@ -874,10 +876,8 @@ class LLMEngine:
         self._drain_pipeline(deltas)
         pages = self.allocator.allocate(n)
         idx = jnp.asarray(np.asarray(pages, np.int32))
-        self.k_pages = self.k_pages.at[:, idx].set(
-            jnp.asarray(handoff["k"], self.k_pages.dtype))
-        self.v_pages = self.v_pages.at[:, idx].set(
-            jnp.asarray(handoff["v"], self.v_pages.dtype))
+        self.kv_pages = self.kv_pages.at[:, idx].set(
+            jnp.asarray(handoff["kv"], self.kv_pages.dtype))
         req = Request(request_id, list(handoff["prompt_ids"]), sampling)
         req.output_ids = list(handoff["output_ids"])
         req.pages = pages
@@ -921,10 +921,12 @@ class LLMEngine:
         n = 0
         if prompt_buckets is None:
             prompt_buckets = self.config.prefill_buckets
-        for sb in prompt_buckets:
-            fn = self._jit("prefill", (sb, rb))
-            toks, self.k_pages, self.v_pages = fn(
-                self.params, self.k_pages, self.v_pages,
+        from itertools import product
+
+        for sb, cp in product(prompt_buckets, (0, self.max_pages_per_seq)):
+            fn = self._jit("prefill", (sb, rb, cp))
+            toks, self.kv_pages = fn(
+                self.params, self.kv_pages,
                 jnp.asarray(np.zeros((rb, self.max_pages_per_seq),
                                      np.int32)),
                 jnp.asarray(np.zeros((rb,), np.int32)),
@@ -937,10 +939,10 @@ class LLMEngine:
             n += 1
         if not include_decode:
             return n
-        for mp in self._mp_buckets:
+        for mp in (self.max_pages_per_seq,):
             fn = self._jit("decode", (k_steps, mp))
-            toks, self.slot_ids, self.k_pages, self.v_pages = fn(
-                self.params, self.k_pages, self.v_pages, self.slot_ids,
+            toks, self.slot_ids, self.kv_pages = fn(
+                self.params, self.kv_pages, self.slot_ids,
                 jnp.asarray(np.zeros((S, mp), np.int32)),
                 jnp.asarray(np.zeros((S,), np.int32)),
                 jnp.asarray(np.ones((S,), np.int32)),

@@ -85,6 +85,65 @@ def test_controller_failover_through_store_server(store_server, tmp_path):
         loop.run(c2.stop())
 
 
+def test_tcp_backend_degraded_detect_and_replay(tmp_path):
+    """A store-server outage mid-run must not silently drop journal
+    records: the backend flips `degraded`, buffers the lost sends, and
+    replays them (in order) once the server is back (ADVICE r3:
+    storage.py notify failures were swallowed)."""
+    import socket
+
+    from ray_tpu.runtime.storage import backend_for
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    def spawn():
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.runtime.storage",
+             "--dir", str(tmp_path / "store"), "--port", str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env={**os.environ, "PYTHONPATH": os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))})
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if "store server on" in proc.stdout.readline():
+                return proc
+        raise AssertionError("store server never came up")
+
+    proc = spawn()
+    be = backend_for(f"tcp:127.0.0.1:{port}")
+    try:
+        be.append_kv(("put", "a"))
+        # the synchronous read also proves the request frame did not
+        # overtake the coalesced one-way append (rpc FIFO, ADVICE r3)
+        assert be.load_kv()[1] == [("put", "a")]
+        proc.terminate()
+        proc.wait(timeout=15)
+
+        be.append_kv(("put", "b"))  # lands on the backlog, async
+        # the failure surfaces only after the client's connect-retry
+        # window (rpc_connect_timeout_s = 10s) expires
+        deadline = time.monotonic() + 30
+        while not be.degraded and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert be.degraded and be._backlog, (be.degraded, be._backlog)
+
+        proc = spawn()
+        be.append_kv(("put", "c"))  # replays the backlog first
+        deadline = time.monotonic() + 30
+        while ((be._backlog
+                or getattr(be.client, "_inflight_notifies", 0) > 0)
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        _, records, _ = be.load_kv()
+        assert records == [("put", "a"), ("put", "b"), ("put", "c")], records
+    finally:
+        be.close()
+        proc.terminate()
+        proc.wait(timeout=15)
+
+
 def test_file_backend_round_trip(tmp_path):
     """The default (local-dir) persistence path still round-trips
     through the backend abstraction."""

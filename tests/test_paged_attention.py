@@ -1,0 +1,163 @@
+"""Paged-attention op parity tests (CPU; the Pallas decode kernel runs in
+interpreter mode). The jnp gather path `paged_attention_reference` is the
+oracle: it is itself checked against dense attention, then the decode
+kernel and the lse-merged prefill path are checked against it.
+
+The reference framework ships no attention kernels (it delegates to vLLM,
+ref: llm/_internal/serve/deployments/llm/vllm/vllm_engine.py:181); the
+coverage model here is the one its engine inherits from vLLM's own kernel
+parity suites.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.ops.attention import reference_attention  # noqa: E402
+from ray_tpu.ops.paged_attention import (  # noqa: E402
+    gather_kv, make_kv_pages, merge_attention, paged_attention_decode,
+    paged_attention_reference, paged_prefill_attention, paged_write)
+
+
+def _make_pages(rng, *, b, hkv, d, page, num_pages, mp, lengths):
+    """Page pool + per-row block tables holding `lengths` real tokens
+    (written via paged_write), plus the dense [B, Smax, Hkv, D] K/V they
+    encode for oracle computation."""
+    kv_pages = make_kv_pages(hkv, num_pages, page, d, jnp.float32)
+    # distinct pages per row, page 0 reserved as the null page
+    perm = rng.permutation(num_pages - 1)[: b * mp] + 1
+    bt = jnp.asarray(perm.reshape(b, mp), jnp.int32)
+    smax = mp * page
+    k_dense = jnp.asarray(rng.standard_normal((b, smax, hkv, d)),
+                          jnp.float32)
+    v_dense = jnp.asarray(rng.standard_normal((b, smax, hkv, d)),
+                          jnp.float32)
+    positions = jnp.broadcast_to(jnp.arange(smax), (b, smax))
+    lens = jnp.asarray(lengths, jnp.int32)
+    kv_pages = paged_write(kv_pages, k_dense, v_dense, bt, positions, lens)
+    return kv_pages, bt, k_dense, v_dense, lens
+
+
+def test_write_then_gather_roundtrip():
+    rng = np.random.default_rng(0)
+    b, hkv, d, page, mp = 3, 2, 8, 4, 5
+    lengths = [17, 0, 20]
+    kv_pages, bt, k_dense, v_dense, lens = _make_pages(
+        rng, b=b, hkv=hkv, d=d, page=page, num_pages=32, mp=mp,
+        lengths=lengths)
+    got_k, got_v = gather_kv(kv_pages, bt)
+    for i, n in enumerate(lengths):
+        np.testing.assert_allclose(got_k[i, :n], k_dense[i, :n], rtol=1e-6)
+        np.testing.assert_allclose(got_v[i, :n], v_dense[i, :n], rtol=1e-6)
+        # beyond the row's length nothing was written
+        assert not np.any(np.asarray(got_k[i, n:]))
+
+
+def test_reference_matches_dense_attention():
+    rng = np.random.default_rng(1)
+    b, hq, hkv, d, page, mp = 2, 4, 2, 16, 4, 4
+    n = mp * page
+    kv_pages, bt, k_dense, v_dense, lens = _make_pages(
+        rng, b=b, hkv=hkv, d=d, page=page, num_pages=32, mp=mp,
+        lengths=[n, n])
+    q = jnp.asarray(rng.standard_normal((b, n, hq, d)), jnp.float32)
+    positions = jnp.broadcast_to(jnp.arange(n), (b, n))
+    got = paged_attention_reference(q, kv_pages, bt, positions)
+    want = reference_attention(q, k_dense, v_dense, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("hq,hkv", [(8, 2), (4, 4)])
+@pytest.mark.parametrize("pages_per_chunk", [1, 3, 8])
+def test_decode_kernel_matches_reference(hq, hkv, pages_per_chunk):
+    rng = np.random.default_rng(2)
+    b, d, page, mp = 4, 32, 4, 8
+    lengths = [1, 13, 0, mp * page]  # incl. inactive + full rows
+    kv_pages, bt, _, _, lens = _make_pages(
+        rng, b=b, hkv=hkv, d=d, page=page, num_pages=64, mp=mp,
+        lengths=lengths)
+    q = jnp.asarray(rng.standard_normal((b, hq, d)), jnp.float32)
+    got = paged_attention_decode(q, kv_pages, bt, lens,
+                                 pages_per_chunk=pages_per_chunk,
+                                 interpret=True)
+    positions = jnp.maximum(lens - 1, 0)[:, None]
+    want = paged_attention_reference(q[:, None], kv_pages, bt,
+                                     positions)[:, 0]
+    got, want = np.asarray(got), np.asarray(want)
+    for i, n in enumerate(lengths):
+        if n == 0:
+            np.testing.assert_array_equal(got[i], 0.0)
+        else:
+            np.testing.assert_allclose(got[i], want[i], rtol=2e-5,
+                                       atol=2e-5)
+
+
+def test_decode_kernel_bf16():
+    rng = np.random.default_rng(3)
+    b, hq, hkv, d, page, mp = 2, 4, 2, 16, 8, 4
+    kv_pages = jnp.asarray(
+        rng.standard_normal((16, hkv, page, 2 * d)), jnp.bfloat16)
+    bt = jnp.asarray(rng.permutation(15)[: b * mp].reshape(b, mp) + 1,
+                     jnp.int32)
+    lens = jnp.asarray([9, 26], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((b, hq, d)), jnp.bfloat16)
+    got = paged_attention_decode(q, kv_pages, bt, lens, interpret=True)
+    want = paged_attention_reference(
+        q[:, None], kv_pages, bt,
+        jnp.maximum(lens - 1, 0)[:, None])[:, 0]
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=5e-2, atol=5e-2)
+
+
+@pytest.mark.parametrize("impl", [None, "flash"])
+@pytest.mark.parametrize("ctx_lens", [(0, 0), (8, 0), (8, 16)])
+def test_prefill_merge_matches_reference(ctx_lens, impl):
+    """New tokens starting at a (page-aligned) cached-prefix offset must
+    attend prefix + themselves exactly like the one-shot gather path."""
+    rng = np.random.default_rng(4)
+    b, hq, hkv, d, page, mp = 2, 4, 2, 16, 8, 6
+    s_new = 12
+    lengths = [c + s_new for c in ctx_lens]
+    kv_pages, bt, k_dense, v_dense, lens = _make_pages(
+        rng, b=b, hkv=hkv, d=d, page=page, num_pages=32, mp=mp,
+        lengths=lengths)
+    positions = jnp.stack([jnp.arange(c, c + s_new) for c in ctx_lens])
+    q = jnp.asarray(rng.standard_normal((b, s_new, hq, d)), jnp.float32)
+    k_new = jnp.stack([k_dense[i, c:c + s_new] for i, c in
+                       enumerate(ctx_lens)])
+    v_new = jnp.stack([v_dense[i, c:c + s_new] for i, c in
+                       enumerate(ctx_lens)])
+    got = paged_prefill_attention(q, k_new, v_new, kv_pages, bt,
+                                  positions, lens, ctx_pages=mp, impl=impl)
+    want = paged_attention_reference(q, kv_pages, bt, positions)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    if max(ctx_lens) == 0:
+        # ctx_pages=0 must also work (and read no pages)
+        got0 = paged_prefill_attention(q, k_new, v_new, kv_pages, bt,
+                                       positions, lens, ctx_pages=0,
+                                       impl=impl)
+        np.testing.assert_allclose(np.asarray(got0), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_merge_attention_equals_joint_softmax():
+    rng = np.random.default_rng(5)
+    b, s, h, d = 2, 4, 3, 8
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, 10, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, 10, h, d)), jnp.float32)
+    from ray_tpu.ops.paged_attention import _attn_lse
+
+    o1, l1 = _attn_lse(q, k[:, :6], v[:, :6], causal=False,
+                       segment_ids=None, scale=d ** -0.5, impl="flash")
+    o2, l2 = _attn_lse(q, k[:, 6:], v[:, 6:], causal=False,
+                       segment_ids=None, scale=d ** -0.5, impl="flash")
+    got = merge_attention(o1, l1, o2, l2)
+    want = reference_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
