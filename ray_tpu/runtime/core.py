@@ -302,18 +302,28 @@ class CoreWorker:
         seconds (the node-metrics-agent channel; ref: stats/metric.h
         exporter → metrics agent). Keyed by worker so per-process series
         stay distinct in `cluster_metrics()`."""
+        import random
+
         from ..util import metrics as metrics_mod
 
+        last = None
         while not self._shutting_down:
-            await asyncio.sleep(5.0)
+            # jittered period, and ONLY on change: thousands of idle
+            # actor workers each reporting an unchanged snapshot every
+            # 5s adds O(workers) constant RPC load on the controller —
+            # enough to visibly slow everything else on a small head
+            await asyncio.sleep(5.0 + random.uniform(0.0, 2.0))
             snap = metrics_mod.snapshot()
-            if not snap:
+            if not snap or snap == last:
                 continue
             try:
                 await self.controller.call_async(
                     "report_metrics",
                     node_id=f"{self.node_id}/{self.worker_id.hex()[:8]}",
                     metrics=snap)
+                # only a DELIVERED snapshot suppresses the resend — a
+                # failed report retries on the next tick
+                last = snap
             except Exception:
                 pass
 

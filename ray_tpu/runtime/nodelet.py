@@ -357,12 +357,30 @@ class Nodelet:
 
     async def _reap_loop(self):
         """Detect dead worker processes and idle-timeout extras (ref:
-        worker_pool.cc idle worker killing; node_manager.cc worker failure)."""
+        worker_pool.cc idle worker killing; node_manager.cc worker failure).
+
+        Liveness probes rotate over a bounded slice per tick: a full scan
+        is one /proc read per worker, and at many-actors scale (2,000+
+        worker processes) an every-200ms full sweep monopolizes the event
+        loop that dispatch runs on. The slice keeps the sweep period
+        ~2s regardless of worker count; RPC disconnects catch most
+        deaths immediately anyway."""
         cfg = get_config()
+        rotor = 0
         while True:
             await asyncio.sleep(0.2)
             now = time.monotonic()
-            for w in list(self.workers.values()):
+            workers = list(self.workers.values())
+            n = len(workers)
+            if n:
+                span = max(64, -(-n // 10))  # full sweep every <=10 ticks
+                sl = [workers[(rotor + i) % n] for i in range(min(span, n))]
+                rotor = (rotor + span) % n
+            else:
+                sl = []
+            for w in sl:
+                if w.worker_id not in self.workers:
+                    continue
                 if (w.proc is not None and w.proc.poll() is not None) or \
                         (w.proc is None and w.pid > 0
                          and not _pid_alive(w.pid, w.start_time)):
@@ -401,12 +419,22 @@ class Nodelet:
         advanced past what was actually published."""
         offsets: Dict[str, int] = {}
         log_dir = os.path.join(self.session_dir, "logs")
+        rotor = 0
         while True:
             await asyncio.sleep(0.5)
             batch = []
             # only workers this nodelet started — session dirs are shared
-            # by every nodelet of a (multi-node-on-one-box) session
-            for prefix in list(self._log_owned):
+            # by every nodelet of a (multi-node-on-one-box) session.
+            # Rotate a bounded slice per tick: stat()ing thousands of log
+            # files every 500ms starves the dispatch loop at
+            # many-actors scale
+            owned = list(self._log_owned)
+            if len(owned) > 256:
+                sl = [owned[(rotor + i) % len(owned)] for i in range(256)]
+                rotor = (rotor + 256) % len(owned)
+            else:
+                sl = owned
+            for prefix in sl:
                 path = os.path.join(log_dir, f"worker-{prefix}.log")
                 try:
                     size = os.path.getsize(path)
@@ -506,8 +534,24 @@ class Nodelet:
             self._dispatch()
 
     # ------------------------------------------------------------ worker pool
+    @staticmethod
+    def _spawn_warm(spec: Optional[dict]) -> bool:
+        """Which factory tier a worker for `spec` forks from: zero-
+        resource, env-less workers (control-plane actors — queues,
+        counters, coordinators, the many-actors pattern) take the SLIM
+        tier, whose forks cost a fraction of the jax-preloaded image's;
+        anything with a real resource request or runtime_env gets the
+        warm tier. A wrong slim guess still works — the lazy preload
+        hook imports jax on first use (worker_factory.py)."""
+        if spec is None:
+            return True
+        if spec.get("runtime_env"):
+            return True
+        res = spec.get("resources") or {}
+        return any(v for v in res.values())
+
     def _start_worker(self, force: bool = False, runtime_env: dict = None,
-                      env_key: str = ""):
+                      env_key: str = "", warm: bool = True):
         # the pool cap applies to TASK workers only: actor workers are
         # explicit user-created processes (force-started, resource-bounded)
         # and must not wedge task scheduling by filling the cap
@@ -530,16 +574,32 @@ class Nodelet:
         try:
             loop = asyncio.get_running_loop()
             loop.run_in_executor(None, self._spawn_worker_proc, ws,
-                                 worker_id, runtime_env)
+                                 worker_id, runtime_env, warm)
         except RuntimeError:
-            self._spawn_worker_proc(ws, worker_id, runtime_env)
+            self._spawn_worker_proc(ws, worker_id, runtime_env, warm)
 
     def _start_factory(self):
-        """Launch the prefork worker factory (pays the python+jax import
-        cost once; forks workers in ~10ms; ref: worker_pool.cc prestart)."""
+        """Launch the prefork worker factory (pays the python import cost
+        once; forks workers in ~10ms; ref: worker_pool.cc prestart).
+
+        When the host preloads jax into every interpreter via a
+        PYTHONPATH sitecustomize hook, the factory is launched WITHOUT
+        that hook: a slim (~26 MB) factory forks trivial workers at a
+        fraction of the jax-preloaded image's cost, and the factory's
+        warm tier restores the preload for workers that need it (see
+        worker_factory.py tiers)."""
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
         out = open(os.path.join(log_dir, "worker-factory.log"), "ab")
+        env = dict(os.environ)
+        from .worker_factory import preload_dirs
+
+        pp = env.get("PYTHONPATH", "")
+        hooks = preload_dirs(pp)
+        if hooks:
+            env["PYTHONPATH"] = os.pathsep.join(
+                d for d in pp.split(os.pathsep) if d and d not in hooks)
+            env["RTPU_ORIG_PYTHONPATH"] = pp
         self._factory_proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.runtime.worker_factory",
              "--listen", self._factory_path,
@@ -548,10 +608,11 @@ class Nodelet:
              "--node-id", self.node_id,
              "--nodelet-addr", self.address,
              "--controller-addr", self.controller_addr],
-            stdout=out, stderr=subprocess.STDOUT)
+            stdout=out, stderr=subprocess.STDOUT, env=env)
 
     def _fork_from_factory(self, worker_id: str,
-                           runtime_env: dict = None) -> tuple:
+                           runtime_env: dict = None,
+                           warm: bool = True) -> tuple:
         """Ask the factory for a forked worker; returns (pid,
         /proc start time captured by the factory right after fork).
 
@@ -580,8 +641,8 @@ class Nodelet:
         try:  # phase 2: exactly-once request
             sock.settimeout(60.0)  # covers the factory's warm import
             sock.sendall((json.dumps(
-                {"worker_id": worker_id,
-                 "runtime_env": runtime_env}) + "\n").encode())
+                {"worker_id": worker_id, "runtime_env": runtime_env,
+                 "warm": warm}) + "\n").encode())
             data = b""
             while not data.endswith(b"\n"):
                 chunk = sock.recv(4096)
@@ -589,6 +650,13 @@ class Nodelet:
                     raise _SpawnAmbiguous("factory closed mid-request")
                 data += chunk
             reply = json.loads(data)
+            if "pid" not in reply:
+                if reply.get("ambiguous"):
+                    # the generation died mid-request: the worker may or
+                    # may not exist — cold-starting would risk a
+                    # duplicate worker_id
+                    raise _SpawnAmbiguous(str(reply.get("error")))
+                raise OSError(f"factory error: {reply.get('error')}")
             return reply["pid"], reply.get("start_time")
         except _SpawnAmbiguous:
             raise
@@ -605,7 +673,7 @@ class Nodelet:
             0, self.starting_by_key.get(env_key, 0) - 1)
 
     def _spawn_worker_proc(self, ws: WorkerState, worker_id: str,
-                           runtime_env: dict = None):
+                           runtime_env: dict = None, warm: bool = True):
         try:
             try:
                 from .runtime_env import needs_cold_start
@@ -618,7 +686,7 @@ class Nodelet:
                     # envs bring their OWN interpreter.
                     raise OSError("isolated env requires cold start")
                 pid, start = self._fork_from_factory(worker_id,
-                                                     runtime_env)
+                                                     runtime_env, warm)
                 ws.set_pid(pid, start)
                 return
             except _SpawnAmbiguous:
@@ -1013,7 +1081,8 @@ class Nodelet:
                     len(self.pending_actor_leases):
                 self._start_worker(force=True,
                                    runtime_env=head.get("runtime_env"),
-                                   env_key=head_key)
+                                   env_key=head_key,
+                                   warm=self._spawn_warm(head))
 
     def _request_worker(self, key: str, spec: dict, demand: int):
         """Start a worker for this env pool if the demand warrants it;
@@ -1037,7 +1106,7 @@ class Nodelet:
             else:
                 return  # every slot is busy: wait for a finish
         self._start_worker(runtime_env=spec.get("runtime_env"),
-                           env_key=key)
+                           env_key=key, warm=self._spawn_warm(spec))
 
     async def _push_to_worker(self, ws: WorkerState, spec: dict):
         try:

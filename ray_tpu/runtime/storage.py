@@ -206,6 +206,11 @@ class TCPBackend(StoreBackend):
 
         from .rpc import EventLoopThread
 
+        # the drain window must exceed the RPC layer's connect-retry
+        # window (rpc_connect_timeout_s, 10s): an inflight notify still
+        # retrying its connection at the deadline is neither delivered
+        # nor yet on the backlog — it would be lost UNCOUNTED
+        drain_s = 12.0
         elt = EventLoopThread.get()
         if threading.current_thread() is elt.thread:
             # on the io loop: a blocking wait here would deadlock the
@@ -220,9 +225,9 @@ class TCPBackend(StoreBackend):
                       f"{len(backlog) + self._dropped} journal/meta "
                       "records in async best-effort replay; a failover "
                       "may replay stale state", flush=True)
-            self.client.close_when_drained(timeout=5.0)
+            self.client.close_when_drained(timeout=drain_s)
             return
-        deadline = time.time() + 5.0
+        deadline = time.time() + drain_s
         while (getattr(self.client, "_inflight_notifies", 0) > 0
                and time.time() < deadline):
             time.sleep(0.01)
@@ -233,10 +238,12 @@ class TCPBackend(StoreBackend):
                 self.client.call(method, _timeout=5, **kwargs)
             except Exception:
                 self._dropped += 1
-        if self._dropped:
+        still_inflight = getattr(self.client, "_inflight_notifies", 0)
+        if self._dropped or still_inflight:
             print(f"[storage] WARNING: {self._dropped} journal/meta "
-                  "records could not be persisted to the store server; "
-                  "a failover will replay stale state", flush=True)
+                  f"records could not be persisted ({still_inflight} "
+                  "more still in flight at close); a failover may "
+                  "replay stale state", flush=True)
         self._backlog = []
         self.client.close()
 

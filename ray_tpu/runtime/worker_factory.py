@@ -6,7 +6,27 @@ src/ray/raylet/worker_pool.cc — idle pools, prestart). On TPU hosts the
 problem is worse: site initialization imports jax (seconds of CPU), so a
 cold `python -m ray_tpu.runtime.worker` is ~100x more expensive than the
 task it will run. The factory pays that import cost once, then `fork()`s
-ready-to-run workers in ~10ms on demand.
+ready-to-run workers on demand.
+
+Three scale mechanisms sit between the accept loop and fork():
+
+- **Slim / warm tiers**: fork() cost is proportional to the parent's
+  resident image, and a jax-preloaded python is ~170 MB — measured
+  15-40 ms per fork once hundreds of forked copies are alive. When the
+  host preloads jax via a PYTHONPATH sitecustomize hook, the nodelet
+  launches the factory WITHOUT that hook (~26 MB image) and trivial
+  zero-resource workers fork from it at a fraction of the cost; workers
+  that plausibly need jax (any real resource request or runtime_env)
+  fork from a WARM generation that restored the preload. Slim children
+  install a lazy import hook so an unexpected `import jax` still works —
+  it just pays the import then.
+- **Spare pools**: children are forked AHEAD and parked on a pipe;
+  handing a request to one is a pipe write (~us). The refill runs only
+  while no request is waiting, keeping fork latency off the spawn
+  critical path during creation bursts.
+- **Generations**: the process that actually forks workers is a child
+  rotated out every `RTPU_FACTORY_GEN_SIZE` spawns (a fresh generation
+  is itself a fork — no re-import), bounding per-parent fork-aging.
 
 Single-threaded by construction (plain blocking sockets, no asyncio, no
 locks) so forked children never inherit a lock held by another thread.
@@ -24,6 +44,78 @@ import socket
 import sys
 
 
+def preload_dirs(pythonpath: str):
+    """PYTHONPATH entries carrying a sitecustomize.py (host preload
+    hooks; e.g. TPU images preload jax this way)."""
+    out = []
+    for d in (pythonpath or "").split(os.pathsep):
+        if d and os.path.exists(os.path.join(d, "sitecustomize.py")):
+            out.append(d)
+    return out
+
+
+def _restore_preload() -> None:
+    """Run the host's stripped sitecustomize preload now (warm tier)."""
+    orig = os.environ.get("RTPU_ORIG_PYTHONPATH")
+    if not orig:
+        return
+    os.environ["PYTHONPATH"] = orig
+    dirs = preload_dirs(orig)
+    if not dirs or "sitecustomize" in sys.modules:
+        return
+    sys.path[:0] = dirs
+    try:
+        import sitecustomize  # noqa: F401 — the preload itself
+    except Exception:
+        pass
+
+
+def _install_lazy_preload() -> None:
+    """Slim tier: arrange for the host preload (and PYTHONPATH) to be
+    restored the first time jax/jaxlib is imported, so user code that
+    unexpectedly needs jax works — it just pays the import cost then."""
+    orig = os.environ.get("RTPU_ORIG_PYTHONPATH")
+    if not orig or "jax" in sys.modules:
+        return
+    os.environ["PYTHONPATH"] = orig  # subprocesses get the full env
+    import importlib.abc
+    import importlib.util
+
+    class _AliasLoader(importlib.abc.Loader):
+        """Hands back an ALREADY-imported module: after the preload has
+        imported `name`, returning None from find_spec would make the
+        import machinery execute the module top-level a second time
+        into a fresh module object (orphaning everything the first
+        execution registered)."""
+
+        def __init__(self, mod):
+            self._mod = mod
+
+        def create_module(self, spec):
+            return self._mod
+
+        def exec_module(self, module):
+            pass
+
+    class _LazyPreload(importlib.abc.MetaPathFinder):
+        done = False
+
+        def find_spec(self, name, path=None, target=None):
+            if _LazyPreload.done:
+                return None
+            if name.split(".")[0] not in ("jax", "jaxlib"):
+                return None
+            _LazyPreload.done = True
+            _restore_preload()
+            mod = sys.modules.get(name)
+            if mod is not None:  # the preload imported it: alias it
+                return importlib.util.spec_from_loader(
+                    name, _AliasLoader(mod))
+            return None  # preload absent: normal import machinery
+
+    sys.meta_path.insert(0, _LazyPreload())
+
+
 def _child_main(req: dict, args) -> None:
     os.setsid()
     worker_id = req["worker_id"]
@@ -37,6 +129,8 @@ def _child_main(req: dict, args) -> None:
     signal.signal(signal.SIGCHLD, signal.SIG_DFL)
     signal.signal(signal.SIGTERM, signal.SIG_DFL)
     os.environ["RTPU_WORKER_ID"] = worker_id
+    if "jax" not in sys.modules:
+        _install_lazy_preload()
 
     from .worker import run_worker
 
@@ -45,6 +139,132 @@ def _child_main(req: dict, args) -> None:
                controller_addr=args.controller_addr, worker_id=worker_id,
                runtime_env=req.get("runtime_env"))
     os._exit(0)
+
+
+def _spare_child(r_fd: int, args) -> None:
+    """A pre-forked child parked on its pipe until a spawn request is
+    handed to it (or the pipe closes: factory shutdown/discard)."""
+    data = b""
+    while not data.endswith(b"\n"):
+        chunk = os.read(r_fd, 65536)
+        if not chunk:
+            os._exit(0)
+        data += chunk
+    os.close(r_fd)
+    try:
+        _child_main(json.loads(data), args)
+    except BaseException:
+        import traceback
+
+        traceback.print_exc()
+    finally:
+        os._exit(1)
+
+
+def _read_line(fd: int) -> bytes:
+    data = b""
+    while not data.endswith(b"\n"):
+        chunk = os.read(fd, 65536)
+        if not chunk:
+            return b""
+        data += chunk
+    return data
+
+
+def _write_all(fd: int, data: bytes) -> None:
+    """os.write can return short on sockets/pipes even when blocking; a
+    partial request line would wedge both ends in _read_line forever."""
+    view = memoryview(data)
+    while view:
+        n = os.write(fd, view)
+        view = view[n:]
+
+
+def _generation_main(conn_fd: int, args, preload: bool) -> None:
+    """A generation: receives spawn-request lines on `conn_fd`, forks
+    workers (through a small spare pool), replies with one
+    '{pid, start_time}' line each. Exits on EOF (rotation/shutdown)."""
+    from .procutil import proc_start_time
+
+    import select as select_mod
+
+    if preload:
+        _restore_preload()
+
+    n_spares = int(os.environ.get("RTPU_FACTORY_SPARES", "4"))
+    debug = bool(os.environ.get("RTPU_FACTORY_DEBUG"))
+    spares = []  # (pid, write_fd)
+
+    def make_spare():
+        import time as _t
+        _t0 = _t.perf_counter()
+        r_fd, w_fd = os.pipe()
+        pid = os.fork()
+        if pid == 0:
+            os.close(conn_fd)
+            os.close(w_fd)
+            for _spid, sw in spares:
+                try:
+                    os.close(sw)
+                except OSError:
+                    pass
+            _spare_child(r_fd, args)
+            os._exit(1)  # unreachable
+        os.close(r_fd)
+        if debug:
+            print(f"[factory-gen{'-warm' if preload else ''}] fork "
+                  f"{(_t.perf_counter()-_t0)*1e3:.1f}ms pid={pid}",
+                  file=sys.stderr, flush=True)
+        return pid, w_fd
+
+    def dispense(req: dict):
+        line = (json.dumps(req) + "\n").encode()
+        while spares:
+            pid, w_fd = spares.pop(0)
+            try:
+                start = proc_start_time(pid)
+                _write_all(w_fd, line)
+                os.close(w_fd)
+                if start is None:
+                    continue  # spare died before handoff; next
+                return pid, start
+            except OSError:
+                try:
+                    os.close(w_fd)
+                except OSError:
+                    pass
+                continue
+        pid, w_fd = make_spare()
+        start = proc_start_time(pid)
+        _write_all(w_fd, line)
+        os.close(w_fd)
+        return pid, start
+
+    while True:
+        # refill ONE spare at a time, only while no request is waiting —
+        # forks must stay off the spawn critical path during bursts
+        while len(spares) < n_spares:
+            ready, _, _ = select_mod.select([conn_fd], [], [], 0)
+            if ready:
+                break
+            try:
+                spares.append(make_spare())
+            except OSError:
+                break  # fork pressure: serve with what we have
+        data = _read_line(conn_fd)
+        if not data:
+            for _pid, w_fd in spares:
+                try:
+                    os.close(w_fd)  # parked spares exit on EOF
+                except OSError:
+                    pass
+            os._exit(0)
+        try:
+            pid, start = dispense(json.loads(data))
+            reply = json.dumps({"pid": pid, "start_time": start})
+        except Exception as e:  # noqa: BLE001 — surface to the factory
+            reply = json.dumps({"error": repr(e)})
+        _write_all(conn_fd, (reply + "\n").encode())
 
 
 def serve(args) -> None:
@@ -60,14 +280,58 @@ def serve(args) -> None:
 
     from . import worker as _warm  # noqa: F401
 
+    # numpy is not imported by the runtime tree itself but practically
+    # every task touches it through serialization — a slim child paying
+    # the ~300 ms numpy import per worker would dwarf the fork savings
+    import numpy as _np  # noqa: F401
+
     sock.settimeout(1.0)
     signal.signal(signal.SIGCHLD, signal.SIG_IGN)  # auto-reap workers
     parent = os.getppid()
+    gen_size = int(os.environ.get("RTPU_FACTORY_GEN_SIZE", "200"))
+    # two tiers only when the nodelet actually stripped a preload hook
+    # out of this process's environment; otherwise every spawn is "warm"
+    # by definition and one generation serves all
+    tiers = (("slim", "warm") if os.environ.get("RTPU_ORIG_PYTHONPATH")
+             else ("warm",))
+    gens = {}  # tier -> [fd, spawned]
+
+    def new_generation(tier: str):
+        old = gens.get(tier)
+        if old is not None:
+            try:
+                os.close(old[0])  # old generation exits on EOF
+            except OSError:
+                pass
+        a, b = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+        pid = os.fork()
+        if pid == 0:
+            sock.close()
+            a.close()
+            for other in gens.values():
+                try:
+                    os.close(other[0])
+                except OSError:
+                    pass
+            fd = b.detach()
+            _generation_main(fd, args, preload=(tier == "warm"
+                                                and len(tiers) > 1))
+            os._exit(0)
+        b.close()
+        gens[tier] = [a.detach(), 0]
+
+    for t in tiers:
+        new_generation(t)
     while True:
         try:
             conn, _ = sock.accept()
         except socket.timeout:
             if os.getppid() != parent:
+                for tier in gens:
+                    try:
+                        os.close(gens[tier][0])
+                    except OSError:
+                        pass
                 return  # nodelet died; die with it
             continue
         except OSError:
@@ -83,26 +347,27 @@ def serve(args) -> None:
                 conn.close()
                 continue
             req = json.loads(data)
-            pid = os.fork()
-            if pid == 0:
-                sock.close()
-                conn.close()
-                try:
-                    _child_main(req, args)
-                except BaseException:
-                    import traceback
-
-                    traceback.print_exc()
-                finally:
-                    os._exit(1)
-            # the child's /proc start time, read at the narrowest
-            # possible window after fork: pid + start time is the
-            # identity the nodelet uses to never signal a recycled pid
-            from .procutil import proc_start_time
-
-            conn.sendall((json.dumps(
-                {"pid": pid, "start_time": proc_start_time(pid)})
-                + "\n").encode())
+            tier = ("slim" if not req.get("warm", True)
+                    and "slim" in tiers else "warm")
+            if gens[tier][1] >= gen_size:
+                new_generation(tier)
+            # relay to the generation. NO retry after a write: a
+            # generation that died mid-request may already have forked
+            # the worker, and a resend would duplicate the worker_id —
+            # report the AMBIGUOUS outcome so the nodelet abandons the
+            # id instead of cold-starting a duplicate.
+            try:
+                _write_all(gens[tier][0], data)
+                reply = _read_line(gens[tier][0])
+            except OSError:
+                reply = b""
+            if not reply:
+                new_generation(tier)  # for future requests
+                reply = (json.dumps(
+                    {"error": "generation died mid-request",
+                     "ambiguous": True}) + "\n").encode()
+            gens[tier][1] += 1
+            conn.sendall(reply)
         except Exception:
             import traceback
 
