@@ -137,6 +137,7 @@ class NativePool:
             raise RuntimeError("native library unavailable")
         self._lib = lib
         self._path = path
+        creator = not os.path.exists(path)
         rc = lib.rtpu_pool_create(path.encode(), capacity, nbuckets)
         if rc != 0:
             raise OSError(f"pool create failed: {rc}")
@@ -148,9 +149,37 @@ class NativePool:
         stats = (ctypes.c_uint64 * 4)()
         lib.rtpu_store_stats(self._handle, ctypes.byref(stats))
         self._pool_size = stats[1]
-        arr = (ctypes.c_ubyte * self._pool_size).from_address(
-            ctypes.addressof(base.contents))
+        base_addr = ctypes.addressof(base.contents)
+        arr = (ctypes.c_ubyte * self._pool_size).from_address(base_addr)
         self._mem = memoryview(arr).cast("B")
+        if creator:
+            # creator-only: openers fault their page tables lazily (the
+            # physical pages are already committed), and thousands of
+            # workers must not each sweep the whole range
+            self._prefault_async(base_addr, self._pool_size)
+
+    @staticmethod
+    def _prefault_async(addr: int, size: int) -> None:
+        """Fault the pool's pages in off the critical path. First-touch
+        faults on fresh /dev/shm pages throttle a large put to ~0.8 GB/s
+        (kernel page allocation + zeroing inside the copy loop); a
+        populated pool copies at memcpy speed. MADV_POPULATE_WRITE
+        allocates without altering contents, so re-opening a live pool
+        is safe. Best-effort: older kernels return EINVAL, and the put
+        path works either way."""
+        import threading
+
+        def run():
+            try:
+                libc = ctypes.CDLL(None, use_errno=True)
+                MADV_POPULATE_WRITE = 23
+                libc.madvise(ctypes.c_void_p(addr),
+                             ctypes.c_size_t(size), MADV_POPULATE_WRITE)
+            except Exception:
+                pass
+
+        threading.Thread(target=run, daemon=True,
+                         name="rtpu-pool-prefault").start()
 
     def _key(self, key: bytes) -> bytes:
         assert len(key) == self.KEY_LEN, key

@@ -307,14 +307,19 @@ class CoreWorker:
         from ..util import metrics as metrics_mod
 
         last = None
+        ticks = 0
         while not self._shutting_down:
             # jittered period, and ONLY on change: thousands of idle
             # actor workers each reporting an unchanged snapshot every
             # 5s adds O(workers) constant RPC load on the controller —
-            # enough to visibly slow everything else on a small head
+            # enough to visibly slow everything else on a small head.
+            # A periodic unconditional resend (~5 min) self-heals a
+            # restarted/failed-over controller whose metric tables
+            # started empty while this worker sat idle.
             await asyncio.sleep(5.0 + random.uniform(0.0, 2.0))
+            ticks += 1
             snap = metrics_mod.snapshot()
-            if not snap or snap == last:
+            if not snap or (snap == last and ticks % 60 != 0):
                 continue
             try:
                 await self.controller.call_async(

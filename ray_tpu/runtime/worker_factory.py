@@ -78,6 +78,9 @@ def _install_lazy_preload() -> None:
     if not orig or "jax" in sys.modules:
         return
     os.environ["PYTHONPATH"] = orig  # subprocesses get the full env
+    # non-jax modules living alongside the stripped sitecustomize.py must
+    # stay importable NOW — only the preload EXECUTION is deferred
+    sys.path[:0] = preload_dirs(orig)
     import importlib.abc
     import importlib.util
 
@@ -183,13 +186,21 @@ def _write_all(fd: int, data: bytes) -> None:
 def _generation_main(conn_fd: int, args, preload: bool) -> None:
     """A generation: receives spawn-request lines on `conn_fd`, forks
     workers (through a small spare pool), replies with one
-    '{pid, start_time}' line each. Exits on EOF (rotation/shutdown)."""
+    '{pid, start_time}' line each. Exits on EOF (shutdown).
+
+    Rotation is SELF-replacement: after RTPU_FACTORY_GEN_SIZE dispensed
+    workers the generation forks a successor — which inherits the warm
+    imports, the conn_fd, and the parked spares — and exits. The
+    factory never notices, and a warm generation never re-pays the
+    preload import."""
     from .procutil import proc_start_time
 
     import select as select_mod
 
     if preload:
         _restore_preload()
+    gen_size = int(os.environ.get("RTPU_FACTORY_GEN_SIZE", "200"))
+    dispensed = 0
 
     n_spares = int(os.environ.get("RTPU_FACTORY_SPARES", "4"))
     debug = bool(os.environ.get("RTPU_FACTORY_DEBUG"))
@@ -265,6 +276,14 @@ def _generation_main(conn_fd: int, args, preload: bool) -> None:
         except Exception as e:  # noqa: BLE001 — surface to the factory
             reply = json.dumps({"error": repr(e)})
         _write_all(conn_fd, (reply + "\n").encode())
+        dispensed += 1
+        if dispensed >= gen_size:
+            # self-rotate between requests: fork-aging resets, state
+            # (conn_fd, spares, warm imports) carries over via fork
+            pid = os.fork()
+            if pid > 0:
+                os._exit(0)
+            dispensed = 0
 
 
 def serve(args) -> None:
@@ -288,7 +307,6 @@ def serve(args) -> None:
     sock.settimeout(1.0)
     signal.signal(signal.SIGCHLD, signal.SIG_IGN)  # auto-reap workers
     parent = os.getppid()
-    gen_size = int(os.environ.get("RTPU_FACTORY_GEN_SIZE", "200"))
     # two tiers only when the nodelet actually stripped a preload hook
     # out of this process's environment; otherwise every spawn is "warm"
     # by definition and one generation serves all
@@ -349,9 +367,8 @@ def serve(args) -> None:
             req = json.loads(data)
             tier = ("slim" if not req.get("warm", True)
                     and "slim" in tiers else "warm")
-            if gens[tier][1] >= gen_size:
-                new_generation(tier)
-            # relay to the generation. NO retry after a write: a
+            # relay to the generation (it rotates itself). NO retry
+            # after a write: a
             # generation that died mid-request may already have forked
             # the worker, and a resend would duplicate the worker_id —
             # report the AMBIGUOUS outcome so the nodelet abandons the
