@@ -9,6 +9,12 @@ per-call task submission entirely. Where the reference moves GPU tensors
 over NCCL channels, colocated TPU actors hand off arrays through the same
 shm channels (host round-trip) — cross-chip device-to-device transfer
 rides the mesh inside jit, not the actor dataplane.
+
+Collectives-in-DAG (`allreduce.bind([...])`, collective.py — ref:
+collective_node.py:144) lower onto the same channels with an overlapped
+schedule: contributions are sent at the earliest point and results
+received at the latest, so ops independent of the collective run while
+peers' contributions are in flight (ref: dag_node_operation.py).
 """
 
 from .dag_node import (  # noqa: F401
@@ -17,7 +23,9 @@ from .dag_node import (  # noqa: F401
     InputNode,
     MultiOutputNode,
 )
+from .collective import CollectiveOutputNode, allreduce  # noqa: F401
 from .compiled_dag import CompiledDAG, CompiledDAGRef  # noqa: F401
 
 __all__ = ["InputNode", "MultiOutputNode", "DAGNode", "ClassMethodNode",
-           "CompiledDAG", "CompiledDAGRef"]
+           "CompiledDAG", "CompiledDAGRef", "allreduce",
+           "CollectiveOutputNode"]

@@ -1,0 +1,136 @@
+"""Collective nodes for compiled DAGs.
+
+Parity with the reference's collective-in-DAG support (ref:
+python/ray/dag/collective_node.py:144 — `allreduce.bind(tensors)` returns
+one CollectiveOutputNode per participant; experimental_compile lowers
+them onto NCCL channels; the compute/comm overlap schedule lives in
+dag_node_operation.py). TPU-first differences:
+
+- The dataplane is the framework's own shm channels, not NCCL: each
+  group lowers to contribute channels (participant -> leader), a
+  host-tier reduction on the leader, and result channels back. Device
+  arrays ride the channels' zero-copy array frames; chip-to-chip
+  reduction at scale belongs INSIDE jit over the mesh (psum on ICI) —
+  the DAG tier reduces across actor processes, where the host hop is
+  the only portable transport.
+- Overlap is a SCHEDULE, like the reference's: each participant's
+  contribution is sent at the earliest point (right after its producer
+  op) and the result is received at the latest (just before its first
+  consumer), so ops independent of the collective run while peers'
+  contributions are still in flight (see compiled_dag.py placement).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, List, Sequence
+
+from .dag_node import DAGNode
+
+_group_counter = itertools.count()
+
+REDUCE_OPS = ("sum", "mean", "max", "min", "prod")
+
+
+def reduce_values(values: Sequence[Any], op: str):
+    """Host-tier reduction over numpy/jax arrays (or scalars)."""
+    try:
+        import jax
+
+        use_jnp = any(isinstance(v, jax.Array) for v in values)
+    except Exception:  # pragma: no cover — jax-less hosts
+        use_jnp = False
+    if use_jnp:
+        import jax.numpy as xp
+    else:
+        import numpy as xp
+    acc = values[0]
+    for v in values[1:]:
+        if op in ("sum", "mean"):
+            acc = acc + v
+        elif op == "max":
+            acc = xp.maximum(acc, v)
+        elif op == "min":
+            acc = xp.minimum(acc, v)
+        elif op == "prod":
+            acc = acc * v
+        else:
+            raise ValueError(f"unknown reduce op {op!r}")
+    if op == "mean":
+        acc = acc / len(values)
+    return acc
+
+
+class CollectiveGroup:
+    """One logical collective: N participant nodes, one reduce op."""
+
+    def __init__(self, inputs: List[DAGNode], op: str):
+        self.gid = next(_group_counter)
+        self.inputs = inputs
+        self.op = op
+
+
+class CollectiveOutputNode(DAGNode):
+    """The per-participant result of a collective (ref:
+    collective_node.py CollectiveOutputNode). Lives on the same actor as
+    its upstream input; usable anywhere a bound method node is."""
+
+    def __init__(self, group: CollectiveGroup, index: int,
+                 upstream: DAGNode):
+        # EVERY group input is an upstream: the reduction depends on all
+        # contributions (topo order and uncompiled execution need them
+        # resolved before any output of the group)
+        super().__init__(tuple(group.inputs))
+        self.group = group
+        self.index = index
+        self.actor = upstream.actor
+        self.method_name = f"allreduce_{group.op}"  # repr/debug only
+
+    def _execute_uncompiled(self, results, input_args):
+        # one reduction per group, cached under the group id so every
+        # output node of the group shares it
+        import ray_tpu
+
+        cache_key = ("__collective__", self.group.gid)
+        if cache_key not in results:
+            values = ray_tpu.get(
+                [results[n.uid] for n in self.group.inputs])
+            results[cache_key] = ray_tpu.put(
+                reduce_values(values, self.group.op))
+        results[self.uid] = results[cache_key]
+
+    def __repr__(self):
+        return (f"CollectiveOutputNode({self.group.op}"
+                f"[{self.index}/{len(self.group.inputs)}])")
+
+
+class _AllReduce:
+    """`allreduce.bind([n1, n2, ...], op=...)` -> one output node per
+    input, each bound to its input's actor (ref: collective_node.py:144
+    AllReduceWrapper)."""
+
+    def bind(self, nodes, op: str = "sum") -> List[CollectiveOutputNode]:
+        if isinstance(nodes, DAGNode):
+            nodes = [nodes]
+        nodes = list(nodes)
+        if not nodes:
+            raise ValueError("allreduce.bind needs at least one node")
+        if op not in REDUCE_OPS:
+            raise ValueError(f"op must be one of {REDUCE_OPS}, got {op!r}")
+        actors = []
+        for n in nodes:
+            if not isinstance(n, DAGNode) or not hasattr(n, "actor"):
+                raise ValueError(
+                    "allreduce participants must be bound actor-method "
+                    f"nodes, got {n!r}")
+            actors.append(n.actor.actor_id)
+        if len(set(actors)) != len(actors):
+            raise ValueError(
+                "allreduce participants must live on distinct actors "
+                "(same-actor values need no collective)")
+        group = CollectiveGroup(nodes, op)
+        return [CollectiveOutputNode(group, i, n)
+                for i, n in enumerate(nodes)]
+
+
+allreduce = _AllReduce()

@@ -15,6 +15,7 @@ import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..runtime.channel import Channel, ChannelClosed
+from .collective import CollectiveOutputNode
 from .dag_node import ClassMethodNode, DAGNode, InputNode, MultiOutputNode
 
 _dag_counter = itertools.count()
@@ -59,8 +60,9 @@ class CompiledDAG:
 
         nodes = root.topo()
         self._input: Optional[InputNode] = None
-        outputs: List[ClassMethodNode] = []
+        outputs: List[DAGNode] = []
         compute_nodes: List[ClassMethodNode] = []
+        coll_nodes: List[CollectiveOutputNode] = []
         for node in nodes:
             if isinstance(node, InputNode):
                 if self._input is not None and node is not self._input:
@@ -68,17 +70,35 @@ class CompiledDAG:
                 self._input = node
             elif isinstance(node, ClassMethodNode):
                 compute_nodes.append(node)
+            elif isinstance(node, CollectiveOutputNode):
+                coll_nodes.append(node)
             elif isinstance(node, MultiOutputNode):
                 if node is not root:
                     raise ValueError("MultiOutputNode must be the DAG root")
+        # every participant of a bound collective must be reachable from
+        # the root: a missing output means its actor would never send its
+        # contribution and the group's reduce would hang
+        groups = {n.group.gid: n.group for n in coll_nodes}
+        for group in groups.values():
+            missing = [i for i, _n in enumerate(group.inputs)
+                       if not any(c.group is group and c.index == i
+                                  for c in coll_nodes)]
+            if missing:
+                raise ValueError(
+                    f"allreduce group {group.gid}: outputs {missing} are "
+                    "not reachable from the DAG root — every "
+                    "participant's output must be consumed (route unused "
+                    "ones through MultiOutputNode)")
         if isinstance(root, MultiOutputNode):
             for arg in root.args:
-                if not isinstance(arg, ClassMethodNode):
+                if not isinstance(arg, (ClassMethodNode,
+                                        CollectiveOutputNode)):
                     raise ValueError("MultiOutputNode accepts bound "
-                                     "actor-method nodes only")
+                                     "actor-method / collective nodes "
+                                     "only")
                 outputs.append(arg)
             self._multi_output = True
-        elif isinstance(root, ClassMethodNode):
+        elif isinstance(root, (ClassMethodNode, CollectiveOutputNode)):
             outputs = [root]
             self._multi_output = False
         else:
@@ -108,7 +128,8 @@ class CompiledDAG:
                     ch = edge_channel(arg.uid, node.uid)
                     self._input_channels.append(ch)
                     arg_specs.append(("chan", ch))
-                elif isinstance(arg, ClassMethodNode):
+                elif isinstance(arg, (ClassMethodNode,
+                                      CollectiveOutputNode)):
                     if arg.actor.actor_id == actor_id:
                         arg_specs.append(("local", arg.uid))
                     else:
@@ -120,8 +141,8 @@ class CompiledDAG:
                 else:
                     arg_specs.append(("const", arg))
             actor_ops.setdefault(actor_id, []).append({
-                "uid": node.uid, "method": node.method_name,
-                "args": arg_specs, "out": []})
+                "kind": "call", "uid": node.uid,
+                "method": node.method_name, "args": arg_specs, "out": []})
 
         self._output_channels: List[Channel] = []
         for out_node in outputs:
@@ -129,12 +150,91 @@ class CompiledDAG:
             consumers.setdefault(out_node.uid, []).append(ch)
             self._output_channels.append(ch)
 
-        # attach output channels to the producing ops
+        # --------------------------------------- collective lowering
+        # Each group becomes: per-participant SEND ops (contribution to
+        # the leader) placed as EARLY as possible, a leader REDUCE op
+        # and per-participant RECV ops placed as LATE as possible —
+        # the compute/comm overlap schedule: ops independent of the
+        # collective run while peers' contributions are in flight (ref:
+        # dag_node_operation.py's read/compute/write scheduling).
+        coll_channels: List[Channel] = []
+
+        # forward adjacency over the whole DAG, for downstream closures:
+        # a recv/reduce must land before the first op that TRANSITIVELY
+        # depends on the collective (a direct-consumer check would place
+        # it after an op that depends through another actor's channel —
+        # a lockstep deadlock), and after nothing else (max overlap)
+        fwd: Dict[int, List[int]] = {}
+        for node in nodes:
+            for up in node.upstreams():
+                fwd.setdefault(up.uid, []).append(node.uid)
+
+        def downstream_closure(uid: int) -> set:
+            seen, stack = set(), [uid]
+            while stack:
+                u = stack.pop()
+                for d in fwd.get(u, ()):
+                    if d not in seen:
+                        seen.add(d)
+                        stack.append(d)
+            return seen
+
+        def insert_after_producer(ops, uid, new_op):
+            for i, op in enumerate(ops):
+                if op.get("uid") == uid:
+                    ops.insert(i + 1, new_op)
+                    return
+            ops.append(new_op)
+
+        def insert_before_closure(ops, closure, new_op):
+            for i, op in enumerate(ops):
+                if op.get("uid") in closure:
+                    ops.insert(i, new_op)
+                    return
+            ops.append(new_op)
+
+        for gid in sorted(groups):  # creation order: chained groups
+            group = groups[gid]
+            outs = sorted((n for n in coll_nodes if n.group is group),
+                          key=lambda n: n.index)
+            leader = outs[0]
+            leader_args = [("local", group.inputs[leader.index].uid)]
+            result_chans = []
+            for out in outs[1:]:
+                aid = out.actor.actor_id
+                contrib = Channel(
+                    self._session,
+                    f"dag{self._dag_id}-g{group.gid}c{out.index}",
+                    item_size=self._buffer, num_slots=self._max_inflight)
+                result = Channel(
+                    self._session,
+                    f"dag{self._dag_id}-g{group.gid}r{out.index}",
+                    item_size=self._buffer, num_slots=self._max_inflight)
+                coll_channels += [contrib, result]
+                leader_args.append(("chan", contrib))
+                result_chans.append(result)
+                in_uid = group.inputs[out.index].uid
+                insert_after_producer(actor_ops[aid], in_uid, {
+                    "kind": "send", "uid": None,
+                    "args": [("local", in_uid)], "out": [contrib]})
+                insert_before_closure(
+                    actor_ops[aid], downstream_closure(out.uid), {
+                        "kind": "recv", "uid": out.uid,
+                        "args": [("chan", result)], "out": []})
+            insert_before_closure(
+                actor_ops[leader.actor.actor_id],
+                downstream_closure(leader.uid), {
+                    "kind": "reduce", "uid": leader.uid, "op": group.op,
+                    "args": leader_args, "out": list(result_chans)})
+
+        # attach consumer channels to the producing ops (extend: reduce/
+        # recv ops carry their collective channels already)
         for ops in actor_ops.values():
             for op in ops:
-                op["out"] = consumers.get(op["uid"], [])
+                if op.get("uid") is not None:
+                    op["out"] = op["out"] + consumers.get(op["uid"], [])
 
-        self._all_channels = list(self._input_channels) + [
+        self._all_channels = list(self._input_channels) + coll_channels + [
             ch for chans in consumers.values() for ch in chans]
 
         # ------------------------------------------------- start the loops

@@ -35,6 +35,7 @@ def run_dag_loop(instance: Any, ops: List[dict]) -> None:
     while True:
         local: Dict[int, Any] = {}
         written: set = set()  # channel names written this iteration
+        consumed: set = set()  # channel names read this iteration
         closed = False
         try:
             for op_i, op in enumerate(ops):
@@ -46,16 +47,29 @@ def run_dag_loop(instance: Any, ops: List[dict]) -> None:
                         args.append(local[spec])
                     else:
                         value = spec.read()
+                        consumed.add(spec.name)
                         if isinstance(value, _DagLoopError):
-                            closed = _drain_rest(ops, op_i, arg_i)
                             raise _Abort(value)
                         args.append(value)
+                kind = op.get("kind", "call")
                 try:
-                    result = getattr(instance, op["method"])(*args)
+                    if kind == "call":
+                        result = getattr(instance, op["method"])(*args)
+                    elif kind in ("send", "recv"):
+                        # collective plumbing: pure pass-through; the
+                        # value moves via op["args"]/op["out"]
+                        result = args[0]
+                    elif kind == "reduce":
+                        from .collective import reduce_values
+
+                        result = reduce_values(args, op["op"])
+                    else:
+                        raise ValueError(f"unknown op kind {kind!r}")
                 except Exception:
                     err = _DagLoopError(traceback.format_exc())
                     raise _Abort(err)
-                local[op["uid"]] = result
+                if op["uid"] is not None:
+                    local[op["uid"]] = result
                 try:
                     for ch in op["out"]:
                         ch.write(result)
@@ -71,8 +85,15 @@ def run_dag_loop(instance: Any, ops: List[dict]) -> None:
             _propagate_sentinel(ops)
             return
         except _Abort as abort:
-            # Keep the one-item-per-iteration invariant: error goes to
-            # every output channel not already written this iteration.
+            # Keep the one-item-per-iteration invariant BOTH ways: the
+            # error marker goes to every output channel not already
+            # written, and every input channel not already read is
+            # drained of its one item — a skipped read (local op
+            # failure, or a collective recv after an abort) would
+            # otherwise desynchronize the whole DAG's rings off-by-one
+            # for every later execution. Peers' own abort handling
+            # guarantees the drained items arrive (as values or error
+            # markers).
             for op in ops:
                 for ch in op["out"]:
                     if ch.name not in written:
@@ -80,22 +101,22 @@ def run_dag_loop(instance: Any, ops: List[dict]) -> None:
                             ch.write(abort.err)
                         except Exception:
                             pass
+            closed = _drain_unconsumed(ops, consumed) or closed
             if closed:
                 _propagate_sentinel(ops)
                 return
 
 
-def _drain_rest(ops: List[dict], op_i: int, arg_i: int) -> bool:
-    """After an upstream error, consume this iteration's remaining input
-    items so the next iteration starts aligned. Returns True if a sentinel
-    was hit (the DAG is shutting down)."""
+def _drain_unconsumed(ops: List[dict], consumed: set) -> bool:
+    """Consume this iteration's unread input items so the next iteration
+    starts aligned. Returns True if a sentinel was hit (the DAG is
+    shutting down)."""
     closed = False
-    for later_op_i, op in enumerate(ops[op_i:], start=op_i):
-        for later_arg_i, (kind, spec) in enumerate(op["args"]):
-            if kind != "chan":
+    for op in ops:
+        for kind, spec in op["args"]:
+            if kind != "chan" or spec.name in consumed:
                 continue
-            if later_op_i == op_i and later_arg_i <= arg_i:
-                continue
+            consumed.add(spec.name)
             try:
                 spec.read(timeout=10)
             except ChannelClosed:

@@ -174,3 +174,184 @@ def test_channel_basics(shared_cluster):
     with pytest.raises(TimeoutError):
         ch.read(timeout=0.05)
     ch.unlink()
+
+
+# ------------------------------------------------- collectives (aDAG)
+
+@ray_tpu.remote
+class GradWorker:
+    """A participant in collective-in-DAG tests (ref:
+    test_accelerated_dag's AllReduce coverage via collective_node.py)."""
+
+    def __init__(self, delay=0.0):
+        self.delay = delay
+        self.times = {}
+
+    def produce(self, x):
+        if self.delay:
+            time.sleep(self.delay)
+        self.times["produce_done"] = time.monotonic()
+        return np.asarray(x, np.float64) * 1.0
+
+    def produce2(self, x):
+        return np.asarray(x, np.float64) + 100.0
+
+    def indep(self, x):
+        self.times["indep_done"] = time.monotonic()
+        return x * 0
+
+    def consume(self, reduced, other):
+        return (reduced, other)
+
+    def get_times(self):
+        return dict(self.times)
+
+
+def test_collective_allreduce_sum(shared_cluster):
+    from ray_tpu.dag import allreduce
+
+    a, b = GradWorker.remote(), GradWorker.remote()
+    with InputNode() as inp:
+        ga = a.produce.bind(inp)
+        gb = b.produce2.bind(inp)
+        ra, rb = allreduce.bind([ga, gb], op="sum")
+        dag = MultiOutputNode([ra, rb]).experimental_compile()
+    try:
+        for k in range(3):
+            va, vb = dag.execute(np.arange(4.0) + k).get()
+            want = (np.arange(4.0) + k) + ((np.arange(4.0) + k) + 100.0)
+            np.testing.assert_allclose(va, want)
+            np.testing.assert_allclose(vb, want)
+    finally:
+        dag.teardown()
+
+
+def test_collective_allreduce_mean_uncompiled(shared_cluster):
+    from ray_tpu.dag import allreduce
+
+    a, b = GradWorker.remote(), GradWorker.remote()
+    with InputNode() as inp:
+        ga = a.produce.bind(inp)
+        gb = b.produce2.bind(inp)
+        ra, rb = allreduce.bind([ga, gb], op="mean")
+        dag = MultiOutputNode([ra, rb])
+    refs = dag.execute(np.zeros(3))
+    va, vb = ray_tpu.get(refs)
+    np.testing.assert_allclose(va, np.full(3, 50.0))
+    np.testing.assert_allclose(vb, np.full(3, 50.0))
+
+
+def test_collective_result_feeds_downstream(shared_cluster):
+    from ray_tpu.dag import allreduce
+
+    a, b = GradWorker.remote(), GradWorker.remote()
+    with InputNode() as inp:
+        ga = a.produce.bind(inp)
+        gb = b.produce2.bind(inp)
+        ra, rb = allreduce.bind([ga, gb], op="sum")
+        out = b.consume.bind(rb, b.indep.bind(inp))
+        dag = MultiOutputNode([ra, out]).experimental_compile()
+    try:
+        va, (reduced, zeros) = dag.execute(np.ones(2)).get()
+        np.testing.assert_allclose(reduced, np.full(2, 102.0))
+        np.testing.assert_allclose(va, reduced)
+        np.testing.assert_allclose(zeros, 0 * np.ones(2))
+    finally:
+        dag.teardown()
+
+
+def test_collective_overlap_schedule(shared_cluster):
+    """Compute/comm overlap: ops independent of the collective run
+    while a slow peer's contribution is still in flight (ref:
+    dag_node_operation.py's overlapped schedule). The non-leader's
+    `indep` must complete BEFORE the delayed leader finishes producing
+    its contribution."""
+    from ray_tpu.dag import allreduce
+
+    slow, fast = GradWorker.remote(delay=0.6), GradWorker.remote()
+    with InputNode() as inp:
+        ga = slow.produce.bind(inp)
+        gb = fast.produce.bind(inp)
+        ra, rb = allreduce.bind([ga, gb], op="sum")
+        out = fast.consume.bind(rb, fast.indep.bind(inp))
+        dag = MultiOutputNode([ra, out]).experimental_compile()
+    try:
+        dag.execute(np.ones(2)).get()
+        t_slow = ray_tpu.get(slow.get_times.remote())
+        t_fast = ray_tpu.get(fast.get_times.remote())
+        assert t_fast["indep_done"] < t_slow["produce_done"], (
+            "indep ran only after the collective completed: the recv was "
+            "not scheduled late")
+    finally:
+        dag.teardown()
+
+
+def test_collective_error_propagates(shared_cluster):
+    from ray_tpu.dag import allreduce
+
+    a, b = GradWorker.remote(), Adder.remote(1)
+    with InputNode() as inp:
+        ga = a.produce.bind(inp)
+        gb = b.boom.bind(inp)
+        ra, rb = allreduce.bind([ga, gb], op="sum")
+        dag = MultiOutputNode([ra, rb]).experimental_compile()
+    try:
+        with pytest.raises(RuntimeError, match="kaboom"):
+            dag.execute(np.ones(2)).get()
+        # the DAG survives: the next execution still works... with the
+        # same failing op it fails again, per-execution semantics
+        with pytest.raises(RuntimeError, match="kaboom"):
+            dag.execute(np.ones(2)).get()
+    finally:
+        dag.teardown()
+
+
+def test_collective_validation(shared_cluster):
+    from ray_tpu.dag import allreduce
+
+    a = GradWorker.remote()
+    with InputNode() as inp:
+        ga = a.produce.bind(inp)
+        gb = a.produce2.bind(inp)
+        with pytest.raises(ValueError, match="distinct actors"):
+            allreduce.bind([ga, gb])
+        with pytest.raises(ValueError, match="op must be"):
+            allreduce.bind([ga], op="xor")
+
+
+def test_collective_realigns_after_error(shared_cluster):
+    """A failed execution must not desynchronize the collective's
+    channels: the NEXT execution returns correct values, not stale
+    error markers (one-item-per-iteration invariant incl. skipped
+    recv/reduce inputs)."""
+    from ray_tpu.dag import allreduce
+
+    @ray_tpu.remote
+    class Maybe:
+        def maybe_boom(self, x):
+            if np.any(np.asarray(x) < 0):
+                raise ValueError("negative grad")
+            return np.asarray(x, np.float64)
+
+        def produce(self, x):
+            return np.asarray(x, np.float64) * 2
+
+    a, b = Maybe.remote(), Maybe.remote()
+    with InputNode() as inp:
+        ga = a.produce.bind(inp)
+        gb = b.maybe_boom.bind(inp)
+        ra, rb = allreduce.bind([ga, gb], op="sum")
+        dag = MultiOutputNode([ra, rb]).experimental_compile()
+    try:
+        with pytest.raises(RuntimeError, match="negative grad"):
+            dag.execute(-np.ones(2)).get()
+        va, vb = dag.execute(np.ones(2)).get()
+        np.testing.assert_allclose(va, np.full(2, 3.0))
+        np.testing.assert_allclose(vb, np.full(2, 3.0))
+        # and again after two interleaved failures
+        with pytest.raises(RuntimeError, match="negative grad"):
+            dag.execute(-np.ones(2)).get()
+        va, vb = dag.execute(np.ones(2) * 2).get()
+        np.testing.assert_allclose(va, np.full(2, 6.0))
+    finally:
+        dag.teardown()
