@@ -45,6 +45,54 @@ def _store_used_fraction() -> float:
         return 0.0
 
 
+class ReservationOpResourceAllocator:
+    """Per-operator admission budgets for concurrently-running stages.
+
+    Ref: python/ray/data/_internal/execution/resource_manager.py
+    ReservationOpResourceAllocator — the reference reserves a fraction
+    of the budget for EACH operator so a hungry upstream producer can
+    never starve a downstream consumer; the remainder is a shared pool.
+    Same contract here over in-flight task slots, with the global
+    store-pressure fraction as the memory backstop: above the pressure
+    threshold an op may only use its RESERVED slots (so the downstream
+    op always has headroom to drain — draining is what frees the store),
+    below it the shared pool serves whoever asks.
+    """
+
+    PRESSURE_HARD = 0.85
+    PRESSURE_SOFT = 0.6
+
+    def __init__(self, n_ops: int, max_in_flight: Optional[int] = None,
+                 reserved_fraction: float = 0.5):
+        self.max_in_flight = max_in_flight or _default_max_in_flight()
+        self.n_ops = max(1, n_ops)
+        self.reserve = max(
+            1, int(self.max_in_flight * reserved_fraction) // self.n_ops)
+        self.shared = max(0, self.max_in_flight - self.reserve * self.n_ops)
+        self.in_flight = [0] * self.n_ops
+        self.shared_used = 0
+
+    def can_admit(self, op: int) -> bool:
+        if self.in_flight[op] < self.reserve:
+            return True
+        frac = _store_used_fraction()
+        if frac >= self.PRESSURE_HARD:
+            return False  # reserved slots only: let consumers drain
+        shared_cap = (self.shared if frac < self.PRESSURE_SOFT
+                      else max(1, self.shared // 4))
+        return self.shared_used < shared_cap
+
+    def admit(self, op: int) -> None:
+        if self.in_flight[op] >= self.reserve:
+            self.shared_used += 1
+        self.in_flight[op] += 1
+
+    def release(self, op: int) -> None:
+        self.in_flight[op] -= 1
+        if self.in_flight[op] >= self.reserve:
+            self.shared_used = max(0, self.shared_used - 1)
+
+
 # ---------------------------------------------------------- remote helpers
 def _apply_chain(fns: List[Callable[[Block], Block]], block: Block) -> Block:
     for fn in fns:
@@ -285,7 +333,19 @@ class StreamingExecutor:
         import ray_tpu
 
         refs: List[Any] = []
-        for stage in stages:
+        i = 0
+        while i < len(stages):
+            stage = stages[i]
+            nxt = stages[i + 1] if i + 1 < len(stages) else None
+            if (isinstance(stage, MapStage)
+                    and isinstance(nxt, AllToAllStage)
+                    and nxt.kind != "sort" and refs):
+                # pipelined pair (sort excluded: its bounds sample needs
+                # every MAPPED block before partitioning can start)
+                refs = self._run_map_then_all_to_all(stage, nxt, refs)
+                i += 2
+                continue
+            i += 1
             if isinstance(stage, SourceStage):
                 refs = self._run_source(stage)
             elif isinstance(stage, MapStage):
@@ -386,16 +446,19 @@ class StreamingExecutor:
             in_flight.append(lst[0])
         return outs
 
-    def _run_all_to_all(self, stage, refs: List[Any]) -> List[Any]:
+    def _run_all_to_all(self, stage, refs: List[Any],
+                        map_outs: Optional[List[List[Any]]] = None
+                        ) -> List[Any]:
         import ray_tpu
 
         kind, args = stage.kind, dict(stage.args)
         n_out = args.pop("num_blocks", None) or max(len(refs), 1)
         if kind == "sort" and "bounds" not in args:
             args["bounds"] = self._sample_sort_bounds(refs, args, n_out)
-        if not refs:
+        if not refs and not map_outs:
             return []
-        map_outs = self._partition_fanout(refs, n_out, kind, args)
+        if map_outs is None:
+            map_outs = self._partition_fanout(refs, n_out, kind, args)
         reduce_ = ray_tpu.remote(_reduce_partition)
         out = self._bounded_submit(
             [(reduce_, (kind, args) + tuple(m[i] for m in map_outs))
@@ -403,6 +466,57 @@ class StreamingExecutor:
         if kind == "sort" and args.get("descending"):
             out.reverse()  # partitions ascend by range; rows descend within
         return out
+
+    def _run_map_then_all_to_all(self, map_stage, a2a_stage,
+                                 refs: List[Any]) -> List[Any]:
+        """Pipelined map -> partition under per-operator reservations:
+        partition tasks start as soon as their input block exists, and
+        each operator's admission is budgeted by the reservation
+        allocator — so a memory-hungry map cannot starve the downstream
+        shuffle of slots, and the shuffle's consumption is what frees
+        the store while the map is throttled (ref: the reference's
+        streaming topology + ReservationOpResourceAllocator)."""
+        import ray_tpu
+
+        kind, args = a2a_stage.kind, dict(a2a_stage.args)
+        n_out = args.pop("num_blocks", None) or max(len(refs), 1)
+        alloc = ReservationOpResourceAllocator(2, self.max_in_flight)
+        apply_ = ray_tpu.remote(_apply_chain)
+        part = ray_tpu.remote(_partition_block).options(num_returns=n_out)
+
+        pending = list(refs)
+        map_running: Dict[Any, None] = {}
+        map_done: List[Any] = []     # mapped blocks awaiting partition
+        part_running: Dict[Any, List[Any]] = {}  # head ref -> parts
+        map_outs: List[List[Any]] = []
+        while pending or map_running or map_done or part_running:
+            progressed = False
+            while pending and alloc.can_admit(0):
+                mref = apply_.remote(map_stage.fns, pending.pop(0))
+                alloc.admit(0)
+                map_running[mref] = None
+                progressed = True
+            while map_done and alloc.can_admit(1):
+                res = part.remote(map_done.pop(0), n_out, kind, args)
+                parts = res if isinstance(res, list) else [res]
+                alloc.admit(1)
+                part_running[parts[0]] = parts
+                progressed = True
+            waitable = list(map_running) + list(part_running)
+            if not waitable:
+                if not progressed:  # nothing runnable: avoid spinning
+                    break
+                continue
+            ready, _ = ray_tpu.wait(waitable, num_returns=1, timeout=300)
+            for r in ready:
+                if r in map_running:
+                    del map_running[r]
+                    alloc.release(0)
+                    map_done.append(r)
+                else:
+                    map_outs.append(part_running.pop(r))
+                    alloc.release(1)
+        return self._run_all_to_all(a2a_stage, refs, map_outs=map_outs)
 
     def _sample_sort_bounds(self, refs, args, n_out):
         import ray_tpu

@@ -79,9 +79,18 @@ def _kl_categorical(p_logits, q_logits):
 
 class _Nets:
     """Flax module bundle built lazily (import-light like the rest of
-    rllib)."""
+    rllib).
 
-    def __init__(self, obs_dim: int, act_dim: int, cfg: Dict[str, Any]):
+    Vector observations use MLP encoder/decoder; rank-3 (H, W, C)
+    observations get a stride-2 CNN encoder and a ConvTranspose decoder
+    (ref: rllib/algorithms/dreamerv3/tf/models/world_model.py's CNN
+    path — re-derived in flax; depths double per level, spatial halves
+    until <=4). Observations flow FLAT [..., obs_dim] through every
+    module boundary (embed reshapes, the decoder re-flattens), so the
+    RSSM/heads/learner are layout-agnostic."""
+
+    def __init__(self, obs_dim: int, act_dim: int, cfg: Dict[str, Any],
+                 obs_shape: tuple = ()):
         import flax.linen as nn
 
         hidden = cfg.get("hidden", 128)
@@ -89,21 +98,69 @@ class _Nets:
         stoch = cfg.get("stoch", 8)
         classes = cfg.get("classes", 8)
         bins = cfg.get("bins", 41)
+        depth = cfg.get("cnn_depth", 16)
         self.deter, self.stoch, self.classes = deter, stoch, classes
         self.act_dim = act_dim
         self.bins = jnp.linspace(-10.0, 10.0, bins)  # symlog space
+
+        image = len(obs_shape) == 3
+        if image:
+            h0, w0, c0 = obs_shape
+            depths = []
+            h, w, d = h0, w0, depth
+            while min(h, w) > 4 and h % 2 == 0 and w % 2 == 0:
+                depths.append(d)
+                h, w, d = h // 2, w // 2, d * 2
+            if not depths:  # degenerate tiny images: one unit level
+                depths = [depth]
+                h, w = h0, w0
+            self._img = (h0, w0, c0)
+            self._img_bottom = (h, w, depths[-1])
 
         def mlp(out, name):
             return nn.Sequential([nn.Dense(hidden), nn.silu,
                                   nn.Dense(out)], name=name)
 
+        outer = self
+
+        class CNNEncoder(nn.Module):
+            @nn.compact
+            def __call__(self, flat):
+                x = flat.reshape(flat.shape[:-1] + outer._img)
+                for i, d in enumerate(depths):
+                    stride = (2 if x.shape[-3] > outer._img_bottom[0]
+                              else 1)
+                    x = nn.silu(nn.Conv(d, (4, 4), (stride, stride),
+                                        name=f"conv{i}")(x))
+                x = x.reshape(x.shape[:-3] + (-1,))
+                return nn.Dense(hidden, name="proj")(x)
+
+        class CNNDecoder(nn.Module):
+            @nn.compact
+            def __call__(self, feat):
+                bh, bw, bd = outer._img_bottom
+                x = nn.Dense(bh * bw * bd, name="proj")(feat)
+                x = x.reshape(x.shape[:-1] + (bh, bw, bd))
+                for i, d in enumerate(reversed(depths[:-1])):
+                    x = nn.silu(nn.ConvTranspose(
+                        d, (4, 4), (2, 2), name=f"deconv{i}")(x))
+                out_ch = outer._img[2]
+                if x.shape[-3] != outer._img[0]:
+                    x = nn.ConvTranspose(out_ch, (4, 4), (2, 2),
+                                         name="deconv_out")(x)
+                else:
+                    x = nn.Conv(out_ch, (3, 3), name="conv_out")(x)
+                return x.reshape(x.shape[:-3] + (-1,))
+
         class Bundle(nn.Module):
             def setup(self):
-                self.enc = mlp(hidden, "enc")
+                self.enc = (CNNEncoder(name="enc") if image
+                            else mlp(hidden, "enc"))
                 self.gru = nn.GRUCell(features=deter, name="gru")
                 self.prior = mlp(stoch * classes, "prior")
                 self.post = mlp(stoch * classes, "post")
-                self.dec = mlp(obs_dim, "dec")
+                self.dec = (CNNDecoder(name="dec") if image
+                            else mlp(obs_dim, "dec"))
                 self.rew = mlp(bins, "rew")
                 self.cont = mlp(1, "cont")
                 self.actor = mlp(act_dim, "actor")
@@ -153,9 +210,11 @@ class DreamerV3Module(RLModule):
 
     def __init__(self, obs_space, act_space, spec: RLModuleSpec):
         self.obs_dim = int(np.prod(obs_space.shape))
+        self.obs_shape = tuple(obs_space.shape)
         self.act_dim = int(getattr(act_space, "n"))
         self.cfg = dict(spec.config or {})
-        self.nets = _Nets(self.obs_dim, self.act_dim, self.cfg)
+        self.nets = _Nets(self.obs_dim, self.act_dim, self.cfg,
+                          obs_shape=self.obs_shape)
 
     def init(self, rng):
         n = self.nets
@@ -188,6 +247,7 @@ class DreamerV3Module(RLModule):
         """One acting step: advance h with the previous (z, a), infer the
         posterior from the new observation, sample an action."""
         n = self.nets
+        obs = obs.reshape(obs.shape[0], -1)  # image obs arrive unflattened
         h = n.apply(params, "step_h", state["h"], state["z"], state["a"])
         embed = n.apply(params, "embed", obs)
         post = n.apply(params, "post_logits", h, embed)
@@ -224,6 +284,7 @@ class DreamerV3Learner(Learner):
         cfg = self.config
         nets = self.module.nets
         B, T = batch["obs"].shape[:2]
+        obs_bt = batch["obs"].reshape(B, T, -1)  # flat at module edges
         H = cfg.get("imagine_horizon", 8)
         gamma = cfg.get("gamma", 0.99)
         lam = cfg.get("lambda_", 0.95)
@@ -252,11 +313,11 @@ class DreamerV3Learner(Learner):
 
         (_, _), (hs, zs, priors, posts) = jax.lax.scan(
             obs_step, (h0, z0),
-            (batch["obs"].swapaxes(0, 1), a_prev.swapaxes(0, 1),
+            (obs_bt.swapaxes(0, 1), a_prev.swapaxes(0, 1),
              batch["is_first"].swapaxes(0, 1), rngs[:T]))
         # [T, B, ...] -> flatten heads once
         heads = nets.apply(params, "heads", hs, zs)
-        obs_t = batch["obs"].swapaxes(0, 1)
+        obs_t = obs_bt.swapaxes(0, 1)
         recon_loss = jnp.square(heads["recon"] - symlog(obs_t)).sum(-1)
         rew_target = twohot(symlog(batch["rewards"].swapaxes(0, 1)),
                             nets.bins)

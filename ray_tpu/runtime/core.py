@@ -139,16 +139,19 @@ class _RemoteShm:
     """Memory-store marker: the value lives in ANOTHER host's pool; pull
     it through that host's nodelet (object-manager tier) on first read."""
 
-    __slots__ = ("host", "node_addr", "size")
+    __slots__ = ("host", "node_addr", "size", "owner_addr")
 
-    def __init__(self, host: str, node_addr: str, size: int):
+    def __init__(self, host: str, node_addr: str, size: int,
+                 owner_addr: Optional[str] = None):
         self.host = host
         self.node_addr = node_addr
         self.size = size
+        self.owner_addr = owner_addr
 
     @classmethod
     def from_loc(cls, loc: dict) -> "_RemoteShm":
-        return cls(loc.get("host", ""), loc["node_addr"], loc["size"])
+        return cls(loc.get("host", ""), loc["node_addr"], loc["size"],
+                   loc.get("owner"))
 
 
 class _PendingTask:
@@ -224,6 +227,9 @@ class CoreWorker:
         self.store = make_store_client(session_name)
         self.host_id = _get_host_id()
         self._pulls: Dict[ObjectID, asyncio.Future] = {}
+        # broadcast directory (owner side): oid -> {addr: [host,
+        # outstanding, last_assign_ts]} of pull-capable replicas
+        self._replica_dirs: Dict[ObjectID, Dict[str, list]] = {}
 
         self.memory_store: Dict[ObjectID, Any] = {}
         self._events: Dict[ObjectID, asyncio.Event] = {}
@@ -265,6 +271,7 @@ class CoreWorker:
             "task_spilled": self._h_task_spilled,
             "task_stream_item": self._h_task_stream_item,
             "fetch_object": self._h_fetch_object,
+            "replica_ready": self._h_replica_ready,
             "borrow_inc": self._h_borrow_inc,
             "borrow_dec": self._h_borrow_dec,
             "ping": lambda: "pong",
@@ -482,6 +489,7 @@ class CoreWorker:
         self.memory_store.pop(oid, None)
         self._events.pop(oid, None)
         self.lineage.pop(oid, None)
+        self._replica_dirs.pop(oid, None)
         # wake stranded sync waiters; they will observe the loss
         for sw in self._sync_waiters.pop(oid, ()):
             sw[0] -= 1
@@ -777,6 +785,16 @@ class CoreWorker:
             self.memory_store[oid] = _IN_SHM
             self.nodelet.notify_nowait("object_sealed", oid=oid.binary(),
                                        size=size)
+            if rs.owner_addr and rs.owner_addr != self.address:
+                # join the broadcast tree: the object is sealed in THIS
+                # HOST's pool, so the host's nodelet om tier can serve
+                # it to later pullers (the nodelet address is TCP —
+                # this worker's own unix socket would be unreachable
+                # from a genuinely different host)
+                serve_addr = self.nodelet_addr or self.address
+                self.client_for(rs.owner_addr).notify_nowait(
+                    "replica_ready", oid=oid.binary(), host=self.host_id,
+                    addr=serve_addr, src=rs.node_addr)
         except Exception as e:
             fut.set_result(e)
             self._pulls.pop(oid, None)
@@ -1185,9 +1203,45 @@ class CoreWorker:
         # remotely-connected driver)
         if host in (None, self.host_id):
             return ("shm", None)
-        return ("remote", {"host": self.host_id,
-                           "node_addr": self.address,
-                           "size": self.store.size_of(obj_id)})
+        return ("remote", self._route_source(
+            obj_id, self.host_id, self.address,
+            self.store.size_of(obj_id)))
+
+    def _route_source(self, obj_id: ObjectID, primary_host: str,
+                      primary_addr: str, size) -> dict:
+        """Pick the least-loaded replica for a cross-host pull (ref:
+        object_manager.cc PushManager — the reference pushes chunks
+        node-to-node so a 1 GiB broadcast doesn't fan N full copies out
+        of one node; here the owner doubles as the object directory and
+        SPREADS pullers across completed replicas, which register
+        themselves via `replica_ready` as the broadcast propagates)."""
+        d = self._replica_dirs.setdefault(obj_id, {})
+        if primary_addr not in d:
+            d[primary_addr] = [primary_host, 0, 0.0]
+        now = time.time()
+        for entry in d.values():
+            if entry[1] and now - entry[2] > 60.0:
+                entry[1] = 0  # puller died without reporting: decay
+        # least-outstanding wins; ties go to the LEAST-recently-assigned
+        # source, so fresh replicas actually take load off the primary
+        addr, entry = min(d.items(), key=lambda kv: (kv[1][1], kv[1][2]))
+        entry[1] += 1
+        entry[2] = now
+        return {"host": entry[0], "node_addr": addr, "size": size,
+                "owner": self.address}
+
+    def _h_replica_ready(self, oid: bytes, host: str, addr: str,
+                         src: str = None):
+        """A puller finished materializing `oid` and can serve it (its
+        process runs the om_read tier too): register it as a source and
+        release the assignment it consumed."""
+        obj_id = ObjectID(oid)
+        d = self._replica_dirs.get(obj_id)
+        if d is None:
+            return
+        d.setdefault(addr, [host, 0, 0.0])
+        if src in d:
+            d[src][1] = max(0, d[src][1] - 1)
 
     async def _h_fetch_object(self, oid: bytes, host: str = None,
                               lost: bool = False):
@@ -1231,9 +1285,8 @@ class CoreWorker:
             # we know where it lives but have not materialized it locally
             if host == value.host:
                 return ("shm", None)
-            return ("remote", {"host": value.host,
-                               "node_addr": value.node_addr,
-                               "size": value.size})
+            return ("remote", self._route_source(
+                obj_id, value.host, value.node_addr, value.size))
         return ("inline", serialization.dumps_inline(value))
 
     # ------------------------------------------------------------ actors

@@ -518,3 +518,53 @@ def test_cloud_readers_gate_on_missing_packages(monkeypatch):
         rdata.read_iceberg("db.tbl")
     with pytest.raises(ImportError, match="pymongo"):
         rdata.read_mongo("mongodb://x", "d", "c")
+
+
+def test_reservation_allocator_guarantees_downstream():
+    """ref: resource_manager.py ReservationOpResourceAllocator — under
+    store pressure an op may only use its RESERVED slots, so the
+    downstream consumer is never starved by a hungry producer."""
+    from ray_tpu.data import executor as ex
+
+    alloc = ex.ReservationOpResourceAllocator(2, max_in_flight=8)
+    assert alloc.reserve == 2 and alloc.shared == 4
+    # producer grabs its reserve + the whole shared pool
+    for _ in range(6):
+        assert alloc.can_admit(0)
+        alloc.admit(0)
+    assert not alloc.can_admit(0) or alloc.shared_used >= 4
+    # the consumer still gets its reserved slots
+    assert alloc.can_admit(1)
+    alloc.admit(1)
+    assert alloc.can_admit(1)
+    alloc.admit(1)
+    # under HARD store pressure, shared admissions stop but reserved
+    # slots still work
+    old = ex._store_used_fraction
+    ex._store_used_fraction = lambda: 0.9
+    try:
+        assert not alloc.can_admit(0)   # producer above reserve
+        alloc.release(1)
+        assert alloc.can_admit(1)       # consumer within reserve
+    finally:
+        ex._store_used_fraction = old
+
+
+def test_pipelined_map_into_shuffle_and_groupby(shared_cluster):
+    """map -> all-to-all runs as a pipelined pair (partition tasks start
+    while the map still runs) and must agree with the unfused answer."""
+    import ray_tpu.data as rd
+
+    out = (rd.range(60, parallelism=6)
+           .map(lambda x: {"k": x["id"] % 3, "v": x["id"] * 2})
+           .groupby("k").agg({"v": "sum"}).take_all())
+    got = {r["k"]: r["sum(v)"] for r in out}
+    want = {}
+    for i in range(60):
+        want[i % 3] = want.get(i % 3, 0) + i * 2
+    assert got == want
+
+    rows = (rd.range(40, parallelism=4)
+            .map(lambda x: {"id": x["id"] + 1})
+            .random_shuffle(seed=3).take_all())
+    assert sorted(r["id"] for r in rows) == list(range(1, 41))

@@ -157,3 +157,56 @@ def test_tcp_cluster_bringup():
                         os.kill(int(line.strip()), 9)
                     except (ValueError, OSError):
                         pass
+
+
+def test_broadcast_spreads_across_replicas(tmp_path):
+    """Fan-out of one large object to several simulated hosts rides the
+    replica directory: the owner routes later pullers at completed
+    replicas instead of serving every copy itself (ref:
+    object_manager.cc PushManager's node-to-node chunk push)."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    session = ray_tpu.init(num_cpus=1)
+    nodes = []
+    try:
+        for i in range(3):
+            pool = str(tmp_path / f"host{i}_shm")
+            os.makedirs(pool, exist_ok=True)
+            nodes.append(session.add_node(
+                num_cpus=1,
+                env={"RTPU_HOST_ID": f"sim-host-{i}",
+                     "RTPU_SHM_ROOT": pool}))
+
+        payload = np.arange(4 << 20, dtype=np.float64)  # 32 MB
+        ref = ray_tpu.put(payload)
+
+        @ray_tpu.remote
+        def fetch(r):
+            arr = ray_tpu.get(r[0])
+            return os.environ.get("RTPU_HOST_ID"), float(arr[-1])
+
+        # serialize the fan-out a little so replicas can register (the
+        # directory spreads whatever is READY at routing time)
+        outs = []
+        for node in nodes:
+            outs.append(ray_tpu.get(fetch.options(
+                scheduling_strategy=_on_node(node)).remote([ref]),
+                timeout=120))
+        hosts = {h for h, _ in outs}
+        assert hosts == {"sim-host-0", "sim-host-1", "sim-host-2"}
+        assert all(v == float(len(payload) - 1) for _, v in outs)
+
+        from ray_tpu.runtime.core import get_core
+
+        d = get_core()._replica_dirs.get(ref.id())
+        assert d, "owner never built a replica directory"
+        # completed pullers registered as sources
+        assert len(d) >= 2, d
+        # and at least one later pull was ROUTED to a non-owner source
+        owner_addr = get_core().address
+        routed_elsewhere = any(
+            addr != owner_addr and (entry[1] > 0 or entry[2] > 0)
+            for addr, entry in d.items())
+        assert routed_elsewhere, d
+    finally:
+        ray_tpu.shutdown()

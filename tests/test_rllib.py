@@ -622,3 +622,59 @@ def test_dreamerv3_learns_on_cartpole(shared_cluster):
         assert max(returns[2:]) > returns[0] * 0.8  # not collapsing
     finally:
         algo.stop()
+
+
+def test_dreamerv3_cnn_learns_on_image_env(shared_cluster):
+    """The world model's CNN encoder/decoder path (ref: rllib/algorithms/
+    dreamerv3/tf/models/world_model.py CNN path) learns on a small image
+    env: an 8x8 frame with a dot at the agent's column; moving right
+    pays more. Bar: learning signal + real conv params, not SOTA."""
+    import gymnasium as gym
+
+    class MovingDot(gym.Env):
+        def __init__(self):
+            self.observation_space = gym.spaces.Box(
+                0.0, 1.0, (8, 8, 1), np.float32)
+            self.action_space = gym.spaces.Discrete(2)
+            self.pos = 0
+            self.t = 0
+
+        def _obs(self):
+            frame = np.zeros((8, 8, 1), np.float32)
+            frame[:, self.pos, 0] = 1.0
+            return frame
+
+        def reset(self, *, seed=None, options=None):
+            self.pos, self.t = 3, 0
+            return self._obs(), {}
+
+        def step(self, action):
+            self.pos = int(np.clip(self.pos + (1 if action else -1), 0, 7))
+            self.t += 1
+            reward = self.pos / 7.0
+            return self._obs(), reward, False, self.t >= 20, {}
+
+    from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3Config
+
+    config = (DreamerV3Config()
+              .environment(MovingDot)
+              .env_runners(num_envs_per_env_runner=2))
+    config.learning_starts = 120
+    config.rollout_fragment_length = 120
+    config.batch_size_B = 4
+    config.batch_length_T = 8
+    config.updates_per_iteration = 4
+    config.imagine_horizon = 5
+    config.module_spec.config.update(
+        hidden=64, deter=64, stoch=4, classes=4, cnn_depth=8)
+    algo = config.build()
+    try:
+        returns = []
+        for _ in range(6):
+            returns.append(algo.train().get("episode_return_mean", 0.0))
+        assert all(np.isfinite(r) for r in returns), returns
+        # moving right pays up to 1.0/step; random walk hovers ~0.5 —
+        # demand clear improvement over the first iteration
+        assert max(returns[2:]) > returns[0], returns
+    finally:
+        algo.stop()
