@@ -133,6 +133,21 @@ def bench_serve(on_tpu: bool) -> dict:
            "n_requests": n_req, "prompt_len": prompt_len,
            "burst_trials": 3}
 
+    # prefill compute efficiency: synchronous prefill-only MFU on the
+    # engine's compiled shape (VERDICT r4 #7 — TTFT met its target but
+    # carried no visibility into remaining prefill headroom)
+    try:
+        import jax
+
+        out["prefill"] = engine.measure_prefill(
+            seq_len=prompt_len, iters=3,
+            peak_flops=(_peak_flops(jax.devices()[0]) if on_tpu
+                        else None))
+        if "mfu" in out["prefill"]:
+            out["prefill_mfu"] = out["prefill"]["mfu"]
+    except Exception as e:  # noqa: BLE001 — never block the wave tiers
+        out["prefill"] = {"error": repr(e)[:200]}
+
     # sustained Poisson arrivals: ~12 req over ~4s (rate chosen well
     # under the decode capacity so the queue stays bounded)
     if time.perf_counter() - t_bench > 400:
@@ -149,6 +164,42 @@ def bench_serve(on_tpu: bool) -> dict:
                                        int(len(ttfts) * 0.99))], 1),
         "tok_s": round(sus_tok_s, 1),
     }
+    p50_low = ttfts[len(ttfts) // 2]
+
+    # saturation search (VERDICT r4 #7): ramp the arrival rate until
+    # TTFT degrades, reporting the highest sustained token throughput
+    # with a still-bounded queue. The previous fixed 0.75x tier proved
+    # only that an under-driven engine keeps up; the CAPACITY ceiling
+    # is the number operators plan against.
+    best = dict(out["sustained"], tok_s=sus_tok_s)
+    trial_rate = rate
+    for step_i in range(4):
+        if time.perf_counter() - t_bench > 460:
+            break  # headline training metric owns the rest of the budget
+        trial_rate *= 1.6
+        gaps = np.random.default_rng(11 + step_i).exponential(
+            1.0 / trial_rate, n_sus)
+        ttfts_r, tok_s_r = run_wave(f"s{step_i}_", n_sus,
+                                    submit_at=list(np.cumsum(gaps)))
+        if not ttfts_r:
+            break
+        p50_r = ttfts_r[len(ttfts_r) // 2]
+        # queue unbounded: median TTFT blew past 4x the low-rate median
+        # (requests are now waiting on each other, not the engine)
+        if p50_r > max(4 * p50_low, 1000.0):
+            break
+        if tok_s_r >= best["tok_s"]:
+            best = {"rate_rps": round(trial_rate, 2),
+                    "n_requests": n_sus,
+                    "ttft_ms_p50": round(p50_r, 1),
+                    "ttft_ms_p99": round(
+                        ttfts_r[min(len(ttfts_r) - 1,
+                                    int(len(ttfts_r) * 0.99))], 1),
+                    "tok_s": round(tok_s_r, 1)}
+        elif tok_s_r < 0.9 * best["tok_s"]:
+            break  # past the knee: throughput is falling, stop ramping
+    out["max_sustained"] = best
+    out["max_sustained_tok_s"] = best["tok_s"]
     return out
 
 

@@ -110,7 +110,15 @@ def run_dag_loop(instance: Any, ops: List[dict]) -> None:
 def _drain_unconsumed(ops: List[dict], consumed: set) -> bool:
     """Consume this iteration's unread input items so the next iteration
     starts aligned. Returns True if a sentinel was hit (the DAG is
-    shutting down)."""
+    shutting down).
+
+    The drain MUST complete for the one-item-per-iteration invariant to
+    hold: a swallowed read timeout would leave the item in the ring and
+    silently desynchronize every later iteration of that channel
+    off-by-one (ADVICE r4). So a timeout gets one long retry (covering a
+    slow peer still producing its abort-iteration item), and if the item
+    STILL hasn't arrived the DAG is torn down with a clear error rather
+    than left running misaligned."""
     closed = False
     for op in ops:
         for kind, spec in op["args"]:
@@ -121,6 +129,18 @@ def _drain_unconsumed(ops: List[dict], consumed: set) -> bool:
                 spec.read(timeout=10)
             except ChannelClosed:
                 closed = True
+            except TimeoutError:
+                try:
+                    spec.read(timeout=120)
+                except ChannelClosed:
+                    closed = True
+                except TimeoutError:
+                    _propagate_sentinel(ops)
+                    raise RuntimeError(
+                        f"abort-drain of channel {spec.name} timed out: "
+                        "a peer never produced its item this iteration; "
+                        "tearing the DAG down instead of running "
+                        "desynchronized") from None
             except Exception:
                 pass
     return closed

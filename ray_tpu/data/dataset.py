@@ -223,7 +223,17 @@ class Dataset:
         return len(self._execute())
 
     def stats(self) -> str:
-        return f"plan: {self._plan.describe()}; blocks={self.num_blocks()}"
+        """Execution stats summary (ref: dataset.py stats() ->
+        DatasetStats — per-stage wall time and output shape). Executes
+        the plan if it hasn't run yet."""
+        self._execute()
+        lines = [f"plan: {self._plan.describe()}"]
+        for s in getattr(self._executor, "stage_stats", []):
+            size = ("" if s["out_bytes_local"] is None
+                    else f", {s['out_bytes_local'] / 1e6:.2f}MB local")
+            lines.append(f"  {s['stage']}: {s['wall_s']:.3f}s, "
+                         f"{s['out_blocks']} blocks{size}")
+        return "\n".join(lines)
 
     # ----------------------------------------------------------- consumption
     def _iter_blocks(self) -> Iterator[Block]:
@@ -403,6 +413,62 @@ class Dataset:
                 self._executor))
         return datasets
 
+    def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
+        """Split at global row indices into len(indices)+1 datasets
+        (ref: dataset.py split_at_indices). Indices must be
+        non-decreasing and non-negative; each output preserves order.
+        Blocks fully inside one slice are REUSED by ref (zero copy);
+        only boundary blocks are sliced, remotely — the dataset never
+        funnels through driver memory."""
+        import ray_tpu
+
+        if any(i < 0 for i in indices):
+            raise ValueError("indices must be non-negative")
+        if sorted(indices) != list(indices):
+            raise ValueError("indices must be non-decreasing")
+        bounds = list(indices) + [None]  # final slice runs to the end
+        refs = self._execute()
+        cnt = ray_tpu.remote(_count_block)
+        rows = ray_tpu.get([cnt.remote(r) for r in refs], timeout=600)
+        blocks = [(r, n) for r, n in zip(refs, rows) if n]
+        total = sum(n for _, n in blocks)
+        offsets = [0]
+        for _, n in blocks:
+            offsets.append(offsets[-1] + n)
+        slice_ = ray_tpu.remote(_slice_block)
+        out: List[Dataset] = []
+        start = 0
+        for bound in bounds:
+            end = total if bound is None else min(bound, total)
+            end = max(end, start)
+            picked: List[Any] = []
+            for bi, (ref, n) in enumerate(blocks):
+                b0, b1 = offsets[bi], offsets[bi + 1]
+                if b1 <= start or b0 >= end:
+                    continue
+                lo, hi = max(start, b0) - b0, min(end, b1) - b0
+                picked.append(ref if (lo, hi) == (0, n)
+                              else slice_.remote(ref, lo, hi))
+            out.append(Dataset(
+                LogicalPlan([InputData(blocks=picked)]), self._executor))
+            start = end
+        return out
+
+    def split_proportionately(
+            self, proportions: List[float]) -> List["Dataset"]:
+        """Split by fractions; a final dataset carries the remainder
+        (ref: dataset.py split_proportionately)."""
+        if not proportions or any(p <= 0 for p in proportions):
+            raise ValueError("proportions must be positive")
+        if sum(proportions) >= 1:
+            raise ValueError("proportions must sum to less than 1")
+        total = self.count()
+        indices, acc = [], 0.0
+        for p in proportions:
+            acc += p
+            indices.append(int(total * acc))
+        return self.split_at_indices(indices)
+
     def streaming_split(self, n: int, *, equal: bool = False,
                         locality_hints=None) -> List["DataIterator"]:
         """n iterators over disjoint shards (ref: dataset.py
@@ -452,6 +518,10 @@ class Dataset:
 
 def _count_block(block: Block) -> int:
     return BlockAccessor(block).num_rows()
+
+
+def _slice_block(block: Block, lo: int, hi: int) -> Block:
+    return BlockAccessor(block).slice(lo, hi)
 
 
 import collections as _collections

@@ -45,6 +45,27 @@ def _store_used_fraction() -> float:
         return 0.0
 
 
+def _store_capacity() -> int:
+    """Local object-store capacity in bytes (0 when unknown)."""
+    try:
+        from ..runtime.core import get_core
+
+        return int(get_core().store.stats().get("capacity") or 0)
+    except Exception:
+        return 0
+
+
+def _ref_size(ref) -> Optional[int]:
+    """Size of a READY object if it lives in this node's store; None for
+    remote/unknown objects (callers fall back to an estimate)."""
+    try:
+        from ..runtime.core import get_core
+
+        return get_core().store.size_of(ref.id())
+    except Exception:
+        return None
+
+
 class ReservationOpResourceAllocator:
     """Per-operator admission budgets for concurrently-running stages.
 
@@ -52,8 +73,16 @@ class ReservationOpResourceAllocator:
     ReservationOpResourceAllocator — the reference reserves a fraction
     of the budget for EACH operator so a hungry upstream producer can
     never starve a downstream consumer; the remainder is a shared pool.
-    Same contract here over in-flight task slots, with the global
-    store-pressure fraction as the memory backstop: above the pressure
+    Same contract here over in-flight task slots AND object-store
+    bytes (the reference accounts object-store memory per op from block
+    metadata — resource_manager.py _ReservationOpResourceAllocator
+    update_usages). Slots bound concurrency; bytes bound how much store
+    an op's unconsumed outputs may pin, so a map producing 10x blocks
+    throttles on its BYTE budget long before its outputs can evict a
+    downstream reducer's. Output sizes are charged as an estimate at
+    admission (input size x the op's observed expansion ratio) and
+    settled to the real size when the task lands. The global
+    store-pressure fraction stays as the backstop: above the hard
     threshold an op may only use its RESERVED slots (so the downstream
     op always has headroom to drain — draining is what frees the store),
     below it the shared pool serves whoever asks.
@@ -61,9 +90,11 @@ class ReservationOpResourceAllocator:
 
     PRESSURE_HARD = 0.85
     PRESSURE_SOFT = 0.6
+    DEFAULT_BLOCK_EST = 1 << 20  # unknown sizes: assume 1 MB blocks
 
     def __init__(self, n_ops: int, max_in_flight: Optional[int] = None,
-                 reserved_fraction: float = 0.5):
+                 reserved_fraction: float = 0.5,
+                 byte_budget: Optional[int] = None):
         self.max_in_flight = max_in_flight or _default_max_in_flight()
         self.n_ops = max(1, n_ops)
         self.reserve = max(
@@ -71,8 +102,39 @@ class ReservationOpResourceAllocator:
         self.shared = max(0, self.max_in_flight - self.reserve * self.n_ops)
         self.in_flight = [0] * self.n_ops
         self.shared_used = 0
+        # ---- byte accounting (0 budget = unknown capacity: slots only)
+        if byte_budget is None:
+            byte_budget = _store_capacity() // 2
+        self.byte_budget = byte_budget
+        self.reserve_bytes = byte_budget // self.n_ops
+        self.op_bytes = [0] * self.n_ops      # charged, not yet released
+        self.charges: Dict[Any, tuple] = {}   # ref -> (op, charged bytes)
+        self.ratio = [1.0] * self.n_ops       # observed out/in expansion
+        self._ratio_n = [0] * self.n_ops
 
-    def can_admit(self, op: int) -> bool:
+    # -------------------------------------------------------------- bytes
+    def estimate_out(self, op: int, in_bytes: Optional[int]) -> int:
+        if not in_bytes:
+            in_bytes = self.DEFAULT_BLOCK_EST
+        return max(1, int(in_bytes * self.ratio[op]))
+
+    def _byte_ok(self, op: int, est: int) -> bool:
+        if not self.byte_budget:
+            return True
+        if self.op_bytes[op] + est <= self.reserve_bytes:
+            return True
+        # beyond its reservation an op dips into the whole budget, but
+        # only while the store itself isn't under pressure
+        total = sum(self.op_bytes)
+        return (total + est <= self.byte_budget
+                and _store_used_fraction() < self.PRESSURE_SOFT)
+
+    def can_admit(self, op: int, est_bytes: int = 0) -> bool:
+        if not self._byte_ok(op, est_bytes):
+            # always leave each op ONE runnable task: byte budgets bound
+            # store growth, they must never deadlock forward progress
+            if self.in_flight[op] > 0:
+                return False
         if self.in_flight[op] < self.reserve:
             return True
         frac = _store_used_fraction()
@@ -82,15 +144,52 @@ class ReservationOpResourceAllocator:
                       else max(1, self.shared // 4))
         return self.shared_used < shared_cap
 
-    def admit(self, op: int) -> None:
+    def admit(self, op: int, ref: Any = None, est_bytes: int = 0) -> None:
         if self.in_flight[op] >= self.reserve:
             self.shared_used += 1
         self.in_flight[op] += 1
+        if ref is not None and self.byte_budget:
+            est = est_bytes or self.DEFAULT_BLOCK_EST
+            self.op_bytes[op] += est
+            self.charges[ref] = (op, est)
 
-    def release(self, op: int) -> None:
+    def settle(self, op: int, ref: Any, in_bytes: Optional[int],
+               actual: Optional[int] = None) -> None:
+        """Task landed: replace the ref's estimated charge with its real
+        size and fold the observation into the op's expansion ratio.
+        `actual` overrides the single-ref measurement for multi-output
+        tasks (a partition task's charge ref is parts[0]; its true
+        output is the SUM over all partitions)."""
+        if ref not in self.charges:
+            return
+        if actual is None:
+            actual = _ref_size(ref)
+        if actual is None:
+            return
+        _, est = self.charges[ref]
+        self.op_bytes[op] += actual - est
+        self.charges[ref] = (op, actual)
+        if in_bytes:
+            n = self._ratio_n[op]
+            self.ratio[op] = (self.ratio[op] * n + actual / in_bytes) / (
+                n + 1)
+            self._ratio_n[op] = n + 1
+
+    def release(self, op: int, ref: Any = None) -> None:
         self.in_flight[op] -= 1
         if self.in_flight[op] >= self.reserve:
             self.shared_used = max(0, self.shared_used - 1)
+        self.release_bytes(ref)
+
+    def release_bytes(self, ref: Any) -> None:
+        """The ref's consumer finished (or the pipeline is handing the
+        blocks on): its store bytes no longer count against the op."""
+        if ref is None:
+            return
+        ch = self.charges.pop(ref, None)
+        if ch is not None:
+            op, n = ch
+            self.op_bytes[op] = max(0, self.op_bytes[op] - n)
 
 
 # ---------------------------------------------------------- remote helpers
@@ -324,6 +423,8 @@ class StreamingExecutor:
 
     def __init__(self, max_in_flight: Optional[int] = None):
         self.max_in_flight = max_in_flight or _default_max_in_flight()
+        self.stage_stats: List[dict] = []  # per-stage execution stats
+        self._depth = 0  # execute() recurses for union/zip/join inputs
 
     # -------------------------------------------------------------- public
     def execute(self, stages: List[Any]) -> List[Any]:
@@ -331,41 +432,68 @@ class StreamingExecutor:
         from .plan import (AllToAllStage, JoinStage, LimitStage, MapStage,
                            SourceStage, UnionStage, ZipStage)
         import ray_tpu
+        import time
 
-        refs: List[Any] = []
-        i = 0
-        while i < len(stages):
-            stage = stages[i]
-            nxt = stages[i + 1] if i + 1 < len(stages) else None
-            if (isinstance(stage, MapStage)
-                    and isinstance(nxt, AllToAllStage)
-                    and nxt.kind != "sort" and refs):
-                # pipelined pair (sort excluded: its bounds sample needs
-                # every MAPPED block before partitioning can start)
-                refs = self._run_map_then_all_to_all(stage, nxt, refs)
-                i += 2
-                continue
-            i += 1
-            if isinstance(stage, SourceStage):
-                refs = self._run_source(stage)
-            elif isinstance(stage, MapStage):
-                refs = self._run_map(stage, refs)
-            elif isinstance(stage, AllToAllStage):
-                refs = self._run_all_to_all(stage, refs)
-            elif isinstance(stage, JoinStage):
-                refs = self._run_join(stage, refs)
-            elif isinstance(stage, UnionStage):
-                from .dataset import Dataset  # noqa: avoid cycle at import
+        if self._depth == 0:
+            self.stage_stats = []
+        self._depth += 1
+        try:
+            refs: List[Any] = []
+            i = 0
+            while i < len(stages):
+                stage = stages[i]
+                nxt = stages[i + 1] if i + 1 < len(stages) else None
+                t0 = time.perf_counter()
+                if (isinstance(stage, MapStage)
+                        and isinstance(nxt, AllToAllStage)
+                        and nxt.kind != "sort" and refs):
+                    # pipelined pair (sort excluded: its bounds sample
+                    # needs every MAPPED block before partitioning)
+                    refs = self._run_map_then_all_to_all(stage, nxt, refs)
+                    self._record(f"Map->AllToAll[{nxt.kind}]", t0, refs)
+                    i += 2
+                    continue
+                i += 1
+                if isinstance(stage, SourceStage):
+                    refs = self._run_source(stage)
+                elif isinstance(stage, MapStage):
+                    refs = self._run_map(stage, refs)
+                elif isinstance(stage, AllToAllStage):
+                    refs = self._run_all_to_all(stage, refs)
+                elif isinstance(stage, JoinStage):
+                    refs = self._run_join(stage, refs)
+                elif isinstance(stage, UnionStage):
+                    from .dataset import Dataset  # noqa: avoid cycle
 
-                for other in stage.others:
-                    refs = refs + self.execute(_compile(other))
-            elif isinstance(stage, ZipStage):
-                refs = self._run_zip(stage, refs)
-            elif isinstance(stage, LimitStage):
-                refs = self._run_limit(stage, refs)
-            else:
-                raise TypeError(f"unknown stage {stage}")
-        return refs
+                    for other in stage.others:
+                        refs = refs + self.execute(_compile(other))
+                elif isinstance(stage, ZipStage):
+                    refs = self._run_zip(stage, refs)
+                elif isinstance(stage, LimitStage):
+                    refs = self._run_limit(stage, refs)
+                else:
+                    raise TypeError(f"unknown stage {stage}")
+                self._record(type(stage).__name__.replace("Stage", ""),
+                             t0, refs)
+            return refs
+        finally:
+            self._depth -= 1
+
+    def _record(self, name: str, t0: float, refs: List[Any]) -> None:
+        """One stats row per executed stage (ref: the reference's
+        DatasetStats per-stage wall time / output rows — _internal/
+        stats.py). Output bytes are best-effort: only blocks resident in
+        this node's store are counted (fetching to measure would defeat
+        streaming)."""
+        import time
+
+        sized = [s for s in (_ref_size(r) for r in refs) if s is not None]
+        self.stage_stats.append({
+            "stage": name,
+            "wall_s": round(time.perf_counter() - t0, 4),
+            "out_blocks": len(refs),
+            "out_bytes_local": sum(sized) if sized else None,
+        })
 
     # ------------------------------------------------------------- sources
     def _run_source(self, stage) -> List[Any]:
@@ -484,23 +612,37 @@ class StreamingExecutor:
         apply_ = ray_tpu.remote(_apply_chain)
         part = ray_tpu.remote(_partition_block).options(num_returns=n_out)
 
-        pending = list(refs)
-        map_running: Dict[Any, None] = {}
-        map_done: List[Any] = []     # mapped blocks awaiting partition
-        part_running: Dict[Any, List[Any]] = {}  # head ref -> parts
-        map_outs: List[List[Any]] = []
+        # map_outs is indexed by INPUT block position, not completion
+        # order: _reduce_partition concatenates the i-th partition from
+        # every map output in map_outs order, so for order-preserving
+        # kinds (repartition) and seeded random_shuffle the global row
+        # order must not depend on which task finished first.
+        pending = list(enumerate(refs))
+        map_running: Dict[Any, tuple] = {}  # ref -> (input idx, in bytes)
+        map_done: List[tuple] = []   # (idx, mapped block) awaiting part
+        part_running: Dict[Any, tuple] = {}  # head -> (idx, parts, mref)
+        map_outs: List[Optional[List[Any]]] = [None] * len(refs)
         while pending or map_running or map_done or part_running:
             progressed = False
-            while pending and alloc.can_admit(0):
-                mref = apply_.remote(map_stage.fns, pending.pop(0))
-                alloc.admit(0)
-                map_running[mref] = None
+            while pending:
+                in_bytes = _ref_size(pending[0][1])
+                est = alloc.estimate_out(0, in_bytes)
+                if not alloc.can_admit(0, est):
+                    break
+                idx, in_ref = pending.pop(0)
+                mref = apply_.remote(map_stage.fns, in_ref)
+                alloc.admit(0, ref=mref, est_bytes=est)
+                map_running[mref] = (idx, in_bytes)
                 progressed = True
-            while map_done and alloc.can_admit(1):
-                res = part.remote(map_done.pop(0), n_out, kind, args)
+            while map_done:
+                est = alloc.estimate_out(1, _ref_size(map_done[0][1]))
+                if not alloc.can_admit(1, est):
+                    break
+                idx, mref = map_done.pop(0)
+                res = part.remote(mref, n_out, kind, args)
                 parts = res if isinstance(res, list) else [res]
-                alloc.admit(1)
-                part_running[parts[0]] = parts
+                alloc.admit(1, ref=parts[0], est_bytes=est)
+                part_running[parts[0]] = (idx, parts, mref)
                 progressed = True
             waitable = list(map_running) + list(part_running)
             if not waitable:
@@ -510,13 +652,22 @@ class StreamingExecutor:
             ready, _ = ray_tpu.wait(waitable, num_returns=1, timeout=300)
             for r in ready:
                 if r in map_running:
-                    del map_running[r]
-                    alloc.release(0)
-                    map_done.append(r)
+                    idx, in_bytes = map_running.pop(r)
+                    alloc.settle(0, r, in_bytes)
+                    alloc.release(0)  # slot freed; bytes stay charged
+                    map_done.append((idx, r))
                 else:
-                    map_outs.append(part_running.pop(r))
-                    alloc.release(1)
-        return self._run_all_to_all(a2a_stage, refs, map_outs=map_outs)
+                    idx, parts, mref = part_running.pop(r)
+                    map_outs[idx] = parts
+                    sizes = [s for s in (_ref_size(p) for p in parts)
+                             if s is not None]
+                    alloc.settle(1, r, _ref_size(mref),
+                                 actual=sum(sizes) if sizes else None)
+                    alloc.release(1, ref=r)  # reduce consumes next stage
+                    alloc.release_bytes(mref)  # mapped block consumed
+        return self._run_all_to_all(
+            a2a_stage, refs,
+            map_outs=[m for m in map_outs if m is not None])
 
     def _sample_sort_bounds(self, refs, args, n_out):
         import ray_tpu

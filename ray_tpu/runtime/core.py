@@ -600,13 +600,19 @@ class CoreWorker:
             return await self._materialize_async(oid)
         client = self.client_for(owner)
         lost = False
-        for attempt in range(3):
+        failed_src = None  # node_addr of the replica a pull failed from
+        primary_failures = 0
+        # a stale SECONDARY replica only costs a drop-and-retry (the
+        # owner prunes it from the directory); the hard 3-failure budget
+        # applies to failures implicating the PRIMARY. The outer cap
+        # bounds pathological directories (many evicted secondaries).
+        for attempt in range(8):
             remaining = None if deadline is None else max(
                 0.0, deadline - time.monotonic())
             try:
                 kind, payload = await client.call_async(
                     "fetch_object", _timeout=remaining, oid=oid.binary(),
-                    host=self.host_id, lost=lost)
+                    host=self.host_id, lost=lost, src=failed_src)
             except asyncio.TimeoutError:
                 raise exceptions.GetTimeoutError(
                     f"get() timed out fetching {oid.hex()} from owner")
@@ -627,11 +633,18 @@ class CoreWorker:
                     oid.hex(), f"unexpected fetch kind {kind}")
             except (exceptions.ObjectLostError, FileNotFoundError,
                     ConnectionLost):
-                if attempt >= 2:
-                    raise
-                # the copy we were pointed at is gone: tell the owner so
-                # it can reconstruct via lineage, then retry
+                # the copy we were pointed at is gone: tell the owner
+                # WHICH source failed so it can drop a stale replica (or
+                # reconstruct via lineage if the primary is implicated),
+                # then retry
                 lost = True
+                failed_src = (payload.get("node_addr")
+                              if kind == "remote"
+                              and isinstance(payload, dict) else None)
+                if failed_src is None:
+                    primary_failures += 1
+                if primary_failures >= 3 or attempt >= 7:
+                    raise
 
     # ------------------------------------------------ lineage reconstruction
     def _remember_lineage(self, pending: "_PendingTask"):
@@ -1244,18 +1257,34 @@ class CoreWorker:
             d[src][1] = max(0, d[src][1] - 1)
 
     async def _h_fetch_object(self, oid: bytes, host: str = None,
-                              lost: bool = False):
+                              lost: bool = False, src: str = None):
         obj_id = ObjectID(oid)
         if lost:
-            # a borrower failed to pull the copy we pointed it at; verify
-            # and reconstruct before answering again
+            # a borrower failed to pull the copy we pointed it at. When
+            # the failed source was a SECONDARY replica (registered via
+            # replica_ready, since evicted), drop it from the directory
+            # and answer from the remaining sources — lineage
+            # reconstruction is for a lost PRIMARY only (ADVICE r4: a
+            # stale replica entry must not trigger reconstruction while
+            # the primary copy still exists).
             value = self.memory_store.get(obj_id, _MISSING)
-            if isinstance(value, _RemoteShm) or (
-                    value is _IN_SHM and not self.store.contains(obj_id)):
-                self.memory_store.pop(obj_id, None)
-            if self.memory_store.get(obj_id, _MISSING) is _MISSING \
-                    and not self.store.contains(obj_id):
-                await self._recover(obj_id, "reported lost by borrower")
+            primary_addr = (value.node_addr
+                            if isinstance(value, _RemoteShm)
+                            else self.address)
+            if src is not None and src != primary_addr:
+                d = self._replica_dirs.get(obj_id)
+                if d is not None:
+                    d.pop(src, None)
+            else:
+                # primary implicated (or source unknown): verify and
+                # reconstruct before answering again
+                if isinstance(value, _RemoteShm) or (
+                        value is _IN_SHM
+                        and not self.store.contains(obj_id)):
+                    self.memory_store.pop(obj_id, None)
+                if self.memory_store.get(obj_id, _MISSING) is _MISSING \
+                        and not self.store.contains(obj_id):
+                    await self._recover(obj_id, "reported lost by borrower")
         if obj_id not in self.memory_store:
             if obj_id in self._events or obj_id in self.owned:
                 await self._event(obj_id).wait()

@@ -121,10 +121,16 @@ class TCPBackend(StoreBackend):
     pass); replay reads are synchronous calls.
 
     Lost sends are NOT silent: a notify that fails (store connection
-    down) is recorded on an ordered backlog and the backend flips
-    ``degraded``; the next verb replays the backlog first (the RPC layer
-    reconnects underneath), and close() makes a final synchronous replay
-    attempt so a head failover can tell whether the store is complete.
+    down) is recorded on a backlog and the backend flips ``degraded``;
+    the next verb replays the backlog first (the RPC layer reconnects
+    underneath), and close() makes a final synchronous replay attempt so
+    a head failover can tell whether the store is complete.
+
+    Every record carries a sequence number stamped at FIRST send, and
+    the backlog replays in seq order: failure callbacks arrive in
+    completion order, so after a second outage mid-replay, re-failed old
+    records and newly-failed ones would otherwise interleave out of
+    journal order (ADVICE r4).
     """
 
     # bound the loss backlog: past this we keep degraded=True but stop
@@ -139,8 +145,9 @@ class TCPBackend(StoreBackend):
         self.client = RpcClient(address)
         self.client.call("ping", _timeout=15)
         self.degraded = False
-        self._backlog: List[Tuple[str, dict]] = []  # send order preserved
+        self._backlog: List[Tuple[str, dict]] = []  # sorted by seq on use
         self._dropped = 0
+        self._seq = 0  # journal order, stamped once per record
         self.client.on_notify_error = self._on_lost
 
     def _on_lost(self, method: str, kwargs: dict, exc) -> None:
@@ -156,11 +163,16 @@ class TCPBackend(StoreBackend):
         else:
             self._dropped += 1
 
+    def _next_seq(self) -> int:
+        self._seq += 1
+        return self._seq
+
     def _replay_backlog(self) -> None:
-        """Re-send recorded losses ahead of new records (journal order
-        matters). Still-failing sends land back on the backlog via the
-        error hook."""
+        """Re-send recorded losses ahead of new records, in original
+        journal (seq) order. Still-failing sends land back on the
+        backlog via the error hook, keeping their original seq."""
         backlog, self._backlog = self._backlog, []
+        backlog.sort(key=lambda e: e[1].get("seq", 0))
         for method, kwargs in backlog:
             self.client.notify_nowait(method, **kwargs)
 
@@ -175,7 +187,8 @@ class TCPBackend(StoreBackend):
     def save_meta(self, blob: bytes) -> None:
         if self._backlog:
             self._replay_backlog()
-        self.client.notify_nowait("st_save_meta", blob=blob)
+        self.client.notify_nowait("st_save_meta", blob=blob,
+                                  seq=self._next_seq())
 
     def load_meta(self) -> Optional[bytes]:
         blob = self.client.call("st_load_meta", _timeout=60)
@@ -185,7 +198,8 @@ class TCPBackend(StoreBackend):
     def append_kv(self, record) -> None:
         if self._backlog:
             self._replay_backlog()
-        self.client.notify_nowait("st_append_kv", record=record)
+        self.client.notify_nowait("st_append_kv", record=record,
+                                  seq=self._next_seq())
 
     def load_kv(self) -> Tuple[Optional[bytes], List, bool]:
         snap, records, had = self.client.call("st_load_kv", _timeout=120)
@@ -194,9 +208,12 @@ class TCPBackend(StoreBackend):
 
     def compact_kv(self, snapshot: bytes) -> None:
         self.client.call("st_compact_kv", snapshot=snapshot, _timeout=120)
-        # a successful synchronous compact supersedes any lost journal
-        # appends recorded before it — the snapshot carries their state
-        self._backlog.clear()
+        # a successful synchronous compact supersedes lost journal
+        # APPENDS recorded before it — the snapshot carries their state.
+        # Lost st_save_meta records cover a DIFFERENT table the KV
+        # snapshot does not supersede: keep them for replay (ADVICE r4).
+        self._backlog = [e for e in self._backlog
+                         if e[0] == "st_save_meta"]
         self._dropped = 0
         self._maybe_recover()
 
@@ -218,6 +235,7 @@ class TCPBackend(StoreBackend):
             # the backlog as one-ways, hand the drain to the loop, and
             # report (a sync last-chance replay is impossible here)
             backlog, self._backlog = self._backlog, []
+            backlog.sort(key=lambda e: e[1].get("seq", 0))
             for method, kwargs in backlog:
                 self.client.notify_nowait(method, **kwargs)
             if backlog or self._dropped:
@@ -233,6 +251,7 @@ class TCPBackend(StoreBackend):
             time.sleep(0.01)
         # last chance for recorded losses: synchronous, so a clean
         # shutdown either persists them or reports exactly what it lost
+        self._backlog.sort(key=lambda e: e[1].get("seq", 0))
         for method, kwargs in self._backlog:
             try:
                 self.client.call(method, _timeout=5, **kwargs)
@@ -266,14 +285,14 @@ def serve_store(directory: str, address: str):
 
     backend = FileBackend(directory)
 
-    async def st_save_meta(blob: bytes):
+    async def st_save_meta(blob: bytes, seq: int = 0):
         backend.save_meta(blob)
         return True
 
     async def st_load_meta():
         return backend.load_meta()
 
-    async def st_append_kv(record):
+    async def st_append_kv(record, seq: int = 0):
         backend.append_kv(record)
         return True
 

@@ -73,7 +73,20 @@ def _restore_preload() -> None:
 def _install_lazy_preload() -> None:
     """Slim tier: arrange for the host preload (and PYTHONPATH) to be
     restored the first time jax/jaxlib is imported, so user code that
-    unexpectedly needs jax works — it just pays the import cost then."""
+    unexpectedly needs jax works — it just pays the import cost then.
+
+    The preload must NOT import jax re-entrantly from inside find_spec:
+    CPython's ``_find_spec`` notices the module appearing in sys.modules
+    mid-find and substitutes the module's real ``__spec__`` for whatever
+    the finder returns, so the import machinery re-executes the module
+    top-level into a FRESH object. For jax that fresh module misses the
+    ``core`` submodule attribute (``import jax.core`` is satisfied from
+    sys.modules on re-exec, so parent-attr binding never re-fires) and
+    every chex/optax import dies with ``jax has no attribute 'core'``.
+    Instead we resolve the real spec ourselves (PathFinder, skipping
+    this finder) and wrap its loader: the module executes normally, and
+    the preload (sitecustomize → PJRT registration) runs AFTER the
+    top-level finishes — the same ordering the warm tier produces."""
     orig = os.environ.get("RTPU_ORIG_PYTHONPATH")
     if not orig or "jax" in sys.modules:
         return
@@ -82,23 +95,38 @@ def _install_lazy_preload() -> None:
     # stay importable NOW — only the preload EXECUTION is deferred
     sys.path[:0] = preload_dirs(orig)
     import importlib.abc
+    import importlib.machinery
     import importlib.util
 
-    class _AliasLoader(importlib.abc.Loader):
-        """Hands back an ALREADY-imported module: after the preload has
-        imported `name`, returning None from find_spec would make the
-        import machinery execute the module top-level a second time
-        into a fresh module object (orphaning everything the first
-        execution registered)."""
+    class _PreloadAfterLoader(importlib.abc.Loader):
+        """Delegates to the real loader, then runs the host preload
+        once the module's top-level has fully executed."""
 
-        def __init__(self, mod):
-            self._mod = mod
+        def __init__(self, real_spec):
+            self._real = real_spec
+
+        def get_filename(self, name):
+            # spec_from_loader only marks the spec has_location (which
+            # is what gives the module a __file__) when the loader
+            # exposes get_filename; without it, slim-tier jax lacks
+            # __file__ and inspect.getfile(jax)/os.path.dirname(
+            # jax.__file__) break only on this tier
+            return self._real.origin
+
+        def is_package(self, name):
+            return self._real.submodule_search_locations is not None
 
         def create_module(self, spec):
-            return self._mod
+            return self._real.loader.create_module(self._real)
 
         def exec_module(self, module):
-            pass
+            self._real.loader.exec_module(module)
+            try:
+                _restore_preload()
+            except Exception:  # noqa: BLE001 — preload failure must not
+                import traceback  # kill the user's jax import
+
+                traceback.print_exc()
 
     class _LazyPreload(importlib.abc.MetaPathFinder):
         done = False
@@ -109,12 +137,19 @@ def _install_lazy_preload() -> None:
             if name.split(".")[0] not in ("jax", "jaxlib"):
                 return None
             _LazyPreload.done = True
-            _restore_preload()
-            mod = sys.modules.get(name)
-            if mod is not None:  # the preload imported it: alias it
-                return importlib.util.spec_from_loader(
-                    name, _AliasLoader(mod))
-            return None  # preload absent: normal import machinery
+            real = importlib.machinery.PathFinder.find_spec(name, path)
+            if real is None or real.loader is None:
+                return None  # not installed: normal machinery (and its
+                # ModuleNotFoundError) takes over
+            # no explicit origin: spec_from_loader must route through
+            # spec_from_file_location (via the loader's get_filename) so
+            # the spec is has_location=True and the module gets __file__
+            spec = importlib.util.spec_from_loader(
+                name, _PreloadAfterLoader(real))
+            if spec.submodule_search_locations is not None:
+                spec.submodule_search_locations = (
+                    real.submodule_search_locations)
+            return spec
 
     sys.meta_path.insert(0, _LazyPreload())
 

@@ -11,13 +11,15 @@ from .api import (  # noqa: F401
     delete,
     get_app_handle,
     get_deployment_handle,
+    get_grpc_address,
     get_proxy_url,
     run,
     shutdown,
     start,
     status,
 )
-from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions  # noqa: F401
+from .config import (AutoscalingConfig, DeploymentConfig,  # noqa: F401
+                     HTTPOptions, gRPCOptions)
 from .deployment import Application, Deployment, deployment  # noqa: F401
 from .handle import DeploymentHandle, DeploymentResponse  # noqa: F401
 from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
@@ -26,6 +28,8 @@ from .replica import Request  # noqa: F401
 __all__ = [
     "deployment", "Deployment", "Application", "run", "start", "status",
     "delete", "shutdown", "get_app_handle", "get_deployment_handle",
-    "get_proxy_url", "DeploymentHandle", "DeploymentResponse", "multiplexed", "get_multiplexed_model_id",
-    "AutoscalingConfig", "DeploymentConfig", "HTTPOptions", "Request",
+    "get_proxy_url", "get_grpc_address", "DeploymentHandle",
+    "DeploymentResponse", "multiplexed", "get_multiplexed_model_id",
+    "AutoscalingConfig", "DeploymentConfig", "HTTPOptions", "gRPCOptions",
+    "Request",
 ]

@@ -12,7 +12,7 @@ import time
 from typing import Any, Dict, Optional
 
 from .config import (CONTROLLER_NAME, DEFAULT_APP_NAME, DEFAULT_HTTP_PORT,
-                     PROXY_NAME, HTTPOptions)
+                     GRPC_PROXY_NAME, PROXY_NAME, HTTPOptions, gRPCOptions)
 from .deployment import Application, flatten_app
 from .handle import DeploymentHandle, _Router
 
@@ -48,8 +48,10 @@ def _get_controller(create: bool = True):
     raise RuntimeError("could not obtain a live Serve controller")
 
 
-def start(http_options: Optional[HTTPOptions] = None, **_ignored) -> None:
-    """Start the Serve control plane + HTTP proxy (ref: api.py serve.start)."""
+def start(http_options: Optional[HTTPOptions] = None,
+          grpc_options: Optional[gRPCOptions] = None, **_ignored) -> None:
+    """Start the Serve control plane + ingress proxies (ref: api.py
+    serve.start — HTTP always, gRPC when grpc_options given)."""
     import ray_tpu
     from ..actor import ActorClass
     from .proxy import ProxyActor
@@ -63,6 +65,18 @@ def start(http_options: Optional[HTTPOptions] = None, **_ignored) -> None:
                            max_concurrency=256).remote(opts.host, opts.port)
         proxy.run.remote()  # fire-and-forget server loop
         ray_tpu.get(proxy.get_port.remote())  # wait until listening
+    if grpc_options is not None:
+        from .grpc_proxy import GrpcProxyActor
+
+        try:
+            ray_tpu.get_actor(GRPC_PROXY_NAME)
+        except Exception:
+            gproxy = ActorClass(
+                GrpcProxyActor, name=GRPC_PROXY_NAME, get_if_exists=True,
+                max_concurrency=256).remote(grpc_options.host,
+                                            grpc_options.port)
+            gproxy.run.remote()  # fire-and-forget server loop
+            ray_tpu.get(gproxy.get_port.remote())
 
 
 def get_proxy_url() -> str:
@@ -73,12 +87,29 @@ def get_proxy_url() -> str:
     return f"http://127.0.0.1:{port}"
 
 
+def get_grpc_address() -> str:
+    """host:port of the gRPC ingress (requires serve.start(
+    grpc_options=...))."""
+    import ray_tpu
+
+    proxy = ray_tpu.get_actor(GRPC_PROXY_NAME)
+    port = ray_tpu.get(proxy.get_port.remote())
+    return f"127.0.0.1:{port}"
+
+
 def run(app: Application, *, name: str = DEFAULT_APP_NAME,
         route_prefix: str = "/", blocking: bool = False,
         _start_http: bool = False, wait_timeout_s: float = 180.0,
+        local_testing_mode: bool = False,
         ) -> DeploymentHandle:
     """Deploy an application and wait for it to be RUNNING
-    (ref: serve/api.py:687)."""
+    (ref: serve/api.py:687). With ``local_testing_mode=True`` every
+    replica runs in-process — no cluster, no controller, no actors
+    (ref: serve/_private/local_testing_mode.py)."""
+    if local_testing_mode:
+        from .local_mode import run_local
+
+        return run_local(app, name)
     from ..runtime import serialization
 
     controller = _get_controller()
@@ -129,6 +160,11 @@ def status() -> Dict[str, Any]:
 def get_app_handle(name: str = DEFAULT_APP_NAME) -> DeploymentHandle:
     import ray_tpu
 
+    from .local_mode import get_local_app
+
+    local = get_local_app(name)
+    if local is not None:
+        return local
     controller = _get_controller(create=False)
     ingress = ray_tpu.get(controller.get_ingress.remote(name))
     if ingress is None:
@@ -145,6 +181,10 @@ def get_deployment_handle(deployment_name: str,
 def delete(name: str) -> None:
     import ray_tpu
 
+    from .local_mode import delete_local_app
+
+    if delete_local_app(name):
+        return
     controller = _get_controller(create=False)
     ray_tpu.get(controller.delete_app.remote(name))
     _Router.reset_all()
@@ -158,14 +198,14 @@ def shutdown() -> None:
         ray_tpu.get(controller.shutdown.remote(), timeout=30)
     except Exception:
         pass  # controller already gone; still clean up proxy below
-    for actor_name in (PROXY_NAME, CONTROLLER_NAME):
+    for actor_name in (PROXY_NAME, GRPC_PROXY_NAME, CONTROLLER_NAME):
         try:
             ray_tpu.kill(ray_tpu.get_actor(actor_name))
         except Exception:
             pass
     # Wait for the names to clear so a subsequent serve.start() is clean.
     deadline = time.time() + 15
-    for actor_name in (PROXY_NAME, CONTROLLER_NAME):
+    for actor_name in (PROXY_NAME, GRPC_PROXY_NAME, CONTROLLER_NAME):
         while time.time() < deadline:
             try:
                 if ray_tpu.get_actor(actor_name) is None:

@@ -17,7 +17,9 @@ DEFAULT_MAX_ONGOING_REQUESTS = 5
 DEFAULT_APP_NAME = "default"
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 PROXY_NAME = "SERVE_PROXY"
+GRPC_PROXY_NAME = "SERVE_GRPC_PROXY"
 DEFAULT_HTTP_PORT = 8800
+DEFAULT_GRPC_PORT = 9800
 
 
 @dataclass
@@ -70,6 +72,17 @@ class DeploymentConfig:
 class HTTPOptions:
     host: str = "127.0.0.1"
     port: int = DEFAULT_HTTP_PORT
+
+
+@dataclass
+class gRPCOptions:
+    """gRPC ingress config (ref: serve/config.py gRPCOptions — the
+    reference takes ``grpc_servicer_functions``; here the generic
+    bytes-in/bytes-out handler serves every method, so only the bind
+    address is needed)."""
+
+    host: str = "127.0.0.1"
+    port: int = DEFAULT_GRPC_PORT
 
 
 def replica_actor_name(app: str, deployment: str, replica_id: str) -> str:

@@ -1,0 +1,126 @@
+"""gRPC ingress proxy actor.
+
+Parity with the reference's gRPC proxy (ref:
+python/ray/serve/_private/proxy.py gRPCProxy :417 — there, user-supplied
+``grpc_servicer_functions`` register generated protobuf servicers and the
+proxy routes by the ``application`` request metadata). TPU-native
+redesign: a ``grpc.aio`` server with ONE GenericRpcHandler accepts every
+unary-unary method without generated stubs — request/response stay raw
+bytes on the wire, and the deployment sees the same ``Request`` object
+the HTTP proxy builds, so a single deployment serves both protocols
+through the shared router. Response mapping mirrors the HTTP proxy's:
+bytes → raw, str → utf-8, dict/list → JSON.
+
+Routing contract (ref: proxy.py gRPCProxy.setup_request_context_and_handle):
+- metadata ``application`` selects the app; with exactly one app deployed
+  the metadata is optional;
+- the called method path (``/pkg.Service/Method``) is forwarded as
+  ``Request.path`` and metadata as ``Request.headers``;
+- ``/grpc.health.v1.Health/Check`` answers SERVING (hand-encoded
+  protobuf: field 1 varint = 1) so standard health checkers work without
+  a generated health servicer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Dict, Optional
+
+from .proxy import RouteTableMixin
+from .replica import Request
+
+_HEALTH_METHOD = "/grpc.health.v1.Health/Check"
+# HealthCheckResponse{status: SERVING}: tag(field=1,varint)=0x08, value=1
+_HEALTH_SERVING = b"\x08\x01"
+
+
+def _encode_reply(result) -> bytes:
+    if isinstance(result, bytes):
+        return result
+    if isinstance(result, str):
+        return result.encode()
+    return json.dumps(result).encode()
+
+
+class GrpcProxyActor(RouteTableMixin):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._port = port
+        self._actual_port: Optional[int] = None
+        self._routes: Dict[str, dict] = {}  # route_prefix -> {app, ingress}
+        self._routes_fetched_at = 0.0
+        self._started = asyncio.Event()
+
+    async def run(self) -> None:
+        import grpc
+
+        proxy = self
+
+        class _Generic(grpc.GenericRpcHandler):
+            def service(self, details):
+                method = details.method
+                if method == _HEALTH_METHOD:
+                    return grpc.unary_unary_rpc_method_handler(
+                        lambda req, ctx: _HEALTH_SERVING)
+
+                async def call(request: bytes, context):
+                    return await proxy._handle(method, request, context)
+
+                # serializer/deserializer None: bytes pass through
+                return grpc.unary_unary_rpc_method_handler(call)
+
+        server = grpc.aio.server()
+        server.add_generic_rpc_handlers((_Generic(),))
+        self._actual_port = server.add_insecure_port(
+            f"{self._host}:{self._port}")
+        await server.start()
+        self._started.set()
+        await server.wait_for_termination()
+
+    async def get_port(self) -> int:
+        await asyncio.wait_for(self._started.wait(), timeout=30)
+        return self._actual_port
+
+    def _pick_app(self, metadata: Dict[str, str]) -> Optional[dict]:
+        want = metadata.get("application")
+        apps = {r["app"]: r for r in self._routes.values()}
+        if want is not None:
+            return apps.get(want)
+        if len(apps) == 1:
+            return next(iter(apps.values()))
+        return None  # ambiguous: metadata required with >1 app
+
+    async def _handle(self, method: str, body: bytes, context):
+        import grpc
+
+        await self._refresh_routes()
+        metadata = {k: v for k, v in (context.invocation_metadata() or ())
+                    if isinstance(v, str)}
+        route = self._pick_app(metadata)
+        if route is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"no application for metadata "
+                f"{metadata.get('application')!r} "
+                f"({len(self._routes)} routes)")
+        req = Request(method="GRPC", path=method, query_params={},
+                      headers=metadata, body=body)
+
+        from .handle import DeploymentHandle
+
+        handle = DeploymentHandle(route["app"], route["ingress"])
+        model_id = metadata.get("multiplexed_model_id")
+        if model_id:
+            handle = handle.options(multiplexed_model_id=model_id)
+        loop = asyncio.get_running_loop()
+
+        def call():
+            return handle.remote(req).result(timeout_s=120)
+
+        try:
+            result = await loop.run_in_executor(None, call)
+        except Exception as e:  # surface user errors as INTERNAL
+            await context.abort(grpc.StatusCode.INTERNAL,
+                                f"{type(e).__name__}: {e}")
+        return _encode_reply(result)

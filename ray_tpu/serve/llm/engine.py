@@ -955,6 +955,64 @@ class LLMEngine:
             n += 1
         return n
 
+    def measure_prefill(self, seq_len: Optional[int] = None,
+                        iters: int = 3,
+                        peak_flops: Optional[float] = None
+                        ) -> Dict[str, Any]:
+        """Synchronous prefill-only microbenchmark on the engine's own
+        compiled shape — the serve-side companion of the training
+        bench's MFU (TTFT alone hides how much prefill compute headroom
+        remains; ref contract: own ops/flash_attention.py reaches ~50%
+        in training). Uses the same masked dummy dispatch as warmup()
+        (total_lens=0: page writes masked, engine state untouched), so
+        it can run on a live replica between waves. Requires an idle
+        pipeline. FLOP accounting matches bench_train's convention:
+        fwd = 2*N params + 4*L*H*hd*S attention per token."""
+        import jax
+        import jax.numpy as jnp
+
+        assert not self._inflight, "measure_prefill requires idle engine"
+        sb = seq_len or max(self.config.prefill_buckets)
+        rb = self._wave_rb
+        fn = self._jit("prefill", (sb, rb, 0))
+        zeros = dict(
+            bt=jnp.asarray(np.zeros((rb, self.max_pages_per_seq),
+                                    np.int32)),
+            total=jnp.asarray(np.zeros((rb,), np.int32)),
+            ids=jnp.asarray(np.zeros((rb, sb), np.int32)),
+            pos=jnp.asarray(np.zeros((rb, sb), np.int32)),
+            gather=jnp.asarray(np.zeros((rb,), np.int32)),
+            temp=np.zeros((rb,), np.float32),
+            topk=np.zeros((rb,), np.int32),
+            keys=np.zeros((rb, 2), np.uint32))
+
+        def once():
+            toks, self.kv_pages = fn(
+                self.params, self.kv_pages, zeros["bt"], zeros["total"],
+                zeros["ids"], zeros["pos"], zeros["gather"],
+                zeros["temp"], zeros["topk"], zeros["keys"])
+            np.asarray(toks)  # host fetch = the only reliable sync here
+
+        once()  # untimed: compile + page-in
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            once()
+        dt = time.perf_counter() - t0
+
+        cfg = self.model_cfg
+        n_params = sum(x.size for x in jax.tree.leaves(self.params))
+        flops_per_tok = (2 * n_params
+                         + 4 * cfg.num_layers * cfg.num_heads
+                         * cfg.head_dim_ * sb)
+        tokens = rb * sb * iters
+        achieved = tokens / dt * flops_per_tok
+        out = {"seq_len": sb, "rows": rb, "iters": iters,
+               "prefill_tok_s": round(tokens / dt, 1),
+               "achieved_tflops": round(achieved / 1e12, 2)}
+        if peak_flops:
+            out["mfu"] = round(100.0 * achieved / peak_flops, 2)
+        return out
+
     # ------------------------------------------------------------ stats
 
     def stats(self) -> Dict[str, Any]:

@@ -1,0 +1,161 @@
+"""In-process local testing mode for Serve applications.
+
+Parity with the reference's local testing mode (ref:
+python/ray/serve/_private/local_testing_mode.py — make_local_deployment_
+handle: ``serve.run(app, local_testing_mode=True)`` runs every replica as
+a plain in-process object, no cluster, no controller, no actors), so a
+deployment graph can be unit-tested in milliseconds. Handles keep the
+production surface: ``.remote()`` → response with ``.result()`` /
+``await``, ``.options(method_name=..., multiplexed_model_id=...)``,
+attribute method access, and handle composition across deployments.
+
+Async user methods run on ONE shared background event loop (replicas in
+local mode share a loop the way replica actors each own one), so async
+deployments that call each other compose without deadlock; sync methods
+run on the submission thread pool.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import inspect
+import threading
+from typing import Any, Dict, Optional
+
+from .deployment import Application, flatten_app
+from .handle import _SUBMIT_POOL, DeploymentHandle
+from .multiplex import _current_model_id
+
+_LOCAL_APPS: Dict[str, "LocalDeploymentHandle"] = {}
+
+_loop_lock = threading.Lock()
+_loop: Optional[asyncio.AbstractEventLoop] = None
+
+
+def _event_loop() -> asyncio.AbstractEventLoop:
+    """The shared background loop for async deployment methods."""
+    global _loop
+    with _loop_lock:
+        if _loop is None or _loop.is_closed():
+            _loop = asyncio.new_event_loop()
+            threading.Thread(target=_loop.run_forever,
+                             name="serve-local-loop", daemon=True).start()
+        return _loop
+
+
+class LocalDeploymentResponse:
+    """Future-like response matching DeploymentResponse's surface."""
+
+    def __init__(self, fut: concurrent.futures.Future):
+        self._fut = fut
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        return self._fut.result(timeout=timeout_s)
+
+    def __await__(self):
+        return asyncio.wrap_future(self._fut).__await__()
+
+
+class LocalDeploymentHandle:
+    """Calls a local replica object directly — same API as
+    DeploymentHandle (ref: local_testing_mode.py LocalDeploymentHandle)."""
+
+    def __init__(self, replica: Any, app_name: str, deployment_name: str,
+                 method_name: str = "__call__", model_id: str = ""):
+        self._replica = replica
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self._method_name = method_name
+        self._model_id = model_id
+
+    def options(self, *, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None,
+                **_ignored) -> "LocalDeploymentHandle":
+        return LocalDeploymentHandle(
+            self._replica, self.app_name, self.deployment_name,
+            method_name or self._method_name,
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self._model_id)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return LocalDeploymentHandle(self._replica, self.app_name,
+                                     self.deployment_name, name,
+                                     self._model_id)
+
+    def remote(self, *args, **kwargs) -> LocalDeploymentResponse:
+        method = getattr(self._replica, self._method_name)
+        model_id = self._model_id
+
+        if inspect.iscoroutinefunction(method):
+            async def run():
+                token = _current_model_id.set(model_id)
+                try:
+                    return await method(*args, **kwargs)
+                finally:
+                    _current_model_id.reset(token)
+
+            fut = asyncio.run_coroutine_threadsafe(run(), _event_loop())
+        else:
+            def run():
+                token = _current_model_id.set(model_id)
+                try:
+                    return method(*args, **kwargs)
+                finally:
+                    _current_model_id.reset(token)
+
+            fut = _SUBMIT_POOL.submit(run)
+        return LocalDeploymentResponse(fut)
+
+    def __repr__(self):
+        return (f"LocalDeploymentHandle({self.app_name}/"
+                f"{self.deployment_name}.{self._method_name})")
+
+
+def run_local(app: Application, name: str) -> LocalDeploymentHandle:
+    """Build every deployment in-process and return the ingress handle
+    (ref: local_testing_mode.py make_local_deployment_handle)."""
+    specs = flatten_app(app, name)
+    replicas: Dict[str, Any] = {}
+    handles: Dict[str, LocalDeploymentHandle] = {}
+
+    def _localize(value):
+        # flatten_app replaced nested Applications with cluster handles;
+        # swap them for local ones (children are built before parents —
+        # flatten_app visits depth-first)
+        if isinstance(value, DeploymentHandle):
+            return handles[value.deployment_name]
+        return value
+
+    ingress: Optional[LocalDeploymentHandle] = None
+    for spec in specs:  # flatten_app inserts children before parents
+        args = tuple(_localize(a) for a in spec.init_args)
+        kwargs = {k: _localize(v) for k, v in spec.init_kwargs.items()}
+        replica = spec.func_or_class(*args, **kwargs)
+        cfg = spec.config
+        if cfg.user_config is not None and hasattr(replica, "reconfigure"):
+            out = replica.reconfigure(cfg.user_config)
+            if inspect.isawaitable(out):
+                asyncio.run_coroutine_threadsafe(
+                    _await(out), _event_loop()).result(timeout=30)
+        replicas[spec.name] = replica
+        handles[spec.name] = LocalDeploymentHandle(replica, name, spec.name)
+        if spec.is_ingress:
+            ingress = handles[spec.name]
+    assert ingress is not None
+    _LOCAL_APPS[name] = ingress
+    return ingress
+
+
+async def _await(x):
+    return await x
+
+
+def get_local_app(name: str) -> Optional[LocalDeploymentHandle]:
+    return _LOCAL_APPS.get(name)
+
+
+def delete_local_app(name: str) -> bool:
+    return _LOCAL_APPS.pop(name, None) is not None

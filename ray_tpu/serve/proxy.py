@@ -18,7 +18,30 @@ from .config import CONTROLLER_NAME
 from .replica import Request
 
 
-class ProxyActor:
+class RouteTableMixin:
+    """Shared controller route-cache for the ingress proxies (HTTP here,
+    gRPC in grpc_proxy.py): one staleness-capped refresh path, so route
+    behavior can't silently diverge between protocols."""
+
+    _routes: Dict[str, dict]
+    _routes_fetched_at: float
+
+    async def _refresh_routes(self) -> None:
+        import time
+
+        if time.time() - self._routes_fetched_at < 0.5:  # staleness cap
+            return
+        from ..actor import get_actor
+
+        controller = get_actor(CONTROLLER_NAME)
+        loop = asyncio.get_running_loop()
+        ref = controller.list_routes.remote()
+        self._routes = await loop.run_in_executor(
+            None, lambda: ref.future().result(timeout=10))
+        self._routes_fetched_at = time.time()
+
+
+class ProxyActor(RouteTableMixin):
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._host = host
         self._port = port
@@ -44,20 +67,6 @@ class ProxyActor:
     async def get_port(self) -> int:
         await asyncio.wait_for(self._started.wait(), timeout=30)
         return self._actual_port
-
-    async def _refresh_routes(self) -> None:
-        import time
-
-        if time.time() - self._routes_fetched_at < 0.5:  # staleness cap
-            return
-        from ..actor import get_actor
-
-        controller = get_actor(CONTROLLER_NAME)
-        loop = asyncio.get_running_loop()
-        ref = controller.list_routes.remote()
-        self._routes = await loop.run_in_executor(
-            None, lambda: ref.future().result(timeout=10))
-        self._routes_fetched_at = time.time()
 
     async def _handle(self, request):
         from aiohttp import web

@@ -133,3 +133,33 @@ def test_actor_restart(fresh_cluster):
             time.sleep(0.3)
     assert pid2 is not None and pid2 != pid1
     assert ray_tpu.get(f.incr.remote(), timeout=60) == 1  # state reset
+
+
+def test_slim_tier_actor_imports_jax_stack(shared_cluster):
+    """Regression: a zero-resource (slim-tier) actor must be able to
+    import the full jax stack. The slim factory tier forks without the
+    host's jax preload and installs a lazy hook; a round-4 version of
+    that hook restored the preload re-entrantly inside find_spec, which
+    re-executed jax/__init__ into a fresh module missing the ``core``
+    attribute — killing any worker importing optax/chex (every RLlib
+    learner). See worker_factory._install_lazy_preload."""
+
+    @ray_tpu.remote  # zero-resource: routed to the slim tier
+    class JaxStackUser:
+        def probe(self):
+            import chex  # noqa: F401
+            import flax  # noqa: F401
+            import optax  # noqa: F401
+            import jax
+            import jax.numpy as jnp
+
+            # jax.core access is exactly what chex needs at import time
+            assert jax.core.__name__ == "jax.core"
+            opt = optax.sgd(1e-2)
+            params = {"w": jnp.ones((4,))}
+            state = opt.init(params)
+            del state
+            return float(jax.jit(lambda x: x.sum())(jnp.ones((8,))))
+
+    a = JaxStackUser.remote()
+    assert ray_tpu.get(a.probe.remote(), timeout=120) == 8.0

@@ -161,3 +161,81 @@ def test_file_backend_round_trip(tmp_path):
         client.close()
     finally:
         EventLoopThread.get().run(c2.stop())
+
+
+def test_store_server_failover_mid_run(tmp_path):
+    """Kill the store server MID-RUN, bring a replacement up from the
+    same journal directory, and verify (a) the controller's backend
+    reconnects and replays everything it buffered while degraded, and
+    (b) a subsequent head restart against the replacement store replays
+    the full state — pre-outage, during-outage, and post-outage
+    mutations alike (ref: redis_store_client.h:111 Redis FT +
+    gcs_init_data.cc restart replay; the store's data dir is the
+    durable tier, the serving process is replaceable)."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    store_dir = str(tmp_path / "store")
+
+    def spawn():
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.runtime.storage",
+             "--dir", store_dir, "--port", str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env={**os.environ, "PYTHONPATH": os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))})
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if "store server on" in proc.stdout.readline():
+                return proc
+        raise AssertionError("store server never came up")
+
+    loop = EventLoopThread.get()
+    proc = spawn()
+    c1 = _start_controller("fo_sess", "tcp:127.0.0.1:0",
+                           f"tcp:127.0.0.1:{port}")
+    client = RpcClient(c1._server.address)
+    try:
+        client.call("kv_put", ns="fo", key="pre", value=b"pre-outage")
+        time.sleep(0.3)  # let the one-way append drain to the store
+
+        proc.terminate()
+        proc.wait(timeout=15)
+        # mutations DURING the outage land on the backend's backlog
+        client.call("kv_put", ns="fo", key="during", value=b"mid-outage")
+        be = c1._store_backend
+        deadline = time.monotonic() + 30
+        while not be.degraded and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert be.degraded, "backend never noticed the store died"
+
+        proc = spawn()  # replacement process, same journal dir
+        # post-outage mutation triggers backlog replay ahead of itself
+        client.call("kv_put", ns="fo", key="post", value=b"post-outage")
+        deadline = time.monotonic() + 30
+        while ((be._backlog
+                or getattr(be.client, "_inflight_notifies", 0) > 0)
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert not be._backlog, "backlog never drained after failover"
+    finally:
+        client.close()
+        loop.run(c1.stop())
+
+    # head restart against the REPLACEMENT store: full replay
+    c2 = _start_controller("fo_sess", "tcp:127.0.0.1:0",
+                           f"tcp:127.0.0.1:{port}")
+    try:
+        client = RpcClient(c2._server.address)
+        assert client.call("kv_get", ns="fo", key="pre") == b"pre-outage"
+        assert client.call("kv_get", ns="fo", key="during") == b"mid-outage"
+        assert client.call("kv_get", ns="fo", key="post") == b"post-outage"
+        client.close()
+    finally:
+        loop.run(c2.stop())
+        proc.terminate()
+        proc.wait(timeout=15)

@@ -245,3 +245,97 @@ def test_model_multiplexing(serve_cluster):
         assert out3["model"] == "wxyz" and out3["out"] == 4
     finally:
         serve.delete("mux")
+
+
+def test_grpc_and_http_share_one_deployment(serve_cluster):
+    """ref: serve/_private/proxy.py gRPCProxy :417 — one deployment
+    served over BOTH ingress protocols through the shared router. The
+    generic gRPC handler passes raw bytes; the deployment sees the same
+    Request object either way."""
+    import grpc
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, request):
+            if request.method == "GRPC":
+                x = json.loads(request.body)["x"]
+                return {"proto": "grpc", "path": request.path, "x": x * 2}
+            x = request.json()["x"]
+            return {"proto": "http", "path": request.path, "x": x * 2}
+
+    serve.start(grpc_options=serve.gRPCOptions(port=0))
+    serve.run(Echo.bind(), name="dual", route_prefix="/dual",
+              _start_http=True)
+
+    # HTTP leg
+    url = serve.get_proxy_url()
+    status_code, raw = _http_json(f"{url}/dual", {"x": 4})
+    assert status_code == 200
+    assert json.loads(raw) == {"proto": "http", "path": "/", "x": 8}
+
+    # gRPC leg: generic bytes-in/bytes-out unary call
+    addr = serve.get_grpc_address()
+    with grpc.insecure_channel(addr) as channel:
+        call = channel.unary_unary(
+            "/user.EchoService/Predict",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+        raw = call(json.dumps({"x": 4}).encode(),
+                   metadata=(("application", "dual"),), timeout=60)
+        assert json.loads(raw) == {
+            "proto": "grpc", "path": "/user.EchoService/Predict", "x": 8}
+        # single app deployed: application metadata is optional
+        raw = call(json.dumps({"x": 6}).encode(), timeout=60)
+        assert json.loads(raw)["x"] == 12
+        # wrong application -> NOT_FOUND
+        with pytest.raises(grpc.RpcError) as ei:
+            call(b"{}", metadata=(("application", "nope"),), timeout=60)
+        assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+        # standard health check answers SERVING without generated stubs
+        health = channel.unary_unary(
+            "/grpc.health.v1.Health/Check",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+        assert health(b"", timeout=60) == b"\x08\x01"
+    serve.delete("dual")
+
+
+def test_local_testing_mode_no_cluster():
+    """ref: serve/_private/local_testing_mode.py — serve.run(app,
+    local_testing_mode=True) executes replicas in-process: no
+    controller, no actors, handles still compose (incl. async methods
+    and multiplexed model ids)."""
+
+    @serve.deployment
+    def adder(x):
+        return x + 1
+
+    @serve.deployment
+    class Pipeline:
+        def __init__(self, downstream):
+            self.downstream = downstream
+            self.scale = 10
+
+        def reconfigure(self, cfg):
+            self.scale = cfg["scale"]
+
+        async def __call__(self, x):
+            out = await self.downstream.remote(x)
+            return out * self.scale
+
+        def which_model(self):
+            return serve.get_multiplexed_model_id()
+
+    app = Pipeline.options(user_config={"scale": 100}).bind(adder.bind())
+    handle = serve.run(app, name="localapp", local_testing_mode=True)
+    assert type(handle).__name__ == "LocalDeploymentHandle"
+    assert handle.remote(4).result(timeout_s=10) == 500  # (4+1)*100
+    # named-method + multiplexed model id context
+    got = (handle.options(method_name="which_model",
+                          multiplexed_model_id="m7")
+           .remote().result(timeout_s=10))
+    assert got == "m7"
+    assert handle.which_model.remote().result(timeout_s=10) == ""
+    # registry surface
+    assert serve.get_app_handle("localapp") is handle
+    serve.delete("localapp")
