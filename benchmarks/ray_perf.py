@@ -20,26 +20,30 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))  # runnable from anywhere
 
 
-def timeit(fn, n: int, warmup: int = 5, chunks: int = 5) -> float:
-    """Best-chunk rate: the run splits into `chunks` windows and reports
-    the fastest. A microbenchmark measures the runtime's CAPABILITY;
-    co-tenant CI load (the driver runs this on a shared box) only ever
-    subtracts, so a single contiguous window under-reports by whatever
-    happened to be running alongside — measured swings of 2-3x between
-    otherwise identical runs (VERDICT r3 'weak #1')."""
+def timeit(fn, n: int, warmup: int = 5, chunks: int = 5):
+    """(mean_rate, best_chunk_rate). The run splits into `chunks`
+    windows; the MEAN over the whole run is the primary number (directly
+    comparable to the reference's mean±std goldens in BASELINE.md), and
+    the fastest window is reported alongside as the capability bound —
+    co-tenant CI load on a shared box only ever subtracts, so the best
+    chunk shows what the runtime can do when the box is quiet (VERDICT
+    r3 'weak #1'; r4 asked for both so the scoreboard stays honest)."""
     for _ in range(warmup):
         fn()
     rates = []
     per = max(1, n // chunks)
     done = 0
+    total_s = 0.0
     while done < n:
         k = min(per, n - done)
         t0 = time.perf_counter()
         for _ in range(k):
             fn()
-        rates.append(k / (time.perf_counter() - t0))
+        dt = time.perf_counter() - t0
+        rates.append(k / dt)
+        total_s += dt
         done += k
-    return max(rates)
+    return n / total_s, max(rates)
 
 
 def main():
@@ -65,11 +69,16 @@ def main():
     ray_tpu.get(nop.remote())
     batch = max(1, int(100 * args.scale))
 
+    def record(key, rates, scale=1.0):
+        mean, best = rates
+        results[key] = round(mean * scale, 1)
+        results[key + "_best"] = round(best * scale, 1)
+
     def submit_batch():
         ray_tpu.get([nop.remote() for _ in range(batch)])
 
-    per_s = timeit(submit_batch, max(1, int(10 * args.scale))) * batch
-    results["tasks_per_s"] = round(per_s, 1)
+    record("tasks_per_s",
+           timeit(submit_batch, max(1, int(10 * args.scale))), batch)
 
     # ---- sync actor calls/s (ref: "1_1_actor_calls_sync")
     @ray_tpu.remote
@@ -83,16 +92,16 @@ def main():
 
     counter = Counter.remote()
     ray_tpu.get(counter.inc.remote())
-    results["actor_calls_sync_per_s"] = round(
-        timeit(lambda: ray_tpu.get(counter.inc.remote()),
-               max(1, int(300 * args.scale))), 1)
+    record("actor_calls_sync_per_s",
+           timeit(lambda: ray_tpu.get(counter.inc.remote()),
+                  max(1, int(300 * args.scale))))
 
     # ---- pipelined actor calls/s (ref: "1_1_actor_calls_async")
     def pipelined():
         ray_tpu.get([counter.inc.remote() for _ in range(batch)])
 
-    results["actor_calls_async_per_s"] = round(
-        timeit(pipelined, max(1, int(10 * args.scale))) * batch, 1)
+    record("actor_calls_async_per_s",
+           timeit(pipelined, max(1, int(10 * args.scale))), batch)
 
     # ---- object store put throughput (ref: "multi_client_put_gigabytes";
     # array payloads ride the pickle5 out-of-band buffer path: one memcpy
@@ -104,14 +113,16 @@ def main():
     def put_big():
         refs.append(ray_tpu.put(payload))
 
-    per_s = timeit(put_big, max(1, int(20 * args.scale)))
-    results["put_gigabytes_per_s"] = round(per_s * payload.nbytes / 1e9, 3)
+    mean, best = timeit(put_big, max(1, int(20 * args.scale)))
+    results["put_gigabytes_per_s"] = round(mean * payload.nbytes / 1e9, 3)
+    results["put_gigabytes_per_s_best"] = round(
+        best * payload.nbytes / 1e9, 3)
     del refs
 
     # ---- put/get roundtrip latency small objects
-    results["put_get_small_per_s"] = round(
-        timeit(lambda: ray_tpu.get(ray_tpu.put(1)),
-               max(1, int(200 * args.scale))), 1)
+    record("put_get_small_per_s",
+           timeit(lambda: ray_tpu.get(ray_tpu.put(1)),
+                  max(1, int(200 * args.scale))))
 
     # ---- multi-client sections (ref: ray_perf.py "multi client tasks
     # async" :185-191, "multi client put calls" :126, "multi client put
@@ -149,25 +160,27 @@ def main():
         def tasks_multi():
             ray_tpu.get([c.task_batch.remote(n) for c in cs])
 
-        per_s = timeit(tasks_multi, max(1, int(3 * args.scale)),
-                       warmup=1) * n * m
-        results[f"multi_tasks_per_s_c{m}"] = round(per_s, 1)
+        record(f"multi_tasks_per_s_c{m}",
+               timeit(tasks_multi, max(1, int(3 * args.scale)),
+                      warmup=1), n * m)
 
         def put_small_multi():
             ray_tpu.get([c.put_small_batch.remote(n) for c in cs])
 
-        per_s = timeit(put_small_multi, max(1, int(3 * args.scale)),
-                       warmup=1) * n * m
-        results[f"multi_put_calls_per_s_c{m}"] = round(per_s, 1)
+        record(f"multi_put_calls_per_s_c{m}",
+               timeit(put_small_multi, max(1, int(3 * args.scale)),
+                      warmup=1), n * m)
 
         nbig, mb = max(1, int(6 * args.scale)), 8
 
         def put_big_multi():
             ray_tpu.get([c.put_big_batch.remote(nbig, mb) for c in cs])
 
-        per_s = timeit(put_big_multi, 2, warmup=1)
+        mean, best = timeit(put_big_multi, 2, warmup=1)
         results[f"multi_put_gb_per_s_c{m}"] = round(
-            per_s * nbig * m * (mb << 20) / 1e9, 3)
+            mean * nbig * m * (mb << 20) / 1e9, 3)
+        results[f"multi_put_gb_per_s_c{m}_best"] = round(
+            best * nbig * m * (mb << 20) / 1e9, 3)
 
     print(json.dumps(results))
     if args.out:

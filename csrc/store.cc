@@ -26,13 +26,20 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x52545055504f4f4cULL;  // "RTPUPOOL"
+constexpr uint64_t kMagic = 0x52545055504f4f4dULL;  // "RTPUPOOM" (v2:
+// segregated free lists — layout differs from the v1 single-list pool)
 constexpr uint64_t kAlign = 64;
 constexpr uint64_t kKeyLen = 20;
 constexpr uint64_t kFooter = 8;
 // payload begins at this offset within a block so that buffers stay
 // 64-byte aligned (blocks themselves sit at 64-aligned offsets)
 constexpr uint64_t kPayloadOff = 128;
+// size-class bins, by floor(log2(total)): bounded allocation time under
+// fragmentation — the v1 single first-fit list walked O(free blocks)
+// INSIDE the global lock, which is exactly where multi-writer puts
+// serialize (ref: plasma/dlmalloc.cc uses binned free lists for the
+// same reason)
+constexpr uint64_t kNumBins = 48;
 
 struct PoolHeader {
   uint64_t magic;
@@ -40,7 +47,7 @@ struct PoolHeader {
   uint64_t heap_start;
   uint64_t nbuckets;
   pthread_mutex_t mutex;
-  uint64_t free_head;
+  uint64_t free_heads[kNumBins];
   uint64_t lru_head;  // most recently used
   uint64_t lru_tail;  // eviction candidate
   uint64_t used_bytes;
@@ -49,6 +56,11 @@ struct PoolHeader {
   uint64_t reserved[8];
   // uint64_t buckets[nbuckets] follows
 };
+
+inline uint64_t bin_of(uint64_t total) {
+  uint64_t b = 63 - __builtin_clzll(total | 1);
+  return b >= kNumBins ? kNumBins - 1 : b;
+}
 
 struct Block {
   uint64_t total;      // whole block size incl. header+footer
@@ -101,11 +113,12 @@ void unlock(Pool* p) { pthread_mutex_unlock(&H(p)->mutex); }
 
 void free_list_push(Pool* p, Block* b) {
   PoolHeader* h = H(p);
+  uint64_t* head = &h->free_heads[bin_of(b->total)];
   b->is_free = 1;
   b->fprev = 0;
-  b->fnext = h->free_head;
-  if (h->free_head) B(p, h->free_head)->fprev = off_of(p, b);
-  h->free_head = off_of(p, b);
+  b->fnext = *head;
+  if (*head) B(p, *head)->fprev = off_of(p, b);
+  *head = off_of(p, b);
   set_footer(p, b);
 }
 
@@ -114,7 +127,7 @@ void free_list_remove(Pool* p, Block* b) {
   if (b->fprev)
     B(p, b->fprev)->fnext = b->fnext;
   else
-    h->free_head = b->fnext;
+    h->free_heads[bin_of(b->total)] = b->fnext;
   if (b->fnext) B(p, b->fnext)->fprev = b->fprev;
   b->is_free = 0;
 }
@@ -236,25 +249,39 @@ uint64_t evict_lru(Pool* p, uint64_t needed) {
   return freed;
 }
 
+int64_t take_block(Pool* p, Block* b, uint64_t need_total) {
+  uint64_t off = off_of(p, b);
+  free_list_remove(p, b);
+  uint64_t remainder = b->total - need_total;
+  if (remainder >= sizeof(Block) + kFooter + kAlign) {
+    b->total = need_total;
+    Block* rest = B(p, off + need_total);
+    memset(rest, 0, sizeof(Block));
+    rest->total = remainder;
+    free_list_push(p, rest);
+    set_footer(p, rest);
+  }
+  b->is_free = 0;
+  set_footer(p, b);
+  return static_cast<int64_t>(off);
+}
+
 int64_t alloc_block(Pool* p, uint64_t need_total) {
-  // first fit
-  for (uint64_t off = H(p)->free_head; off; off = B(p, off)->fnext) {
+  PoolHeader* h = H(p);
+  // the request's own bin may hold fitting blocks (sizes within a bin
+  // span 2x) — bounded walk so a long run of too-small blocks cannot
+  // stall the allocation under the lock
+  uint64_t start = bin_of(need_total);
+  int walk = 8;
+  for (uint64_t off = h->free_heads[start]; off && walk--;
+       off = B(p, off)->fnext) {
     Block* b = B(p, off);
-    if (b->total >= need_total) {
-      free_list_remove(p, b);
-      uint64_t remainder = b->total - need_total;
-      if (remainder >= sizeof(Block) + kFooter + kAlign) {
-        b->total = need_total;
-        Block* rest = B(p, off + need_total);
-        memset(rest, 0, sizeof(Block));
-        rest->total = remainder;
-        free_list_push(p, rest);
-        set_footer(p, rest);
-      }
-      b->is_free = 0;
-      set_footer(p, b);
-      return static_cast<int64_t>(off);
-    }
+    if (b->total >= need_total) return take_block(p, b, need_total);
+  }
+  // every block in a higher bin fits by construction: O(1) pop
+  for (uint64_t bin = start + 1; bin < kNumBins; bin++) {
+    uint64_t off = h->free_heads[bin];
+    if (off) return take_block(p, B(p, off), need_total);
   }
   return -1;
 }
@@ -351,7 +378,12 @@ int64_t rtpu_store_create(void* handle, const uint8_t* key,
   need = (need + kAlign - 1) & ~(kAlign - 1);
   int64_t off = alloc_block(p, need);
   if (off < 0) {
-    evict_lru(p, need);
+    // evict in batches: freeing exactly `need` makes every put at a
+    // full pool pay its own eviction pass (multi-writer churn thrash);
+    // a pool/16 batch amortizes the LRU walk across many puts
+    PoolHeader* h = H(p);
+    uint64_t batch = need > h->pool_size / 16 ? need : h->pool_size / 16;
+    evict_lru(p, batch);
     off = alloc_block(p, need);
   }
   if (off < 0) {

@@ -207,6 +207,11 @@ class Dataset:
         if self._cached_refs is None:
             self._cached_refs = self._executor.execute(
                 compile_plan(self._plan))
+            # snapshot NOW: the executor is shared across derived
+            # datasets, so its stage_stats describe whichever dataset
+            # ran last — stats() must report THIS dataset's run
+            self._stage_stats = list(
+                getattr(self._executor, "stage_stats", []))
         return self._cached_refs
 
     def materialize(self) -> "Dataset":
@@ -228,7 +233,7 @@ class Dataset:
         the plan if it hasn't run yet."""
         self._execute()
         lines = [f"plan: {self._plan.describe()}"]
-        for s in getattr(self._executor, "stage_stats", []):
+        for s in getattr(self, "_stage_stats", []):
             size = ("" if s["out_bytes_local"] is None
                     else f", {s['out_bytes_local'] / 1e6:.2f}MB local")
             lines.append(f"  {s['stage']}: {s['wall_s']:.3f}s, "

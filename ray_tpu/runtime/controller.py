@@ -398,6 +398,11 @@ class Controller:
 
     # ------------------------------------------------------------------ actors
     async def register_actor(self, actor_id: str, spec: Dict[str, Any]):
+        if actor_id in self.actors:
+            # duplicate delivery: unnamed registration is ONE-WAY from
+            # the driver and redelivered on notify loss — re-running it
+            # would double-schedule the actor
+            return {"status": "registered", "actor_id": actor_id}
         name = spec.get("name")
         namespace = spec.get("namespace", "")
         if name:
@@ -454,6 +459,7 @@ class Controller:
         info.address = address
         info.worker_id = worker_id
         info.node_id = node_id
+        self._wake_actor_waiters(actor_id)
         await self._publish(f"actor:{actor_id}", info.snapshot())
         if getattr(info, "drain_requested", False):
             try:
@@ -482,16 +488,50 @@ class Controller:
             if name:
                 self.named_actors.pop((info.spec.get("namespace", ""), name), None)
                 self._persist()
+            self._wake_actor_waiters(actor_id)
             await self._publish(f"actor:{actor_id}", info.snapshot())
         return True
 
+    def _wake_actor_waiters(self, actor_id: str) -> None:
+        ev = getattr(self, "_actor_waiters", {}).pop(actor_id, None)
+        if ev is not None:
+            ev.set()
+
     async def get_actor(self, actor_id: str = None, name: str = None,
-                        namespace: str = ""):
+                        namespace: str = "", wait_alive: float = 0,
+                        subscribe: bool = False, _conn: ServerConn = None):
+        """Actor snapshot. With wait_alive > 0 and the actor still
+        PENDING/RESTARTING, the call parks on a server-side event until
+        the next ALIVE/DEAD transition (or the timeout) instead of
+        making the caller poll — at thousands of concurrent creations
+        the poll traffic was itself a main load on this loop (ref:
+        gcs_actor_manager's push model serves the same purpose).
+        subscribe=True additionally registers the calling connection on
+        the actor's state channel, folding the separate per-actor
+        subscribe RPC into this call."""
         if actor_id is None and name is not None:
             actor_id = self.named_actors.get((namespace, name))
         if actor_id is None:
             return None
+        if subscribe and _conn is not None:
+            chan = self.subscribers[f"actor:{actor_id}"]
+            if _conn not in chan:
+                chan.append(_conn)
         info = self.actors.get(actor_id)
+        if (wait_alive and info is not None
+                and info.state not in (ACTOR_ALIVE, ACTOR_DEAD)):
+            waiters = getattr(self, "_actor_waiters", None)
+            if waiters is None:
+                waiters = self._actor_waiters = {}
+            ev = waiters.get(actor_id)
+            if ev is None:
+                ev = waiters[actor_id] = asyncio.Event()
+            try:
+                await asyncio.wait_for(ev.wait(),
+                                       timeout=min(wait_alive, 30.0))
+            except asyncio.TimeoutError:
+                pass
+            info = self.actors.get(actor_id)
         return info.snapshot() if info else None
 
     async def list_actors(self):

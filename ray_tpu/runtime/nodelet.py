@@ -96,6 +96,7 @@ class WorkerState:
         self.proc = proc
         self.env_key = env_key  # runtime-env pool this worker belongs to
         self.client: Optional[RpcClient] = None
+        self.conn = None  # the worker's inbound ServerConn (push channel)
         self.current_task: Optional[dict] = None
         self.actor_id: Optional[str] = None
         self.idle_since = time.monotonic()
@@ -273,6 +274,8 @@ class Nodelet:
             "task_finished": self.task_finished,
             "task_done": self.task_done,
             "actor_exited": self.actor_exited,
+            "actor_ready": self.actor_ready,
+            "report_metrics": self.report_metrics,
             "reserve_bundle": self.reserve_bundle,
             "return_bundle": self.return_bundle,
             "cancel_task": self.cancel_task,
@@ -368,7 +371,10 @@ class Nodelet:
         cfg = get_config()
         rotor = 0
         while True:
-            await asyncio.sleep(0.2)
+            # tick backs off as the worker census grows (same tradeoff
+            # as the log monitor: death-detection latency for hub-loop
+            # headroom; RPC disconnects still catch most deaths at once)
+            await asyncio.sleep(0.2 if len(self.workers) <= 500 else 0.5)
             now = time.monotonic()
             workers = list(self.workers.values())
             n = len(workers)
@@ -421,7 +427,14 @@ class Nodelet:
         log_dir = os.path.join(self.session_dir, "logs")
         rotor = 0
         while True:
-            await asyncio.sleep(0.5)
+            # cadence backs off with the worker count: the slice bound
+            # caps per-tick work, but at thousands of workers the
+            # CUMULATIVE stat rate still loaded the hub loop (r5
+            # many_actors profile) — trade log-streaming latency for
+            # control-plane headroom as the node fills up
+            n_owned = len(self._log_owned)
+            await asyncio.sleep(0.5 if n_owned <= 256
+                                else min(5.0, 0.5 * n_owned / 256))
             batch = []
             # only workers this nodelet started — session dirs are shared
             # by every nodelet of a (multi-node-on-one-box) session.
@@ -596,6 +609,7 @@ class Nodelet:
 
         pp = env.get("PYTHONPATH", "")
         hooks = preload_dirs(pp)
+        self._factory_two_tiers = bool(hooks)
         if hooks:
             env["PYTHONPATH"] = os.pathsep.join(
                 d for d in pp.split(os.pathsep) if d and d not in hooks)
@@ -616,28 +630,46 @@ class Nodelet:
         """Ask the factory for a forked worker; returns (pid,
         /proc start time captured by the factory right after fork).
 
-        Two phases with different retry rules: connecting retries until the
-        factory binds its socket; the spawn request itself is sent AT MOST
-        ONCE (a retried request could fork a duplicate worker with the same
+        Spawn requests go DIRECTLY to a per-generation socket, picked
+        round-robin, so N generations fork in parallel during a burst
+        (see worker_factory.n_gens); the factory parent's legacy relay
+        socket is the last-resort fallback. Two phases with different
+        retry rules: connecting retries until the factory binds its
+        sockets; the spawn request itself is sent AT MOST ONCE (a
+        retried request could fork a duplicate worker with the same
         worker_id out of the factory's backlog)."""
         import json
         import socket as socket_mod
 
+        from .worker_factory import gen_socket_path, n_gens
+
+        tier = ("slim" if not warm
+                and getattr(self, "_factory_two_tiers", False) else "warm")
+        n = n_gens(tier)
+        self._spawn_rr = getattr(self, "_spawn_rr", 0) + 1
+        candidates = [gen_socket_path(self._factory_path, tier,
+                                      (self._spawn_rr + k) % n)
+                      for k in range(n)] + [self._factory_path]
         deadline = time.monotonic() + 15.0
         sock = None
-        while True:  # phase 1: retryable connect
-            sock = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
-            sock.settimeout(2.0)
-            try:
-                sock.connect(self._factory_path)
+        while True:  # phase 1: retryable connect, cycling candidates
+            for path in candidates:
+                sock = socket_mod.socket(socket_mod.AF_UNIX,
+                                         socket_mod.SOCK_STREAM)
+                sock.settimeout(2.0)
+                try:
+                    sock.connect(path)
+                    break
+                except OSError:
+                    sock.close()
+                    sock = None
+            if sock is not None:
                 break
-            except OSError:
-                sock.close()
-                if self._stopping or time.monotonic() > deadline or (
-                        self._factory_proc is not None
-                        and self._factory_proc.poll() is not None):
-                    raise
-                time.sleep(0.05)
+            if self._stopping or time.monotonic() > deadline or (
+                    self._factory_proc is not None
+                    and self._factory_proc.poll() is not None):
+                raise OSError("factory sockets unreachable")
+            time.sleep(0.05)
         try:  # phase 2: exactly-once request
             sock.settimeout(60.0)  # covers the factory's warm import
             sock.sendall((json.dumps(
@@ -743,7 +775,8 @@ class Nodelet:
 
     async def worker_register(self, worker_id: str, address: str, pid: int,
                               env_key: str = "",
-                              start_time: Optional[int] = None):
+                              start_time: Optional[int] = None,
+                              _conn: ServerConn = None):
         ws = self.workers.get(worker_id)
         if ws is None:
             # unknown id: adopt it (e.g. a fork whose spawn reply was lost)
@@ -754,6 +787,12 @@ class Nodelet:
         ws.set_pid(pid, start_time)
         ws.address = address
         ws.current_task = None
+        # push dispatches back over THIS registered connection; the
+        # dial-back client stays as the lazy fallback. At many-actors
+        # scale the dial-back was one of the hub's 4 fds + 1 connect per
+        # worker (r5: hub fd census grew 4/actor and the creation rate
+        # cliffed with it)
+        ws.conn = _conn
         ws.client = RpcClient(address)
         ws.idle_since = time.monotonic()
         self._idle_pool(ws.env_key).append(worker_id)
@@ -1076,13 +1115,28 @@ class Nodelet:
         if self.pending_actor_leases:
             actor_id, head = self.pending_actor_leases[0]
             head_key = head.get("_env_key", "")
+            # bound CONCURRENT boots, not total: a 2k-actor burst
+            # starting every worker at once thrashes the box (hundreds
+            # of processes mid-boot, context-switch + memory pressure);
+            # each registration re-enters _dispatch and starts the next,
+            # so the pipeline stays full at the cap (ref:
+            # worker_pool.cc prestart caps by available concurrency)
+            cap = min(len(self.pending_actor_leases),
+                      self._max_concurrent_starts())
             if not self.idle.get(head_key) and \
-                    self.starting_by_key.get(head_key, 0) < \
-                    len(self.pending_actor_leases):
+                    self.starting_by_key.get(head_key, 0) < cap:
                 self._start_worker(force=True,
                                    runtime_env=head.get("runtime_env"),
                                    env_key=head_key,
                                    warm=self._spawn_warm(head))
+
+    def _max_concurrent_starts(self) -> int:
+        """How many workers may be mid-boot at once (env override:
+        RTPU_MAX_CONCURRENT_STARTS)."""
+        env = os.environ.get("RTPU_MAX_CONCURRENT_STARTS")
+        if env:
+            return max(1, int(env))
+        return max(12, 4 * (os.cpu_count() or 1))
 
     def _request_worker(self, key: str, spec: dict, demand: int):
         """Start a worker for this env pool if the demand warrants it;
@@ -1108,17 +1162,59 @@ class Nodelet:
         self._start_worker(runtime_env=spec.get("runtime_env"),
                            env_key=key, warm=self._spawn_warm(spec))
 
+    async def _notify_worker(self, ws: WorkerState, method: str, **kw):
+        """Prefer the worker's inbound connection (no dial-back fd);
+        fall back to the client if the push channel is gone."""
+        if ws.conn is not None and not ws.conn.closed:
+            await ws.conn.notify(method, **kw)
+            if not ws.conn.closed:
+                return
+        await ws.client.notify_async(method, **kw)
+
     async def _push_to_worker(self, ws: WorkerState, spec: dict):
         try:
-            await ws.client.notify_async("execute_task", spec=spec)
+            await self._notify_worker(ws, "execute_task", spec=spec)
         except Exception:
             await self._on_worker_death(ws)
 
     async def _push_actor_to_worker(self, ws: WorkerState, spec: dict):
         try:
-            await ws.client.notify_async("create_actor", spec=spec)
+            await self._attach_cls_blob(spec)
+            await self._notify_worker(ws, "create_actor", spec=spec)
         except Exception:
             await self._on_worker_death(ws)
+
+    # cls_key -> pickled class blob. Bounded: each entry pins a class
+    # definition for the nodelet's lifetime.
+    _CLS_CACHE_MAX = 64
+
+    async def _attach_cls_blob(self, spec: dict) -> None:
+        """Ship the actor's class blob WITH the create dispatch, served
+        from a node-local cache (ref: worker_pool/function_manager — the
+        reference's workers each fetch the function table from GCS; at
+        2k actors of one class that is 2k GCS round-trips on the one
+        box, and the contended controller loop was the top cost in the
+        many_actors profile). One controller fetch per cls_key per node;
+        every worker then skips its own KV fetch."""
+        cls_key = spec.get("cls_key")
+        if not cls_key or "cls_blob" in spec:
+            return
+        cache = getattr(self, "_cls_cache", None)
+        if cache is None:
+            cache = self._cls_cache = {}
+        blob = cache.get(cls_key)
+        if blob is None:
+            try:
+                blob = await self.controller.call_async(
+                    "kv_get", ns="fn", key=cls_key)
+            except Exception:
+                return  # worker falls back to its own controller fetch
+            if blob is None:
+                return
+            if len(cache) >= self._CLS_CACHE_MAX:
+                cache.pop(next(iter(cache)))
+            cache[cls_key] = blob
+        spec["cls_blob"] = blob
 
     async def task_done(self, worker_id: str, task_id: bytes,
                         owner_addr: str, result: dict):
@@ -1201,6 +1297,31 @@ class Nodelet:
             _env_key=_env_key(spec.get("runtime_env")))))
         self._dispatch()
         return True
+
+    async def actor_ready(self, actor_id: str, address: str,
+                          worker_id: str, node_id: str):
+        """Forward a replica's readiness to the controller. Workers send
+        this over their EXISTING nodelet connection instead of opening a
+        controller client of their own — on the head the nodelet and
+        controller share a process, so the forward is an in-process
+        dispatch and each actor creation costs one fewer socket
+        connect + fd in the hub (r5 many_actors: connects were a top
+        hub-loop cost at high live-worker counts). Forward failures
+        PROPAGATE: the worker's creation path must see them and report
+        the actor failed, or the actor stays PENDING forever."""
+        return await self.controller.call_async(
+            "actor_ready", actor_id=actor_id, address=address,
+            worker_id=worker_id, node_id=node_id)
+
+    async def report_metrics(self, node_id: str, metrics: dict):
+        """Worker metric snapshots ride the nodelet connection too (same
+        rationale as actor_ready; losses are fine — the worker's flush
+        loop resends on the next tick)."""
+        try:
+            return await self.controller.call_async(
+                "report_metrics", node_id=node_id, metrics=metrics)
+        except Exception:
+            return False
 
     async def actor_exited(self, worker_id: str, actor_id: str, reason: str = "",
                            intended: bool = False):
@@ -1304,6 +1425,17 @@ def main():
         await nodelet.start()
         await asyncio.Event().wait()
 
+    if os.environ.get("RTPU_NODELET_PROFILE"):
+        import cProfile
+        import signal as signal_mod
+
+        prof = cProfile.Profile()
+        path = os.path.join(args.session_dir, "logs", "nodelet.pstats")
+        signal_mod.signal(
+            signal_mod.SIGUSR1,
+            lambda *_: prof.dump_stats(path))
+        prof.runcall(asyncio.run, run())
+        return
     asyncio.run(run())
 
 

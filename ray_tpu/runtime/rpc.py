@@ -242,11 +242,16 @@ class RpcServer:
             os.makedirs(os.path.dirname(parsed[1]), exist_ok=True)
             if os.path.exists(parsed[1]):
                 os.unlink(parsed[1])
-            self._server = await asyncio.start_unix_server(self._on_conn, parsed[1])
+            # big backlog: during creation bursts hundreds of workers
+            # dial the hub faster than a loaded loop accepts; the
+            # asyncio default (100) overflows and every refused client
+            # backs off 50ms — a silent throughput cliff (r5)
+            self._server = await asyncio.start_unix_server(
+                self._on_conn, parsed[1], backlog=2048)
         else:
             host, port = parsed[1], parsed[2]
             self._server = await asyncio.start_server(
-                self._on_conn, host or None, port)
+                self._on_conn, host or None, port, backlog=2048)
             # ephemeral port / wildcard bind: advertise the real endpoint
             real_port = self._server.sockets[0].getsockname()[1]
             adv_host = advertise_ip() if host in ("0.0.0.0", "") else host

@@ -344,16 +344,30 @@ class Executor:
         try:
             # actors own their worker process: runtime env applies for
             # life, and BEFORE user code loads (import-time reads see it)
+            _t0 = time.perf_counter()
             _apply_runtime_env_permanent(spec.get("runtime_env"),
                                          self.core.session_dir)
-            cls = self.core.load_function(spec["cls_key"])
+            _t1 = time.perf_counter()
+            cls = self.core.load_function(spec["cls_key"],
+                                          blob=spec.get("cls_blob"))
+            _t2 = time.perf_counter()
             args, kwargs = self._unpack_args(spec)
             self.actor_instance = cls(*args, **kwargs)
-            self.core.controller.call(
+            _t3 = time.perf_counter()
+            # via the nodelet (existing connection; in-process forward
+            # to the controller on the head) — a direct controller call
+            # would cost this worker a fresh connect (nodelet.actor_ready)
+            self.core.nodelet.call(
                 "actor_ready", actor_id=self.actor_id,
                 address=self.core.address,
                 worker_id=self.core.worker_id.hex(),
                 node_id=self.core.node_id)
+            if os.environ.get("RTPU_BOOT_DEBUG"):
+                print(f"[actor] env={1e3 * (_t1 - _t0):.1f}ms "
+                      f"load={1e3 * (_t2 - _t1):.1f}ms "
+                      f"init={1e3 * (_t3 - _t2):.1f}ms "
+                      f"ready={1e3 * (time.perf_counter() - _t3):.1f}ms",
+                      flush=True)
         except Exception:
             tb = traceback.format_exc()
             try:
@@ -554,6 +568,14 @@ def run_worker(*, session_name: str, session_dir: str, node_id: str,
                runtime_env: Optional[dict] = None):
     from .runtime_env import apply_to_process, ensure_env, env_key
 
+    _boot_t0 = time.perf_counter()
+    _boot_dbg = bool(os.environ.get("RTPU_BOOT_DEBUG"))
+    _prof = None
+    if os.environ.get("RTPU_WORKER_PROFILE"):
+        import cProfile
+
+        _prof = cProfile.Profile()
+        _prof.enable()
     key = env_key(runtime_env)
     # a spawn-time env failure (conda build in the nodelet) rides in by
     # env var so it surfaces per-task like worker-side build failures
@@ -578,7 +600,9 @@ def run_worker(*, session_name: str, session_dir: str, node_id: str,
     set_core(core)
     executor = Executor(core)
     executor.env_error = env_error
+    _t_core = time.perf_counter()
     core.start(extra_handlers=executor.handlers())
+    _t_start = time.perf_counter()
     from .procutil import proc_start_time
 
     core.nodelet.call("worker_register", worker_id=worker_id,
@@ -586,6 +610,15 @@ def run_worker(*, session_name: str, session_dir: str, node_id: str,
                       # self-reported identity: /proc/self is immune to
                       # the pid-recycling races a sampling observer has
                       start_time=proc_start_time(os.getpid()))
+    if _boot_dbg:
+        print(f"[boot] core={1e3 * (_t_core - _boot_t0):.1f}ms "
+              f"start={1e3 * (_t_start - _t_core):.1f}ms "
+              f"register={1e3 * (time.perf_counter() - _t_start):.1f}ms",
+              flush=True)
+    if _prof is not None:
+        _prof.disable()
+        _prof.dump_stats(os.path.join(
+            session_dir, "logs", f"prof-{worker_id[:8]}.pstats"))
     executor.shutdown_event.wait()
     core.flush_events()
     core.shutdown()

@@ -218,28 +218,51 @@ def _write_all(fd: int, data: bytes) -> None:
         view = view[n:]
 
 
-def _generation_main(conn_fd: int, args, preload: bool) -> None:
-    """A generation: receives spawn-request lines on `conn_fd`, forks
-    workers (through a small spare pool), replies with one
-    '{pid, start_time}' line each. Exits on EOF (shutdown).
+def n_gens(tier: str) -> int:
+    """Parallel generation count per tier (shared contract with the
+    nodelet's round-robin). A SINGLE serial generation caps burst spawn
+    throughput at ~1/(dispense wall time): each dispense needs several
+    scheduling slots (read, fork, reply) and under a 2k-actor burst the
+    runqueue latency multiplied that into the dominant creation cost
+    (r5 many_actors cliff). N generations pipeline those waits."""
+    default = "3" if tier == "slim" else "2"
+    return max(1, int(os.environ.get(
+        f"RTPU_FACTORY_GENS_{tier.upper()}", default)))
+
+
+def gen_socket_path(base: str, tier: str, i: int) -> str:
+    return f"{base}.{tier[0]}{i}"
+
+
+def _generation_main(listen_sock, lifeline_r: int, args,
+                     preload: bool) -> None:
+    """A generation: accepts one spawn-request line per connection on
+    its OWN listening socket, forks workers (through a small spare
+    pool), replies with one '{pid, start_time}' line. Exits when the
+    lifeline pipe closes (factory parent died) or on {"cmd": "exit"}.
 
     Rotation is SELF-replacement: after RTPU_FACTORY_GEN_SIZE dispensed
     workers the generation forks a successor — which inherits the warm
-    imports, the conn_fd, and the parked spares — and exits. The
-    factory never notices, and a warm generation never re-pays the
-    preload import."""
+    imports, the listening socket, the lifeline, and the parked spares —
+    and exits. Callers never notice, and a warm generation never
+    re-pays the preload import."""
     from .procutil import proc_start_time
 
     import select as select_mod
 
     if preload:
         _restore_preload()
+        import gc
+
+        gc.collect()
+        gc.freeze()  # the preload's objects join the permanent gen too
     gen_size = int(os.environ.get("RTPU_FACTORY_GEN_SIZE", "200"))
     dispensed = 0
 
     n_spares = int(os.environ.get("RTPU_FACTORY_SPARES", "4"))
     debug = bool(os.environ.get("RTPU_FACTORY_DEBUG"))
     spares = []  # (pid, write_fd)
+    listen_fd = listen_sock.fileno()
 
     def make_spare():
         import time as _t
@@ -247,7 +270,8 @@ def _generation_main(conn_fd: int, args, preload: bool) -> None:
         r_fd, w_fd = os.pipe()
         pid = os.fork()
         if pid == 0:
-            os.close(conn_fd)
+            listen_sock.close()
+            os.close(lifeline_r)
             os.close(w_fd)
             for _spid, sw in spares:
                 try:
@@ -286,35 +310,66 @@ def _generation_main(conn_fd: int, args, preload: bool) -> None:
         os.close(w_fd)
         return pid, start
 
+    def shutdown():
+        for _pid, w_fd in spares:
+            try:
+                os.close(w_fd)  # parked spares exit on EOF
+            except OSError:
+                pass
+        os._exit(0)
+
     while True:
         # refill ONE spare at a time, only while no request is waiting —
         # forks must stay off the spawn critical path during bursts
         while len(spares) < n_spares:
-            ready, _, _ = select_mod.select([conn_fd], [], [], 0)
+            ready, _, _ = select_mod.select(
+                [listen_fd, lifeline_r], [], [], 0)
             if ready:
                 break
             try:
                 spares.append(make_spare())
             except OSError:
                 break  # fork pressure: serve with what we have
-        data = _read_line(conn_fd)
-        if not data:
-            for _pid, w_fd in spares:
-                try:
-                    os.close(w_fd)  # parked spares exit on EOF
-                except OSError:
-                    pass
-            os._exit(0)
+        ready, _, _ = select_mod.select([listen_fd, lifeline_r], [], [])
+        if lifeline_r in ready and not os.read(lifeline_r, 1):
+            shutdown()  # parent died / closed the lifeline
+        if listen_fd not in ready:
+            continue
         try:
-            pid, start = dispense(json.loads(data))
-            reply = json.dumps({"pid": pid, "start_time": start})
-        except Exception as e:  # noqa: BLE001 — surface to the factory
-            reply = json.dumps({"error": repr(e)})
-        _write_all(conn_fd, (reply + "\n").encode())
+            conn, _ = listen_sock.accept()
+        except OSError:
+            shutdown()
+        try:
+            data = b""
+            while not data.endswith(b"\n"):
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+            if not data.endswith(b"\n"):
+                continue  # health ping (bare connect) or torn request
+            req = json.loads(data)
+            if req.get("cmd") == "exit":
+                conn.close()
+                shutdown()
+            try:
+                pid, start = dispense(req)
+                reply = json.dumps({"pid": pid, "start_time": start})
+            except Exception as e:  # noqa: BLE001 — surface to caller
+                reply = json.dumps({"error": repr(e)})
+            conn.sendall((reply + "\n").encode())
+        except OSError:
+            pass  # caller went away; the fork (if any) is adopted below
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
         dispensed += 1
         if dispensed >= gen_size:
             # self-rotate between requests: fork-aging resets, state
-            # (conn_fd, spares, warm imports) carries over via fork
+            # (listen socket, lifeline, spares, warm imports) carries
+            # over via fork
             pid = os.fork()
             if pid > 0:
                 os._exit(0)
@@ -334,61 +389,135 @@ def serve(args) -> None:
 
     from . import worker as _warm  # noqa: F401
 
+    # Modules the worker boot path imports LAZILY; with the host's
+    # PYTHONDONTWRITEBYTECODE=1 there is no .pyc cache, so every forked
+    # worker would re-COMPILE them from source (runtime_env alone was
+    # ~14 ms — the single largest worker-boot cost in the many_actors
+    # profile, r5). Import once here; children inherit compiled modules.
+    from . import runtime_env as _warm_env  # noqa: F401
+    from ..util import metrics as _warm_metrics  # noqa: F401
+
     # numpy is not imported by the runtime tree itself but practically
     # every task touches it through serialization — a slim child paying
     # the ~300 ms numpy import per worker would dwarf the fork savings
     import numpy as _np  # noqa: F401
+
+    # dlopen the native store library once (and run its ensure_built
+    # source check once) — children inherit the mapping instead of each
+    # paying the dlopen + stat sweep at CoreWorker init
+    try:
+        from .._native import get_lib as _get_lib
+
+        _get_lib()
+    except Exception:
+        pass  # workers fall back to their own (pure-python) path
+
+    # Prefork hygiene (the Instagram trick): move every existing object
+    # into the permanent generation so children's GC passes never sweep
+    # (and COW-dirty) the inherited heap. At hundreds of live forked
+    # workers each page a child dirties pays an anon_vma walk over the
+    # whole descendant tree — keeping children's writes off parent pages
+    # is what keeps fork lineages fast at many-actors scale (r5).
+    import gc
+
+    gc.collect()
+    gc.freeze()
 
     sock.settimeout(1.0)
     signal.signal(signal.SIGCHLD, signal.SIG_IGN)  # auto-reap workers
     parent = os.getppid()
     # two tiers only when the nodelet actually stripped a preload hook
     # out of this process's environment; otherwise every spawn is "warm"
-    # by definition and one generation serves all
+    # by definition and the warm generations serve all requests
     tiers = (("slim", "warm") if os.environ.get("RTPU_ORIG_PYTHONPATH")
              else ("warm",))
-    gens = {}  # tier -> [fd, spawned]
+    # slot -> (tier, index, lifeline write fd). Each generation owns its
+    # OWN listening socket; callers round-robin across them so N forks
+    # can be in flight at once (see n_gens docstring).
+    lifelines = {}
 
-    def new_generation(tier: str):
-        old = gens.get(tier)
-        if old is not None:
-            try:
-                os.close(old[0])  # old generation exits on EOF
-            except OSError:
-                pass
-        a, b = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+    def spawn_generation(tier: str, i: int):
+        path = gen_socket_path(args.listen, tier, i)
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+        gsock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        gsock.bind(path)
+        gsock.listen(128)
+        life_r, life_w = os.pipe()
         pid = os.fork()
         if pid == 0:
             sock.close()
-            a.close()
-            for other in gens.values():
+            os.close(life_w)
+            for lw in lifelines.values():
                 try:
-                    os.close(other[0])
+                    os.close(lw)
                 except OSError:
                     pass
-            fd = b.detach()
-            _generation_main(fd, args, preload=(tier == "warm"
-                                                and len(tiers) > 1))
+            _generation_main(gsock, life_r, args,
+                             preload=(tier == "warm" and len(tiers) > 1))
             os._exit(0)
-        b.close()
-        gens[tier] = [a.detach(), 0]
+        gsock.close()
+        os.close(life_r)
+        old = lifelines.pop((tier, i), None)
+        if old is not None:
+            try:
+                os.close(old)
+            except OSError:
+                pass
+        lifelines[(tier, i)] = life_w
+
+    def check_generation(tier: str, i: int):
+        """Respawn a generation line whose socket no longer accepts
+        (every holder of the listening fd died). A bare connect+close is
+        the probe; generations treat it as a health ping."""
+        path = gen_socket_path(args.listen, tier, i)
+        probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        probe.settimeout(1.0)
+        try:
+            probe.connect(path)
+        except socket.timeout:
+            pass  # alive but busy (loaded box): do NOT churn the line
+        except OSError:
+            spawn_generation(tier, i)
+        finally:
+            probe.close()
 
     for t in tiers:
-        new_generation(t)
+        for i in range(n_gens(t)):
+            spawn_generation(t, i)
+    rr = {t: 0 for t in tiers}
+    last_check = 0.0
+    import time as time_mod
+
     while True:
         try:
             conn, _ = sock.accept()
         except socket.timeout:
             if os.getppid() != parent:
-                for tier in gens:
+                for lw in lifelines.values():
                     try:
-                        os.close(gens[tier][0])
+                        os.close(lw)  # generations exit on lifeline EOF
                     except OSError:
                         pass
                 return  # nodelet died; die with it
+            now = time_mod.monotonic()
+            if now - last_check > 5.0:
+                last_check = now
+                for t in tiers:
+                    for i in range(n_gens(t)):
+                        check_generation(t, i)
             continue
         except OSError:
             return
+        # Legacy relay path (fallback when a caller cannot reach the
+        # per-generation sockets): forward the request to slot 0 of the
+        # tier over its socket. NO retry after a send: a generation that
+        # died mid-request may already have forked the worker, and a
+        # resend would duplicate the worker_id — report the AMBIGUOUS
+        # outcome so the nodelet abandons the id instead of
+        # cold-starting a duplicate.
         try:
             data = b""
             while not data.endswith(b"\n"):
@@ -402,23 +531,26 @@ def serve(args) -> None:
             req = json.loads(data)
             tier = ("slim" if not req.get("warm", True)
                     and "slim" in tiers else "warm")
-            # relay to the generation (it rotates itself). NO retry
-            # after a write: a
-            # generation that died mid-request may already have forked
-            # the worker, and a resend would duplicate the worker_id —
-            # report the AMBIGUOUS outcome so the nodelet abandons the
-            # id instead of cold-starting a duplicate.
+            slot = rr[tier] = (rr[tier] + 1) % n_gens(tier)
+            reply = b""
             try:
-                _write_all(gens[tier][0], data)
-                reply = _read_line(gens[tier][0])
+                fwd = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                fwd.settimeout(60.0)
+                fwd.connect(gen_socket_path(args.listen, tier, slot))
+                fwd.sendall(data)
+                while not reply.endswith(b"\n"):
+                    chunk = fwd.recv(65536)
+                    if not chunk:
+                        break
+                    reply += chunk
+                fwd.close()
             except OSError:
                 reply = b""
-            if not reply:
-                new_generation(tier)  # for future requests
+            if not reply.endswith(b"\n"):
+                check_generation(tier, slot)  # for future requests
                 reply = (json.dumps(
                     {"error": "generation died mid-request",
                      "ambiguous": True}) + "\n").encode()
-            gens[tier][1] += 1
             conn.sendall(reply)
         except Exception:
             import traceback
