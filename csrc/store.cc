@@ -268,17 +268,17 @@ int64_t take_block(Pool* p, Block* b, uint64_t need_total) {
 
 int64_t alloc_block(Pool* p, uint64_t need_total) {
   PoolHeader* h = H(p);
-  // the request's own bin may hold fitting blocks (sizes within a bin
-  // span 2x) — bounded walk so a long run of too-small blocks cannot
-  // stall the allocation under the lock
+  // the request's own bin first (best reuse — sizes within a bin span
+  // 2x): walked FULLY, because a bounded walk could miss a fitting
+  // block and force a spurious eviction / OOM. Worst case (every free
+  // block in one bin) degrades to the v1 single-list first fit.
   uint64_t start = bin_of(need_total);
-  int walk = 8;
-  for (uint64_t off = h->free_heads[start]; off && walk--;
+  for (uint64_t off = h->free_heads[start]; off;
        off = B(p, off)->fnext) {
     Block* b = B(p, off);
     if (b->total >= need_total) return take_block(p, b, need_total);
   }
-  // every block in a higher bin fits by construction: O(1) pop
+  // any block in a higher bin fits by construction: O(1) pop
   for (uint64_t bin = start + 1; bin < kNumBins; bin++) {
     uint64_t off = h->free_heads[bin];
     if (off) return take_block(p, B(p, off), need_total);

@@ -1413,26 +1413,37 @@ class CoreWorker:
                 await self._ensure_actor_sub(actor_id)
             return addr
         # fold the death-watch subscription into the resolve call (one
-        # RPC instead of two per actor). Local bookkeeping happens only
-        # AFTER the subscribing call succeeds: marking first with no
-        # rollback would permanently skip the subscription if the first
-        # call failed, and the actor's death would then never fail
-        # in-flight tasks fast.
+        # RPC instead of two per actor). Bookkeeping is SYNCHRONOUS
+        # before the first await — concurrent resolves for the same
+        # actor must not each append a permanent pubsub handler — and
+        # rolled back if the subscribing call fails, so a retry (or the
+        # cached-addr path's _ensure_actor_sub) re-subscribes.
         sub = actor_id not in self._actor_subs
+        handler = None
+        if sub:
+            self._actor_subs.add(actor_id)
+            handler = lambda msg: self._on_actor_update(actor_id, msg)  # noqa: E731
+            self._pubsub_handlers.setdefault(
+                f"actor:{actor_id}", []).append(handler)
         while True:
             # wait_alive parks on the controller's state event, so a
             # pending actor costs ONE call instead of a poll loop — at
             # thousands of concurrent creations the polls were a main
             # load on the controller (many_actors profile, r5)
-            info = await self.controller.call_async(
-                "get_actor", actor_id=actor_id, wait_alive=20.0,
-                subscribe=sub)
-            if sub:
-                self._actor_subs.add(actor_id)
-                self._pubsub_handlers.setdefault(
-                    f"actor:{actor_id}", []).append(
-                    lambda msg: self._on_actor_update(actor_id, msg))
-                sub = False
+            try:
+                info = await self.controller.call_async(
+                    "get_actor", actor_id=actor_id, wait_alive=20.0,
+                    subscribe=sub)
+            except Exception:
+                if sub:
+                    self._actor_subs.discard(actor_id)
+                    try:
+                        self._pubsub_handlers.get(
+                            f"actor:{actor_id}", []).remove(handler)
+                    except ValueError:
+                        pass
+                raise
+            sub = False
             if info is None:
                 raise exceptions.ActorDiedError(actor_id, "unknown actor")
             if info["state"] == "ALIVE":

@@ -264,7 +264,7 @@ def _generation_main(listen_sock, lifeline_r: int, args,
     spares = []  # (pid, write_fd)
     listen_fd = listen_sock.fileno()
 
-    def make_spare():
+    def make_spare(extra_close=None):
         import time as _t
         _t0 = _t.perf_counter()
         r_fd, w_fd = os.pipe()
@@ -273,6 +273,15 @@ def _generation_main(listen_sock, lifeline_r: int, args,
             listen_sock.close()
             os.close(lifeline_r)
             os.close(w_fd)
+            if extra_close is not None:
+                # the accepted spawn-request socket: a worker forked
+                # mid-request must not inherit it (the fd would leak for
+                # the worker's lifetime, and the caller's EOF detection
+                # on generation death would hang until its timeout)
+                try:
+                    extra_close.close()
+                except OSError:
+                    pass
             for _spid, sw in spares:
                 try:
                     os.close(sw)
@@ -287,7 +296,7 @@ def _generation_main(listen_sock, lifeline_r: int, args,
                   file=sys.stderr, flush=True)
         return pid, w_fd
 
-    def dispense(req: dict):
+    def dispense(req: dict, extra_close=None):
         line = (json.dumps(req) + "\n").encode()
         while spares:
             pid, w_fd = spares.pop(0)
@@ -304,7 +313,7 @@ def _generation_main(listen_sock, lifeline_r: int, args,
                 except OSError:
                     pass
                 continue
-        pid, w_fd = make_spare()
+        pid, w_fd = make_spare(extra_close)
         start = proc_start_time(pid)
         _write_all(w_fd, line)
         os.close(w_fd)
@@ -353,7 +362,7 @@ def _generation_main(listen_sock, lifeline_r: int, args,
                 conn.close()
                 shutdown()
             try:
-                pid, start = dispense(req)
+                pid, start = dispense(req, extra_close=conn)
                 reply = json.dumps({"pid": pid, "start_time": start})
             except Exception as e:  # noqa: BLE001 — surface to caller
                 reply = json.dumps({"error": repr(e)})
