@@ -370,6 +370,90 @@ class Dataset:
         for row in self.take(n):
             print(row)
 
+    def to_pandas(self, limit: Optional[int] = None):
+        """Materialize into one pandas DataFrame (ref: dataset.py
+        to_pandas; `limit` bounds accidental huge pulls)."""
+        rows = list(itertools.islice(self.iter_rows(), limit)) \
+            if limit is not None else self.take_all()
+        import pandas as pd
+
+        return pd.DataFrame(rows)
+
+    def to_arrow_refs(self) -> List[Any]:
+        """Block refs converted to pyarrow Tables, remotely (ref:
+        dataset.py to_arrow_refs — no driver materialization)."""
+        import ray_tpu
+
+        conv = ray_tpu.remote(_block_to_arrow)
+        return [conv.remote(r) for r in self._execute()]
+
+    def to_numpy_refs(self) -> List[Any]:
+        """Block refs converted to column->ndarray dicts, remotely
+        (ref: dataset.py to_numpy_refs)."""
+        import ray_tpu
+
+        conv = ray_tpu.remote(_block_to_numpy)
+        return [conv.remote(r) for r in self._execute()]
+
+    def to_tf(self, feature_columns, label_columns, *,
+              batch_size: int = 256):
+        """tf.data.Dataset over this dataset's batches (ref: dataset.py
+        to_tf). Gated on tensorflow being importable; iter_jax_batches /
+        iter_torch_batches are the native ingest paths."""
+        try:
+            import tensorflow as tf
+        except ImportError as e:
+            raise ImportError(
+                "tensorflow is not installed in this image; use "
+                "iter_jax_batches or iter_torch_batches instead") from e
+        feats = ([feature_columns] if isinstance(feature_columns, str)
+                 else list(feature_columns))
+        labels = ([label_columns] if isinstance(label_columns, str)
+                  else list(label_columns))
+
+        def pick(batch, cols):
+            vals = tuple(batch[c] for c in cols)
+            return vals[0] if len(vals) == 1 else vals
+
+        def gen():
+            for batch in self.iter_batches(batch_size=batch_size,
+                                           batch_format="numpy"):
+                yield pick(batch, feats), pick(batch, labels)
+
+        try:
+            # spec probe iterates the (already-executed, cached) blocks
+            first = next(iter(
+                self.iter_batches(batch_size=batch_size,
+                                  batch_format="numpy")))
+        except StopIteration:
+            raise ValueError(
+                "to_tf requires a non-empty dataset (the TensorSpec is "
+                "inferred from the first batch)") from None
+
+        def spec(cols):
+            specs = tuple(
+                tf.TensorSpec(shape=(None,) + first[c].shape[1:],
+                              dtype=tf.as_dtype(first[c].dtype))
+                for c in cols)
+            return specs[0] if len(specs) == 1 else specs
+
+        return tf.data.Dataset.from_generator(
+            gen, output_signature=(spec(feats), spec(labels)))
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of one column (ref: dataset.py unique).
+        Distilled remotely via the groupby shuffle — only the distinct
+        keys travel to the driver, never the rows."""
+        return [r[column]
+                for r in self.groupby(column).count().take_all()]
+
+    def aggregate(self, aggs: Dict[str, Union[str, List[str]]]
+                  ) -> Dict[str, Any]:
+        """Global (ungrouped) aggregation, one result row as a dict
+        (ref: dataset.py aggregate)."""
+        rows = GroupedData(self, []).agg(aggs).take_all()
+        return rows[0] if rows else {}
+
     def sum(self, on: str):
         return self._simple_agg("sum", on)
 
@@ -529,6 +613,14 @@ def _slice_block(block: Block, lo: int, hi: int) -> Block:
     return BlockAccessor(block).slice(lo, hi)
 
 
+def _block_to_arrow(block: Block):
+    return BlockAccessor(block).to_arrow()
+
+
+def _block_to_numpy(block: Block):
+    return BlockAccessor(block).to_numpy()
+
+
 import collections as _collections
 
 _JOIN_LOOKUPS: "_collections.OrderedDict[str, tuple]" = \
@@ -576,6 +668,14 @@ class GroupedData:
             kind="aggregate",
             args={"keys": self._keys, "aggs": spec,
                   "num_blocks": 1 if not self._keys else None}))
+
+    def map_groups(self, fn: Callable[[List[dict]], Iterable[Any]]
+                   ) -> Dataset:
+        """Apply fn to each complete group (a list of rows); fn returns
+        the group's output rows (ref: grouped_data.py map_groups —
+        hash-shuffled so every occurrence of a key lands in one task)."""
+        return self._ds._append(AllToAll(
+            kind="map_groups", args={"keys": self._keys, "fn": fn}))
 
     def count(self) -> Dataset:
         first_col = "__count__"

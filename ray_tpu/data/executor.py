@@ -223,11 +223,14 @@ def _partition_block(block: Block, n: int, kind: str, args: Dict[str, Any]):
             k = _sort_key(r, key)
             idx = int(np.searchsorted(bounds, _orderable(k), side="right"))
             parts[idx].append(r)
-    elif kind in ("aggregate", "join_key"):
+    elif kind in ("aggregate", "join_key", "map_groups"):
         keys = args["keys"]
-        part_ids = _hash_partition_rows(rows, keys, n)
-        for r, pid in zip(rows, part_ids):
-            parts[pid].append(r)
+        if not keys:  # global: one partition holds everything
+            parts[0].extend(rows)
+        else:
+            part_ids = _hash_partition_rows(rows, keys, n)
+            for r, pid in zip(rows, part_ids):
+                parts[pid].append(r)
     else:
         raise ValueError(kind)
     out = tuple(rows_to_block(p) for p in parts)
@@ -248,6 +251,16 @@ def _reduce_partition(kind: str, args: Dict[str, Any], *parts: Block) -> Block:
                          reverse=desc)
     elif kind == "aggregate":
         return _aggregate_rows(merged_rows, args)
+    elif kind == "map_groups":
+        keys, fn = args["keys"], args["fn"]
+        groups: Dict[tuple, List[Any]] = {}
+        for r in merged_rows:
+            groups.setdefault(tuple(r[k] for k in keys), []).append(r)
+        out: List[Any] = []
+        for g in groups.values():
+            res = fn(g)
+            out.extend(res if isinstance(res, list) else list(res))
+        return rows_to_block(out)
     return rows_to_block(merged_rows)
 
 

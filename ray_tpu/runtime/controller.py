@@ -127,6 +127,11 @@ class Controller:
         self.unschedulable: collections.deque = collections.deque(maxlen=1000)
         self.trace_spans: collections.deque = collections.deque(maxlen=100000)
         self.task_events: collections.deque = collections.deque(maxlen=100000)
+        # per-task aggregation over the event stream (ref:
+        # gcs_task_manager.cc — attempt counts, terminal state, error,
+        # bounded by task count with LRU drop)
+        self.task_index: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
         self.metrics: Dict[str, Any] = {}
         self._server = RpcServer(address, self._handlers(), on_disconnect=self._on_disconnect)
         self._health_task: Optional[asyncio.Task] = None
@@ -248,6 +253,8 @@ class Controller:
             # observability
             "add_task_events": self.add_task_events,
             "list_task_events": self.list_task_events,
+            "get_task": self.get_task,
+            "list_tasks": self.list_tasks,
             "add_trace_spans": self.add_trace_spans,
             "list_trace_spans": self.list_trace_spans,
             "report_metrics": self.report_metrics,
@@ -719,12 +726,61 @@ class Controller:
         return list(self.jobs.values())
 
     # ------------------------------------------------------------------ observability
+    TASK_INDEX_MAX = 20000
+
     async def add_task_events(self, events: List[Dict[str, Any]]):
         self.task_events.extend(events)
+        for ev in events:
+            tid = ev.get("task_id")
+            if not tid:
+                continue
+            row = self.task_index.get(tid)
+            if row is None:
+                row = self.task_index[tid] = {
+                    "task_id": tid, "name": ev.get("name", ""),
+                    "attempts": 1, "state": "", "error": None,
+                    "worker_id": ev.get("worker_id"),
+                    "start_ts": ev.get("ts"), "events": [],
+                }
+                while len(self.task_index) > self.TASK_INDEX_MAX:
+                    self.task_index.popitem(last=False)
+            else:
+                self.task_index.move_to_end(tid)
+            state = ev.get("state", "")
+            row["state"] = state
+            row["end_ts"] = ev.get("ts")
+            if state == "RETRYING":
+                row["attempts"] += 1
+            if ev.get("error"):
+                row["error"] = ev["error"]
+            row["events"].append({"state": state, "ts": ev.get("ts")})
+            if len(row["events"]) > 32:
+                del row["events"][0]
         return True
 
     async def list_task_events(self, limit: int = 1000):
         return list(self.task_events)[-limit:]
+
+    async def get_task(self, task_id: str):
+        """Aggregated per-task view: attempts, state timeline, error
+        (ref: `ray get tasks <id>` / gcs_task_manager.cc:789)."""
+        return self.task_index.get(task_id)
+
+    async def list_tasks(self, limit: int = 1000, state: str = None,
+                         name: str = None):
+        """Aggregated per-task rows, most recent last (ref: `ray list
+        tasks` with state/name filters)."""
+        out = []
+        for row in reversed(self.task_index.values()):
+            if state is not None and row["state"] != state:
+                continue
+            if name is not None and row["name"] != name:
+                continue
+            out.append(row)
+            if len(out) >= limit:
+                break
+        out.reverse()
+        return out
 
     async def add_trace_spans(self, spans: List[Dict[str, Any]]):
         self.trace_spans.extend(spans)

@@ -1202,15 +1202,20 @@ class CoreWorker:
             err = serialization.loads_inline(error)
             if pending.spec.get("retry_exceptions") and pending.retries_left > 0:
                 pending.retries_left -= 1
+                self._record_event(tid, pending.spec.get("name", ""),
+                                   "RETRYING", error=repr(err))
                 await self._resubmit(pending)
                 return True
             self.pending_tasks.pop(tid, None)
             for oid in pending.return_ids:
                 self._resolve(oid, err)
-            self._record_event(tid, pending.spec.get("name", ""), "FAILED")
+            self._record_event(tid, pending.spec.get("name", ""),
+                               "FAILED", error=repr(err))
         else:  # system failure (worker crash, node death)
             if pending.retries_left > 0:
                 pending.retries_left -= 1
+                self._record_event(tid, pending.spec.get("name", ""),
+                                   "RETRYING", error=str(error))
                 await self._resubmit(pending)
                 return True
             self.pending_tasks.pop(tid, None)
@@ -1218,7 +1223,8 @@ class CoreWorker:
                 f"task {tid.hex()} failed: {error}")
             for oid in pending.return_ids:
                 self._resolve(oid, err)
-            self._record_event(tid, pending.spec.get("name", ""), "FAILED")
+            self._record_event(tid, pending.spec.get("name", ""),
+                               "FAILED", error=str(error))
         return True
 
     async def _resubmit(self, pending: _PendingTask):
@@ -1604,13 +1610,17 @@ class CoreWorker:
         for r in refs:
             self._delete_object(r.id())
 
-    def _record_event(self, task_id: TaskID, name: str, state: str):
+    def _record_event(self, task_id: TaskID, name: str, state: str,
+                      error: Optional[str] = None):
         if not get_config().enable_timeline:
             return
-        self._task_events.append({
+        ev = {
             "task_id": task_id.hex(), "name": name, "state": state,
             "ts": time.time(), "worker_id": self.worker_id.hex(),
-        })
+        }
+        if error:
+            ev["error"] = error[:400]
+        self._task_events.append(ev)
         if len(self._task_events) >= 512:
             batch, self._task_events = self._task_events, []
             try:

@@ -1018,15 +1018,22 @@ class LLMEngine:
         achieved = tokens / dt * flops_per_tok
         # compute-only estimate: rtt sample = link + 1 compute, chain =
         # K computes + link, so per-dispatch compute c = (dt-rtt)/(K-1).
-        # Clamped against noisy samples (rtt jitter can exceed K*c).
+        # Clamped against noisy samples (rtt jitter can exceed K*c) and
+        # flagged unreliable when the chain barely exceeds one
+        # round-trip — a fabricated estimate must not be presentable as
+        # a physically impossible >100% MFU.
+        reliable = dt > 1.5 * rtt
         c = max((dt - rtt) / max(iters - 1, 1), dt / iters * 0.05)
         achieved_compute = (rb * sb * flops_per_tok) / c
+        if peak_flops:
+            achieved_compute = min(achieved_compute, float(peak_flops))
         out = {"seq_len": sb, "rows": rb, "iters": iters,
                "link_rtt_ms": round(rtt * 1e3, 1),
                "prefill_tok_s": round(tokens / dt, 1),
                "achieved_tflops": round(achieved / 1e12, 2),
                "achieved_tflops_compute": round(
-                   achieved_compute / 1e12, 2)}
+                   achieved_compute / 1e12, 2),
+               "compute_estimate_reliable": reliable}
         if peak_flops:
             out["mfu"] = round(100.0 * achieved / peak_flops, 2)
             out["mfu_compute"] = round(

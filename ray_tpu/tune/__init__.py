@@ -35,9 +35,11 @@ from .search import (  # noqa: F401
     uniform,
 )
 from .searchers import (  # noqa: F401
+    BayesOptSearch,
     ConcurrencyLimiter,
     HyperOptSearch,
     ListSearcher,
+    NevergradSearch,
     OptunaSearch,
     Searcher,
     TPESearcher,

@@ -327,3 +327,197 @@ class HyperOptSearch(Searcher):
         raise NotImplementedError(
             "hyperopt adapter: install hyperopt and use OptunaSearch-style "
             "wiring, or the native TPESearcher")
+
+
+class BayesOptSearch(Searcher):
+    """Native Gaussian-process Bayesian optimization (ref:
+    tune/search/bayesopt/bayesopt_search.py, which wraps the external
+    `bayesian-optimization` package — here the GP + expected-improvement
+    loop is implemented directly on scikit-learn, which the TPU image
+    ships, so no extra dependency is needed).
+
+    Dimensions map to the unit hypercube (log-scaled floats in log
+    space, categoricals by index); after `n_initial` random trials a
+    Matern-5/2 GP is fit on the observations and the next config
+    maximizes expected improvement over `n_candidates` random probes.
+    Best suited to expensive low-dimensional sweeps; for
+    high-dimensional or conditional spaces prefer TPESearcher.
+    """
+
+    def __init__(self, space: Dict[str, Any],
+                 metric: Optional[str] = None, mode: str = "max",
+                 n_initial: int = 8, n_candidates: int = 256,
+                 xi: float = 0.01, seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        try:
+            from sklearn.gaussian_process import GaussianProcessRegressor
+            from sklearn.gaussian_process.kernels import (  # noqa: F401
+                ConstantKernel, Matern)
+        except ImportError as e:  # pragma: no cover — sklearn is baked in
+            raise ImportError(
+                "BayesOptSearch needs scikit-learn; use TPESearcher "
+                "instead") from e
+        self._gpr_cls = GaussianProcessRegressor
+        self._kernel = ConstantKernel(1.0) * Matern(nu=2.5)
+        self.space = space
+        bad = [p for p, d in _flatten_space(space)
+               if isinstance(d, GridSearch)]
+        if bad:
+            raise ValueError(
+                f"BayesOptSearch does not support grid_search dimensions "
+                f"({['.'.join(p) for p in bad]}); enumerate them with "
+                f"tune.choice or use TPESearcher")
+        self.dims = [(path, dom) for path, dom in _flatten_space(space)
+                     if isinstance(dom, (Float, Integer, Categorical))]
+        self.static = [(path, val) for path, val in _flatten_space(space)
+                       if not isinstance(val, (Float, Integer, Categorical,
+                                               GridSearch, Function))]
+        self.fns = [(path, val) for path, val in _flatten_space(space)
+                    if isinstance(val, Function)]
+        self.n_initial = n_initial
+        self.n_candidates = n_candidates
+        self.xi = xi
+        self.rng = np.random.RandomState(seed)
+        self._pending: Dict[str, np.ndarray] = {}
+        self._X: List[np.ndarray] = []
+        self._y: List[float] = []
+
+    # --------------------------------------------------- unit-cube codec
+    def _to_unit_vec(self, u: np.ndarray) -> Dict[Tuple[str, ...], Any]:
+        flat = {}
+        for (path, dom), x in zip(self.dims, u):
+            x = float(min(max(x, 0.0), 1.0))
+            if isinstance(dom, Float):
+                if dom.log:
+                    lo, hi = math.log(dom.lower), math.log(dom.upper)
+                    flat[path] = math.exp(lo + x * (hi - lo))
+                else:
+                    flat[path] = dom.lower + x * (dom.upper - dom.lower)
+            elif isinstance(dom, Integer):
+                span = dom.upper - dom.lower
+                flat[path] = int(dom.lower + min(int(x * span),
+                                                 span - 1))
+            else:  # Categorical
+                n = len(dom.categories)
+                flat[path] = dom.categories[min(int(x * n), n - 1)]
+        return flat
+
+    def _random_unit(self) -> np.ndarray:
+        return self.rng.uniform(0.0, 1.0, size=len(self.dims))
+
+    # ------------------------------------------------------------ suggest
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if len(self._y) < self.n_initial or not self.dims:
+            u = self._random_unit()
+        else:
+            u = self._ei_argmax()
+        cfg: Dict[str, Any] = {}
+        for path, val in self.static:
+            _set_path(cfg, path, val)
+        for path, fn in self.fns:
+            _set_path(cfg, path, fn.fn())
+        for path, val in self._to_unit_vec(u).items():
+            _set_path(cfg, path, val)
+        self._pending[trial_id] = u
+        return cfg
+
+    def _ei_argmax(self) -> np.ndarray:
+        import warnings
+
+        X = np.asarray(self._X)
+        y = np.asarray(self._y)
+        y_mu, y_sd = y.mean(), y.std() or 1.0
+        yn = (y - y_mu) / y_sd
+        gp = self._gpr_cls(kernel=self._kernel, normalize_y=False,
+                           alpha=1e-6, n_restarts_optimizer=1,
+                           random_state=self.rng.randint(2**31 - 1))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # GP convergence chatter
+            gp.fit(X, yn)
+        cand = self.rng.uniform(
+            0.0, 1.0, size=(self.n_candidates, len(self.dims)))
+        mu, sd = gp.predict(cand, return_std=True)
+        best = yn.max()
+        sd = np.maximum(sd, 1e-9)
+        z = (mu - best - self.xi) / sd
+        from scipy.stats import norm
+
+        ei = (mu - best - self.xi) * norm.cdf(z) + sd * norm.pdf(z)
+        return cand[int(np.argmax(ei))]
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None) -> None:
+        u = self._pending.pop(trial_id, None)
+        if u is None or not result or self.metric not in result:
+            return
+        value = float(result[self.metric])
+        if not math.isfinite(value):
+            return
+        self._X.append(u)
+        self._y.append(value if self.mode == "max" else -value)
+
+
+class NevergradSearch(Searcher):
+    """Adapter over nevergrad's ask/tell optimizers (ref:
+    tune/search/nevergrad/). Gated: nevergrad is not in the hermetic
+    TPU image; BayesOptSearch and TPESearcher are the native,
+    dependency-free equivalents."""
+
+    def __init__(self, space: Dict[str, Any],
+                 metric: Optional[str] = None, mode: str = "max",
+                 optimizer: str = "NGOpt", budget: int = 100):
+        super().__init__(metric, mode)
+        try:
+            import nevergrad as ng
+        except ImportError as e:
+            raise ImportError(
+                "nevergrad is not installed; use BayesOptSearch or "
+                "TPESearcher (native, no dependencies) instead") from e
+        params = {}
+        bad = [p for p, d in _flatten_space(space)
+               if isinstance(d, GridSearch)]
+        if bad:
+            raise ValueError(
+                f"NevergradSearch does not support grid_search dimensions "
+                f"({['.'.join(p) for p in bad]}); enumerate them with "
+                f"tune.choice instead")
+        for path, dom in _flatten_space(space):
+            name = ".".join(path)
+            if isinstance(dom, Float):
+                params[name] = (ng.p.Log(lower=dom.lower, upper=dom.upper)
+                                if dom.log else
+                                ng.p.Scalar(lower=dom.lower,
+                                            upper=dom.upper))
+            elif isinstance(dom, Integer):
+                params[name] = ng.p.Scalar(
+                    lower=dom.lower, upper=dom.upper - 1).set_integer_casting()
+            elif isinstance(dom, Categorical):
+                params[name] = ng.p.Choice(dom.categories)
+        self._space = space
+        self._opt = ng.optimizers.registry[optimizer](
+            parametrization=ng.p.Dict(**params), budget=budget)
+        self._asked: Dict[str, Any] = {}
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        cand = self._opt.ask()
+        self._asked[trial_id] = cand
+        cfg: Dict[str, Any] = {}
+        flat = dict(cand.value)
+        for path, dom in _flatten_space(self._space):
+            name = ".".join(path)
+            if name in flat:
+                _set_path(cfg, path, flat[name])
+            elif isinstance(dom, Function):
+                _set_path(cfg, path, dom.fn())
+            elif not isinstance(dom, (Float, Integer, Categorical,
+                                      GridSearch)):
+                _set_path(cfg, path, dom)
+        return cfg
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None) -> None:
+        cand = self._asked.pop(trial_id, None)
+        if cand is None or not result or self.metric not in result:
+            return
+        value = float(result[self.metric])
+        self._opt.tell(cand, -value if self.mode == "max" else value)

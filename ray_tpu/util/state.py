@@ -44,6 +44,28 @@ def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
     return _controller().call("list_task_events", limit=limit)
 
 
+def list_task_states(limit: int = 1000, state: Optional[str] = None,
+                     name: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Aggregated per-task rows — attempts, latest state, error, event
+    timeline — with state/name filters (ref: `ray list tasks`;
+    gcs_task_manager.cc per-attempt bookkeeping)."""
+    from ..runtime.core import get_core
+
+    get_core().flush_events()
+    return _controller().call("list_tasks", limit=limit, state=state,
+                              name=name)
+
+
+def get_task(task_id: str) -> Optional[Dict[str, Any]]:
+    """One task's aggregated view: how many attempts ran, where it
+    ended, the error that terminated it, and its state timeline (ref:
+    `ray get tasks <id>`)."""
+    from ..runtime.core import get_core
+
+    get_core().flush_events()
+    return _controller().call("get_task", task_id=task_id)
+
+
 def cluster_metrics() -> Dict[str, Any]:
     return _controller().call("get_metrics")
 

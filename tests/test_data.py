@@ -666,3 +666,51 @@ def test_expansion_ratio_settles_to_actual():
     assert alloc.op_bytes[0] == 400
     assert alloc.ratio[0] == pytest.approx(4.0)
     assert alloc.estimate_out(0, 100) == 400
+
+
+def test_dataset_breadth_to_pandas_unique_aggregate(shared_cluster):
+    ds = rd.range(20, parallelism=3).map(
+        lambda r: {"id": r["id"], "k": r["id"] % 3})
+    df = ds.to_pandas()
+    assert len(df) == 20 and set(df.columns) == {"id", "k"}
+    assert sorted(ds.unique("k")) == [0, 1, 2]
+    agg = ds.aggregate({"id": ["sum", "max"]})
+    assert agg["sum(id)"] == sum(range(20)) and agg["max(id)"] == 19
+    # remote block conversions: no driver materialization of blocks
+    import ray_tpu
+
+    tables = ray_tpu.get(ds.to_arrow_refs(), timeout=120)
+    assert sum(t.num_rows for t in tables) == 20
+    cols = ray_tpu.get(ds.to_numpy_refs(), timeout=120)
+    assert sum(len(c["id"]) for c in cols) == 20
+
+
+def test_map_groups(shared_cluster):
+    """ref: grouped_data.py map_groups — each group lands whole in one
+    task; fn sees the full row list."""
+    ds = rd.range(30, parallelism=4).map(
+        lambda r: {"k": r["id"] % 3, "v": r["id"]})
+    out = (ds.groupby("k")
+           .map_groups(lambda rows: [{
+               "k": rows[0]["k"],
+               "n": len(rows),
+               "span": max(r["v"] for r in rows) - min(
+                   r["v"] for r in rows)}])
+           .take_all())
+    got = {r["k"]: (r["n"], r["span"]) for r in out}
+    assert got == {0: (10, 27), 1: (10, 27), 2: (10, 27)}
+
+
+def test_to_tf(shared_cluster):
+    """ref: dataset.py to_tf — tf.data pipeline over dataset batches."""
+    tf = pytest.importorskip("tensorflow")
+
+    ds = rd.range(20, parallelism=2).map(
+        lambda r: {"x": float(r["id"]), "y": float(r["id"] * 2)})
+    tfds = ds.to_tf("x", "y", batch_size=8)
+    xs, ys = [], []
+    for bx, by in tfds:
+        xs.extend(bx.numpy().tolist())
+        ys.extend(by.numpy().tolist())
+    assert sorted(xs) == [float(i) for i in range(20)]
+    assert sorted(ys) == [float(i * 2) for i in range(20)]

@@ -247,3 +247,37 @@ def test_profiling_endpoints(shared_cluster):
         assert workers and all(w["threads"] for w in workers)
     finally:
         server.shutdown()
+
+
+def test_task_state_api_tracks_attempts_and_errors(shared_cluster):
+    """Per-task introspection (ref: gcs_task_manager.cc — `ray list
+    tasks` / `ray get tasks <id>`): a retried-then-failed task exposes
+    its attempt count, terminal state, and the error that killed it."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    @ray_tpu.remote(max_retries=2, retry_exceptions=True, name="flaky_st")
+    def flaky():
+        raise ValueError("deliberate boom")
+
+    ref = flaky.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=120)
+
+    import time as _t
+
+    deadline = _t.time() + 30
+    row = None
+    while _t.time() < deadline:
+        rows = state.list_task_states(state="FAILED", name="flaky_st")
+        if rows:
+            row = rows[-1]
+            break
+        _t.sleep(0.2)
+    assert row is not None, "task never indexed"
+    assert row["attempts"] == 3  # initial + 2 retries
+    assert "deliberate boom" in (row["error"] or "")
+    assert [e["state"] for e in row["events"]].count("RETRYING") == 2
+    # point lookup agrees
+    got = state.get_task(row["task_id"])
+    assert got["state"] == "FAILED" and got["attempts"] == 3

@@ -355,3 +355,38 @@ def test_tuner_restore_after_driver_kill_pbt(shared_cluster, tmp_path):
         "perturbation_interval=4, "
         "hyperparam_mutations={'x': [1, 2, 3]})")
     assert grid.num_terminated() == 3
+
+
+def test_bayesopt_searcher_converges_on_quadratic():
+    """Native GP/EI Bayesian optimization (ref: tune/search/bayesopt/ —
+    here on scikit-learn, dependency-free in this image) finds the
+    optimum of a smooth objective with few samples and handles the
+    categorical arm."""
+    from ray_tpu import tune
+    from ray_tpu.tune.searchers import BayesOptSearch
+
+    space = {"x": tune.uniform(0, 1), "y": tune.uniform(0, 1),
+             "kind": tune.choice(["a", "b"])}
+    bo = BayesOptSearch(space, metric="score", mode="max",
+                        n_initial=6, seed=0)
+    best, best_cfg = -1e9, None
+    for i in range(30):
+        tid = f"b{i}"
+        cfg = bo.suggest(tid)
+        score = (-(cfg["x"] - 0.3) ** 2 - (cfg["y"] - 0.7) ** 2
+                 - (0.5 if cfg["kind"] == "b" else 0.0))
+        bo.on_trial_complete(tid, {"score": score})
+        if score > best:
+            best, best_cfg = score, cfg
+    assert best > -0.05, best
+    assert best_cfg["kind"] == "a"
+
+
+def test_gated_adapters_raise_with_guidance():
+    import pytest as _pytest
+
+    from ray_tpu import tune
+    from ray_tpu.tune.searchers import NevergradSearch
+
+    with _pytest.raises(ImportError, match="BayesOptSearch or"):
+        NevergradSearch({"x": tune.uniform(0, 1)}, metric="m")
