@@ -378,12 +378,14 @@ int64_t rtpu_store_create(void* handle, const uint8_t* key,
   need = (need + kAlign - 1) & ~(kAlign - 1);
   int64_t off = alloc_block(p, need);
   if (off < 0) {
-    // evict in batches: freeing exactly `need` makes every put at a
-    // full pool pay its own eviction pass (multi-writer churn thrash);
-    // a pool/16 batch amortizes the LRU walk across many puts
-    PoolHeader* h = H(p);
-    uint64_t batch = need > h->pool_size / 16 ? need : h->pool_size / 16;
-    evict_lru(p, batch);
+    // evict EXACTLY what the allocation needs: refcount-0 entries can
+    // still be logically live at their owners (reconstruction relies
+    // on a bounded lineage FIFO, and puts/streamed returns have none),
+    // so every evicted byte is a gamble the owner never reads it
+    // again. A batched sweep (tried in r5 for multi-writer churn)
+    // reached recent entries and surfaced as ObjectLostError under
+    // suite-level pressure — the minimal footprint is the safe policy.
+    evict_lru(p, need);
     off = alloc_block(p, need);
   }
   if (off < 0) {

@@ -242,6 +242,7 @@ class CoreWorker:
         self._borrowed_owners: Dict[ObjectID, str] = {}  # we borrow FROM
         self.borrows: Dict[ObjectID, set] = {}  # borrower addrs of OUR objects
         self._pending_delete: set = set()  # delete deferred on borrows
+        self._stream_pins: set = set()  # owner pins on streamed returns
         # lineage for reconstruction (ref: object_recovery_manager.h:43,
         # task_manager.h:182 lineage cap)
         self.lineage: Dict[ObjectID, tuple] = {}
@@ -309,6 +310,41 @@ class CoreWorker:
             for line in entry.get("lines", []):
                 print(f"{prefix} {line}", file=sys_mod.stderr)
 
+    def maybe_flush_metrics(self, min_interval_s: float = 30.0) -> None:
+        """Piggyback metric reporting on work the process is ALREADY
+        awake for (task completion): workers get fresh series while
+        active and zero timer wakes while idle — periodic wakes across
+        hundreds of forked workers were the r5 many_actors cliff. Cheap
+        on the hot path: one clock read unless the interval elapsed."""
+        now = time.monotonic()
+        if now - getattr(self, "_metrics_flushed_at", 0.0) < min_interval_s:
+            return
+        self._metrics_flushed_at = now
+        from ..util import metrics as metrics_mod
+
+        snap = metrics_mod.snapshot()
+        if not snap or snap == getattr(self, "_metrics_last_sent", None):
+            return
+        self._metrics_last_sent = snap
+        target = self.nodelet if self.mode == "worker" else self.controller
+
+        async def _send():
+            try:
+                await target.notify_async(
+                    "report_metrics",
+                    node_id=f"{self.node_id}/{self.worker_id.hex()[:8]}",
+                    metrics=snap)
+            except Exception:
+                # delivery failed: un-mark so the next piggyback (or the
+                # slow self-heal tick) resends
+                if self._metrics_last_sent is snap:
+                    self._metrics_last_sent = None
+
+        try:
+            EventLoopThread.get().spawn(_send())
+        except Exception:
+            self._metrics_last_sent = None
+
     async def _metrics_flush_loop(self):
         """Ship this process's metric registry to the controller every few
         seconds (the node-metrics-agent channel; ref: stats/metric.h
@@ -320,27 +356,28 @@ class CoreWorker:
 
         if os.environ.get("RTPU_METRICS_FLUSH", "1") == "0":
             return
-        # WORKERS report on a much longer period than the driver: at
-        # hundreds of live actors the per-worker wakeup + changed-ping
-        # counters made the 5s cadence a continuous RPC storm on the
-        # controller (r5 many_actors: creation at 600 alive collapsed
-        # 4x in the post-ping metrics window). Worker-side counters are
-        # observability, not control-plane state — 30s is plenty.
-        period = 5.0 if self.mode == "driver" else 30.0
+        # WORKERS piggyback reporting on task completion (see
+        # maybe_flush_metrics) and keep only a SLOW self-heal timer
+        # here: the r5 many_actors hunt found that mere periodic WAKES
+        # of hundreds of idle forked workers collapse creation
+        # throughput 4x past ~650 live (kernel-level cost per wake in a
+        # wide COW fork lineage, not the report RPCs — disabling the
+        # loop flattened the cliff at a steady ~35/s to 1000+ alive).
+        # The slow tick re-delivers state to a restarted/failed-over
+        # controller whose metric tables started empty.
+        period = 5.0 if self.mode == "driver" else 600.0
         last = None
         ticks = 0
         while not self._shutting_down:
             # jittered period, and ONLY on change: thousands of idle
-            # actor workers each reporting an unchanged snapshot every
-            # 5s adds O(workers) constant RPC load on the controller —
-            # enough to visibly slow everything else on a small head.
-            # A periodic unconditional resend (~5 min) self-heals a
-            # restarted/failed-over controller whose metric tables
-            # started empty while this worker sat idle.
+            # actor workers each reporting an unchanged snapshot adds
+            # O(workers) constant RPC load on the controller — enough
+            # to visibly slow everything else on a small head.
             await asyncio.sleep(period + random.uniform(0.0, period * 0.4))
             ticks += 1
+            resend_tick = ticks % (60 if self.mode == "driver" else 2)
             snap = metrics_mod.snapshot()
-            if not snap or (snap == last and ticks % 60 != 0):
+            if not snap or (snap == last and resend_tick != 0):
                 continue
             try:
                 # workers report via the nodelet (existing connection,
@@ -472,9 +509,27 @@ class CoreWorker:
         deserializing for a couple of seconds is NOT dead, and releasing
         a live borrower's ref would let the owner delete under it."""
         ping_failures: Dict[str, int] = {}
+        # Event-driven: a 10s timer in EVERY worker was one of the
+        # periodic wakes behind the r5 many_actors cliff (idle forked
+        # workers must be fully quiescent). The loop parks until a
+        # delete actually defers on live borrowers (nudged from
+        # _delete_object), with a slow 10-min recheck as the backstop.
+        self._borrow_sweep_wake = asyncio.Event()
         while not self._shutting_down:
-            await asyncio.sleep(10.0)
-            blocked = [oid for oid in self._pending_delete
+            # snapshot: _delete_object adds from arbitrary threads
+            # (ObjectRef.__del__ paths) — iterating the live set would
+            # die with 'set changed size during iteration' and silently
+            # kill this GC loop
+            if not any(self.borrows.get(oid)
+                       for oid in list(self._pending_delete)):
+                self._borrow_sweep_wake.clear()
+                try:
+                    await asyncio.wait_for(
+                        self._borrow_sweep_wake.wait(), timeout=600.0)
+                except asyncio.TimeoutError:
+                    continue  # still nothing pending: park again
+            await asyncio.sleep(10.0)  # reconciliation cadence
+            blocked = [oid for oid in list(self._pending_delete)
                        if self.borrows.get(oid)]
             checked: Dict[str, bool] = {}
             for oid in blocked:
@@ -500,8 +555,15 @@ class CoreWorker:
     def _delete_object(self, oid: ObjectID):
         if self.borrows.get(oid):
             # borrowers still hold it: defer (ref: reference_count.cc —
-            # owner waits for borrower refs to drain)
+            # owner waits for borrower refs to drain), and nudge the
+            # parked sweep (callable from any thread — __del__ paths)
             self._pending_delete.add(oid)
+            ev = getattr(self, "_borrow_sweep_wake", None)
+            if ev is not None:
+                try:
+                    EventLoopThread.get().loop.call_soon_threadsafe(ev.set)
+                except Exception:
+                    pass
             return
         self._pending_delete.discard(oid)
         self.owned.discard(oid)
@@ -509,6 +571,12 @@ class CoreWorker:
         self._events.pop(oid, None)
         self.lineage.pop(oid, None)
         self._replica_dirs.pop(oid, None)
+        if oid in self._stream_pins:
+            self._stream_pins.discard(oid)
+            try:
+                self.store.unpin(oid)
+            except Exception:
+                pass
         # wake stranded sync waiters; they will observe the loss
         for sw in self._sync_waiters.pop(oid, ()):
             sw[0] -= 1
@@ -1117,7 +1185,19 @@ class CoreWorker:
         if kind == "inline":
             self._resolve(oid, serialization.loads_inline(payload))
         else:
-            self._resolve(oid, self._shm_marker(payload))
+            marker = self._shm_marker(payload)
+            if marker is _IN_SHM:
+                # streamed returns have NO lineage: once the producer
+                # worker drops its creation pin, the entry would be
+                # LRU-evictable while this owner still references it —
+                # unrecoverable data loss. Pin it for the ref's
+                # lifetime (_delete_object unpins).
+                try:
+                    if self.store.pin(oid):
+                        self._stream_pins.add(oid)
+                except Exception:
+                    pass
+            self._resolve(oid, marker)
         return True
 
     def _shm_marker(self, loc: Optional[dict]):

@@ -189,6 +189,13 @@ class ObjectStoreClient:
             buffers.append(mv[off:off + ln])
         return serialization.deserialize(meta, buffers)
 
+    def pin(self, oid: ObjectID) -> bool:
+        # file-backed store: entries live until deleted, nothing evicts
+        return True
+
+    def unpin(self, oid: ObjectID) -> None:
+        pass
+
     def release(self, oid: ObjectID):
         seg = self._pinned.pop(oid, None)
         if seg is not None:
@@ -396,6 +403,21 @@ class NativeObjectStoreClient:
         # pool refcount stays bumped until release(); mm pins this process
         self._pinned.setdefault(oid, []).append(mm)
         return value
+
+    def pin(self, oid: ObjectID) -> bool:
+        """Take a bare refcount on a resident object (no read, no mmap):
+        protects an entry whose logical owner holds no pool refcount —
+        a streamed return created by a since-idle worker — from LRU
+        eviction until unpin(). Streaming results have NO lineage to
+        reconstruct from, so eviction there is data loss (r5). Returns
+        False when the object is not resident."""
+        return self._pool.get_raw(self._key(oid)) is not None
+
+    def unpin(self, oid: ObjectID) -> None:
+        try:
+            self._pool.release(self._key(oid))
+        except Exception:  # noqa: BLE001 — already gone is fine
+            pass
 
     def release(self, oid: ObjectID):
         self._sweep_zombies()

@@ -309,6 +309,7 @@ class Executor:
         involve the nodelet, and stream items must stay FIFO with their
         terminator on one connection. Returns True when the combined
         task_done frame was used (no separate task_finished needed)."""
+        self.core.maybe_flush_metrics()  # piggyback: already awake
         if spec.get("type") == "task" and \
                 spec.get("num_returns") not in ("streaming", "dynamic"):
             self.core.nodelet.notify_nowait(
