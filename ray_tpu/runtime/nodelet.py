@@ -1064,6 +1064,7 @@ class Nodelet:
             # idle worker. Per-call work stays O(window + dispatched),
             # independent of backlog depth.
             blocked = 0
+            failed_reqs: list = []
             while self.queue.count(key) > blocked and blocked < 64:
                 spec = self.queue.peek(key)
                 if spec["task_id"] in self.cancelled:
@@ -1074,6 +1075,19 @@ class Nodelet:
                 if not pool:
                     break
                 if not self._acquire(spec):
+                    req = spec.get("resources", {})
+                    if not spec.get("placement_group_id") \
+                            and req in failed_reqs:
+                        # an identical request already failed THIS pass
+                        # and node resources cannot appear mid-pass:
+                        # stop — with a homogeneous backlog (the common
+                        # case) the old full-window rotation burned ~64
+                        # acquire attempts per task completion, the top
+                        # dispatch cost in the tasks/s profile (r5).
+                        # PG specs are exempt: same request, different
+                        # bundle can still succeed.
+                        break
+                    failed_reqs.append(req)
                     # rotate: blocked specs go to the back of this key
                     self.queue.append(self.queue.popleft(key))
                     blocked += 1
