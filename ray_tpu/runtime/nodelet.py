@@ -1064,7 +1064,6 @@ class Nodelet:
             # idle worker. Per-call work stays O(window + dispatched),
             # independent of backlog depth.
             blocked = 0
-            failed_reqs: list = []
             while self.queue.count(key) > blocked and blocked < 64:
                 spec = self.queue.peek(key)
                 if spec["task_id"] in self.cancelled:
@@ -1075,20 +1074,17 @@ class Nodelet:
                 if not pool:
                     break
                 if not self._acquire(spec):
-                    req = spec.get("resources", {})
-                    if not spec.get("placement_group_id") \
-                            and req in failed_reqs:
-                        # an identical request already failed THIS pass
-                        # and node resources cannot appear mid-pass:
-                        # stop — with a homogeneous backlog (the common
-                        # case) the old full-window rotation burned ~64
-                        # acquire attempts per task completion, the top
-                        # dispatch cost in the tasks/s profile (r5).
-                        # PG specs are exempt: same request, different
-                        # bundle can still succeed.
-                        break
-                    failed_reqs.append(req)
-                    # rotate: blocked specs go to the back of this key
+                    # rotate: blocked specs go to the back of this key.
+                    # NOTE: the rotation must run the FULL window — a
+                    # complete pass rotates every blocked spec, so
+                    # relative FIFO order is preserved cyclically. An
+                    # early break after the first repeated request shape
+                    # (tried in r5 to cut the ~64 acquire attempts per
+                    # completion) rotates only the FRONT spec per pass,
+                    # slowly cycling producers behind consumers until
+                    # arg-blocked consumers hold every CPU with their
+                    # producers queued — a hard deadlock in pipelined
+                    # shuffles (data repartition hung reproducibly).
                     self.queue.append(self.queue.popleft(key))
                     blocked += 1
                     continue
