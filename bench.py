@@ -205,6 +205,31 @@ def bench_serve(on_tpu: bool) -> dict:
     return out
 
 
+def bench_serve_tp() -> dict:
+    """Tensor-parallel serve datapoint: sharded vs single-chip decode
+    step latency + greedy parity on the virtual 8-device CPU mesh
+    (benchmarks/sharded_serve.py). Runs in a subprocess so its CPU
+    device config never touches this process's TPU backend."""
+    import os
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               JAX_PLATFORMS="", JAX_PLATFORM_NAME="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(here, "benchmarks",
+                                      "sharded_serve.py"),
+         "--tp", "2", "--steps", "15"],
+        capture_output=True, text=True, timeout=420, cwd=here, env=env)
+    for line in reversed(out.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(
+        f"sharded_serve produced no JSON: {out.stderr[-300:]}")
+
+
 def bench_runtime() -> dict:
     """Core-runtime microbenchmarks (tasks/s, actor calls/s) — the
     BASELINE.md table companion, measured on this host."""
@@ -322,6 +347,14 @@ def main():
             result["detail"]["runtime"] = bench_runtime()
         except Exception as e:  # noqa: BLE001
             result["detail"]["runtime"] = {"error": repr(e)[:200]}
+
+    # 4. tensor-parallel serve datapoint (virtual-mesh subprocess),
+    # same time guard
+    if time.perf_counter() - start < 420:
+        try:
+            result["detail"]["serve_tp"] = bench_serve_tp()
+        except Exception as e:  # noqa: BLE001
+            result["detail"]["serve_tp"] = {"error": repr(e)[:200]}
     print(json.dumps(result))
 
 

@@ -1,0 +1,144 @@
+"""Sharded vs single-chip Serve-LLM decode step latency.
+
+Measures the fused decode dispatch of the tensor-parallel engine
+(ray_tpu/serve/llm/sharding.py) against the single-device engine on the
+virtual 8-device CPU mesh, plus a greedy-parity check — the same
+bit-exactness contract the dryrun serve tier asserts. Run:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python benchmarks/sharded_serve.py [--tp 2] [--steps 30]
+
+Prints ONE JSON line. On this 1-vCPU box all virtual devices share one
+core, so tp>1 adds partitioning overhead rather than speedup — the
+datapoint tracks that overhead (and correctness) per round; real speedup
+needs real chips, where each shard owns its HBM bandwidth.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # runnable from anywhere
+
+ENGINE_CFG = dict(model="tiny", page_size=8, num_pages=64,
+                  max_model_len=128, max_batch=4,
+                  prefill_buckets=(16, 32, 64), dtype="float32",
+                  model_overrides={"vocab_size": 512})
+
+
+def _setup_devices(n: int) -> None:
+    # APPEND the device-count flag when XLA_FLAGS is already set (a bare
+    # setdefault would leave pre-0.5 jax — where jax_num_cpu_devices
+    # doesn't exist — with one device and a misleading tp error)
+    flag = f"--xla_force_host_platform_device_count={n}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = f"{flags} {flag}".strip()
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", n)
+    except (RuntimeError, AttributeError):
+        pass
+
+
+def parity_prompts():
+    """The fixed prompt set of the greedy bit-exactness contract —
+    shared with the dryrun serve tier (__graft_entry__.py), so bench and
+    dryrun assert the SAME parity, defined once."""
+    import numpy as np
+
+    return [list(np.random.default_rng(s).integers(0, 500, n))
+            for s, n in ((0, 13), (1, 9), (2, 21))]
+
+
+def greedy_collect(engine, prompts, max_tokens=8):
+    """Run `prompts` to completion greedily; returns {rid: token_ids}."""
+    from ray_tpu.serve.llm import SamplingParams
+
+    for i, p in enumerate(prompts):
+        engine.add_request(f"g{i}", p, SamplingParams(max_tokens=max_tokens))
+    out = {f"g{i}": [] for i in range(len(prompts))}
+    done = set()
+    for _ in range(500):
+        for d in engine.step():
+            out[d.request_id].extend(d.new_token_ids)
+            if d.finished:
+                done.add(d.request_id)
+        if len(done) == len(prompts):
+            break
+    return out
+
+
+def _decode_step_ms(engine, steps: int) -> float:
+    """Steady-state decode: fill every slot, drain prefill, then time
+    `steps` scheduler iterations of pure fused decode."""
+    import numpy as np
+
+    from ray_tpu.serve.llm import SamplingParams
+
+    rng = np.random.default_rng(0)
+    budget = steps * max(1, engine.config.decode_steps_per_dispatch) + 16
+    for i in range(engine.config.max_batch):
+        engine.add_request(f"d{i}", list(rng.integers(0, 400, 12)),
+                           SamplingParams(max_tokens=budget))
+    # drain prefill + first decode compiles (warm shapes)
+    for _ in range(8):
+        engine.step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        engine.step()
+    dt = time.perf_counter() - t0
+    for i in range(engine.config.max_batch):
+        engine.abort(f"d{i}")
+    while engine.has_work():
+        engine.step()
+    return dt / steps * 1e3
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--tp", type=int, default=2)
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--devices", type=int, default=8)
+    args = parser.parse_args()
+    _setup_devices(args.devices)
+
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine
+
+    prompts = parity_prompts()
+
+    single = LLMEngine(EngineConfig(**ENGINE_CFG))
+    ref_out = greedy_collect(single, prompts)
+    single_ms = _decode_step_ms(single, args.steps)
+
+    sharded = LLMEngine(EngineConfig(**ENGINE_CFG, tp=args.tp))
+    tp_out = greedy_collect(sharded, prompts)
+    parity = tp_out == ref_out
+    tp_ms = _decode_step_ms(sharded, args.steps)
+
+    out = {
+        "metric": "sharded_serve_decode_step",
+        "tp": args.tp,
+        "devices": args.devices,
+        "steps": args.steps,
+        "batch": ENGINE_CFG["max_batch"],
+        "decode_step_ms_single": round(single_ms, 2),
+        "decode_step_ms_tp": round(tp_ms, 2),
+        "tp_overhead_x": round(tp_ms / single_ms, 2) if single_ms else None,
+        "greedy_parity": parity,
+        "sharding": sharded.stats().get("sharding"),
+    }
+    print(json.dumps(out))
+    if not parity:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -147,6 +147,13 @@ class PagedCache:
     # STATIC number of block-table columns a cached prefix may span during
     # prefill (0 = no prefix part compiled in); decode ignores it
     ctx_pages: int = struct.field(pytree_node=False, default=0)
+    # STATIC: force the jnp reference attention paths. Set by
+    # tensor-parallel engines — the Pallas kernels are single-device
+    # programs, so sharded steps (traced under GSPMD) must use the
+    # reference einsums, which partition like any other XLA op. A static
+    # field (not a process flag): each engine's jit cache keys on it, so
+    # kernel and reference lowerings never mix within or across engines.
+    ref_attention: bool = struct.field(pytree_node=False, default=False)
 
 
 class Attention(nn.Module):
@@ -180,12 +187,13 @@ class Attention(nn.Module):
                                    positions, pc.total_lens)
             if s == 1:
                 out = paged_attention_decode(
-                    q[:, 0], kv_pages, pc.block_tables,
-                    pc.total_lens)[:, None]
+                    q[:, 0], kv_pages, pc.block_tables, pc.total_lens,
+                    force_reference=pc.ref_attention)[:, None]
             else:
                 out = paged_prefill_attention(
                     q, k, v, kv_pages, pc.block_tables, positions,
-                    pc.total_lens, ctx_pages=pc.ctx_pages)
+                    pc.total_lens, ctx_pages=pc.ctx_pages,
+                    impl="reference" if pc.ref_attention else None)
             new_cache = pc.replace(kv_pages=kv_pages)
         else:
             if kv_cache is not None:

@@ -32,6 +32,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# renamed TPUCompilerParams -> CompilerParams in jax 0.5; accept both
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 NEG_INF = -1e30
 BLOCK = 512  # default tile edge: benches fastest fwd+bwd on v5e
 GRAN = 128   # MXU-minimal granularity: short sequences round up to this,
@@ -163,7 +167,7 @@ def _fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel")),
         interpret=interpret,
     )(*args)
@@ -314,7 +318,7 @@ def _bwd(q, k, v, q_seg, kv_seg, o, lse, do, causal, sm_scale,
                   vec_blk_spec, qseg_blk, kseg_full],
         out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h, i: (b_, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b, hq, sq_p, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel")),
         interpret=interpret,
     )(q, k, v, do, lse, delta, *seg_args)
@@ -340,7 +344,7 @@ def _bwd(q, k, v, q_seg, kv_seg, o, lse, do, causal, sm_scale,
             jax.ShapeDtypeStruct((b, hq, sk_p, d), jnp.float32),
             jax.ShapeDtypeStruct((b, hq, sk_p, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel")),
         interpret=interpret,
     )(q, k, v, do, lse, delta, *seg_args)

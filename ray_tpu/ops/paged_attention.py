@@ -44,6 +44,24 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def _hbm_space(pltpu):
+    """pltpu.MemorySpace.HBM across jax versions (pre-0.5: the enum is
+    TPUMemorySpace and lacks HBM; ANY is the closest placement)."""
+    space = getattr(pltpu, "MemorySpace", None) \
+        or pltpu.TPUMemorySpace
+    return getattr(space, "HBM", space.ANY)
+
+
+def _fori_no_unroll(lo, hi, body, init):
+    """fori_loop with unrolling pinned OFF. Pre-0.5 jax only accepts the
+    `unroll` kwarg with static bounds (and its default is no-unroll
+    anyway), so fall back to the bare call there."""
+    try:
+        return jax.lax.fori_loop(lo, hi, body, init, unroll=False)
+    except ValueError:
+        return jax.lax.fori_loop(lo, hi, body, init)
+
+
 def make_kv_pages(num_kv_heads: int, num_pages: int, page_size: int,
                   head_dim: int, dtype) -> jax.Array:
     """Allocate a zeroed page pool [P, Hkv, page, 2*D] (K | V in lanes)."""
@@ -153,10 +171,9 @@ def _decode_kernel(lengths_ref, bt_ref,            # SMEM scalars
             work_c[cnt] = c
             return cnt + 1
 
-        return jax.lax.fori_loop(0, pl.cdiv(n_pages, chunk), fill_c, cnt,
-                                 unroll=False)
+        return _fori_no_unroll(0, pl.cdiv(n_pages, chunk), fill_c, cnt)
 
-    n_items = jax.lax.fori_loop(0, n_b, fill_b, 0, unroll=False)
+    n_items = _fori_no_unroll(0, n_b, fill_b, 0)
 
     # rows not covered by any work item (inactive slots) stay zero
     o_ref[...] = jnp.zeros_like(o_ref)
@@ -255,7 +272,7 @@ def _decode_kernel(lengths_ref, bt_ref,            # SMEM scalars
     m0 = jnp.full((hq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((hq, 1), jnp.float32)
     acc0 = jnp.zeros((hq, d2), jnp.float32)
-    jax.lax.fori_loop(0, n_items, body, (m0, l0, acc0), unroll=False)
+    _fori_no_unroll(0, n_items, body, (m0, l0, acc0))
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "pages_per_chunk",
@@ -282,8 +299,10 @@ def _decode_call(q, kv_pages, block_tables, lengths, *,
             pl.BlockSpec(memory_space=pltpu.VMEM),      # q (zero-padded)
             # explicitly HBM (not ANY): the compiler would happily place
             # a small page pool in VMEM, where per-page slices violate
-            # tile alignment — and the pool must not eat VMEM anyway
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+            # tile alignment — and the pool must not eat VMEM anyway.
+            # (pre-0.5 jax calls the enum TPUMemorySpace and has no HBM
+            # member — ANY is the closest it offers)
+            pl.BlockSpec(memory_space=_hbm_space(pltpu)),
         ],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((b, hq, d), q.dtype),
@@ -303,7 +322,8 @@ def paged_attention_decode(q: jax.Array, kv_pages: jax.Array,
                            block_tables: jax.Array, lengths: jax.Array, *,
                            scale: Optional[float] = None,
                            pages_per_chunk: Optional[int] = None,
-                           interpret: Optional[bool] = None) -> jax.Array:
+                           interpret: Optional[bool] = None,
+                           force_reference: bool = False) -> jax.Array:
     """Single-token decode attention over paged KV (Pallas on TPU).
 
     q: [B, Hq, D] (the newest token per sequence, already written to its
@@ -322,7 +342,9 @@ def paged_attention_decode(q: jax.Array, kv_pages: jax.Array,
     sublane = 16 if kv_pages.dtype == jnp.bfloat16 else 8
     kernel_ok = (2 * d) % 128 == 0 and page % sublane == 0
     if interpret is None:
-        if jax.default_backend() != "tpu" or not kernel_ok:
+        # force_reference: caller traces under GSPMD (tensor-parallel
+        # engine) where the single-device Pallas kernel cannot run
+        if force_reference or jax.default_backend() != "tpu" or not kernel_ok:
             positions = jnp.maximum(lengths - 1, 0)[:, None]
             out = paged_attention_reference(
                 q[:, None], kv_pages, block_tables, positions,

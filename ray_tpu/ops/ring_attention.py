@@ -28,7 +28,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map  # noqa: F401  (public alias since jax 0.8)
+from .shard_map_compat import shard_map  # noqa: F401  (version shim)
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .attention import NEG_INF, _repeat_kv

@@ -1,0 +1,51 @@
+"""shard_map across jax versions.
+
+jax >= 0.8 exposes ``jax.shard_map`` with ``axis_names`` (partial-manual
+axes) and ``check_vma``; older releases ship it at
+``jax.experimental.shard_map.shard_map`` with the equivalent ``auto``
+(complement of the manual axes) and ``check_rep`` knobs. Collective ops
+(ring/ulysses attention, the pipeline wrapper) call through this shim so
+one spelling works on both.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def shard_map(f, mesh, in_specs, out_specs, axis_names=None,
+              check_vma: Optional[bool] = None):
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        kwargs = {}
+        if axis_names is not None:
+            kwargs["axis_names"] = set(axis_names)
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    # Legacy partial-auto sections lower through a PartitionId pattern
+    # XLA's SPMD partitioner rejects; run fully manual instead. That is
+    # equivalent for our call sites: the non-manual axes appear only
+    # replicated (P(None...)) in their specs and no collective names
+    # them, so each device computes the same replicated value either
+    # way. Replication CHECKING also lacks rules for several of our
+    # collectives (scan-over-ppermute) — default it off like the modern
+    # check_vma call sites do explicitly.
+    kwargs = {
+        "check_rep": bool(check_vma) if check_vma is not None else False}
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)
+
+
+def pcast_varying(tree, axis_names):
+    """Mark values as varying over manual axes (jax.lax.pcast with
+    to="varying"). Pre-vma jax tracks no varying-ness — identity."""
+    import jax
+
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(tree, tuple(axis_names), to="varying")
+    return tree

@@ -520,11 +520,16 @@ class Controller:
             actor_id = self.named_actors.get((namespace, name))
         if actor_id is None:
             return None
-        if subscribe and _conn is not None:
+        info = self.actors.get(actor_id)
+        # subscribe only AFTER the lookup, and only for actors that can
+        # still transition: unknown/DEAD ids will never publish again, so
+        # appending their channel would leak one subscriber entry per
+        # failed lookup on a long-lived controller
+        if (subscribe and _conn is not None and info is not None
+                and info.state != ACTOR_DEAD):
             chan = self.subscribers[f"actor:{actor_id}"]
             if _conn not in chan:
                 chan.append(_conn)
-        info = self.actors.get(actor_id)
         if (wait_alive and info is not None
                 and info.state not in (ACTOR_ALIVE, ACTOR_DEAD)):
             waiters = getattr(self, "_actor_waiters", None)
@@ -533,11 +538,21 @@ class Controller:
             ev = waiters.get(actor_id)
             if ev is None:
                 ev = waiters[actor_id] = asyncio.Event()
+                ev._rtpu_waiters = 0
+            ev._rtpu_waiters += 1
             try:
                 await asyncio.wait_for(ev.wait(),
                                        timeout=min(wait_alive, 30.0))
             except asyncio.TimeoutError:
                 pass
+            finally:
+                # drop the event with the LAST waiter: an actor stuck
+                # PENDING forever (permanently unschedulable) must not
+                # grow the dict by one Event per such actor
+                ev._rtpu_waiters -= 1
+                if ev._rtpu_waiters <= 0 and not ev.is_set():
+                    if waiters.get(actor_id) is ev:
+                        waiters.pop(actor_id, None)
             info = self.actors.get(actor_id)
         return info.snapshot() if info else None
 

@@ -1506,6 +1506,22 @@ class CoreWorker:
         # cached-addr path's _ensure_actor_sub) re-subscribes.
         sub = actor_id not in self._actor_subs
         handler = None
+
+        def drop_sub():
+            # roll back the subscription THIS resolve added — on a
+            # transport failure (retry re-subscribes) and equally on a
+            # terminal ActorDiedError: an unknown/dead actor never
+            # publishes again, so keeping the handler + _actor_subs
+            # entry would leak one pair per dead-actor lookup
+            if handler is None:
+                return
+            self._actor_subs.discard(actor_id)
+            try:
+                self._pubsub_handlers.get(
+                    f"actor:{actor_id}", []).remove(handler)
+            except ValueError:
+                pass
+
         if sub:
             self._actor_subs.add(actor_id)
             handler = lambda msg: self._on_actor_update(actor_id, msg)  # noqa: E731
@@ -1521,21 +1537,18 @@ class CoreWorker:
                     "get_actor", actor_id=actor_id, wait_alive=20.0,
                     subscribe=sub)
             except Exception:
-                if sub:
-                    self._actor_subs.discard(actor_id)
-                    try:
-                        self._pubsub_handlers.get(
-                            f"actor:{actor_id}", []).remove(handler)
-                    except ValueError:
-                        pass
+                if sub:  # the subscribing call itself failed
+                    drop_sub()
                 raise
             sub = False
             if info is None:
+                drop_sub()
                 raise exceptions.ActorDiedError(actor_id, "unknown actor")
             if info["state"] == "ALIVE":
                 self._actor_addr[actor_id] = info["address"]
                 return info["address"]
             if info["state"] == "DEAD":
+                drop_sub()
                 raise exceptions.ActorDiedError(
                     actor_id, info.get("death_cause") or "actor is dead")
             await asyncio.sleep(0.02)  # RESTARTING: brief yield, re-park
@@ -1704,12 +1717,29 @@ class CoreWorker:
         if len(self._task_events) >= 512:
             batch, self._task_events = self._task_events, []
             try:
-                EventLoopThread.get().spawn(
+                fut = EventLoopThread.get().spawn(
                     self.controller.call_async("add_task_events", events=batch))
+                # track the in-flight send so flush_events can await it:
+                # a size-triggered batch racing a reader's flush was the
+                # timeline test's missing-slice flake
+                futs = getattr(self, "_event_flush_futs", None)
+                if futs is None:
+                    futs = self._event_flush_futs = set()
+                futs.add(fut)
+                fut.add_done_callback(futs.discard)
             except Exception:
                 pass
 
     def flush_events(self):
+        """Synchronously land every recorded task event at the
+        controller — both the current buffer and any size-triggered
+        batches still in flight on the io loop — so a reader that calls
+        this (state API, timeline dump) sees a complete table."""
+        for fut in list(getattr(self, "_event_flush_futs", ()) or ()):
+            try:
+                fut.result(timeout=10)
+            except Exception:
+                pass
         if self._task_events:
             batch, self._task_events = self._task_events, []
             try:

@@ -140,8 +140,10 @@ class EventLoopThread:
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         return fut.result(timeout)
 
-    def spawn(self, coro: Awaitable) -> None:
-        asyncio.run_coroutine_threadsafe(coro, self.loop)
+    def spawn(self, coro: Awaitable):
+        """Fire-and-forget by default; the returned concurrent future
+        lets callers that need completion (event-batch flush) wait."""
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
 
 
 # --------------------------------------------------------------------------

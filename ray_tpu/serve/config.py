@@ -61,6 +61,13 @@ class DeploymentConfig:
     graceful_shutdown_timeout_s: float = 20.0
     max_concurrency: int = 100
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    # gang placement: when set, each replica gets its own placement
+    # group with these bundles (e.g. a tensor-parallel LLM replica
+    # reserving [{"TPU": tp}] on one ICI slice via SLICE_PACK — see
+    # serve/llm/sharding.py tp_bundles). The group is removed with the
+    # replica.
+    placement_bundles: Optional[list] = None
+    placement_strategy: str = "SLICE_PACK"
 
     def initial_replicas(self) -> int:
         if self.autoscaling_config is not None:

@@ -19,9 +19,12 @@ from .config import replica_actor_name
 
 
 class _ReplicaState:
-    def __init__(self, replica_id: str, handle):
+    def __init__(self, replica_id: str, handle, pg=None):
         self.replica_id = replica_id
         self.handle = handle
+        # per-replica placement group (tp-sized TPU gang reservation);
+        # removed with the replica
+        self.pg = pg
         self.started_at = time.time()
         self.healthy = True
         # A replica is "ready" after its first successful health check
@@ -168,11 +171,46 @@ class ServeControllerActor:
         replica_id = f"r{next(self._id_counter)}"
         name = replica_actor_name(state.app_name, state.name, replica_id)
         opts = dict(state.config.ray_actor_options)
-        handle = ActorClass(ReplicaActor, name=name,
-                            max_concurrency=state.config.max_concurrency,
-                            max_restarts=0, **opts).remote(
-            state.app_name, state.name, replica_id, state.spec_blob)
-        state.replicas[replica_id] = _ReplicaState(replica_id, handle)
+        pg = None
+        if (getattr(state.config, "placement_bundles", None)
+                and "scheduling_strategy" not in opts):
+            # gang reservation (tensor-parallel replicas ask for a
+            # tp-chip SLICE_PACK bundle): the group is created
+            # non-blocking — the replica actor stays PENDING until its
+            # bundle commits, exactly like any unschedulable actor. An
+            # explicit scheduling_strategy in ray_actor_options wins;
+            # creating a group the replica would never use would pin
+            # idle chips for its whole lifetime.
+            from ..util.placement_group import placement_group
+            from ..util.scheduling_strategies import (
+                PlacementGroupSchedulingStrategy)
+
+            pg = placement_group(
+                [dict(b) for b in state.config.placement_bundles],
+                strategy=state.config.placement_strategy,
+                name=f"{name}-pg")
+            opts["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+                pg, placement_group_bundle_index=0)
+        try:
+            handle = ActorClass(ReplicaActor, name=name,
+                                max_concurrency=state.config.max_concurrency,
+                                max_restarts=0, **opts).remote(
+                state.app_name, state.name, replica_id, state.spec_blob)
+        except Exception:
+            # actor creation failed before any _ReplicaState could own
+            # the group: release it now, or every reconcile retry would
+            # strand another tp-chip reservation nothing can ever use
+            if pg is not None:
+                try:
+                    from ..util.placement_group import (
+                        remove_placement_group)
+
+                    remove_placement_group(pg)
+                except Exception:
+                    pass
+            raise
+        state.replicas[replica_id] = _ReplicaState(replica_id, handle,
+                                                   pg=pg)
         state.version += 1
 
     def _stop_replica(self, state: _DeploymentState,
@@ -200,6 +238,19 @@ class ServeControllerActor:
             ray_tpu.kill(rep.handle)
         except Exception:
             pass
+        self._remove_replica_pg(rep)
+
+    @staticmethod
+    def _remove_replica_pg(rep: _ReplicaState) -> None:
+        if rep.pg is None:
+            return
+        try:
+            from ..util.placement_group import remove_placement_group
+
+            remove_placement_group(rep.pg)
+        except Exception:
+            pass
+        rep.pg = None
 
     def _stop_all_replicas(self, state: _DeploymentState) -> None:
         for replica_id in list(state.replicas):
@@ -257,6 +308,7 @@ class ServeControllerActor:
             ray_tpu.kill(rep.handle)
         except Exception:
             pass
+        self._remove_replica_pg(rep)
 
     async def _autoscale(self, state: _DeploymentState) -> None:
         cfg = state.config.autoscaling_config

@@ -61,6 +61,8 @@ class Deployment:
                 health_check_period_s: Optional[float] = None,
                 graceful_shutdown_timeout_s: Optional[float] = None,
                 ray_actor_options: Optional[dict] = None,
+                placement_bundles: Optional[list] = None,
+                placement_strategy: Optional[str] = None,
                 **_ignored) -> "Deployment":
         cfg = DeploymentConfig(**vars(self.config))
         if num_replicas is not None:
@@ -77,6 +79,10 @@ class Deployment:
             cfg.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
         if ray_actor_options is not None:
             cfg.ray_actor_options = dict(ray_actor_options)
+        if placement_bundles is not None:
+            cfg.placement_bundles = list(placement_bundles)
+        if placement_strategy is not None:
+            cfg.placement_strategy = placement_strategy
         return Deployment(self.func_or_class, name or self.name, cfg)
 
     def bind(self, *args, **kwargs) -> Application:
@@ -102,6 +108,8 @@ def deployment(func_or_class=None, *, name: Optional[str] = None,
                health_check_period_s: Optional[float] = None,
                graceful_shutdown_timeout_s: Optional[float] = None,
                ray_actor_options: Optional[dict] = None,
+               placement_bundles: Optional[list] = None,
+               placement_strategy: Optional[str] = None,
                **_ignored):
     """`@serve.deployment` (ref: serve/api.py:339)."""
 
@@ -121,6 +129,10 @@ def deployment(func_or_class=None, *, name: Optional[str] = None,
             cfg.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
         if ray_actor_options is not None:
             cfg.ray_actor_options = dict(ray_actor_options)
+        if placement_bundles is not None:
+            cfg.placement_bundles = list(placement_bundles)
+        if placement_strategy is not None:
+            cfg.placement_strategy = placement_strategy
         return Deployment(fc, name or fc.__name__, cfg)
 
     if func_or_class is not None:

@@ -44,13 +44,26 @@ def _encode_reply(result) -> bytes:
 
 
 class GrpcProxyActor(RouteTableMixin):
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_concurrency: int = 256):
+        from concurrent.futures import ThreadPoolExecutor
+
         self._host = host
         self._port = port
         self._actual_port: Optional[int] = None
         self._routes: Dict[str, dict] = {}  # route_prefix -> {app, ingress}
         self._routes_fetched_at = 0.0
         self._started = asyncio.Event()
+        # dedicated pool for blocking handle calls: each in-flight RPC
+        # parks a thread for up to its full 120 s timeout, and the
+        # loop's DEFAULT executor has only min(32, cpus+4) threads (5 on
+        # the 1-vCPU target box) — which capped effective concurrency
+        # far below max_concurrency and let parked calls head-of-line
+        # block _refresh_routes, which shares the default pool. Threads
+        # here are parked-on-IO, not running, so a high cap is cheap.
+        self._call_pool = ThreadPoolExecutor(
+            max_workers=max_concurrency,
+            thread_name_prefix="grpc-proxy-call")
 
     async def run(self) -> None:
         import grpc
@@ -119,7 +132,7 @@ class GrpcProxyActor(RouteTableMixin):
             return handle.remote(req).result(timeout_s=120)
 
         try:
-            result = await loop.run_in_executor(None, call)
+            result = await loop.run_in_executor(self._call_pool, call)
         except Exception as e:  # surface user errors as INTERNAL
             await context.abort(grpc.StatusCode.INTERNAL,
                                 f"{type(e).__name__}: {e}")

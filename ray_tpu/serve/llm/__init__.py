@@ -32,6 +32,11 @@ from .server import (  # noqa: F401
     OpenAIIngress,
     build_openai_app,
 )
+from .sharding import (  # noqa: F401
+    ServeSharding,
+    resolve_serve_mesh,
+    tp_bundles,
+)
 from .tokenizer import ByteTokenizer, get_tokenizer  # noqa: F401
 
 __all__ = [
@@ -41,4 +46,5 @@ __all__ = [
     "Processor", "ProcessorConfig", "build_llm_processor",
     "HttpRequestProcessorConfig", "build_http_request_processor",
     "PrefillServer", "DecodeServer", "PDRouter", "build_pd_openai_app",
+    "ServeSharding", "resolve_serve_mesh", "tp_bundles",
 ]

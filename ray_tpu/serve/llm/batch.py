@@ -31,7 +31,12 @@ from .tokenizer import get_tokenizer
 
 # One engine per (worker process, engine config): engine construction
 # compiles jit buckets and allocates the page pool, so map tasks running
-# in the same worker must reuse it across batches.
+# in the same worker must reuse it across batches. The key is the full
+# config dict — including `tp`, so a tensor-parallel engine (sharded
+# params + Hkv-split page pool over a tp mesh, serve/llm/sharding.py)
+# never aliases a single-device engine's donated buffers. Block tables
+# are global under tp (each shard holds Hkv/tp heads of every page), so
+# the batching loop below is identical in both modes.
 _ENGINE_CACHE: Dict[str, LLMEngine] = {}
 
 

@@ -20,12 +20,23 @@ class OutOfPages(Exception):
 
 
 class PageAllocator:
-    """Page 0 is reserved as the dummy page (padding block-table slots)."""
+    """Page 0 is reserved as the dummy page (padding block-table slots).
 
-    def __init__(self, num_pages: int, page_size: int):
+    Page ids are GLOBAL under tensor parallelism: a tp shard holds
+    Hkv/tp heads of every page (serve/llm/sharding.py), so one host-side
+    allocator drives all shards and block tables need no translation.
+    `shard_degree` only labels the byte accounting (surfaced in stats) —
+    each page costs 1/shard_degree of its dense footprint per chip, so a
+    fixed per-chip HBM budget affords shard_degree× the pages (size
+    num_pages with sharding.pages_for_budget).
+    """
+
+    def __init__(self, num_pages: int, page_size: int,
+                 shard_degree: int = 1):
         assert num_pages >= 2
         self.num_pages = num_pages
         self.page_size = page_size
+        self.shard_degree = max(1, int(shard_degree))
         self._free: List[int] = list(range(1, num_pages))
         self._refcount: Dict[int, int] = {}
         # prefix cache: chain_hash -> page id; pages with refcount 0 that
@@ -34,7 +45,8 @@ class PageAllocator:
         self._page_to_hash: Dict[int, int] = {}
         self._evictable: "collections.OrderedDict[int, None]" = \
             collections.OrderedDict()
-        self.stats = {"allocated": 0, "cache_hits": 0, "evictions": 0}
+        self.stats = {"allocated": 0, "cache_hits": 0, "evictions": 0,
+                      "shard_degree": self.shard_degree}
 
     # ------------------------------------------------------------ queries
 

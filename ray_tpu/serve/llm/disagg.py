@@ -164,15 +164,20 @@ def build_pd_openai_app(llm_config: LLMConfig, *,
                         num_decode_replicas: int = 1):
     """OpenAI-compatible app with disaggregated prefill/decode tiers
     (ref: prefill_decode_disagg.py build_app)."""
+    from .server import placement_options
+
+    placement = placement_options(llm_config)
     prefill = PrefillServer.options(
         name=f"PrefillServer:{llm_config.model_id}",
         num_replicas=num_prefill_replicas,
         ray_actor_options=llm_config.ray_actor_options,
+        **placement,
     ).bind(llm_config)
     decode = DecodeServer.options(
         name=f"DecodeServer:{llm_config.model_id}",
         num_replicas=num_decode_replicas,
         ray_actor_options=llm_config.ray_actor_options,
+        **placement,
     ).bind(llm_config)
     router = PDRouter.options(
         name=f"PDRouter:{llm_config.model_id}").bind(

@@ -16,6 +16,12 @@ TPU-first mechanics:
   (ray_tpu/ops/paged_attention.py)
 - prefix caching: full pages are refcount-shared across requests keyed by
   rolling content hash (cache.py), so shared system prompts prefill once
+- tensor parallelism (EngineConfig.tp > 1 or an explicit mesh=): params
+  shard by the train-side logical-axis rules and the page pool splits
+  its Hkv axis over the mesh's tp axis; block tables and the decode
+  carry stay replicated, so the scheduler/allocator logic below is
+  IDENTICAL in both modes and all sharding lives in __init__ + the
+  in/out_shardings of the two jits (serve/llm/sharding.py)
 
 Latency model (measured through the remote-device tunnel this engine is
 deployed behind): ANY host-blocking fetch costs ~1 RTT (100-140 ms here)
@@ -115,6 +121,12 @@ class EngineConfig:
     eos_token_id: Optional[int] = None
     seed: int = 0
     dtype: str = "bfloat16"
+    # tensor-parallel degree: >1 shards params (megatron-style, by the
+    # logical axis rules shared with training) and the paged KV cache's
+    # Hkv axis over a tp mesh built from the first `tp` local devices
+    # (serve/llm/sharding.py). 1 = single-device fast path. An explicit
+    # mesh passed to LLMEngine(mesh=...) overrides this degree.
+    tp: int = 1
     # decode steps fused into ONE device dispatch (lax.scan): amortizes
     # dispatch latency (dominant through remote-device tunnels; material
     # even locally). Trade-off: token delivery is chunked and a request
@@ -172,6 +184,7 @@ class LLMEngine:
         import jax.numpy as jnp
 
         from ...models.llama import LlamaModel, get_config
+        from .sharding import resolve_serve_mesh
 
         self.config = config
         dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
@@ -180,29 +193,66 @@ class LLMEngine:
             param_dtype=dtype, max_seq_len=config.max_model_len,
             **config.model_overrides)
         self.model = LlamaModel(self.model_cfg)
+        # tensor parallelism: resolve mesh/tp BEFORE any compute so the
+        # divisibility contract fails at construction, not first dispatch
+        self.sharding = resolve_serve_mesh(mesh, tp=config.tp)
+        if self.sharding is not None:
+            self.sharding.validate(self.model_cfg)
+        init_ids = jnp.zeros((1, 8), jnp.int32)
+        if self.sharding is not None:
+            # shardings first (shape-only eval): init and the page pool
+            # below materialize DIRECTLY into their sharded placement —
+            # building them unsharded first would bound the servable
+            # model by ONE chip's HBM, the exact limit tp removes
+            self._param_shardings = self.sharding.param_shardings(
+                self.model, init_ids)
+            self._kv_sharding = self.sharding.kv_pages_sharding()
+            self._repl_sharding = self.sharding.replicated()
         if params is None:
             import flax.linen as nn
 
-            init_ids = jnp.zeros((1, 8), jnp.int32)
-            params = nn.meta.unbox(
-                self.model.init(jax.random.PRNGKey(config.seed),
-                                init_ids)["params"])
+            def init_params(rng):
+                return nn.meta.unbox(
+                    self.model.init(rng, init_ids)["params"])
+
+            if self.sharding is not None:
+                init_params = jax.jit(
+                    init_params, out_shardings=self._param_shardings)
+            params = init_params(jax.random.PRNGKey(config.seed))
+        elif self.sharding is not None:
+            # provided params (checkpoint leaves): place shard-by-shard
+            params = self.sharding.shard_params(params,
+                                                self._param_shardings)
         self.params = params
 
         cfg_m = self.model_cfg
         L = cfg_m.num_layers
         # page-major combined layout [L, P, Hkv, page, 2*D]: one decode
         # DMA per page moves K and V for every head together; the Hkv
-        # axis remains the tensor-parallel shard (ops/paged_attention.py)
+        # axis is the tensor-parallel shard (each tp shard holds Hkv/tp
+        # heads of EVERY page, so block tables stay global + replicated)
         shape = (L, config.num_pages, cfg_m.num_kv_heads,
                  config.page_size, 2 * cfg_m.head_dim_)
-        self.kv_pages = jnp.zeros(shape, dtype)
+        if self.sharding is not None:
+            # zero-fill compiled WITH the sharding: each chip only ever
+            # allocates its Hkv/tp slice of the pool (num_pages is sized
+            # against per-shard HBM — sharding.pages_for_budget)
+            self.kv_pages = jax.jit(
+                lambda: jnp.zeros(shape, dtype),
+                out_shardings=self._kv_sharding)()
+            self.slot_ids = jax.device_put(
+                jnp.zeros((config.max_batch, 1), jnp.int32),
+                self._repl_sharding)
+        else:
+            self.kv_pages = jnp.zeros(shape, dtype)
+            # device-resident last-sampled-token per slot: the decode
+            # chain's carry (design rule 2 in the module docstring)
+            self.slot_ids = jnp.zeros((config.max_batch, 1), jnp.int32)
         self.max_pages_per_seq = config.max_model_len // config.page_size
-        # device-resident last-sampled-token per slot: the decode chain's
-        # carry (design rule 2 in the module docstring)
-        self.slot_ids = jnp.zeros((config.max_batch, 1), jnp.int32)
 
-        self.allocator = PageAllocator(config.num_pages, config.page_size)
+        self.allocator = PageAllocator(
+            config.num_pages, config.page_size,
+            shard_degree=(self.sharding.tp if self.sharding else 1))
         self._intake: List[Request] = []
         self._intake_lock = threading.Lock()
         self._aborted: set = set()
@@ -378,6 +428,10 @@ class LLMEngine:
             return fn
         model = self.model
         L = self.model_cfg.num_layers
+        # sharded engines trace under GSPMD, where the single-device
+        # Pallas kernels cannot run: pin the reference attention paths
+        # via the cache's STATIC field (part of each jit's cache key)
+        ref_attn = self.sharding is not None
 
         if kind == "prefill":
             # ctx_pages buckets to {0, full}: a fresh-prompt wave (the
@@ -395,7 +449,7 @@ class LLMEngine:
                         block_tables, (L,) + block_tables.shape),
                     total_lens=jnp.broadcast_to(total_lens,
                                                 (L,) + total_lens.shape),
-                    ctx_pages=cp)
+                    ctx_pages=cp, ref_attention=ref_attn)
                 logits, new_pc = model.apply({"params": params}, input_ids,
                                              positions=positions,
                                              kv_caches=pc)
@@ -407,7 +461,18 @@ class LLMEngine:
                 tokens = _device_sample(rows, temperature, top_k, rng_keys)
                 return tokens, new_pc.kv_pages
 
-            fn = jax.jit(run_prefill, donate_argnums=(1,))
+            if self.sharding is not None:
+                # explicit shardings: params + pages by their specs,
+                # every host-built operand replicated; tokens come back
+                # replicated so the harvest fetch is shard-agnostic
+                repl = self._repl_sharding
+                fn = jax.jit(
+                    run_prefill, donate_argnums=(1,),
+                    in_shardings=(self._param_shardings,
+                                  self._kv_sharding) + (repl,) * 8,
+                    out_shardings=(repl, self._kv_sharding))
+            else:
+                fn = jax.jit(run_prefill, donate_argnums=(1,))
             self._jit_cache[key] = fn
             return fn
 
@@ -427,7 +492,8 @@ class LLMEngine:
                 ids, pos, kvp, tot = carry
                 pc = PagedCache(
                     kv_pages=kvp, block_tables=bt_b,
-                    total_lens=jnp.broadcast_to(tot, (L,) + tot.shape))
+                    total_lens=jnp.broadcast_to(tot, (L,) + tot.shape),
+                    ref_attention=ref_attn)
                 logits, new_pc = model.apply(
                     {"params": params}, ids, positions=pos,
                     kv_caches=pc)
@@ -457,7 +523,15 @@ class LLMEngine:
             new_slot_ids = jnp.where(active[:, None], last_ids, slot_ids)
             return toks, new_slot_ids, kvp
 
-        fn = jax.jit(run_decode, donate_argnums=(1, 2))
+        if self.sharding is not None:
+            repl = self._repl_sharding
+            fn = jax.jit(
+                run_decode, donate_argnums=(1, 2),
+                in_shardings=(self._param_shardings, self._kv_sharding,
+                              repl) + (repl,) * 9,
+                out_shardings=(repl, repl, self._kv_sharding))
+        else:
+            fn = jax.jit(run_decode, donate_argnums=(1, 2))
         self._jit_cache[key] = fn
         return fn
 
@@ -878,6 +952,14 @@ class LLMEngine:
         idx = jnp.asarray(np.asarray(pages, np.int32))
         self.kv_pages = self.kv_pages.at[:, idx].set(
             jnp.asarray(handoff["kv"], self.kv_pages.dtype))
+        if self.sharding is not None:
+            # the eager scatter may come back with a propagated (not
+            # necessarily Hkv-split) sharding; pin it before the next
+            # donated dispatch
+            import jax
+
+            self.kv_pages = jax.device_put(self.kv_pages,
+                                           self._kv_sharding)
         req = Request(request_id, list(handoff["prompt_ids"]), sampling)
         req.output_ids = list(handoff["output_ids"])
         req.pages = pages
@@ -1043,10 +1125,14 @@ class LLMEngine:
     # ------------------------------------------------------------ stats
 
     def stats(self) -> Dict[str, Any]:
-        return {
+        out = {
             "running": len(self.running),
             "waiting": len(self.waiting),
             "inflight": len(self._inflight),
             "free_pages": self.allocator.num_free(),
             **self.allocator.stats,
         }
+        if self.sharding is not None:
+            out["sharding"] = self.sharding.page_accounting(
+                self.config, self.model_cfg)
+        return out

@@ -41,6 +41,12 @@ class LLMConfig:
     # JAX_PLATFORMS for CPU smoke deployments)
     ray_actor_options: Dict[str, Any] = dataclasses.field(
         default_factory=dict)
+    # reserve a tp-chip TPU gang per replica: each replica gets its own
+    # SLICE_PACK placement group sized engine.tp (one bundle per host,
+    # serve/llm/sharding.py tp_bundles), so a tensor-parallel engine is
+    # guaranteed ICI-adjacent chips. Off by default — CPU smoke
+    # deployments and single-chip replicas need no reservation.
+    reserve_tpu_bundle: bool = False
 
 
 class EngineDriverMixin:
@@ -220,6 +226,19 @@ class OpenAIIngress:
         }
 
 
+def placement_options(llm_config: LLMConfig) -> Dict[str, Any]:
+    """Deployment placement options for an engine-hosting replica: a
+    tp-sized SLICE_PACK bundle set when the config asks for a TPU gang
+    reservation, else nothing."""
+    tp = getattr(llm_config.engine, "tp", 1)
+    if not llm_config.reserve_tpu_bundle or tp <= 1:
+        return {}
+    from .sharding import tp_bundles
+
+    return {"placement_bundles": tp_bundles(tp),
+            "placement_strategy": "SLICE_PACK"}
+
+
 def build_openai_app(llm_config: LLMConfig):
     """Application: OpenAI ingress -> LLMServer replicas (ref:
     application_builders.py:55 build_openai_app)."""
@@ -228,6 +247,7 @@ def build_openai_app(llm_config: LLMConfig):
         num_replicas=llm_config.num_replicas,
         max_ongoing_requests=llm_config.max_ongoing_requests,
         ray_actor_options=llm_config.ray_actor_options,
+        **placement_options(llm_config),
     ).bind(llm_config)
     return OpenAIIngress.options(name="OpenAIIngress").bind(
         server, llm_config.model_id)

@@ -20,7 +20,12 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (<0.5) has no jax_num_cpu_devices option; the XLA_FLAGS
+    # host-platform flag set above provides the 8 virtual devices
+    pass
 
 import pytest  # noqa: E402
 

@@ -11,8 +11,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.ops.shard_map_compat import shard_map
 
 from ray_tpu.ops.attention import reference_attention
 from ray_tpu.ops.ring_attention import (

@@ -339,3 +339,41 @@ def test_local_testing_mode_no_cluster():
     # registry surface
     assert serve.get_app_handle("localapp") is handle
     serve.delete("localapp")
+
+
+def test_replica_placement_bundle_lifecycle():
+    """A deployment with placement_bundles gets one placement group per
+    replica (the tensor-parallel LLM gang-reservation path) and the
+    group is removed with the replica."""
+    from ray_tpu.util.placement_group import placement_group_table
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=2)
+    try:
+        @serve.deployment
+        class Gang:
+            def __call__(self, x):
+                return x * 3
+
+        app = Gang.options(placement_bundles=[{"TPU": 2.0}],
+                           placement_strategy="PACK").bind()
+        handle = serve.run(app, name="gang", wait_timeout_s=180)
+        assert handle.remote(7).result(timeout_s=60) == 21
+        pgs = [pg for pg in placement_group_table()
+               if pg.get("state") == "CREATED"
+               and pg.get("bundles") == [{"TPU": 2.0}]]
+        assert pgs, placement_group_table()
+        serve.delete("gang")
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            left = [pg for pg in placement_group_table()
+                    if pg.get("state") == "CREATED"
+                    and pg.get("bundles") == [{"TPU": 2.0}]]
+            if not left:
+                break
+            time.sleep(0.5)
+        assert not left, left
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
