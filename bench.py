@@ -248,6 +248,26 @@ def bench_runtime() -> dict:
     raise RuntimeError(f"ray_perf produced no JSON: {out.stderr[-300:]}")
 
 
+def bench_transfer() -> dict:
+    """Cross-host object-pull throughput on the simulated two-host
+    localhost setup (benchmarks/transfer.py): the bulk-stream data plane
+    (`object_pull_gb_s`) vs the om_read RPC fallback
+    (`object_pull_gb_s_rpc`), so the data plane has its own trend line."""
+    import os
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = subprocess.run(
+        [sys.executable, os.path.join(here, "benchmarks", "transfer.py"),
+         "--size-mb", "48", "--pulls", "3"],
+        capture_output=True, text=True, timeout=600, cwd=here)
+    for line in reversed(out.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(f"transfer bench produced no JSON: {out.stderr[-300:]}")
+
+
 def bench_train(on_tpu: bool) -> dict:
     import jax
     import jax.numpy as jnp
@@ -355,6 +375,18 @@ def main():
             result["detail"]["serve_tp"] = bench_serve_tp()
         except Exception as e:  # noqa: BLE001
             result["detail"]["serve_tp"] = {"error": repr(e)[:200]}
+
+    # 5. cross-host data plane: bulk-stream pull GB/s vs the RPC
+    # fallback (object_pull_gb_s key), same time guard
+    if time.perf_counter() - start < 440:
+        try:
+            transfer = bench_transfer()
+            result["detail"]["transfer"] = transfer
+            if "object_pull_gb_s" in transfer:
+                result["detail"]["object_pull_gb_s"] = \
+                    transfer["object_pull_gb_s"]
+        except Exception as e:  # noqa: BLE001
+            result["detail"]["transfer"] = {"error": repr(e)[:200]}
     print(json.dumps(result))
 
 

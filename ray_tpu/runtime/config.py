@@ -60,6 +60,20 @@ class RuntimeConfig:
     object_store_fraction: float = 0.3
     object_spill_dir: str = ""  # "" = <session>/spill
 
+    # --- bulk data plane (cross-host object pulls; transfer.py) ---
+    # master switch: False forces every pull onto the om_read RPC path
+    # (the bulk stream is strictly additive — same bytes, slower)
+    bulk_transfer_enabled: bool = True
+    bulk_chunk_size: int = 4 << 20  # per-request range on the stream
+    # SO_SNDBUF/SO_RCVBUF hint for stream sockets (0 = kernel default).
+    # Large buffers let sendfile push a whole chunk per syscall and the
+    # receiver drain it in few recv_into calls (~2x on loopback sims;
+    # real fabrics autotune past it and merely start warmer)
+    bulk_socket_buffer: int = 4 << 20
+    pull_window_max: int = 16  # AIMD sliding-window ceiling (chunks)
+    pull_conns_per_link: int = 2  # stream connections per replica
+    pull_chunk_timeout_s: float = 60.0  # per-chunk fetch deadline
+
     # --- memory monitor (ref: src/ray/common/memory_monitor.h:52 —
     # cgroup/rss watcher; kill policy raylet/worker_killing_policy.cc) ---
     memory_usage_threshold: float = 0.95

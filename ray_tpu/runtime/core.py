@@ -138,21 +138,24 @@ _MISSING = object()  # sentinel for fast-path memory-store lookups
 
 class _RemoteShm:
     """Memory-store marker: the value lives in ANOTHER host's pool; pull
-    it through that host's nodelet (object-manager tier) on first read."""
+    it through that host's nodelet (object-manager tier) on first read.
+    `replicas` carries additional ready sources from the owner's replica
+    directory — the puller stripes chunk ranges across them."""
 
-    __slots__ = ("host", "node_addr", "size", "owner_addr")
+    __slots__ = ("host", "node_addr", "size", "owner_addr", "replicas")
 
     def __init__(self, host: str, node_addr: str, size: int,
-                 owner_addr: Optional[str] = None):
+                 owner_addr: Optional[str] = None, replicas=None):
         self.host = host
         self.node_addr = node_addr
         self.size = size
         self.owner_addr = owner_addr
+        self.replicas = replicas or []  # [{"host": h, "addr": a}, ...]
 
     @classmethod
     def from_loc(cls, loc: dict) -> "_RemoteShm":
         return cls(loc.get("host", ""), loc["node_addr"], loc["size"],
-                   loc.get("owner"))
+                   loc.get("owner"), loc.get("replicas"))
 
 
 class _PendingTask:
@@ -228,6 +231,8 @@ class CoreWorker:
         self.store = make_store_client(session_name)
         self.host_id = _get_host_id()
         self._pulls: Dict[ObjectID, asyncio.Future] = {}
+        self._pull_manager = None  # lazy (transfer.PullManager)
+        self._om_bulk: Dict[str, Any] = {}  # lazily-started BulkServer
         # broadcast directory (owner side): oid -> {addr: [host,
         # outstanding, last_assign_ts]} of pull-capable replicas
         self._replica_dirs: Dict[ObjectID, Dict[str, list]] = {}
@@ -280,7 +285,7 @@ class CoreWorker:
         }
         from .object_store import om_handlers
 
-        handlers.update(om_handlers(lambda: self.store))
+        handlers.update(om_handlers(lambda: self.store, self._om_bulk))
         if extra_handlers:
             handlers.update(extra_handlers)
         # the nodelet pushes dispatches back over this worker's OWN
@@ -421,6 +426,12 @@ class CoreWorker:
             time.sleep(0.1)  # let the scheduled dec sends flush
         self._borrowed_owners.clear()
         self._shutting_down = True
+        bulk_srv = self._om_bulk.get("server")
+        if bulk_srv is not None:
+            try:
+                EventLoopThread.get().run(bulk_srv.stop(), timeout=3)
+            except Exception:
+                pass
         try:
             if self._server is not None:
                 # bounded: peers (e.g. live workers on other nodes) may
@@ -829,10 +840,21 @@ class CoreWorker:
         return value
 
     # ---------------------------------------------- cross-host object pull
+    @property
+    def pull_manager(self):
+        """Receiver side of the bulk data plane (transfer.PullManager):
+        striped multi-replica chunk pulls over the zero-copy stream, with
+        per-source om_read RPC fallback."""
+        if self._pull_manager is None:
+            from .transfer import PullManager
+
+            self._pull_manager = PullManager(self.client_for)
+        return self._pull_manager
+
     async def _pull_remote(self, oid: ObjectID, rs: _RemoteShm):
-        """Chunked pull of an object from another host's nodelet into the
-        local pool (ref: object_manager/pull_manager.cc — here demand-
-        driven with per-object dedup and a small pipeline window)."""
+        """Pull an object from another host into the local pool (ref:
+        object_manager/pull_manager.cc — demand-driven, per-object dedup,
+        sliding-window chunk stream striped across ready replicas)."""
         if self.store.contains(oid):
             self.memory_store[oid] = _IN_SHM
             return
@@ -858,26 +880,21 @@ class CoreWorker:
             except FileExistsError:
                 # another process on this host is already ingesting the
                 # same object into the shared pool; wait for its seal
+                # (single-flight: no duplicate transfer per host)
                 await self._await_local_ingest(oid)
                 self.memory_store[oid] = _IN_SHM
                 fut.set_result(True)
                 self._pulls.pop(oid, None)
                 return
-            chunk = 4 << 20
-
-            async def _one(off: int):
-                data = await client.call_async(
-                    "om_read", oid=oid.binary(), offset=off,
-                    length=min(chunk, size - off))
-                if data is None:
-                    raise exceptions.ObjectLostError(
-                        oid.hex(), f"evicted from {rs.node_addr} mid-pull")
-                writer.write_at(off, data)
-
+            sources = [(rs.host, rs.node_addr)]
+            for rep in rs.replicas or ():
+                addr = rep.get("addr") if isinstance(rep, dict) else rep[1]
+                host = rep.get("host", "") if isinstance(rep, dict) \
+                    else rep[0]
+                if addr and addr != rs.node_addr and addr != self.address:
+                    sources.append((host, addr))
             try:
-                offs = list(range(0, size, chunk))
-                for i in range(0, len(offs), 4):  # pipeline window
-                    await asyncio.gather(*(_one(o) for o in offs[i:i + 4]))
+                await self.pull_manager.pull(oid, size, sources, writer)
                 writer.seal()
             except BaseException:
                 writer.abort()
@@ -1350,8 +1367,16 @@ class CoreWorker:
         addr, entry = min(d.items(), key=lambda kv: (kv[1][1], kv[1][2]))
         entry[1] += 1
         entry[2] = now
-        return {"host": entry[0], "node_addr": addr, "size": size,
-                "owner": self.address}
+        payload = {"host": entry[0], "node_addr": addr, "size": size,
+                   "owner": self.address}
+        # advertise the other ready replicas so the puller can STRIPE
+        # chunk ranges across them (and fail over mid-pull without a
+        # fresh owner round-trip)
+        others = [{"host": e[0], "addr": a}
+                  for a, e in d.items() if a != addr]
+        if others:
+            payload["replicas"] = others[:4]
+        return payload
 
     def _h_replica_ready(self, oid: bytes, host: str, addr: str,
                          src: str = None):

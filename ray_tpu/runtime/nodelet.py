@@ -262,7 +262,8 @@ class Nodelet:
     def _handlers(self):
         from .object_store import om_handlers
 
-        handlers = om_handlers(lambda: self.store)
+        self._om_bulk = {}  # lazily-started bulk stream server
+        handlers = om_handlers(lambda: self.store, self._om_bulk)
         handlers.update(self._base_handlers())
         return handlers
 
@@ -321,6 +322,12 @@ class Nodelet:
         for client in self._owner_clients.values():
             client.close()
         self._owner_clients.clear()
+        bulk_srv = self._om_bulk.get("server")
+        if bulk_srv is not None:
+            try:
+                await bulk_srv.stop()
+            except Exception:
+                pass
         await self._server.stop()
 
     def _on_shutdown(self):
@@ -675,13 +682,15 @@ class Nodelet:
             sock.sendall((json.dumps(
                 {"worker_id": worker_id, "runtime_env": runtime_env,
                  "warm": warm}) + "\n").encode())
-            data = b""
+            # bytearray: += on bytes re-copies the whole prefix per recv
+            # (quadratic over the reply); bytearray extends in place
+            data = bytearray()
             while not data.endswith(b"\n"):
                 chunk = sock.recv(4096)
                 if not chunk:
                     raise _SpawnAmbiguous("factory closed mid-request")
                 data += chunk
-            reply = json.loads(data)
+            reply = json.loads(bytes(data))
             if "pid" not in reply:
                 if reply.get("ambiguous"):
                     # the generation died mid-request: the worker may or

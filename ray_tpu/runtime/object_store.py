@@ -16,6 +16,7 @@ buffers are 64-byte aligned (TPU DMA and numpy both like alignment).
 
 from __future__ import annotations
 
+import collections
 import itertools
 import mmap
 import os
@@ -77,6 +78,54 @@ def _spill_dir(session_name: str) -> str:
 _tmp_ids = itertools.count()
 
 
+class _FdCache:
+    """LRU of open backing-file objects for the object-manager read tier.
+
+    read_range used to open()+close() the backing file for every 4 MiB
+    chunk served to a remote puller; the bulk stream needs a stable fd to
+    sendfile from anyway. Entries verify identity by inode on each hit so
+    a delete+re-put of the same object id never serves stale bytes."""
+
+    def __init__(self, cap: int = 64):
+        self._cap = cap
+        self._files: "collections.OrderedDict[str, tuple]" = \
+            collections.OrderedDict()
+
+    def acquire(self, path: str):
+        """Open (or reuse) the file at `path`; returns the file object.
+        Raises FileNotFoundError when the path is gone — eviction must
+        surface as not-found to pullers, never as stale data."""
+        st = os.stat(path)  # raises FileNotFoundError on eviction
+        entry = self._files.get(path)
+        if entry is not None:
+            f, ino = entry
+            if ino == st.st_ino:
+                self._files.move_to_end(path)
+                return f
+            self.drop(path)  # same path, new object: reopen below
+        f = open(path, "rb")
+        self._files[path] = (f, st.st_ino)
+        while len(self._files) > self._cap:
+            _, (old, _ino) = self._files.popitem(last=False)
+            try:
+                old.close()
+            except OSError:
+                pass
+        return f
+
+    def drop(self, path: str):
+        entry = self._files.pop(path, None)
+        if entry is not None:
+            try:
+                entry[0].close()
+            except OSError:
+                pass
+
+    def close_all(self):
+        for path in list(self._files):
+            self.drop(path)
+
+
 class _Segment:
     """An mmap'ed shared-memory file."""
 
@@ -117,10 +166,17 @@ class _Segment:
         return cls(path, path, mm, fd, size)
 
     def close(self):
+        # fd closes at most once: a BufferError from mm.close() (live
+        # zero-copy views) leaves the segment pinned for a later retry,
+        # and that retry must not os.close an already-closed fd (EBADF —
+        # or worse, an unrelated fd that recycled the number). The mmap
+        # holds its own internal dup, so the mapping stays valid.
         try:
             self.mm.close()
         finally:
-            os.close(self.fd)
+            if self.fd >= 0:
+                fd, self.fd = self.fd, -1
+                os.close(fd)
 
 
 class ObjectStoreClient:
@@ -135,6 +191,7 @@ class ObjectStoreClient:
         self.session_name = session_name
         self._root = root or _shm_dir(session_name)
         self._pinned: Dict[ObjectID, _Segment] = {}
+        self._fds = _FdCache()  # object-manager read tier (read_range)
 
     def _path(self, oid: ObjectID) -> str:
         return os.path.join(self._root, oid.hex())
@@ -207,6 +264,7 @@ class ObjectStoreClient:
 
     def delete(self, oid: ObjectID):
         self.release(oid)
+        self._fds.drop(self._path(oid))
         try:
             os.unlink(self._path(oid))
         except FileNotFoundError:
@@ -221,8 +279,23 @@ class ObjectStoreClient:
     # ---- node-to-node transfer (object-manager tier; ref:
     # src/ray/object_manager/object_manager.h:119 chunked push/pull) ----
     def read_range(self, oid: ObjectID, offset: int, length: int) -> bytes:
-        with open(self._path(oid), "rb") as f:
-            return os.pread(f.fileno(), length, offset)
+        f = self._fds.acquire(self._path(oid))  # FileNotFoundError if gone
+        return os.pread(f.fileno(), length, offset)
+
+    def acquire_range(self, oid: ObjectID):
+        """(file, base_offset, size, release) for the bulk stream to
+        sendfile from, or None when the object is not present. Returns a
+        dup of the cached fd: a concurrent delete() (or LRU eviction of
+        the cache entry) closes the cached fd, and an async sendfile
+        mid-body must keep a valid descriptor — the dup'd fd serves the
+        in-flight range to completion even if the file is unlinked."""
+        try:
+            f = self._fds.acquire(self._path(oid))
+            dupf = os.fdopen(os.dup(f.fileno()), "rb")
+        except FileNotFoundError:
+            return None
+        size = os.fstat(dupf.fileno()).st_size
+        return (dupf, 0, size, dupf.close)
 
     def create_for_ingest(self, oid: ObjectID, size: int) -> "_FileIngest":
         return _FileIngest(self._path(oid), size)
@@ -282,6 +355,15 @@ class _FileIngest:
 
     def write_at(self, offset: int, data: bytes) -> None:
         _bulk_copy(memoryview(self._seg.mm), [(offset, len(data))], [data])
+        self.touch()
+
+    def view(self, offset: int, length: int) -> memoryview:
+        """Writable window over the ingest mmap: the bulk stream
+        recv_into's straight into it (zero-copy rx). Callers must
+        release() the view before seal()/abort()."""
+        return memoryview(self._seg.mm)[offset:offset + length]
+
+    def touch(self) -> None:
         # mmap stores never update mtime: refresh it so a slow (>120s)
         # ingest is not misread as crashed and unlinked by a peer
         now = time.time()
@@ -298,7 +380,10 @@ class _FileIngest:
 
     def abort(self) -> None:
         tmp = self._seg.tmp_path
-        self._seg.close()
+        try:
+            self._seg.close()
+        except BufferError:
+            pass  # a stranded view keeps the mmap alive; still unlink
         try:
             os.unlink(tmp)
         except OSError:
@@ -325,6 +410,7 @@ class NativeObjectStoreClient:
         # signal (plasma's client works the same way; ref: plasma/client.cc
         # mmap-per-object + Release)
         self._fd = os.open(pool._path, os.O_RDWR)
+        self._sf_file = None  # lazy sendfile source (acquire_range)
         self._pinned: Dict[ObjectID, List[mmap.mmap]] = {}
         # release() was requested but zero-copy aliases were still alive;
         # swept opportunistically until the aliases die
@@ -487,6 +573,23 @@ class NativeObjectStoreClient:
         finally:
             self._pool.release(key)
 
+    def acquire_range(self, oid: ObjectID):
+        """(file, base_offset, size, release) for the bulk stream to
+        sendfile from. The pool refcount stays bumped until release —
+        pins the entry across the (async) send like read_range does
+        across its pread."""
+        key = self._key(oid)
+        raw = self._pool.get_raw(key)
+        if raw is None:
+            return self.spill.acquire_range(oid)
+        file_off, size = raw
+        if self._sf_file is None:
+            # independent fd: sendfile never touches the file position,
+            # and the pread fallback is positionless too
+            self._sf_file = open(self._pool._path, "rb")
+        return (self._sf_file, file_off, size,
+                lambda: self._pool.release(key))
+
     def create_for_ingest(self, oid: ObjectID, size: int):
         key = self._key(oid)
         try:
@@ -505,12 +608,19 @@ class _PoolIngest:
     def write_at(self, offset: int, data: bytes) -> None:
         _bulk_copy(self._mv, [(offset, len(data))], [data])
 
+    def view(self, offset: int, length: int) -> memoryview:
+        """Writable window for zero-copy recv_into (see _FileIngest)."""
+        return self._mv[offset:offset + length]
+
     def seal(self) -> None:
         self._mv.release()
         self._pool.seal(self._key)
 
     def abort(self) -> None:
-        self._mv.release()
+        try:
+            self._mv.release()
+        except BufferError:
+            pass  # a stranded view still exports the buffer
         try:
             self._pool.delete(self._key)
         except Exception:
@@ -534,9 +644,13 @@ def make_store_client(session_name: str):
     return ObjectStoreClient(session_name)
 
 
-def om_handlers(get_store) -> dict:
+def om_handlers(get_store, bulk: Optional[dict] = None) -> dict:
     """RPC handlers for the object-manager read tier, shared by every
-    process that serves its pool to peers (nodelets and owners)."""
+    process that serves its pool to peers (nodelets and owners).
+
+    `bulk` is a caller-owned dict holding the lazily-started BulkServer
+    (key "server"); the caller stops it at shutdown. When omitted, the
+    process serves the RPC path only and om_endpoint answers None."""
     import asyncio
 
     async def om_meta(oid: bytes):
@@ -550,7 +664,29 @@ def om_handlers(get_store) -> dict:
         except FileNotFoundError:
             return None
 
-    return {"om_meta": om_meta, "om_read": om_read}
+    async def om_endpoint():
+        """Bulk-stream endpoint of this process ("tcp:host:port"), or
+        None when the stream is disabled — pullers then stay on om_read.
+        The listener starts on FIRST demand so idle workers never hold
+        a socket."""
+        from .config import get_config
+
+        if bulk is None or not get_config().bulk_transfer_enabled:
+            return None
+        server = bulk.get("server")
+        if server is None:
+            lock = bulk.setdefault("lock", asyncio.Lock())
+            async with lock:
+                server = bulk.get("server")
+                if server is None:
+                    from .transfer import BulkServer
+
+                    server = await BulkServer(get_store).start()
+                    bulk["server"] = server
+        return server.address
+
+    return {"om_meta": om_meta, "om_read": om_read,
+            "om_endpoint": om_endpoint}
 
 
 def cleanup_session(session_name: str):

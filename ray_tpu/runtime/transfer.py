@@ -1,0 +1,563 @@
+"""Zero-copy bulk data plane for cross-host object movement.
+
+The control RPC layer (rpc.py) frames every payload through pickle — fine
+for control traffic, but each cross-host object chunk then pays three
+copies (serializer copy out of the pool, socket buffer, deserializer copy
+into the destination pool) and competes with task dispatch on the same
+frame stream. This module is the dedicated bulk path the reference keeps
+its pull manager on (ref: src/ray/object_manager/object_manager.h:119
+chunked push/pull, pull_manager.cc):
+
+- ``BulkServer``: serves chunk ranges of sealed objects over a raw
+  length-prefixed binary stream. TX is ``os.sendfile`` straight from the
+  backing shm/pool fd into the socket (zero user-space copies), with a
+  pread fallback for transports/filesystems without sendfile.
+- ``PullManager``: receiver-side orchestration. RX is ``recv_into``
+  directly into the destination ingest mmap (no intermediate ``bytes``),
+  chunks flow through an AIMD sliding window instead of a fixed
+  gather barrier, ranges stripe across every ready replica the owner's
+  directory advertises, and a chunk whose source evicts mid-pull retries
+  on an alternate replica before surfacing ``ObjectLostError``.
+
+Falls back per-source to the ``om_read`` RPC path whenever the stream
+cannot be established (endpoint handler missing, connect refused,
+``bulk_transfer_enabled=False``), so behavior is strictly additive.
+
+Protocol (one stream = one TCP connection, requests served in order):
+    request : >2sB16sQQ  = magic b"RB", version, object id, offset, length
+    response: >q         = payload length that follows (clamped to the
+                           object's size), or -1 when the source no
+                           longer holds the object (evicted / never had)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import socket
+import struct
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import exceptions
+from .config import get_config
+from .ids import ObjectID
+
+_REQ = struct.Struct(">2sB16sQQ")
+_RESP = struct.Struct(">q")
+_MAGIC = b"RB"
+_VERSION = 1
+_NOT_FOUND = -1
+
+
+class _RangeGone(Exception):
+    """The source answered -1: it no longer holds the object. The
+    connection stays protocol-clean (no body follows) and is reusable."""
+
+
+class _SourceFailure(Exception):
+    """This replica cannot serve the pull (evicted, unreachable, stale
+    shorter copy): drop it and retry the chunk on an alternate."""
+
+
+# ---------------------------------------------------------------- metrics
+_metrics = None
+
+
+def _get_metrics():
+    global _metrics
+    if _metrics is None:
+        from ..util.metrics import Counter, Gauge
+
+        _metrics = {
+            "bytes_in": Counter(
+                "rtpu_transfer_bytes_in_total",
+                "bytes pulled from remote object pools", ("path",)),
+            "bytes_out": Counter(
+                "rtpu_transfer_bytes_out_total",
+                "bytes served to remote pullers over the bulk stream"),
+            "active": Gauge(
+                "rtpu_transfer_active_pulls", "cross-host pulls in flight"),
+            "gb_s": Gauge(
+                "rtpu_transfer_pull_gb_s",
+                "throughput of the most recent cross-host pull"),
+        }
+    return _metrics
+
+
+def _parse_tcp(endpoint: str) -> Tuple[str, int]:
+    if not endpoint.startswith("tcp:"):
+        raise ValueError(f"bulk endpoint must be tcp, got {endpoint!r}")
+    host, port = endpoint[4:].rsplit(":", 1)
+    return host, int(port)
+
+
+# ---------------------------------------------------------------- server
+class BulkServer:
+    """Serves chunk ranges out of this process's object store over the
+    raw binary stream. Started lazily by the ``om_endpoint`` RPC handler
+    the first time a remote puller asks, so idle workers never hold a
+    listener."""
+
+    def __init__(self, get_store: Callable, host: str = "0.0.0.0"):
+        self._get_store = get_store
+        self._host = host
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._sendfile_ok = True
+        self.address: Optional[str] = None
+        self.bytes_out = 0
+
+    async def start(self) -> "BulkServer":
+        # own listening socket: accepted conns inherit SO_SNDBUF from it,
+        # and the buffer must be set before accept for window scaling
+        lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        bufsz = get_config().bulk_socket_buffer
+        if bufsz:
+            try:
+                lsock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, bufsz)
+            except OSError:
+                pass
+        lsock.bind((self._host, 0))
+        self._server = await asyncio.start_server(
+            self._on_conn, sock=lsock, backlog=256)
+        port = self._server.sockets[0].getsockname()[1]
+        from .rpc import advertise_ip
+
+        host = advertise_ip() if self._host in ("0.0.0.0", "") else self._host
+        self.address = f"tcp:{host}:{port}"
+        return self
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+
+    async def _on_conn(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter):
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+        try:
+            while True:
+                hdr = await reader.readexactly(_REQ.size)
+                magic, ver, oid, off, ln = _REQ.unpack(hdr)
+                if magic != _MAGIC or ver != _VERSION:
+                    break
+                await self._serve_range(writer, ObjectID(oid), off, ln)
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                BrokenPipeError, OSError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _serve_range(self, writer, oid: ObjectID, off: int, ln: int):
+        store = self._get_store()
+        try:
+            rng = store.acquire_range(oid)
+        except Exception:
+            rng = None
+        if rng is None:
+            writer.write(_RESP.pack(_NOT_FOUND))
+            await writer.drain()
+            return
+        f, base, size, release = rng
+        try:
+            if off >= size:
+                # the puller's metadata disagrees with this copy (re-put
+                # after eviction): answer not-found so it re-resolves
+                writer.write(_RESP.pack(_NOT_FOUND))
+                await writer.drain()
+                return
+            ln = min(ln, size - off)
+            writer.write(_RESP.pack(ln))
+            await writer.drain()
+            if ln:
+                await self._send_body(writer, f, base + off, ln)
+            self.bytes_out += ln
+            _get_metrics()["bytes_out"].inc(ln)
+        finally:
+            release()
+
+    async def _send_body(self, writer, f, offset: int, count: int):
+        loop = asyncio.get_event_loop()
+        if self._sendfile_ok:
+            try:
+                await loop.sendfile(writer.transport, f, offset, count,
+                                    fallback=False)
+                return
+            except (asyncio.SendfileNotAvailableError, NotImplementedError,
+                    AttributeError, RuntimeError):
+                # raised before any byte moves: the pread path below is a
+                # safe restart (an OSError mid-transfer is NOT — it
+                # propagates and tears the connection down instead)
+                self._sendfile_ok = False
+        import os
+
+        fd = f.fileno()
+        sent = 0
+        while sent < count:
+            data = os.pread(fd, min(1 << 20, count - sent), offset + sent)
+            if not data:
+                raise ConnectionResetError("short read while serving range")
+            writer.write(data)
+            await writer.drain()
+            sent += len(data)
+
+
+# ---------------------------------------------------------------- client
+class _BulkConn:
+    """One client connection to a bulk endpoint. Serves one range at a
+    time; pullers pipeline by pooling a few of these per link."""
+
+    __slots__ = ("sock", "_hdr")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self._hdr = bytearray(_RESP.size)
+
+    @classmethod
+    async def open(cls, endpoint: str, timeout: float) -> "_BulkConn":
+        host, port = _parse_tcp(endpoint)
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setblocking(False)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            bufsz = get_config().bulk_socket_buffer
+            if bufsz:
+                try:
+                    sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                                    bufsz)
+                except OSError:
+                    pass
+            loop = asyncio.get_event_loop()
+            await asyncio.wait_for(loop.sock_connect(sock, (host, port)),
+                                   timeout)
+        except BaseException:
+            sock.close()
+            raise
+        return cls(sock)
+
+    async def fetch_into(self, oid: ObjectID, off: int, ln: int,
+                         view: memoryview) -> int:
+        """Request [off, off+ln) and receive the body straight into
+        `view` (the destination ingest mmap — zero-copy rx)."""
+        loop = asyncio.get_event_loop()
+        await loop.sock_sendall(
+            self.sock, _REQ.pack(_MAGIC, _VERSION, oid.binary(), off, ln))
+        hdr = memoryview(self._hdr)
+        got = 0
+        while got < _RESP.size:
+            n = await loop.sock_recv_into(self.sock, hdr[got:])
+            if n == 0:
+                raise ConnectionResetError("bulk peer closed")
+            got += n
+        (status,) = _RESP.unpack(self._hdr)
+        if status < 0:
+            raise _RangeGone()
+        if status != ln:
+            # a shorter (stale) copy: the connection now carries a body
+            # we did not size for — poison it and fail the source
+            raise ConnectionResetError(
+                f"bulk source returned {status} bytes for a {ln}-byte range")
+        got = 0
+        while got < status:
+            # explicit sub-view with its own release: a sub-view stranded
+            # in an exception traceback would keep the ingest mmap
+            # exported and turn seal()/abort() into BufferError
+            sub = view[got:]
+            try:
+                n = await loop.sock_recv_into(self.sock, sub)
+            finally:
+                sub.release()
+            if n == 0:
+                raise ConnectionResetError("bulk peer closed mid-body")
+            got += n
+        return got
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class _Source:
+    """One replica serving a pull: link-local concurrency cap (the conn
+    pool) and per-pull accounting."""
+
+    def __init__(self, host: str, addr: str, conns_per_link: int):
+        self.host = host
+        self.addr = addr
+        self.alive = True
+        self.inflight = 0
+        self.bytes = 0
+        self._cap = max(1, conns_per_link)
+        self._pool: asyncio.Queue = asyncio.Queue()
+        for _ in range(self._cap):
+            self._pool.put_nowait(None)  # placeholder: connect on demand
+
+    async def acquire_conn(self, endpoint: str,
+                           timeout: float) -> _BulkConn:
+        conn = await self._pool.get()
+        if conn is None:
+            try:
+                conn = await _BulkConn.open(endpoint, timeout)
+            except BaseException:
+                self._pool.put_nowait(None)  # return the slot
+                raise
+        return conn
+
+    def release_conn(self, conn: Optional[_BulkConn]):
+        # None = the connection broke; the slot reopens on next acquire
+        self._pool.put_nowait(conn)
+
+    def close(self):
+        while True:
+            try:
+                conn = self._pool.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if conn is not None:
+                conn.close()
+
+
+class _Window:
+    """AIMD sliding-window permit gate. Replaces the old gather-of-4
+    barrier: a straggler chunk no longer stalls its three window-mates —
+    completed permits immediately admit the next chunk. Grows by one on
+    each success up to `max`, halves on a source failure."""
+
+    def __init__(self, start: int, max_: int):
+        self.size = max(1, start)
+        self.max = max(self.size, max_)
+        self._sem = asyncio.Semaphore(self.size)
+        self._debt = 0
+
+    async def acquire(self):
+        await self._sem.acquire()
+
+    def release(self):
+        if self._debt > 0 and self.size > 1:
+            self._debt -= 1
+            self.size -= 1  # shrink by swallowing the returned permit
+        else:
+            self._sem.release()
+
+    def grow(self):
+        if self.size < self.max:
+            self.size += 1
+            self._sem.release()  # net new permit
+
+    def shrink(self):
+        self._debt += self.size - max(1, self.size // 2)
+
+
+class PullManager:
+    """Receiver-side pull orchestration for one process (ref:
+    object_manager/pull_manager.cc): striped chunk scheduling over the
+    advertised replicas, per-link concurrency caps, adaptive windowing,
+    retry-with-alternate-replica, and transfer accounting."""
+
+    def __init__(self, client_for: Callable[[str], object]):
+        self._client_for = client_for
+        # addr -> bulk endpoint ("tcp:host:port"); None = the peer
+        # ANSWERED None (stream disabled on its side, cached until it
+        # changes address). Transient stream failures instead back off
+        # via _bulk_retry_at and re-probe.
+        self._endpoints: Dict[str, Optional[str]] = {}
+        self._bulk_retry_at: Dict[str, float] = {}
+        self._stats = {
+            "pulls": 0, "active": 0, "bulk_bytes_in": 0, "rpc_bytes_in": 0,
+            "failovers": 0, "last_gb_s": 0.0,
+        }
+
+    def stats(self) -> dict:
+        return dict(self._stats)
+
+    async def _endpoint_for(self, addr: str) -> Optional[str]:
+        if not get_config().bulk_transfer_enabled:
+            return None  # not cached: re-enabling takes effect live
+        if time.monotonic() < self._bulk_retry_at.get(addr, 0.0):
+            return None  # backing off after a stream failure (not cached)
+        if addr in self._endpoints:
+            return self._endpoints[addr]
+        try:
+            ep = await self._client_for(addr).call_async(
+                "om_endpoint", _timeout=10)
+        except Exception:
+            # old peer / momentary unreachability: RPC path now, re-probe
+            # after the backoff instead of downgrading forever
+            self._bulk_retry_at[addr] = time.monotonic() + 30.0
+            return None
+        self._endpoints[addr] = ep
+        return ep
+
+    def _note_stream_failure(self, addr: str):
+        """A broken/timed-out stream downgrades this peer to RPC for a
+        bounded backoff, then re-probes — one transient hiccup must not
+        pin a long-lived process to the slow path forever."""
+        self._endpoints.pop(addr, None)
+        self._bulk_retry_at[addr] = time.monotonic() + 30.0
+
+    async def pull(self, oid: ObjectID, size: int,
+                   sources: List[Tuple[str, str]], writer) -> dict:
+        """Fill `writer` (an ingest from create_for_ingest) with the
+        object's bytes, striping chunk ranges across `sources`
+        [(host, rpc_addr), ...]. Caller seals/aborts the writer. Raises
+        ObjectLostError when every source fails. Returns per-pull info:
+        {bytes, seconds, gb_s, per_source: {addr: bytes}}."""
+        cfg = get_config()
+        chunk = max(64 << 10, int(cfg.bulk_chunk_size))
+        srcs = [_Source(h, a, cfg.pull_conns_per_link) for h, a in sources]
+        info = {"bytes": size, "seconds": 0.0, "gb_s": 0.0, "per_source": {}}
+        if size <= 0:
+            return info
+        offs = collections.deque(range(0, size, chunk))
+        window = _Window(min(4, len(offs)), max(4, cfg.pull_window_max))
+        n_workers = min(len(offs), window.max)
+        errors: List[Exception] = []
+        touch = getattr(writer, "touch", None)
+
+        async def run_chunk(off: int):
+            ln = min(chunk, size - off)
+            while True:
+                src = self._pick(srcs)
+                if src is None:
+                    raise exceptions.ObjectLostError(
+                        oid.hex(),
+                        "every replica failed or evicted mid-pull")
+                src.inflight += 1
+                try:
+                    await self._fetch(src, oid, off, ln, writer)
+                    src.bytes += ln
+                    return
+                except _SourceFailure:
+                    src.alive = False
+                    self._stats["failovers"] += 1
+                    window.shrink()
+                finally:
+                    src.inflight -= 1
+
+        async def worker():
+            while offs and not errors:
+                off = offs.popleft()
+                await window.acquire()
+                try:
+                    await run_chunk(off)
+                    window.grow()
+                    if touch is not None:
+                        touch()
+                except Exception as e:  # noqa: BLE001 — collected below
+                    errors.append(e)
+                finally:
+                    window.release()
+
+        self._stats["pulls"] += 1
+        self._stats["active"] += 1
+        _get_metrics()["active"].set(self._stats["active"])
+        t0 = time.perf_counter()
+        try:
+            await asyncio.gather(*(worker() for _ in range(n_workers)))
+        finally:
+            self._stats["active"] -= 1
+            _get_metrics()["active"].set(self._stats["active"])
+            for src in srcs:
+                src.close()
+        if errors:
+            raise errors[0]
+        dt = time.perf_counter() - t0
+        info["seconds"] = dt
+        info["gb_s"] = (size / dt / 1e9) if dt > 0 else 0.0
+        info["per_source"] = {s.addr: s.bytes for s in srcs if s.bytes}
+        self._stats["last_gb_s"] = round(info["gb_s"], 3)
+        _get_metrics()["gb_s"].set(info["gb_s"])
+        return info
+
+    @staticmethod
+    def _pick(srcs: List[_Source]) -> Optional[_Source]:
+        """Least-loaded alive source: striping falls out of the in-flight
+        counter — concurrent chunks spread across every ready replica."""
+        alive = [s for s in srcs if s.alive]
+        if not alive:
+            return None
+        return min(alive, key=lambda s: s.inflight)
+
+    async def _fetch(self, src: _Source, oid: ObjectID, off: int, ln: int,
+                     writer):
+        cfg = get_config()
+        ep = await self._endpoint_for(src.addr)
+        if ep is not None:
+            try:
+                n = await self._fetch_bulk(src, ep, oid, off, ln, writer)
+                self._stats["bulk_bytes_in"] += n
+                _get_metrics()["bytes_in"].inc(n, tags={"path": "bulk"})
+                return
+            except _RangeGone:
+                raise _SourceFailure(f"{src.addr}: object gone") from None
+            except (ConnectionError, OSError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError):
+                # stream broken — back this addr off to the RPC path and
+                # re-probe later (strictly-additive guarantee)
+                self._note_stream_failure(src.addr)
+        from .rpc import ConnectionLost, RemoteHandlerError
+
+        try:
+            data = await self._client_for(src.addr).call_async(
+                "om_read", oid=oid.binary(), offset=off, length=ln,
+                _timeout=cfg.pull_chunk_timeout_s)
+        except (ConnectionLost, RemoteHandlerError, OSError,
+                asyncio.TimeoutError) as e:
+            raise _SourceFailure(f"{src.addr}: {e}") from None
+        if data is None:
+            raise _SourceFailure(f"{src.addr}: evicted mid-pull")
+        if len(data) != ln:
+            raise _SourceFailure(
+                f"{src.addr}: stale copy ({len(data)} != {ln} bytes)")
+        writer.write_at(off, data)
+        self._stats["rpc_bytes_in"] += len(data)
+        _get_metrics()["bytes_in"].inc(len(data), tags={"path": "rpc"})
+
+    async def _fetch_bulk(self, src: _Source, ep: str, oid: ObjectID,
+                          off: int, ln: int, writer) -> int:
+        cfg = get_config()
+        conn = await src.acquire_conn(ep, cfg.rpc_connect_timeout_s)
+        view_fn = getattr(writer, "view", None)
+        tmp = None
+        if view_fn is not None:
+            view = view_fn(off, ln)
+        else:  # ingest without a writable window: recv once, copy once
+            tmp = bytearray(ln)
+            view = memoryview(tmp)
+        ok = False
+        clean = False  # protocol-clean failure (reusable connection)
+        try:
+            n = await asyncio.wait_for(
+                conn.fetch_into(oid, off, ln, view),
+                timeout=cfg.pull_chunk_timeout_s)
+            ok = True
+        except _RangeGone:
+            clean = True
+            raise
+        finally:
+            try:
+                view.release()
+            except BufferError:
+                pass
+            if ok or clean:
+                src.release_conn(conn)
+            else:
+                conn.close()
+                src.release_conn(None)
+        if tmp is not None:
+            writer.write_at(off, tmp)
+        return n

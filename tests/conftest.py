@@ -30,6 +30,15 @@ except AttributeError:
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: stress-scale tests excluded from tier-1 "
+        "(-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "transfer: bulk data-plane (cross-host object "
+        "transfer) tests")
+
+
 @pytest.fixture
 def shared_cluster():
     """A cluster shared by tests that only need basic cluster services.

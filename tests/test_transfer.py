@@ -1,0 +1,390 @@
+"""Bulk data plane: zero-copy chunk streams + striped multi-replica pulls.
+
+Unit tier exercises transfer.py directly against file-backed stores (no
+cluster): striped byte-equality, mid-pull eviction failover, loss
+surfacing, concurrent-ingest dedup. The integration tier reuses the
+simulated-two-host fixture from test_multihost (RTPU_HOST_ID +
+RTPU_SHM_ROOT give a nodelet its own pool, so object movement must ride
+the node-to-node transfer tier) and checks that real pulls ride the bulk
+stream — and still complete over the om_read RPC path when the stream is
+disabled (`bulk_transfer_enabled=False`).
+"""
+
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+from ray_tpu.runtime import object_store
+from ray_tpu.runtime.config import get_config
+from ray_tpu.runtime.ids import ObjectID
+from ray_tpu.runtime.rpc import EventLoopThread
+from ray_tpu.runtime.transfer import BulkServer, PullManager
+
+pytestmark = pytest.mark.transfer
+
+
+# --------------------------------------------------------------- helpers
+class _NoRpc:
+    """client_for stub for pure-stream tests: any RPC use is a bug."""
+
+    async def call_async(self, *a, **k):
+        raise AssertionError("unexpected RPC fallback in a stream test")
+
+
+def _make_replicas(tmp_path, n, nbytes=8 << 20, seed=0):
+    """n byte-identical single-object stores + the payload + its oid."""
+    oid = ObjectID.from_random()
+    payload = np.random.default_rng(seed).integers(
+        0, 255, nbytes, dtype=np.uint8)
+    stores = [object_store.ObjectStoreClient(
+        "xfer", root=str(tmp_path / f"src{i}")) for i in range(n)]
+    stores[0].put(oid, payload)
+    src0 = str(tmp_path / "src0" / oid.hex())
+    for i in range(1, n):
+        os.makedirs(str(tmp_path / f"src{i}"), exist_ok=True)
+        shutil.copy(src0, str(tmp_path / f"src{i}" / oid.hex()))
+    return stores, oid, payload
+
+
+def _start_servers(stores):
+    elt = EventLoopThread.get()
+    return [elt.run(BulkServer(lambda s=s: s, host="127.0.0.1").start())
+            for s in stores]
+
+
+@pytest.fixture
+def small_chunks():
+    """Shrink the stream chunk so a few-MB object stripes across many
+    chunks (deterministic multi-chunk scheduling without big payloads)."""
+    cfg = get_config()
+    old = cfg.bulk_chunk_size
+    cfg.bulk_chunk_size = 256 << 10
+    yield
+    cfg.bulk_chunk_size = old
+
+
+# --------------------------------------------------------------- unit tier
+def test_striped_pull_byte_equality(tmp_path, small_chunks):
+    """A pull striped over two replicas is byte-identical to the source,
+    and both replicas actually served bytes."""
+    stores, oid, payload = _make_replicas(tmp_path, 2)
+    servers = _start_servers(stores)
+    dst = object_store.ObjectStoreClient("xfer", root=str(tmp_path / "dst"))
+    pm = PullManager(lambda addr: _NoRpc())
+    pm._endpoints = {"a": servers[0].address, "b": servers[1].address}
+    size = stores[0].size_of(oid)
+    writer = dst.create_for_ingest(oid, size)
+    elt = EventLoopThread.get()
+    info = elt.run(pm.pull(oid, size, [("hA", "a"), ("hB", "b")], writer))
+    writer.seal()
+    assert np.array_equal(dst.get(oid), payload)
+    # striping: every source carried part of the object
+    assert set(info["per_source"]) == {"a", "b"}
+    assert all(v > 0 for v in info["per_source"].values())
+    assert sum(info["per_source"].values()) == size
+    assert pm.stats()["bulk_bytes_in"] >= size
+    for s in servers:
+        elt.run(s.stop())
+
+
+def test_pull_failover_to_alternate_replica(tmp_path, small_chunks):
+    """Eviction at one replica mid-pull retries chunks on the alternate
+    and still produces byte-identical output."""
+    stores, oid, payload = _make_replicas(tmp_path, 2)
+    servers = _start_servers(stores)
+    stores[0].delete(oid)  # replica A evicted: every chunk it gets fails
+    dst = object_store.ObjectStoreClient("xfer", root=str(tmp_path / "dst"))
+    pm = PullManager(lambda addr: _NoRpc())
+    pm._endpoints = {"a": servers[0].address, "b": servers[1].address}
+    size = stores[1].size_of(oid)
+    writer = dst.create_for_ingest(oid, size)
+    elt = EventLoopThread.get()
+    info = elt.run(pm.pull(oid, size, [("hA", "a"), ("hB", "b")], writer))
+    writer.seal()
+    assert np.array_equal(dst.get(oid), payload)
+    assert info["per_source"] == {"b": size}
+    assert pm.stats()["failovers"] >= 1
+    for s in servers:
+        elt.run(s.stop())
+
+
+def test_pull_surfaces_object_lost_when_all_replicas_evicted(
+        tmp_path, small_chunks):
+    stores, oid, _ = _make_replicas(tmp_path, 2, nbytes=1 << 20)
+    servers = _start_servers(stores)
+    size = stores[0].size_of(oid)
+    for s in stores:
+        s.delete(oid)
+    dst = object_store.ObjectStoreClient("xfer", root=str(tmp_path / "dst"))
+    pm = PullManager(lambda addr: _NoRpc())
+    pm._endpoints = {"a": servers[0].address, "b": servers[1].address}
+    writer = dst.create_for_ingest(oid, size)
+    elt = EventLoopThread.get()
+    with pytest.raises(exceptions.ObjectLostError):
+        elt.run(pm.pull(oid, size, [("hA", "a"), ("hB", "b")], writer))
+    writer.abort()
+    assert not dst.contains(oid)
+    for s in servers:
+        elt.run(s.stop())
+
+
+def test_concurrent_ingest_dedup_single_flight(tmp_path):
+    """Two pullers racing on one host's pool: exactly one transfers, the
+    loser gets FileExistsError and waits for the winner's seal (the
+    core worker's _await_local_ingest path)."""
+    root = str(tmp_path / "pool")
+    a = object_store.ObjectStoreClient("xfer", root=root)
+    b = object_store.ObjectStoreClient("xfer", root=root)
+    oid = ObjectID.from_random()
+    w = a.create_for_ingest(oid, 1 << 20)
+    with pytest.raises(FileExistsError):
+        b.create_for_ingest(oid, 1 << 20)
+    # the winner seals; the loser's wait-for-seal now observes the object
+    w.write_at(0, b"\xab" * (1 << 20))
+    w.seal()
+    assert b.contains(oid)
+    # after the seal, a fresh ingest attempt is again exclusive (re-pull
+    # of an evicted object), not wedged by leftover state
+    a.delete(oid)
+    w2 = b.create_for_ingest(oid, 1 << 10)
+    w2.abort()
+
+
+def test_concurrent_ingest_loser_waits_for_seal(tmp_path):
+    """Threaded race: the loser polls contains() (as the core worker
+    does) and sees the winner's bytes, not a duplicate transfer."""
+    root = str(tmp_path / "pool")
+    winner = object_store.ObjectStoreClient("xfer", root=root)
+    loser = object_store.ObjectStoreClient("xfer", root=root)
+    oid = ObjectID.from_random()
+    payload = np.arange(1 << 18, dtype=np.uint8).tobytes()
+    w = winner.create_for_ingest(oid, len(payload))
+    seen = {}
+
+    def losing_pull():
+        try:
+            loser.create_for_ingest(oid, len(payload))
+            seen["result"] = "transferred"  # would be a duplicate
+        except FileExistsError:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if loser.contains(oid):
+                    seen["result"] = "waited"
+                    return
+                time.sleep(0.01)
+            seen["result"] = "timeout"
+
+    t = threading.Thread(target=losing_pull)
+    t.start()
+    time.sleep(0.05)  # let the loser hit the in-progress ingest
+    w.write_at(0, payload)
+    w.seal()
+    t.join(timeout=15)
+    assert seen.get("result") == "waited"
+
+
+def test_fd_cache_survives_reput_and_eviction(tmp_path):
+    """read_range's fd cache must never serve stale bytes: eviction
+    surfaces FileNotFoundError, a re-put of the same id reopens."""
+    store = object_store.ObjectStoreClient(
+        "xfer", root=str(tmp_path / "pool"))
+    oid = ObjectID.from_random()
+    store.put(oid, b"first-generation-bytes")
+    size = store.size_of(oid)
+    first = store.read_range(oid, 0, size)
+    assert store.read_range(oid, 0, size) == first  # cached-fd hit
+    store.delete(oid)
+    with pytest.raises(FileNotFoundError):
+        store.read_range(oid, 0, 8)
+    assert store.acquire_range(oid) is None
+    store.put(oid, b"second-generation-bytes!")
+    second = store.read_range(oid, 0, store.size_of(oid))
+    assert second != first  # new inode picked up, no stale fd
+
+
+def test_rpc_fallback_when_stream_disabled(tmp_path, small_chunks):
+    """bulk_transfer_enabled=False: the same pull completes over the
+    om_read RPC path (strictly-additive guarantee)."""
+    from ray_tpu.runtime.rpc import RpcClient, RpcServer
+
+    stores, oid, payload = _make_replicas(tmp_path, 1, nbytes=2 << 20)
+    elt = EventLoopThread.get()
+    srv = RpcServer("tcp:127.0.0.1:0",
+                    object_store.om_handlers(lambda: stores[0], bulk={}))
+    elt.run(srv.start())
+    clients = {}
+
+    def client_for(addr):
+        if addr not in clients:
+            clients[addr] = RpcClient(addr)
+        return clients[addr]
+
+    dst = object_store.ObjectStoreClient("xfer", root=str(tmp_path / "dst"))
+    pm = PullManager(client_for)
+    size = stores[0].size_of(oid)
+    cfg = get_config()
+    cfg.bulk_transfer_enabled = False
+    try:
+        writer = dst.create_for_ingest(oid, size)
+        elt.run(pm.pull(oid, size, [("hA", srv.address)], writer))
+        writer.seal()
+    finally:
+        cfg.bulk_transfer_enabled = True
+    assert np.array_equal(dst.get(oid), payload)
+    stats = pm.stats()
+    assert stats["rpc_bytes_in"] >= size
+    assert stats["bulk_bytes_in"] == 0
+    for c in clients.values():
+        c.close()
+    elt.run(srv.stop())
+
+
+def test_bulk_stream_after_rpc_only_peer(tmp_path, small_chunks):
+    """A peer that answers om_endpoint=None (stream disabled on ITS
+    side) stays on RPC; one that answers with an endpoint streams."""
+    from ray_tpu.runtime.rpc import RpcClient, RpcServer
+
+    stores, oid, payload = _make_replicas(tmp_path, 1, nbytes=2 << 20)
+    elt = EventLoopThread.get()
+    # bulk=None: this peer never offers a stream endpoint
+    srv = RpcServer("tcp:127.0.0.1:0",
+                    object_store.om_handlers(lambda: stores[0]))
+    elt.run(srv.start())
+    clients = {}
+
+    def client_for(addr):
+        if addr not in clients:
+            clients[addr] = RpcClient(addr)
+        return clients[addr]
+
+    dst = object_store.ObjectStoreClient("xfer", root=str(tmp_path / "dst"))
+    pm = PullManager(client_for)
+    size = stores[0].size_of(oid)
+    writer = dst.create_for_ingest(oid, size)
+    elt.run(pm.pull(oid, size, [("hA", srv.address)], writer))
+    writer.seal()
+    assert np.array_equal(dst.get(oid), payload)
+    assert pm.stats()["rpc_bytes_in"] >= size
+    for c in clients.values():
+        c.close()
+    elt.run(srv.stop())
+
+
+# -------------------------------------------------------- integration tier
+@pytest.fixture
+def two_host_session(tmp_path):
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    session = ray_tpu.init(num_cpus=2)
+    host_b_pool = str(tmp_path / "hostB_shm")
+    os.makedirs(host_b_pool, exist_ok=True)
+    node_b = session.add_node(
+        num_cpus=2,
+        env={"RTPU_HOST_ID": "xfer-host-b",
+             "RTPU_SHM_ROOT": host_b_pool})
+    yield session, node_b
+    ray_tpu.shutdown()
+
+
+def _on_node(node_id):
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    return NodeAffinitySchedulingStrategy(node_id=node_id)
+
+
+def test_cross_host_pull_rides_bulk_stream(two_host_session):
+    """Tier-1 localhost stream test: a result produced on the simulated
+    host B reaches the driver over the bulk stream (not om_read), and
+    the bytes are exact."""
+    session, node_b = two_host_session
+
+    @ray_tpu.remote
+    def produce():
+        assert os.environ.get("RTPU_HOST_ID") == "xfer-host-b"
+        return np.arange(3 << 20, dtype=np.float64)  # 24 MB
+
+    ref = produce.options(
+        scheduling_strategy=_on_node(node_b)).remote()
+    arr = ray_tpu.get(ref, timeout=120)
+    assert arr.shape == (3 << 20,)
+    assert float(arr[12345]) == 12345.0
+    from ray_tpu.runtime.core import get_core
+
+    core = get_core()
+    assert core.store.contains(ref.id())
+    stats = core.pull_manager.stats()
+    assert stats["pulls"] >= 1
+    assert stats["bulk_bytes_in"] >= arr.nbytes, stats
+    assert stats["rpc_bytes_in"] == 0, stats
+
+
+def test_cross_host_pull_rpc_fallback_end_to_end(two_host_session):
+    """Same flow with the stream disabled on the puller: the pull rides
+    om_read and the value is still exact."""
+    session, node_b = two_host_session
+
+    @ray_tpu.remote
+    def produce():
+        return np.full(2 << 20, 2.25)  # 16 MB
+
+    cfg = get_config()
+    cfg.bulk_transfer_enabled = False
+    try:
+        ref = produce.options(
+            scheduling_strategy=_on_node(node_b)).remote()
+        arr = ray_tpu.get(ref, timeout=120)
+    finally:
+        cfg.bulk_transfer_enabled = True
+    assert float(arr[-1]) == 2.25
+    from ray_tpu.runtime.core import get_core
+
+    stats = get_core().pull_manager.stats()
+    assert stats["rpc_bytes_in"] >= arr.nbytes, stats
+
+
+# ------------------------------------------------------------- stress tier
+@pytest.mark.slow
+def test_striped_broadcast_stress(tmp_path):
+    """Fan one large object out to 3 simulated hosts; every copy must be
+    byte-identical and the owner's replica directory must have spread
+    pull load (stream-path edition of the broadcast test)."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    session = ray_tpu.init(num_cpus=1)
+    nodes = []
+    try:
+        for i in range(3):
+            pool = str(tmp_path / f"host{i}_shm")
+            os.makedirs(pool, exist_ok=True)
+            nodes.append(session.add_node(
+                num_cpus=1,
+                env={"RTPU_HOST_ID": f"xfer-stress-{i}",
+                     "RTPU_SHM_ROOT": pool}))
+        payload = np.random.default_rng(3).integers(
+            0, 2 ** 62, 8 << 20, dtype=np.int64)  # 64 MB
+        ref = ray_tpu.put(payload)
+        digest = int(payload.sum())
+
+        @ray_tpu.remote
+        def fetch(r):
+            arr = ray_tpu.get(r[0])
+            return os.environ.get("RTPU_HOST_ID"), int(arr.sum())
+
+        outs = []
+        for node in nodes:
+            outs.append(ray_tpu.get(fetch.options(
+                scheduling_strategy=_on_node(node)).remote([ref]),
+                timeout=180))
+        assert {h for h, _ in outs} == {f"xfer-stress-{i}"
+                                        for i in range(3)}
+        assert all(s == digest for _, s in outs)
+    finally:
+        ray_tpu.shutdown()
