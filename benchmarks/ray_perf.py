@@ -103,6 +103,28 @@ def main():
     record("actor_calls_async_per_s",
            timeit(pipelined, max(1, int(10 * args.scale))), batch)
 
+    # ---- submit→result latency percentiles: the per-call view of the
+    # control-plane hot path (throughput hides tail regressions — a
+    # batched fast path that helps the mean but doubles p99 shows here)
+    def percentiles(fn, n):
+        samples = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            fn()
+            samples.append((time.perf_counter() - t0) * 1e3)
+        samples.sort()
+        return (samples[len(samples) // 2],
+                samples[min(len(samples) - 1, int(len(samples) * 0.99))])
+
+    p50, p99 = percentiles(lambda: ray_tpu.get(nop.remote()),
+                           max(20, int(200 * args.scale)))
+    results["task_latency_ms_p50"] = round(p50, 3)
+    results["task_latency_ms_p99"] = round(p99, 3)
+    p50, p99 = percentiles(lambda: ray_tpu.get(counter.inc.remote()),
+                           max(20, int(200 * args.scale)))
+    results["actor_call_latency_ms_p50"] = round(p50, 3)
+    results["actor_call_latency_ms_p99"] = round(p99, 3)
+
     # ---- object store put throughput (ref: "multi_client_put_gigabytes";
     # array payloads ride the pickle5 out-of-band buffer path: one memcpy
     # into the pool, no serializer copy)

@@ -33,11 +33,16 @@ def _build_actor_resources(opts: Dict[str, Any]) -> Dict[str, float]:
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str,
-                 num_returns: int = 1, concurrency_group: str = None):
+                 num_returns: int = 1, concurrency_group: str = None,
+                 tmpl_cache: Optional[Dict[int, dict]] = None):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
         self._concurrency_group = concurrency_group
+        # core_token -> spec template; default-options methods share the
+        # handle-held cache (plain data, so no handle<->method ref cycle)
+        self._tmpl_cache: Dict[int, dict] = \
+            tmpl_cache if tmpl_cache is not None else {}
 
     def options(self, **opts) -> "ActorMethod":
         return ActorMethod(
@@ -48,10 +53,30 @@ class ActorMethod:
 
     def remote(self, *args, **kwargs):
         core = get_core()
-        refs = core.submit_actor_task(
-            self._handle._actor_id, self._method_name, args, kwargs,
-            {"num_returns": self._num_returns,
-             "concurrency_group": self._concurrency_group})
+        # cached per-(actor, method) spec template: each call re-stamps
+        # only task id, seq and args (ref: actor_task_submitter.cc keeps
+        # the invariant call header per resolved handle)
+        if hasattr(core, "submit_actor_task_template"):
+            # keyed by core GENERATION, not id(core) — see
+            # RemoteFunction.remote for the address-reuse hazard
+            token = core.core_token
+            tmpl = self._tmpl_cache.get(token)
+            if tmpl is None:
+                tmpl = core.make_actor_template(
+                    self._handle._actor_id, self._method_name,
+                    {"num_returns": self._num_returns,
+                     "concurrency_group": self._concurrency_group})
+                # mutate IN PLACE: the dict is shared through the handle
+                # so later ActorMethod instances reuse it; clear first so
+                # only the live core's entry survives a re-init
+                self._tmpl_cache.clear()
+                self._tmpl_cache[token] = tmpl
+            refs = core.submit_actor_task_template(tmpl, args, kwargs)
+        else:
+            refs = core.submit_actor_task(
+                self._handle._actor_id, self._method_name, args, kwargs,
+                {"num_returns": self._num_returns,
+                 "concurrency_group": self._concurrency_group})
         if self._num_returns in ("streaming", "dynamic"):
             return refs  # an ObjectRefGenerator
         if self._num_returns == 1:
@@ -72,6 +97,13 @@ class ActorMethod:
             f"actor method {self._method_name} cannot be called directly; "
             f"use .{self._method_name}.remote()")
 
+    def __getstate__(self):
+        # spec templates are core-bound (owner_addr/caller_id): a method
+        # pickled into another process must rebuild its own
+        state = self.__dict__.copy()
+        state["_tmpl_cache"] = {}
+        return state
+
 
 def _rebuild_handle(actor_id: str):
     return ActorHandle(actor_id)
@@ -81,6 +113,11 @@ class ActorHandle:
     def __init__(self, actor_id: str, owning: bool = False):
         self._actor_id = actor_id
         self._owning = owning  # creator's original handle
+        # method name -> shared template cache (plain dicts only —
+        # caching ActorMethod objects here would close a reference
+        # cycle through ActorMethod._handle and defer this handle's
+        # __del__ fate-sharing kill to an eventual cyclic-GC pass)
+        self._tmpl_caches: Dict[str, Dict[int, dict]] = {}
 
     def __del__(self):
         # Owner-based actor lifetime (ref: actor fate-sharing with the
@@ -100,7 +137,11 @@ class ActorHandle:
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name)
+        # the ActorMethod is transient, but its spec template persists
+        # in the handle-held cache, so repeat `handle.method.remote()`
+        # calls skip the template rebuild
+        return ActorMethod(self, name,
+                           tmpl_cache=self._tmpl_caches.setdefault(name, {}))
 
     @property
     def actor_id(self) -> str:
@@ -140,11 +181,12 @@ class ActorClass:
 
     def _export(self) -> str:
         core = get_core()
-        key = self._cls_key_cache.get(id(core))
+        token = getattr(core, "core_token", None) or id(core)
+        key = self._cls_key_cache.get(token)
         if key is None:
             blob = serialization.dumps_inline(self._cls)
             key = core.export_function(blob)
-            self._cls_key_cache = {id(core): key}
+            self._cls_key_cache = {token: key}
         return key
 
     def remote(self, *args, **kwargs) -> ActorHandle:

@@ -31,12 +31,22 @@ class RemoteFunction:
         self._fn = fn
         self._options = options
         self._fn_key_cache: Dict[int, str] = {}  # id(core) -> exported key
+        self._spec_opts: Optional[Dict[str, Any]] = None  # built once
+        self._tmpl_cache: Dict[int, dict] = {}  # id(core) -> spec template
         functools.update_wrapper(self, fn)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"remote function {self._fn.__name__} cannot be called directly; "
             f"use {self._fn.__name__}.remote()")
+
+    def __getstate__(self):
+        # a handle captured in another task's closure ships by value:
+        # the spec template is CORE-BOUND (owner_addr/caller_id) and
+        # must never leak into the unpickling process's cache
+        state = self.__dict__.copy()
+        state["_tmpl_cache"] = {}
+        return state
 
     def options(self, **new_options) -> "RemoteFunction":
         merged = dict(self._options)
@@ -45,16 +55,18 @@ class RemoteFunction:
 
     def _export(self) -> str:
         core = get_core()
-        key = self._fn_key_cache.get(id(core))
+        token = getattr(core, "core_token", None) or id(core)
+        key = self._fn_key_cache.get(token)
         if key is None:
             blob = serialization.dumps_inline(self._fn)
             key = core.export_function(blob)
-            self._fn_key_cache = {id(core): key}
+            self._fn_key_cache = {token: key}
         return key
 
-    def remote(self, *args, **kwargs):
-        core = get_core()
-        opts = dict(self._options)
+    def _build_spec_opts(self) -> Dict[str, Any]:
+        """Options are immutable per handle (.options() returns a new
+        RemoteFunction), so resolve them ONCE instead of per call."""
+        opts = self._options
         spec_opts = {
             "num_returns": opts.get("num_returns", 1),
             "resources": _build_resources(opts),
@@ -70,10 +82,31 @@ class RemoteFunction:
                 "get(ref) returns the generator) is not supported; use "
                 "num_returns='streaming', whose .remote() returns the "
                 "ObjectRefGenerator directly")
-        refs = core.submit_task(self._export(), args, kwargs, spec_opts)
-        if spec_opts["num_returns"] == "streaming":
+        return spec_opts
+
+    def remote(self, *args, **kwargs):
+        core = get_core()
+        spec_opts = self._spec_opts
+        if spec_opts is None:
+            spec_opts = self._spec_opts = self._build_spec_opts()
+        num_returns = spec_opts["num_returns"]
+        # cached spec template (in-cluster cores only; the remote-client
+        # core ships opts over the wire and templates on the server side)
+        if hasattr(core, "submit_task_template"):
+            # keyed by core GENERATION, not id(core): a re-init can
+            # allocate the new core at the freed core's address, and a
+            # stale template would ship a dead owner_addr
+            token = core.core_token
+            tmpl = self._tmpl_cache.get(token)
+            if tmpl is None:
+                tmpl = core.make_task_template(self._export(), spec_opts)
+                self._tmpl_cache = {token: tmpl}
+            refs = core.submit_task_template(tmpl, args, kwargs)
+        else:
+            refs = core.submit_task(self._export(), args, kwargs, spec_opts)
+        if num_returns == "streaming":
             return refs  # an ObjectRefGenerator
-        if spec_opts["num_returns"] == 1:
+        if num_returns == 1:
             return refs[0]
         return refs
 

@@ -37,6 +37,18 @@ class RuntimeConfig:
     # ray_config_def.h:873). Format: "Method=max_failures:req_prob:resp_prob".
     testing_rpc_failure: str = ""
 
+    # --- control-plane submission hot path (owner→nodelet/worker) ---
+    # Batched submission: .remote() calls stage into an MPSC queue and a
+    # whole burst registers + ships on ONE io-loop wakeup (False restores
+    # the per-call call_soon_threadsafe hop).
+    submit_batch_enabled: bool = True
+    # Max specs registered per drain pass: bounds how long one drain can
+    # hold the io loop under a very large staged burst.
+    submit_batch_max: int = 1024
+    # Drain delay in seconds. 0 drains on the next loop pass (lowest
+    # latency); >0 trades per-call latency for larger coalesced bursts.
+    submit_drain_interval_s: float = 0.0
+
     # --- health / liveness (ref: gcs_health_check_manager.cc cadence flags
     # ray_config_def.h:879-885) ---
     heartbeat_interval_s: float = 1.0

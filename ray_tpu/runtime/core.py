@@ -36,6 +36,12 @@ from .rpc import EventLoopThread, RpcClient, RpcServer, ConnectionLost, RemoteHa
 
 _core_lock = threading.Lock()
 _global_core: Optional["CoreWorker"] = None
+# monotonically increasing core generation — handle-side template/key
+# caches key on this instead of id(core), which CPython can reuse for a
+# NEW core allocated at a freed core's address after re-init
+import itertools as _itertools
+
+_core_counter = _itertools.count(1)
 
 
 def get_core(required: bool = True) -> Optional["CoreWorker"]:
@@ -210,6 +216,10 @@ class CoreWorker:
                  worker_id: Optional[WorkerID] = None,
                  job_id: Optional[JobID] = None):
         self.mode = mode  # "driver" | "worker"
+        # cache key across re-inits AND processes: pid-qualified so a
+        # pickled handle landing in a worker can never hit a same-valued
+        # token from the driver's process
+        self.core_token = (os.getpid(), next(_core_counter))
         self.session_name = session_name
         self.session_dir = session_dir
         self.controller_addr = controller_addr
@@ -240,6 +250,12 @@ class CoreWorker:
         self.memory_store: Dict[ObjectID, Any] = {}
         self._events: Dict[ObjectID, asyncio.Event] = {}
         self._sync_waiters: Dict[ObjectID, list] = {}
+        # guards memory_store-resolve + _sync_waiters handoff so sync
+        # callers can arm waiters WITHOUT bridging to the io loop.
+        # RLock: the guarded sections allocate, so a cyclic-GC pass can
+        # fire ObjectRef.__del__ → _delete_object INSIDE them on the
+        # same thread — a plain Lock would self-deadlock there
+        self._sync_lock = threading.RLock()
         self.pending_tasks: Dict[TaskID, _PendingTask] = {}
         self.local_refs: Dict[ObjectID, int] = {}
         self.owned: set = set()  # ObjectIDs owned by this process
@@ -270,6 +286,18 @@ class CoreWorker:
         self._server: Optional[RpcServer] = None
         self._task_events: List[dict] = []
         self._pubsub_handlers: Dict[str, list] = {}
+        # batched submission: .remote() calls stage here (MPSC) and one
+        # io-loop wakeup registers + ships the whole burst in FIFO order
+        # (ref: the owner-side submit queue in normal_task_submitter.cc —
+        # one loop pass drains a burst instead of one hop per task)
+        cfg = get_config()
+        self._staged: collections.deque = collections.deque()
+        self._stage_armed = False
+        self._stage_lock = threading.Lock()
+        self._submit_batch_enabled = cfg.submit_batch_enabled
+        self._submit_batch_max = max(1, cfg.submit_batch_max)
+        self._submit_drain_interval = cfg.submit_drain_interval_s
+        self._loop = None  # io loop, cached at start()
 
     # ------------------------------------------------------------ lifecycle
     def start(self, extra_handlers: Optional[dict] = None):
@@ -293,6 +321,7 @@ class CoreWorker:
         # handler table serves both the server and that push channel
         self.nodelet.notify_handlers.update(handlers)
         self._server = RpcServer(self.address, handlers)
+        self._loop = EventLoopThread.get().loop
         EventLoopThread.get().run(self._server.start())
         self.address = self._server.address  # ephemeral tcp port resolved
         EventLoopThread.get().spawn(self._metrics_flush_loop())
@@ -439,7 +468,20 @@ class CoreWorker:
                 EventLoopThread.get().run(self._server.stop(), timeout=5)
         except Exception:
             pass
-        for c in self._clients.values():
+        # staged/fire-and-forget frames (task results, stream
+        # terminators) must reach the socket before close — a frame
+        # dropped here hangs the owner's get()/generator forever.
+        # Concurrent: one slow/dead peer costs ~2s total, not 2s each.
+        clients = list(self._clients.values())
+        if clients:
+            try:
+                EventLoopThread.get().run(
+                    asyncio.gather(*(c.drain_async(2.0) for c in clients),
+                                   return_exceptions=True),
+                    timeout=4.0)
+            except Exception:
+                pass
+        for c in clients:
             c.close()
         self.controller.close()
         self.nodelet.close()
@@ -578,56 +620,101 @@ class CoreWorker:
             return
         self._pending_delete.discard(oid)
         self.owned.discard(oid)
-        self.memory_store.pop(oid, None)
+        with self._sync_lock:
+            value = self.memory_store.pop(oid, _MISSING)
+            # wake stranded sync waiters; they will observe the loss
+            waiters = self._sync_waiters.pop(oid, ())
+            wake = []
+            for sw in waiters:
+                sw[0] -= 1
+                if sw[0] <= 0:
+                    wake.append(sw)
+        for sw in wake:
+            sw[1].set()
         self._events.pop(oid, None)
         self.lineage.pop(oid, None)
         self._replica_dirs.pop(oid, None)
+        if value is not _MISSING and value is not _IN_SHM \
+                and not isinstance(value, _RemoteShm):
+            # plain inline value: the bytes never touched the shm store
+            # in this process, so skip the store delete — on the
+            # per-task ref-release hot path store.delete costs a pool
+            # lookup plus a spill-unlink syscall per object
+            return
         if oid in self._stream_pins:
             self._stream_pins.discard(oid)
             try:
                 self.store.unpin(oid)
             except Exception:
                 pass
-        # wake stranded sync waiters; they will observe the loss
-        for sw in self._sync_waiters.pop(oid, ()):
-            sw[0] -= 1
-            if sw[0] <= 0:
-                sw[1].set()
         self.store.delete(oid)
 
     # ------------------------------------------------------------ events
     def _event(self, oid: ObjectID) -> asyncio.Event:
+        # setdefault: submit paths create events eagerly from the CALLER
+        # thread (so a sync get() can arm before the staged registration
+        # drains on the loop) — racing creators must converge on one Event
         ev = self._events.get(oid)
         if ev is None:
-            ev = asyncio.Event()
-            self._events[oid] = ev
+            ev = self._events.setdefault(oid, asyncio.Event())
         return ev
 
     def _resolve(self, oid: ObjectID, value: Any):
-        self.memory_store[oid] = value
+        # runs on the io loop; the lock orders the store-write +
+        # waiter-pop against sync callers arming off-loop (a waiter that
+        # missed the memory_store check must be observed here)
+        with self._sync_lock:
+            self.memory_store[oid] = value
+            waiters = self._sync_waiters.pop(oid, ())
+            wake = []
+            for sw in waiters:
+                sw[0] -= 1
+                if sw[0] <= 0:
+                    wake.append(sw)
         ev = self._events.get(oid)
         if ev is not None:
             ev.set()
-        for sw in self._sync_waiters.pop(oid, ()):
-            sw[0] -= 1
-            if sw[0] <= 0:
-                sw[1].set()
+        for sw in wake:
+            sw[1].set()
 
     def _arm_sync_wait(self, oids, sw):
-        """Runs on the io loop: count refs still unresolved and subscribe
-        the sync waiter (a [count, threading.Event] pair) to them."""
-        for oid in oids:
-            if oid in self.memory_store:
-                sw[0] -= 1
-            else:
-                self._sync_waiters.setdefault(oid, []).append(sw)
-                ev = self._events.get(oid)
-                if (ev is None or ev.is_set()) and oid in self.owned:
-                    # resolved once, then evicted: no producer will set
-                    # this again — reconstruct via lineage
-                    asyncio.ensure_future(self._recover_and_resolve(oid))
+        """Callable from ANY thread (no io-loop hop — this is the sync
+        get() fast path): count refs still unresolved and subscribe the
+        sync waiter (a [count, threading.Event] pair) to them."""
+        recover = []
+        with self._sync_lock:
+            for oid in oids:
+                if oid in self.memory_store:
+                    sw[0] -= 1
+                else:
+                    self._sync_waiters.setdefault(oid, []).append(sw)
+                    ev = self._events.get(oid)
+                    if (ev is None or ev.is_set()) and oid in self.owned:
+                        # resolved once, then evicted: no producer will
+                        # set this again — reconstruct via lineage.
+                        # (Freshly-submitted refs never land here: their
+                        # events are created eagerly at submit time.)
+                        recover.append(oid)
         if sw[0] <= 0:
             sw[1].set()
+        for oid in recover:
+            self._spawn_threadsafe(self._recover_and_resolve(oid))
+
+    def _spawn_threadsafe(self, coro):
+        """ensure_future on the CORE's io loop from any thread — the
+        caller may itself be inside some other running loop (a user
+        calling a sync get() from their own async code), so identity
+        matters, not merely 'a loop is running'."""
+        loop = self._loop or EventLoopThread.get().loop
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            asyncio.ensure_future(coro)
+        else:
+            loop.call_soon_threadsafe(
+                lambda c=coro: asyncio.ensure_future(c))
 
     async def _recover_and_resolve(self, oid: ObjectID):
         try:
@@ -920,16 +1007,20 @@ class CoreWorker:
         self._pulls.pop(oid, None)
 
     def _disarm_sync_wait(self, sw):
-        empty = []
-        for oid, waiters in self._sync_waiters.items():
-            try:
-                waiters.remove(sw)
-            except ValueError:
-                pass
-            if not waiters:
-                empty.append(oid)
-        for oid in empty:
-            del self._sync_waiters[oid]
+        # callable from any thread (timeout path of a sync get()); a
+        # GC-triggered reentrant _delete_object may pop entries mid-walk,
+        # so iterate a snapshot and pop leniently
+        with self._sync_lock:
+            empty = []
+            for oid, waiters in list(self._sync_waiters.items()):
+                try:
+                    waiters.remove(sw)
+                except ValueError:
+                    pass
+                if not waiters:
+                    empty.append(oid)
+            for oid in empty:
+                self._sync_waiters.pop(oid, None)
 
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
@@ -958,7 +1049,9 @@ class CoreWorker:
             values.append(v)
         if values is None:
             # locally-owned pending refs (results of our own tasks): wait on
-            # a plain threading.Event set by _resolve — one wakeup, no
+            # a plain threading.Event set by _resolve — armed DIRECTLY from
+            # this thread under _sync_lock (no io-loop bridge at all), so a
+            # blocking sync get() costs one cross-thread wakeup and zero
             # coroutine scaffolding. Anything borrowed needs the async
             # owner-fetch machinery.
             owned = self.owned
@@ -966,10 +1059,9 @@ class CoreWorker:
                    or r.id() in owned for r in refs):
                 missing = [r.id() for r in refs if r.id() not in ms]
                 sw = [len(missing), threading.Event()]
-                loop = EventLoopThread.get().loop
-                loop.call_soon_threadsafe(self._arm_sync_wait, missing, sw)
+                self._arm_sync_wait(missing, sw)
                 if not sw[1].wait(timeout):
-                    loop.call_soon_threadsafe(self._disarm_sync_wait, sw)
+                    self._disarm_sync_wait(sw)
                     raise exceptions.GetTimeoutError(
                         "get() timed out waiting for "
                         + ", ".join(o.hex() for o in missing
@@ -1085,31 +1177,52 @@ class CoreWorker:
         arg_refs.append(ObjectRef(oid, owner_addr=self.address))
         return {"args_oid": oid.binary(), "args_owner": self.address}
 
-    def submit_task(self, fn_key: str, args: tuple, kwargs: dict,
-                    opts: Dict[str, Any]) -> List[ObjectRef]:
-        task_id = TaskID.from_random()
-        num_returns = opts.get("num_returns", 1)
-        streaming = num_returns in ("streaming", "dynamic")
-        return_ids = [] if streaming else [
-            ObjectID.for_task_return(task_id, i)
-            for i in range(num_returns)]
-        arg_refs = _collect_refs(args, kwargs)
-        spec = {
+    def make_task_template(self, fn_key: str,
+                           opts: Dict[str, Any]) -> Dict[str, Any]:
+        """Pre-build the invariant TaskSpecification fields for a remote
+        function ONCE per handle (ref: the reference's cached TaskSpec
+        builder — common/task/task_spec.h: the owner re-stamps only the
+        per-call fields). Each call then pays one dict copy plus
+        task_id/args instead of rebuilding ~15 fields. The returned
+        template is shared across calls: treat it as immutable —
+        submit_task_template copies it per call."""
+        from .runtime_env import env_key as _env_key
+
+        return {
             "type": "task",
-            "task_id": task_id.binary(),
             "fn_key": fn_key,
             "name": opts.get("name", ""),
-            "num_returns": num_returns,
+            "num_returns": opts.get("num_returns", 1),
             "resources": opts.get("resources") or {"CPU": 1},
             "owner_addr": self.address,
             "caller_id": self.worker_id.hex(),
-            "max_retries": opts.get("max_retries", get_config().default_max_retries),
+            "max_retries": opts.get("max_retries",
+                                    get_config().default_max_retries),
             "retry_exceptions": opts.get("retry_exceptions", False),
             "placement_group_id": opts.get("placement_group_id"),
             "bundle_index": opts.get("bundle_index", -1),
             "scheduling_strategy": opts.get("scheduling_strategy"),
             "runtime_env": opts.get("runtime_env"),
+            # precomputed so the nodelet skips its per-task env_key()
+            "_env_key": _env_key(opts.get("runtime_env")),
         }
+
+    def submit_task(self, fn_key: str, args: tuple, kwargs: dict,
+                    opts: Dict[str, Any]) -> List[ObjectRef]:
+        return self.submit_task_template(
+            self.make_task_template(fn_key, opts), args, kwargs)
+
+    def submit_task_template(self, tmpl: Dict[str, Any], args: tuple,
+                             kwargs: dict) -> List[ObjectRef]:
+        task_id = TaskID.from_random()
+        num_returns = tmpl["num_returns"]
+        streaming = num_returns in ("streaming", "dynamic")
+        return_ids = [] if streaming else [
+            ObjectID.for_task_return(task_id, i)
+            for i in range(num_returns)]
+        arg_refs = _collect_refs(args, kwargs)
+        spec = dict(tmpl)
+        spec["task_id"] = task_id.binary()
         from ..util import tracing
 
         if tracing.is_enabled():
@@ -1122,27 +1235,145 @@ class CoreWorker:
         spec.update(self._pack_args(args, kwargs, arg_refs))
         for oid in return_ids:
             self.owned.add(oid)
-            # create events eagerly on the io loop so get() can wait
-        loop = EventLoopThread.get().loop
-        loop.call_soon_threadsafe(self._register_and_submit, task_id, spec,
-                                  return_ids, arg_refs)
+            # create events eagerly ON THIS THREAD: a sync get() may arm
+            # its waiter before the staged registration drains on the loop
+            self._event(oid)
+        self._stage_submit(("task", task_id, spec, return_ids, arg_refs,
+                            None))
         self._record_event(task_id, spec["name"], "SUBMITTED")
         if streaming:
             return ObjectRefGenerator(task_id, self)
         return [ObjectRef(oid, owner_addr=self.address) for oid in return_ids]
+
+    # ---------------------------------------------- batched submission
+    def _stage_submit(self, entry):
+        """MPSC staging queue (the tentpole's batched-submission path):
+        .remote() calls append here from any thread and ONE io-loop
+        wakeup registers + ships the whole burst in FIFO order — the
+        per-call call_soon_threadsafe hop was a top control-plane cost at
+        fine-grained task rates. submit_batch_enabled=False restores the
+        legacy per-call hop."""
+        if not self._submit_batch_enabled:
+            kind, task_id, spec, return_ids, arg_refs, actor_id = entry
+            loop = self._loop or EventLoopThread.get().loop
+            if kind == "task":
+                loop.call_soon_threadsafe(
+                    self._register_and_submit, task_id, spec, return_ids,
+                    arg_refs)
+            else:
+                loop.call_soon_threadsafe(
+                    self._register_and_send_actor, task_id, spec,
+                    return_ids, arg_refs, actor_id)
+            return
+        self._staged.append(entry)
+        with self._stage_lock:
+            if self._stage_armed:
+                return
+            self._stage_armed = True
+        loop = self._loop or EventLoopThread.get().loop
+        if self._submit_drain_interval > 0:
+            loop.call_soon_threadsafe(self._arm_delayed_drain)
+        else:
+            loop.call_soon_threadsafe(self._drain_staged)
+
+    def _arm_delayed_drain(self):
+        (self._loop or EventLoopThread.get().loop).call_later(
+            self._submit_drain_interval, self._drain_staged)
+
+    def _drain_staged(self):
+        """Io-loop drain of the staging queue: registers every staged
+        submission, coalesces consecutive plain tasks into ONE
+        submit_task_batch frame, and starts actor sends in staging order
+        (per-connection FIFO — and therefore actor `seq` order and
+        cancel-after-submit — is preserved because registration and send
+        scheduling happen in queue order within one loop pass)."""
+        # disarm BEFORE popping: a producer appending after the pop loop
+        # finishes observes the flag down and re-arms
+        with self._stage_lock:
+            self._stage_armed = False
+        staged = self._staged
+        task_specs = []
+        n = 0
+        while n < self._submit_batch_max:
+            try:
+                kind, task_id, spec, return_ids, arg_refs, actor_id = \
+                    staged.popleft()
+            except IndexError:
+                break
+            n += 1
+            self._register_pending(task_id, spec, return_ids, arg_refs)
+            if kind == "task":
+                task_specs.append(spec)
+            else:
+                if task_specs:
+                    # flush so global staging order also holds across
+                    # the task/actor interleave
+                    asyncio.ensure_future(
+                        self._submit_batch_to_nodelet(task_specs))
+                    task_specs = []
+                asyncio.ensure_future(self._send_actor_task(actor_id, spec))
+        if task_specs:
+            asyncio.ensure_future(self._submit_batch_to_nodelet(task_specs))
+        if staged:
+            # past the per-pass cap: keep the loop responsive, drain the
+            # rest on the next pass
+            with self._stage_lock:
+                if not self._stage_armed:
+                    self._stage_armed = True
+                    (self._loop or EventLoopThread.get().loop).call_soon(
+                        self._drain_staged)
+
+    def _flush_staged(self):
+        """Synchronously land staged submissions on the loop — cancel()
+        must observe its target in pending_tasks before it can route the
+        cancel, so a cancel can never overtake its own submit."""
+        if not self._staged:
+            return
+        try:
+            EventLoopThread.get().run(self._drain_staged_async())
+        except Exception:
+            pass
+
+    def _drain_staged_fully(self):
+        """Drain (on the loop) everything staged at ENTRY. Bounded:
+        entries appended concurrently belong to later submissions and
+        re-arm their own drain wakeup — an unbounded `while self._staged`
+        here would let a producer hot-loop starve the io loop, freezing
+        cancel()/heartbeats/result handling for as long as the producers
+        keep pace. FIFO means the first len(_staged) pops are exactly
+        the pre-entry entries, which is all the ordering invariant
+        (cancel/kill never overtakes its submit) requires."""
+        passes = -(-len(self._staged) // self._submit_batch_max)
+        for _ in range(passes):
+            if not self._staged:
+                break
+            self._drain_staged()
+
+    async def _drain_staged_async(self):
+        self._drain_staged_fully()
 
     def _register_and_submit(self, task_id, spec, return_ids, arg_refs):
         self._register_pending(task_id, spec, return_ids, arg_refs)
         asyncio.ensure_future(self._submit_to_nodelet(spec))
 
     async def _submit_to_nodelet(self, spec):
+        await self._submit_batch_to_nodelet([spec])
+
+    async def _submit_batch_to_nodelet(self, specs):
         # one-way (no per-task ack round-trip), but a submit-path failure
-        # must still fail the pending task instead of hanging its refs
+        # must still fail the pending tasks instead of hanging their refs
         try:
-            await self.nodelet.notify_async("submit_task", spec=spec)
+            if len(specs) == 1:
+                await self.nodelet.notify_async("submit_task",
+                                                spec=specs[0])
+            else:
+                await self.nodelet.notify_async("submit_task_batch",
+                                                specs=specs)
         except Exception as e:
-            await self._h_task_result(spec["task_id"], "system_error",
-                                      error=f"task submission failed: {e}")
+            for spec in specs:
+                await self._h_task_result(
+                    spec["task_id"], "system_error",
+                    error=f"task submission failed: {e}")
 
     def _register_pending(self, task_id, spec, return_ids, arg_refs):
         self.pending_tasks[task_id] = _PendingTask(
@@ -1226,18 +1457,16 @@ class CoreWorker:
     def _wait_stream_item(self, oid: ObjectID):
         """Block until a stream slot resolves; returns the RAW memory-
         store entry (may be _END_OF_STREAM / _IN_SHM / an exception —
-        the generator decides, get() materializes)."""
-
+        the generator decides, get() materializes). Uses the same
+        loop-free sync waiter as get(): one threading.Event per blocked
+        item instead of a run_coroutine_threadsafe round trip."""
         v = self.memory_store.get(oid, _MISSING)
         if v is not _MISSING:
             return v
-
-        async def _wait():
-            if oid not in self.memory_store:
-                await self._event(oid).wait()
-            return self.memory_store.get(oid)
-
-        return EventLoopThread.get().run(_wait())
+        sw = [1, threading.Event()]
+        self._arm_sync_wait([oid], sw)
+        sw[1].wait()
+        return self.memory_store.get(oid)
 
     # handler: executing worker pushed results to us (the owner)
     async def _h_task_result(self, task_id: bytes, status: str, results=None,
@@ -1578,36 +1807,51 @@ class CoreWorker:
                     actor_id, info.get("death_cause") or "actor is dead")
             await asyncio.sleep(0.02)  # RESTARTING: brief yield, re-park
 
+    def make_actor_template(self, actor_id: str, method: str,
+                            opts: Dict[str, Any]) -> Dict[str, Any]:
+        """Invariant spec fields per (actor handle, method) — the direct
+        actor transport's cached call header (ref: transport/
+        actor_task_submitter.cc — the submitter caches the resolved
+        connection and per-call deltas are task id, seq and args).
+        Shared across calls: treat as immutable."""
+        return {
+            "type": "actor_call",
+            "actor_id": actor_id,
+            "method": method,
+            "name": f"{actor_id[:8]}.{method}",
+            "num_returns": opts.get("num_returns", 1),
+            "owner_addr": self.address,
+            "caller_id": self.worker_id.hex(),
+            "max_retries": 0,
+            "concurrency_group": opts.get("concurrency_group"),
+        }
+
     def submit_actor_task(self, actor_id: str, method: str, args: tuple,
                           kwargs: dict, opts: Dict[str, Any]) -> List[ObjectRef]:
+        return self.submit_actor_task_template(
+            self.make_actor_template(actor_id, method, opts), args, kwargs)
+
+    def submit_actor_task_template(self, tmpl: Dict[str, Any], args: tuple,
+                                   kwargs: dict) -> List[ObjectRef]:
+        actor_id = tmpl["actor_id"]
         task_id = TaskID.from_random()
-        num_returns = opts.get("num_returns", 1)
+        num_returns = tmpl["num_returns"]
         streaming = num_returns in ("streaming", "dynamic")
         return_ids = [] if streaming else [
             ObjectID.for_task_return(task_id, i)
             for i in range(num_returns)]
         seq = self._actor_seq.get(actor_id, 0)
         self._actor_seq[actor_id] = seq + 1
-        spec = {
-            "type": "actor_call",
-            "task_id": task_id.binary(),
-            "actor_id": actor_id,
-            "method": method,
-            "name": f"{actor_id[:8]}.{method}",
-            "num_returns": num_returns,
-            "owner_addr": self.address,
-            "caller_id": self.worker_id.hex(),
-            "seq": seq,
-            "max_retries": 0,
-            "concurrency_group": opts.get("concurrency_group"),
-        }
+        spec = dict(tmpl)
+        spec["task_id"] = task_id.binary()
+        spec["seq"] = seq
         arg_refs = _collect_refs(args, kwargs)
         spec.update(self._pack_args(args, kwargs, arg_refs))
         for oid in return_ids:
             self.owned.add(oid)
-        loop = EventLoopThread.get().loop
-        loop.call_soon_threadsafe(self._register_and_send_actor, task_id,
-                                  spec, return_ids, arg_refs, actor_id)
+            self._event(oid)  # eager: sync get() may arm before the drain
+        self._stage_submit(("actor", task_id, spec, return_ids, arg_refs,
+                            actor_id))
         if streaming:
             return ObjectRefGenerator(task_id, self)
         return [ObjectRef(oid, owner_addr=self.address) for oid in return_ids]
@@ -1697,6 +1941,10 @@ class CoreWorker:
             pass
 
     def _release_actor_handle(self, actor_id: str):
+        # staged calls must count as in-flight before the drain decision
+        # (a >0 submit_drain_interval could otherwise let the kill
+        # overtake calls still sitting in the staging queue)
+        self._drain_staged_fully()
         if self._actor_inflight.get(actor_id):
             self._kill_when_drained.add(actor_id)
         else:
@@ -1711,6 +1959,10 @@ class CoreWorker:
 
     # ------------------------------------------------------------ misc
     def cancel(self, ref: ObjectRef, force: bool = False):
+        # staged-but-undrained submissions must register first: the
+        # cancel below routes through pending_tasks, and per-connection
+        # FIFO then guarantees the cancel frame follows the submit frame
+        self._flush_staged()
         # find the producing task; streaming tasks have no pre-declared
         # return ids, so match by the deterministic slot derivation
         for tid, pending in list(self.pending_tasks.items()):

@@ -17,13 +17,14 @@ synchronous callers bridge onto it with run_coroutine_threadsafe.
 from __future__ import annotations
 
 import asyncio
+import collections
 import itertools
 import os
 import random
 import struct
 import threading
 import traceback
-from typing import Any, Awaitable, Callable, Dict, Optional
+from typing import Any, Awaitable, Callable, Dict, List, Optional
 
 from . import serialization
 
@@ -81,6 +82,15 @@ def _get_chaos() -> _Chaos:
 
         _chaos = _Chaos(get_config().testing_rpc_failure)
     return _chaos
+
+
+def chaos_should_drop(method: str) -> bool:
+    """Consult the chaos rules for `method` outside the dispatch layer.
+    Batched endpoints (submit_task_batch) use this to apply the
+    PER-LOGICAL-REQUEST rules of the method they aggregate, so
+    fault-tolerance tests keyed on e.g. "submit_task" keep exercising
+    real drops on the coalesced fast path."""
+    return _get_chaos().should_drop_request(method)
 
 
 # --------------------------------------------------------------------------
@@ -211,7 +221,7 @@ class ServerConn:
         self.meta: Dict[str, Any] = {}  # handlers can stash identity here
 
     async def send(self, msg_tuple) -> None:
-        payload = serialization.dumps_inline(msg_tuple)
+        payload = serialization.dumps_frame(msg_tuple)
         async with self.wlock:
             if self.closed:
                 raise ConnectionLost("connection closed")
@@ -417,6 +427,11 @@ class RpcClient:
         # one-way frames awaiting the coalesced flush (notify_async)
         self._wbuf: List[bytes] = []
         self._wbuf_fut: Optional[asyncio.Future] = None
+        # MPSC staging for fire-and-forget sends from non-loop threads:
+        # a burst rides ONE call_soon_threadsafe wakeup (see notify_nowait)
+        self._nowait_buf: "collections.deque" = collections.deque()
+        self._nowait_armed = False
+        self._nowait_lock = threading.Lock()
 
     def _local_server(self) -> Optional["RpcServer"]:
         return _local_servers.get(self.address)
@@ -528,7 +543,7 @@ class RpcClient:
         msg_id = next(self._ids)
         fut = asyncio.get_event_loop().create_future()
         self._pending[msg_id] = fut
-        payload = serialization.dumps_inline((REQ, msg_id, method, kwargs))
+        payload = serialization.dumps_frame((REQ, msg_id, method, kwargs))
         if self._wbuf:
             # flush coalesced one-way frames enqueued earlier on this
             # connection BEFORE the request frame: a request overtaking a
@@ -559,7 +574,7 @@ class RpcClient:
         # terminator, actor-call order) is preserved; the shared flush
         # future propagates write failures to every caller in the batch,
         # keeping retry-on-stale-address semantics intact.
-        payload = _frame(serialization.dumps_inline((NTF, method, kwargs)))
+        payload = _frame(serialization.dumps_frame((NTF, method, kwargs)))
         self._wbuf.append(payload)
         if self._wbuf_fut is None:
             loop = asyncio.get_event_loop()
@@ -607,12 +622,35 @@ class RpcClient:
         """Fire-and-forget from ANY thread: schedules the send on the io
         loop without waiting for it (the hot-path result/ack sends —
         blocking an executor thread ~200us per send just to learn the
-        bytes left the socket buys nothing)."""
+        bytes left the socket buys nothing).
+
+        Off-loop sends STAGE into an MPSC buffer drained once per loop
+        wakeup: a burst of task_result/task_done pushes from an executor
+        thread costs one call_soon_threadsafe instead of one per send,
+        and the staged order is the send order, so per-connection FIFO
+        (streaming items + terminator) is preserved."""
         elt = EventLoopThread.get()
         if threading.current_thread() is elt.thread:
             self._spawn_notify(method, kwargs)
-        else:
-            elt.loop.call_soon_threadsafe(self._spawn_notify, method, kwargs)
+            return
+        self._nowait_buf.append((method, kwargs))
+        with self._nowait_lock:
+            if self._nowait_armed:
+                return
+            self._nowait_armed = True
+        elt.loop.call_soon_threadsafe(self._drain_nowait)
+
+    def _drain_nowait(self):
+        # disarm BEFORE popping: a producer that appends after the pop
+        # loop finished will observe the flag down and re-arm
+        with self._nowait_lock:
+            self._nowait_armed = False
+        while True:
+            try:
+                method, kwargs = self._nowait_buf.popleft()
+            except IndexError:
+                return
+            self._spawn_notify(method, kwargs)
 
     def _spawn_notify(self, method: str, kwargs: dict):
         # counted at ENQUEUE (synchronously on the loop): a drain that
@@ -649,6 +687,45 @@ class RpcClient:
         except Exception:
             traceback.print_exc()
 
+    def queued_nowait(self) -> int:
+        """Approximate count of fire-and-forget sends not yet on the
+        socket (staged + in flight). Producers use it as a high-water
+        check to fall back to blocking sends instead of growing the
+        staging buffer without bound."""
+        return len(self._nowait_buf) + self._inflight_notifies
+
+    async def drain_async(self, timeout: float = 2.0):
+        """Runs on the io loop: spawn any frames still staged in the
+        nowait buffer, then wait (bounded) until every in-flight
+        fire-and-forget send has been handed to the socket. The single
+        shared implementation behind drain() and close_when_drained().
+        Concurrent drainers share one idle event — replacing it would
+        strand the earlier waiter for its full timeout."""
+        if self._nowait_buf:
+            self._drain_nowait()
+        if self._inflight_notifies > 0:
+            ev = self._idle_event
+            if ev is None or ev.is_set():
+                ev = self._idle_event = asyncio.Event()
+            try:
+                await asyncio.wait_for(ev.wait(), timeout)
+            except asyncio.TimeoutError:
+                pass
+
+    def drain(self, timeout: float = 2.0):
+        """Block the calling (non-loop) thread until every queued
+        fire-and-forget send — staged or in flight — has been handed to
+        the socket, or `timeout` elapses. Exit paths use this before
+        close(): a result/terminator frame still staged at close would
+        hang the owner's get() forever."""
+        elt = EventLoopThread.get()
+        if threading.current_thread() is elt.thread:
+            return  # cannot block the loop; staged frames drain in-pass
+        try:
+            elt.run(self.drain_async(timeout), timeout=timeout + 1.0)
+        except Exception:
+            pass
+
     def close_when_drained(self, timeout: float = 10.0):
         """Close once every queued fire-and-forget notify has been sent
         (or after `timeout`). A plain close() between notify_nowait() and
@@ -657,12 +734,7 @@ class RpcClient:
         result, and the owner's get() hangs forever."""
 
         async def _drain_then_close():
-            if self._inflight_notifies > 0:
-                self._idle_event = asyncio.Event()
-                try:
-                    await asyncio.wait_for(self._idle_event.wait(), timeout)
-                except asyncio.TimeoutError:
-                    pass
+            await self.drain_async(timeout)
             self.close()
 
         elt = EventLoopThread.get()

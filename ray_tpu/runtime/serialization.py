@@ -61,8 +61,28 @@ def deserialize(meta: bytes, buffers: Sequence[Any]) -> Any:
 
 
 def dumps_inline(value: Any) -> bytes:
-    """Single-buffer pickle for small inline payloads (RPC args, messages)."""
+    """Single-buffer pickle for small inline payloads (RPC args, messages).
+
+    cloudpickle: the payload may contain user objects that only pickle
+    by VALUE (functions/classes defined in ``__main__``) — plain pickle
+    would serialize those by reference and the receiving process could
+    never resolve them."""
     return cloudpickle.dumps(value, protocol=5)
+
+
+def dumps_frame(value: Any) -> bytes:
+    """Protocol-frame pickle for the RPC envelope: ``(kind, msg_id,
+    method, kwargs)`` tuples whose leaves are plain data — specs, result
+    descriptors, and user payloads that the layer above ALREADY reduced
+    to bytes with :func:`dumps_inline`. The C pickler is several times
+    faster than cloudpickle's reducer-override machinery on these small
+    structures, and every control-plane message pays this cost; the
+    cloudpickle fallback covers the rare envelope that smuggles a
+    by-value-only object."""
+    try:
+        return pickle.dumps(value, protocol=5)
+    except Exception:  # noqa: BLE001 — any pickling failure falls back
+        return cloudpickle.dumps(value, protocol=5)
 
 
 def loads_inline(data: bytes) -> Any:

@@ -246,7 +246,8 @@ class TCPBackend(StoreBackend):
             self.client.close_when_drained(timeout=drain_s)
             return
         deadline = time.time() + drain_s
-        while (getattr(self.client, "_inflight_notifies", 0) > 0
+        while ((getattr(self.client, "_inflight_notifies", 0) > 0
+                or len(getattr(self.client, "_nowait_buf", ()) or ()) > 0)
                and time.time() < deadline):
             time.sleep(0.01)
         # last chance for recorded losses: synchronous, so a clean

@@ -226,17 +226,30 @@ class Executor:
         for value in gen:
             self._send_stream_item(spec, index, value)
             index += 1
-        owner.notify("task_result", task_id=spec["task_id"], status="ok",
-                     results=[], stream_len=index)
+        # nowait like the items: staged per-client in call order, so the
+        # terminator can never overtake an item on the owner connection
+        owner.notify_nowait("task_result", task_id=spec["task_id"],
+                            status="ok", results=[], stream_len=index)
 
     def _send_stream_item(self, spec: dict, index: int, value: Any) -> None:
         task_id = TaskID(spec["task_id"])
         owner = self.core.client_for(spec["owner_addr"])
         sv = serialization.serialize(value)
         if sv.total_size() <= get_config().max_direct_call_object_size:
-            owner.notify("task_stream_item", task_id=spec["task_id"],
-                         index=index, kind="inline",
-                         payload=serialization.dumps_inline(value))
+            # fire-and-forget: item frames stage per-client in call order
+            # (FIFO with the terminator) and a burst of yields rides one
+            # io-loop wakeup instead of one blocking bridge per item.
+            # Past the high-water mark, block on one send: per-connection
+            # FIFO then drains everything queued ahead, so a producer
+            # outrunning a slow consumer can't grow the buffer unbounded.
+            if owner.queued_nowait() > 256:
+                owner.notify("task_stream_item", task_id=spec["task_id"],
+                             index=index, kind="inline",
+                             payload=serialization.dumps_inline(value))
+                return
+            owner.notify_nowait("task_stream_item", task_id=spec["task_id"],
+                                index=index, kind="inline",
+                                payload=serialization.dumps_inline(value))
         else:
             oid = ObjectID.for_task_return(task_id, index)
             size = self.core.store.put_serialized(oid, sv)
@@ -245,11 +258,11 @@ class Executor:
                     "object_sealed", oid=oid.binary(), size=size)
             except Exception:
                 pass
-            owner.notify("task_stream_item", task_id=spec["task_id"],
-                         index=index, kind="shm",
-                         payload={"host": self.core.host_id,
-                                  "node_addr": self.core.nodelet_addr,
-                                  "size": size})
+            owner.notify_nowait("task_stream_item", task_id=spec["task_id"],
+                                index=index, kind="shm",
+                                payload={"host": self.core.host_id,
+                                         "node_addr": self.core.nodelet_addr,
+                                         "size": size})
 
     def _send_results(self, spec: dict, result: Any) -> bool:
         """Returns True if the combined task_done frame (result + worker
@@ -465,9 +478,11 @@ class Executor:
                             None, self._send_stream_item, spec, index, item)
                         index += 1
                     owner = self.core.client_for(spec["owner_addr"])
-                    await loop.run_in_executor(None, lambda: owner.notify(
+                    # nowait: staged after the items on the same client,
+                    # and non-blocking so no executor hop is needed
+                    owner.notify_nowait(
                         "task_result", task_id=spec["task_id"],
-                        status="ok", results=[], stream_len=index))
+                        status="ok", results=[], stream_len=index)
                     self._maybe_drain_exit()
                     return
                 result = await method(*args, **kwargs)

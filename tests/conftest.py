@@ -37,6 +37,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "transfer: bulk data-plane (cross-host object "
         "transfer) tests")
+    config.addinivalue_line(
+        "markers", "perf: microbench-style smoke tests (timing-sensitive; "
+        "also marked slow so tier-1 stays within budget)")
 
 
 @pytest.fixture

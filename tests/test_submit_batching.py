@@ -1,0 +1,374 @@
+"""Control-plane hot path: spec templates, batched submission, sync
+fast paths.
+
+Covers the ordering invariants the batched owner→nodelet/worker
+submission pipeline must preserve (per-connection FIFO, monotonic actor
+`seq`, cancel-after-submit, streaming item order) and that chaos
+injection (testing_rpc_failure) still fires on the coalesced fast
+paths. Ref: the reference's in-order actor scheduling queue
+(transport/actor_scheduling_queue.cc) and rpc_chaos.cc.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+from ray_tpu.actor import ActorMethod
+from ray_tpu.runtime import rpc as rpc_mod
+from ray_tpu.runtime.config import get_config
+from ray_tpu.runtime.core import get_core
+from ray_tpu.runtime.ids import ObjectID, TaskID
+
+
+@ray_tpu.remote
+def nop():
+    return 0
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+class Recorder:
+    def __init__(self):
+        self.calls = []
+
+    def record(self, i):
+        self.calls.append(i)
+        return i
+
+    def snapshot(self):
+        return list(self.calls)
+
+
+# --------------------------------------------------------------- rpc layer
+def test_rpc_wbuf_preserves_fifo(tmp_path):
+    """Coalesced one-way frames and a trailing request leave the socket
+    in enqueue order: a request must never overtake a buffered notify
+    (cancel-vs-submit FIFO at the transport level)."""
+    got = []
+    addr = f"unix:{tmp_path}/fifo.sock"
+    server = rpc_mod.RpcServer(addr, {
+        "note": lambda i: got.append(("n", i)),
+        "ask": lambda i: (got.append(("c", i)), "ok")[1],
+    })
+    elt = rpc_mod.EventLoopThread.get()
+    elt.run(server.start())
+    # force the SOCKET path: the in-process registry would short-circuit
+    rpc_mod._local_servers.pop(addr, None)
+    client = rpc_mod.RpcClient(addr)
+    try:
+        async def burst():
+            futs = [asyncio.ensure_future(client.notify_async("note", i=i))
+                    for i in range(50)]
+            futs.append(
+                asyncio.ensure_future(client.call_async("ask", i=50)))
+            await asyncio.gather(*futs)
+
+        elt.run(burst(), timeout=30)
+        # the reply to "ask" orders after every coalesced notify
+        assert got == [("n", i) for i in range(50)] + [("c", 50)]
+    finally:
+        client.close()
+        elt.run(server.stop())
+
+
+def test_notify_nowait_staging_preserves_order(tmp_path):
+    """Off-loop notify_nowait bursts drain in call order (worker-side
+    result/stream coalescing relies on this)."""
+    got = []
+    addr = f"unix:{tmp_path}/nowait.sock"
+    server = rpc_mod.RpcServer(addr, {"note": lambda i: got.append(i)})
+    elt = rpc_mod.EventLoopThread.get()
+    elt.run(server.start())
+    rpc_mod._local_servers.pop(addr, None)
+    client = rpc_mod.RpcClient(addr)
+    try:
+        for i in range(100):
+            client.notify_nowait("note", i=i)
+        deadline = time.monotonic() + 10
+        while len(got) < 100 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert got == list(range(100))
+    finally:
+        client.close()
+        elt.run(server.stop())
+
+
+# ------------------------------------------------------------- task FIFO
+def test_batched_submission_task_fifo(shared_cluster):
+    """A burst of plain tasks arrives at the nodelet in submission order
+    whether it rides submit_task or coalesced submit_task_batch frames."""
+    core = get_core()
+    server = rpc_mod._local_servers.get(core.nodelet.address)
+    assert server is not None, "single-host session runs the nodelet in-process"
+    got = []
+    orig_single = server.handlers["submit_task"]
+    orig_batch = server.handlers["submit_task_batch"]
+
+    async def rec_single(spec):
+        got.append(spec["task_id"])
+        return await orig_single(spec)
+
+    async def rec_batch(specs):
+        got.extend(s["task_id"] for s in specs)
+        return await orig_batch(specs)
+
+    server.handlers["submit_task"] = rec_single
+    server.handlers["submit_task_batch"] = rec_batch
+    try:
+        refs = [nop.remote() for _ in range(60)]
+        assert ray_tpu.get(refs, timeout=120) == [0] * 60
+    finally:
+        server.handlers["submit_task"] = orig_single
+        server.handlers["submit_task_batch"] = orig_batch
+    arrived = [ObjectID.for_task_return(TaskID(t), 0) for t in got[-60:]]
+    assert arrived == [r.id() for r in refs]
+
+
+def test_actor_burst_seq_monotonic_fifo(shared_cluster):
+    """A burst of actor calls leaves the owner transport with
+    monotonically increasing `seq` in submission order, and executes at
+    the worker in that order."""
+    rec = Recorder.remote()
+    assert ray_tpu.get(rec.record.remote(-1), timeout=120) == -1
+    core = get_core()
+    addr = core._actor_addr[rec._actor_id]
+    client = core._clients[addr]
+    seqs = []
+    orig = client.notify_async
+
+    async def spy(method, **kwargs):
+        if method == "actor_call":
+            seqs.append(kwargs["spec"]["seq"])
+        return await orig(method, **kwargs)
+
+    client.notify_async = spy
+    try:
+        refs = [rec.record.remote(i) for i in range(60)]
+        assert ray_tpu.get(refs, timeout=120) == list(range(60))
+    finally:
+        client.notify_async = orig
+    assert len(seqs) == 60
+    assert seqs == list(range(seqs[0], seqs[0] + 60))
+    # worker-side execution order matches submission order
+    calls = ray_tpu.get(rec.snapshot.remote(), timeout=60)
+    assert calls == [-1] + list(range(60))
+
+
+def test_streaming_order_across_staged_queue(shared_cluster):
+    """A streaming generator's items (and its terminator) never reorder
+    while plain-task submissions interleave through the staging queue."""
+
+    @ray_tpu.remote
+    def stream_n(n):
+        for i in range(n):
+            yield i
+
+    stream = stream_n.options(num_returns="streaming").remote(80)
+    vals = []
+    for i, ref in enumerate(stream):
+        vals.append(ray_tpu.get(ref, timeout=120))
+        if i % 10 == 0:
+            nop.remote()  # interleave staged submissions mid-stream
+    assert vals == list(range(80))
+
+
+def test_cancel_never_overtakes_submit(shared_cluster):
+    """cancel() lands AFTER its target's submit even when the submit is
+    still in the staging queue: nothing hangs, the burst completes, and
+    the victim is either cancelled or already ran — never lost."""
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(0.3)
+        return 1
+
+    refs = [slow.remote() for _ in range(10)]
+    victim = refs[-1]
+    # core-level cancel: True means the victim was FOUND in
+    # pending_tasks — i.e. the staged submit drained before the cancel
+    # routed, the invariant under test
+    assert get_core().cancel(victim) is True
+    done = 0
+    cancelled = 0
+    for r in refs:
+        try:
+            assert ray_tpu.get(r, timeout=120) == 1
+            done += 1
+        except exceptions.TaskCancelledError:
+            cancelled += 1
+    assert done + cancelled == 10
+    assert done >= 9  # only the victim may be cancelled
+
+
+# ----------------------------------------------------------------- chaos
+def test_chaos_drops_apply_to_batched_submissions(shared_cluster):
+    """testing_rpc_failure rules keyed on submit_task drop individual
+    specs on the coalesced path too (in-process _call_local route): with
+    a budget of 2 certain drops, exactly 2 of 6 submissions vanish."""
+    cfg = get_config()
+    saved = cfg.testing_rpc_failure
+    cfg.testing_rpc_failure = "submit_task=2:1.0:0.0"
+    rpc_mod._chaos = None  # re-parse from config
+    try:
+        refs = [nop.remote() for _ in range(6)]
+        ready, not_ready = ray_tpu.wait(refs, num_returns=6, timeout=8)
+        assert len(not_ready) == 2, (len(ready), len(not_ready))
+        assert ray_tpu.get(ready, timeout=60) == [0] * len(ready)
+    finally:
+        cfg.testing_rpc_failure = saved
+        rpc_mod._chaos = None
+
+
+def test_chaos_drops_batch_frames_over_socket(tmp_path):
+    """The submit_task_batch endpoint itself stays chaos-injectable on
+    the socket dispatch path (rule keyed on the batch method)."""
+    cfg = get_config()
+    saved = cfg.testing_rpc_failure
+    cfg.testing_rpc_failure = "probe=2:1.0:0.0"
+    rpc_mod._chaos = None
+    addr = f"unix:{tmp_path}/chaos2.sock"
+    server = rpc_mod.RpcServer(addr, {"probe": lambda: "ok"})
+    elt = rpc_mod.EventLoopThread.get()
+    client = None
+    try:
+        elt.run(server.start())
+        rpc_mod._local_servers.pop(addr, None)
+        client = rpc_mod.RpcClient(addr)
+        failures, result = 0, None
+        for _ in range(6):
+            try:
+                result = client.call("probe", _timeout=1)
+                break
+            except Exception:
+                failures += 1
+        assert failures == 2
+        assert result == "ok"
+    finally:
+        if client is not None:
+            client.close()
+        elt.run(server.stop())
+        cfg.testing_rpc_failure = saved
+        rpc_mod._chaos = None
+
+
+# ------------------------------------------------------------- templates
+def test_spec_template_cached_and_options_respected(shared_cluster):
+    core = get_core()
+    token = core.core_token
+    r1 = add.remote(1, 2)
+    tmpl = add._tmpl_cache.get(token)
+    assert tmpl is not None
+    r2 = add.remote(3, 4)
+    assert add._tmpl_cache.get(token) is tmpl  # reused across calls
+    assert ray_tpu.get([r1, r2], timeout=120) == [3, 7]
+    # .options() derives a NEW handle with its own template
+    named = add.options(name="custom_add")
+    r3 = named.remote(5, 5)
+    assert named._tmpl_cache.get(token) is not tmpl
+    assert named._tmpl_cache[token]["name"] == "custom_add"
+    assert ray_tpu.get(r3, timeout=120) == 10
+    # the shared template never accumulates per-call fields
+    assert "task_id" not in tmpl and "args_inline" not in tmpl \
+        and "args_oid" not in tmpl
+
+
+def test_nested_submission_after_template_warmup(shared_cluster):
+    """A RemoteFunction captured in another task's closure ships WITHOUT
+    its core-bound template: the executing worker must stamp its OWN
+    owner_addr (regression: a warmed driver template shipped by value
+    made the inner task's result push target the driver, hanging the
+    worker's get())."""
+    ray_tpu.get(add.remote(0, 0), timeout=60)  # warm the driver template
+    core = get_core()
+    assert add._tmpl_cache.get(core.core_token) is not None
+
+    @ray_tpu.remote
+    def outer():
+        return ray_tpu.get(add.remote(3, 4), timeout=60)
+
+    assert ray_tpu.get(outer.remote(), timeout=90) == 7
+
+
+def test_actor_method_handle_cache(shared_cluster):
+    rec = Recorder.remote()
+    m1 = rec.record
+    m2 = rec.record
+    # methods are transient (a cached ActorMethod would close a
+    # handle<->method ref cycle and defer the owning handle's __del__
+    # fate-sharing kill), but they SHARE the handle-held template cache
+    assert m1 is not m2
+    assert m1._tmpl_cache is m2._tmpl_cache
+    assert ray_tpu.get(m1.remote(7), timeout=120) == 7
+    core = get_core()
+    assert m1._tmpl_cache.get(core.core_token)["method"] == "record"
+    assert rec.record._tmpl_cache.get(core.core_token)["method"] == "record"
+    # the handle itself must stay acyclic: no ActorMethod in __dict__
+    assert all(not isinstance(v, ActorMethod)
+               for v in rec.__dict__.values())
+
+
+@pytest.mark.slow
+def test_batching_disabled_fallback():
+    # slow-marked: tears down + re-creates a session (~15s on a loaded box)
+    """submit_batch_enabled=False restores the per-call hop; semantics
+    are identical."""
+    cfg = get_config()
+    saved = cfg.submit_batch_enabled
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cfg.submit_batch_enabled = False
+    try:
+        ray_tpu.init(num_cpus=2)
+        assert not get_core()._submit_batch_enabled
+        assert ray_tpu.get([add.remote(i, 1) for i in range(20)],
+                           timeout=120) == [i + 1 for i in range(20)]
+        rec = Recorder.remote()
+        assert ray_tpu.get([rec.record.remote(i) for i in range(10)],
+                           timeout=120) == list(range(10))
+    finally:
+        cfg.submit_batch_enabled = saved
+        ray_tpu.shutdown()
+
+
+@pytest.mark.slow
+def test_streaming_order_past_backpressure_high_water(shared_cluster):
+    """A stream longer than the producer's 256-frame high-water mark
+    (where _send_stream_item falls back to blocking sends) still
+    delivers every item and the terminator in order."""
+
+    @ray_tpu.remote
+    class Burst:
+        def burst(self, n):
+            for i in range(n):
+                yield i
+
+    b = Burst.remote()
+    stream = b.burst.options(num_returns="streaming").remote(400)
+    vals = [ray_tpu.get(r, timeout=180) for r in stream]
+    assert vals == list(range(400))
+
+
+# ------------------------------------------------------------ perf smoke
+@pytest.mark.perf
+@pytest.mark.slow
+def test_submit_throughput_smoke(shared_cluster):
+    """Microbench-style sanity: a 200-task burst and a 100-call sync
+    actor loop complete inside a very loose budget (catches a hot-path
+    regression that turns batching into per-call stalls)."""
+    ray_tpu.get(nop.remote(), timeout=120)  # warm a worker
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range(200)], timeout=120)
+    assert time.perf_counter() - t0 < 60
+    rec = Recorder.remote()
+    ray_tpu.get(rec.record.remote(0), timeout=120)
+    t0 = time.perf_counter()
+    for i in range(100):
+        ray_tpu.get(rec.record.remote(i), timeout=120)
+    assert time.perf_counter() - t0 < 60
