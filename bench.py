@@ -268,6 +268,33 @@ def bench_transfer() -> dict:
     raise RuntimeError(f"transfer bench produced no JSON: {out.stderr[-300:]}")
 
 
+def bench_pd_handoff() -> dict:
+    """Prefill→decode KV handoff on the simulated two-host setup
+    (benchmarks/pd_handoff.py): bulk-plane descriptor pull
+    (`kv_handoff_gb_s`) vs the om_read RPC fallback
+    (`kv_handoff_gb_s_rpc`), plus the tiny in-process PD pair's
+    `pd_ttft_ms` with its queue/prefill/handoff breakdown. Runs on the
+    CPU backend in a subprocess so the engines never touch this
+    process's TPU tunnel."""
+    import os
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               JAX_PLATFORMS="", JAX_PLATFORM_NAME="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(here, "benchmarks", "pd_handoff.py"),
+         "--size-mb", "16", "--pulls", "3"],
+        capture_output=True, text=True, timeout=600, cwd=here, env=env)
+    for line in reversed(out.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(
+        f"pd_handoff produced no JSON: {out.stderr[-300:]}")
+
+
 def bench_train(on_tpu: bool) -> dict:
     import jax
     import jax.numpy as jnp
@@ -387,6 +414,17 @@ def main():
                     transfer["object_pull_gb_s"]
         except Exception as e:  # noqa: BLE001
             result["detail"]["transfer"] = {"error": repr(e)[:200]}
+
+    # 6. KV-cache plane: prefill→decode handoff GB/s (bulk vs RPC) +
+    # tiny-PD TTFT breakdown (pd_handoff keys), same time guard
+    if time.perf_counter() - start < 460:
+        try:
+            pd = bench_pd_handoff()
+            result["detail"]["pd_handoff"] = pd
+            if "kv_handoff_gb_s" in pd:
+                result["detail"]["kv_handoff_gb_s"] = pd["kv_handoff_gb_s"]
+        except Exception as e:  # noqa: BLE001
+            result["detail"]["pd_handoff"] = {"error": repr(e)[:200]}
     print(json.dumps(result))
 
 

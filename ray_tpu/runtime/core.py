@@ -732,13 +732,18 @@ class CoreWorker:
         return client
 
     # ------------------------------------------------------------ put / get
-    def put(self, value: Any) -> ObjectRef:
+    def put(self, value: Any, *, force_pool: bool = False) -> ObjectRef:
+        """force_pool skips the small-value inline branch: the object
+        lands in the shm pool whatever its size, so remote readers pull
+        it over the bulk data plane instead of an RPC payload (the KV
+        handoff plane seals blobs this way)."""
         oid = ObjectID.for_put()
         sv = serialization.serialize(value)
         self.owned.add(oid)
         # fresh oid: no waiter can exist yet, so a plain (GIL-atomic) dict
         # set is enough — no io-loop bounce on the put hot path
-        if sv.total_size() <= get_config().max_direct_call_object_size:
+        if (not force_pool and sv.total_size()
+                <= get_config().max_direct_call_object_size):
             self.memory_store[oid] = value
         else:
             self.store.put_serialized(oid, sv)

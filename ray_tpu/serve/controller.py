@@ -42,6 +42,11 @@ class _ReplicaState:
 
 REPLICA_STARTUP_TIMEOUT_S = 600.0
 
+# cluster prefix-cache registry: poll cadence for replica frontiers and
+# the staleness TTL past which an entry stops influencing routing
+KV_POLL_INTERVAL_S = 1.0
+KV_REGISTRY_TTL_S = 15.0
+
 
 class _DeploymentState:
     def __init__(self, app_name: str, spec_blob: bytes, config):
@@ -56,6 +61,10 @@ class _DeploymentState:
         # autoscaling smoothing state
         self._scale_up_since: Optional[float] = None
         self._scale_down_since: Optional[float] = None
+        # prefix-cache registry polling state: None = unknown (probe),
+        # False = replicas expose no KV frontier (stop probing)
+        self._kv_enabled: Optional[bool] = None
+        self._kv_next_poll = 0.0
 
 
 class ServeControllerActor:
@@ -71,6 +80,11 @@ class ServeControllerActor:
         self._http = (http_host, http_port)
         self._reconcile_wakeup = asyncio.Event()
         self._stop_tasks: set = set()
+        # cluster prefix-cache registry (KV plane): (app, deployment) ->
+        # {replica actor_id: {hashes, rev, page_size, ts}}; fed by the
+        # reconcile loop's frontier polls (or kv_registry_publish pushes)
+        # and served to routers via kv_registry_get
+        self._kv_registry: Dict[tuple, Dict[str, dict]] = {}
 
     # ------------------------------------------------------------- deploy
 
@@ -120,8 +134,9 @@ class ServeControllerActor:
         states = self._apps.pop(app_name, {})
         self._ingress.pop(app_name, None)
         self._route_prefixes.pop(app_name, None)
-        for state in states.values():
+        for name, state in states.items():
             self._stop_all_replicas(state)
+            self._kv_registry.pop((app_name, name), None)
 
     async def shutdown(self) -> None:
         self._running = False
@@ -155,6 +170,7 @@ class ServeControllerActor:
             for state in list(states.values()):
                 await self._autoscale(state)
                 await self._health_check(state)
+                await self._kv_poll(state)
                 # Scale up
                 while len(state.replicas) < state.target_replicas:
                     self._start_replica(state)
@@ -309,6 +325,100 @@ class ServeControllerActor:
         except Exception:
             pass
         self._remove_replica_pg(rep)
+
+    async def _kv_poll(self, state: _DeploymentState) -> None:
+        """Poll ready replicas' KV prefix-cache frontiers into the
+        cluster registry (KV plane). Piggybacks on the reconcile loop so
+        publication is naturally batched (one snapshot per replica per
+        interval) and the registry TTLs on the poll timestamps. A
+        deployment whose replicas expose no frontier (ReplicaActor
+        kv_frontier -> None) is marked off after the first answer and
+        never polled again."""
+        if state._kv_enabled is False:
+            return
+        now = time.time()
+        if now < state._kv_next_poll:
+            return
+        state._kv_next_poll = now + KV_POLL_INTERVAL_S
+        reps = [rep for rep in state.replicas.values()
+                if rep.ready and rep.healthy]
+        if not reps:
+            return
+        key = (state.app_name, state.name)
+        entry = self._kv_registry.setdefault(key, {})
+        # send each replica the rev we already hold: an unchanged
+        # frontier answers WITHOUT its hash list (O(1) steady state)
+        futs = {}
+        for rep in reps:
+            aid = rep.handle.actor_id
+            prev = entry.get(aid)
+            futs[aid] = asyncio.wrap_future(rep.handle.kv_frontier.remote(
+                prev.get("rev") if prev else None).future())
+        await asyncio.wait(futs.values(), timeout=2.0)
+        answered, any_kv = False, False
+        for aid, fut in futs.items():
+            if not fut.done():
+                fut.cancel()
+                continue
+            if fut.exception() is not None:
+                continue
+            answered = True
+            snap = fut.result()
+            if not isinstance(snap, dict) or "rev" not in snap:
+                continue
+            any_kv = True
+            prev = entry.get(aid)
+            if "hashes" in snap:
+                entry[aid] = {"hashes": list(snap["hashes"]),
+                              "rev": snap.get("rev"),
+                              "page_size": snap.get("page_size"),
+                              "ts": now}
+            elif prev is not None and prev.get("rev") == snap.get("rev"):
+                prev["ts"] = now  # unchanged frontier: refresh TTL only
+            # hashes omitted with a rev we do not hold: stale protocol
+            # answer — drop it; the next poll sends rev=None and gets
+            # the full list
+        if state._kv_enabled is None and answered:
+            state._kv_enabled = any_kv
+        # prune replicas that left the deployment
+        live = {rep.handle.actor_id for rep in state.replicas.values()}
+        for aid in list(entry):
+            if aid not in live:
+                del entry[aid]
+        if not entry:
+            self._kv_registry.pop(key, None)
+
+    def kv_registry_publish(self, app_name: str, deployment_name: str,
+                            replica_actor_id: str, snapshot: dict) -> None:
+        """Push-side registry entry (tests / external publishers; the
+        normal path is the _kv_poll pull)."""
+        entry = self._kv_registry.setdefault(
+            (app_name, deployment_name), {})
+        entry[replica_actor_id] = {
+            "hashes": list(snapshot.get("hashes", ())),
+            "rev": snapshot.get("rev"),
+            "page_size": snapshot.get("page_size"),
+            "ts": time.time()}
+
+    def kv_registry_get(self, app_name: str,
+                        deployment_name: str) -> Optional[dict]:
+        """Router-facing registry view: {actor_id: [hashes]} with stale
+        (TTL-expired) entries pruned."""
+        entry = self._kv_registry.get((app_name, deployment_name))
+        if not entry:
+            return None
+        now = time.time()
+        for aid in list(entry):
+            if now - entry[aid]["ts"] > KV_REGISTRY_TTL_S:
+                del entry[aid]
+        if not entry:
+            return None
+        page_sizes = {e["page_size"] for e in entry.values()
+                      if e.get("page_size")}
+        return {
+            "replicas": {aid: e["hashes"] for aid, e in entry.items()},
+            "page_size": next(iter(page_sizes)) if page_sizes else None,
+        }
 
     async def _autoscale(self, state: _DeploymentState) -> None:
         cfg = state.config.autoscaling_config

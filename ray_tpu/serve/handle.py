@@ -34,6 +34,20 @@ _SUBMIT_POOL = concurrent.futures.ThreadPoolExecutor(
 # prefix-affinity gives way to load balance beyond this in-flight skew
 _PREFIX_IMBALANCE = 4
 
+# cache-aware routing metrics (lazy: util.metrics registers per-process)
+_kv_metrics = None
+
+
+def _get_kv_metrics():
+    global _kv_metrics
+    if _kv_metrics is None:
+        from ..util.metrics import Counter
+
+        _kv_metrics = Counter(
+            "rtpu_kv_router_requests_total",
+            "cache-aware router decisions", ("outcome",))
+    return _kv_metrics
+
 
 class DeploymentResponse:
     """Future-like result of handle.remote() (ref: serve/handle.py
@@ -104,6 +118,11 @@ class _Router:
         self.inflight: Dict[str, int] = {}  # actor_id -> count
         self.cond = threading.Condition()
         self._last_refresh = 0.0
+        # cluster prefix-cache registry view (controller-polled frontiers
+        # of each replica's PageAllocator): actor_id -> frozenset of
+        # chain hashes. Refreshed lazily, only for prefix-hash requests.
+        self.kv_replicas: Dict[str, frozenset] = {}
+        self._kv_last_refresh = 0.0
 
     def _controller(self):
         from ..actor import get_actor
@@ -145,16 +164,91 @@ class _Router:
                     f"after {timeout_s}s")
             time.sleep(0.1)
 
-    def pick(self, routing_key: Optional[str] = None) -> "Any":
+    def refresh_kv(self):
+        """Pull the deployment's prefix-cache registry view (replica
+        frontiers polled by the controller) when stale; at most every
+        0.5 s, and only ever on prefix-hash requests."""
+        import ray_tpu
+
+        if time.time() - self._kv_last_refresh < 0.5:
+            return
+        try:
+            table = ray_tpu.get(self._controller().kv_registry_get.remote(
+                self.app, self.deployment))
+        except Exception:  # registry is advisory: no table, no affinity
+            table = None
+        with self.cond:
+            self._kv_last_refresh = time.time()
+            self.kv_replicas = {
+                aid: frozenset(hashes)
+                for aid, hashes in ((table or {}).get("replicas")
+                                    or {}).items()}
+
+    def _pick_by_prefix(self, candidates, prefix_hashes):
+        """Longest-matched-prefix choice over the registry view, or None
+        when nothing matches. Ties break toward the less-loaded replica;
+        the winner still respects the imbalance guard + ongoing cap (the
+        caller falls back to least-outstanding on None)."""
+        best, best_depth = None, 0
+        for h in candidates:
+            cached = self.kv_replicas.get(h.actor_id)
+            if not cached:
+                continue
+            depth = 0
+            for ph in prefix_hashes:
+                if ph not in cached:
+                    break
+                depth += 1
+            if depth > best_depth or (
+                    depth == best_depth and depth > 0 and best is not None
+                    and self.inflight.get(h.actor_id, 0)
+                    < self.inflight.get(best.actor_id, 0)):
+                best, best_depth = h, depth
+        if best is None or best_depth == 0:
+            return None
+        load = self.inflight.get(best.actor_id, 0)
+        min_load = min(self.inflight.get(h.actor_id, 0)
+                       for h in candidates)
+        if (load - min_load <= _PREFIX_IMBALANCE
+                and (self.max_ongoing <= 0 or load < self.max_ongoing)):
+            return best
+        return None
+
+    def _claim(self, replica) -> bool:
+        """Under self.cond: claim an in-flight slot on `replica` unless
+        it sits at the ongoing cap."""
+        load = self.inflight.get(replica.actor_id, 0)
+        if self.max_ongoing <= 0 or load < self.max_ongoing:
+            self.inflight[replica.actor_id] = load + 1
+            return True
+        return False
+
+    def _wait_saturated(self, deadline: float) -> None:
+        """Under self.cond: block briefly for a completion, force a
+        routing-table re-pull, and enforce the pick deadline — the one
+        saturation behavior every routing policy shares."""
+        self.cond.wait(timeout=0.2)
+        self._last_refresh = 0.0
+        if time.time() > deadline:
+            raise TimeoutError("all replicas saturated for 120s")
+
+    def pick(self, routing_key: Optional[str] = None,
+             prefix_hashes: Optional[list] = None) -> "Any":
         """Power-of-two-choices over in-flight counts
-        (ref: pow_2_router.py:27). With a routing_key, prefer the
-        rendezvous-hash choice for that key (prefix-aware routing: requests
-        sharing a prompt prefix land on the replica whose KV prefix cache
-        already holds it; ref: request_router/prefix_aware/
-        prefix_aware_router.py) and fall back to pow-2 when saturated."""
+        (ref: pow_2_router.py:27). With prefix_hashes (the prompt's
+        page-chain hashes), prefer the replica whose PUBLISHED prefix
+        cache matches the longest prefix (cluster registry; ref:
+        request_router/prefix_aware/prefix_aware_router.py — here matched
+        against real frontiers, not locality heuristics), falling back to
+        least-outstanding-requests. With only a routing_key, prefer the
+        rendezvous-hash choice for that key. Both affinities yield to
+        load balance when the preferred replica is saturated."""
         deadline = time.time() + 120.0
+        kv_counted = False  # outcome metric: once per pick(), not per spin
         while True:
             self.refresh()
+            if prefix_hashes:
+                self.refresh_kv()
             with self.cond:
                 candidates = self.replicas
                 if not candidates:
@@ -163,6 +257,32 @@ class _Router:
                     self.cond.wait(timeout=0.2)
                     self._last_refresh = 0.0
                     continue
+                if prefix_hashes:
+                    best = self._pick_by_prefix(candidates, prefix_hashes)
+                    if best is not None and self._claim(best):
+                        if not kv_counted:
+                            _get_kv_metrics().inc(
+                                tags={"outcome": "prefix"})
+                        return best
+                    if not kv_counted:
+                        kv_counted = True
+                        _get_kv_metrics().inc(tags={"outcome": "fallback"})
+                    if routing_key is None:
+                        # no registry match and no string key (the PD
+                        # router's prefill leg): least-outstanding over
+                        # ALL replicas (not a 2-sample) — a cold replica
+                        # should take the new prefix and start caching it
+                        best = min(candidates,
+                                   key=lambda h: self.inflight.get(
+                                       h.actor_id, 0))
+                        if self._claim(best):
+                            return best
+                        self._wait_saturated(deadline)
+                        continue
+                    # registry miss WITH a routing_key (the ingress
+                    # path): fall through to the rendezvous affinity so
+                    # repeated prefixes stay sticky even while the
+                    # registry is empty/stale — the pre-registry policy
                 if routing_key is not None:
                     # rendezvous hashing: stable under replica changes AND
                     # across processes (hashlib, not salted builtin hash)
@@ -181,26 +301,17 @@ class _Router:
                     # reference's prefix router falls back on load, not
                     # only at the hard cap) and under its cap
                     if (pref_load - min_load <= _PREFIX_IMBALANCE
-                            and (self.max_ongoing <= 0
-                                 or pref_load < self.max_ongoing)):
-                        self.inflight[preferred.actor_id] = pref_load + 1
+                            and self._claim(preferred)):
                         return preferred
                     # imbalanced/saturated: fall through to pow-2
                 if len(candidates) > 2:
                     candidates = random.sample(candidates, 2)
                 best = min(candidates,
                            key=lambda h: self.inflight.get(h.actor_id, 0))
-                if (self.max_ongoing <= 0
-                        or self.inflight.get(best.actor_id, 0)
-                        < self.max_ongoing):
-                    self.inflight[best.actor_id] = (
-                        self.inflight.get(best.actor_id, 0) + 1)
+                if self._claim(best):
                     return best
-                # All replicas saturated: wait for a completion, then retry.
-                self.cond.wait(timeout=0.2)
-            self._last_refresh = 0.0  # force a table re-pull while queued
-            if time.time() > deadline:
-                raise TimeoutError("all replicas saturated for 120s")
+                # All replicas saturated: wait for a completion, retry.
+                self._wait_saturated(deadline)
 
     def release(self, actor_id: str):
         with self.cond:
@@ -222,11 +333,15 @@ class DeploymentHandle:
         self._method_name = method_name
         self._routing_key = routing_key
         self._model_id = model_id
+        # per-request page-chain hashes for cache-aware routing
+        # (ephemeral: set via options(prefix_hashes=...), not serialized)
+        self._prefix_hashes: Optional[list] = None
 
     _UNSET = object()
 
     def options(self, *, method_name: Optional[str] = None,
                 routing_key: Any = _UNSET,
+                prefix_hashes: Optional[list] = None,
                 multiplexed_model_id: Optional[str] = None,
                 **_ignored) -> "DeploymentHandle":
         handle = DeploymentHandle(
@@ -235,6 +350,9 @@ class DeploymentHandle:
             self._routing_key if routing_key is DeploymentHandle._UNSET
             else routing_key,
             self._model_id)
+        handle._prefix_hashes = (list(prefix_hashes)
+                                 if prefix_hashes is not None
+                                 else self._prefix_hashes)
         if multiplexed_model_id is not None:
             # the model id routes (affinity: reuse the replica that has the
             # model loaded, ref: serve multiplexed routing) AND travels
@@ -253,6 +371,7 @@ class DeploymentHandle:
         app, deployment = self.app_name, self.deployment_name
         method_name = self._method_name
         routing_key = self._routing_key
+        prefix_hashes = self._prefix_hashes
         model_id = self._model_id
         if model_id is not None:
             kwargs = {**kwargs, "_multiplexed_model_id": model_id}
@@ -265,7 +384,7 @@ class DeploymentHandle:
                 k: (v._to_object_ref() if isinstance(v, DeploymentResponse)
                     else v) for k, v in kwargs.items()}
             router = _Router.get(app, deployment)
-            replica = router.pick(routing_key)
+            replica = router.pick(routing_key, prefix_hashes)
             try:
                 ref = replica.handle_request.remote(method_name, resolved,
                                                     resolved_kw)

@@ -26,6 +26,12 @@ from .engine import (  # noqa: F401
     Request,
     SamplingParams,
 )
+from .kv_transfer import (  # noqa: F401
+    HandoffRegistry,
+    fetch_handoff,
+    prefix_chain_hashes,
+    seal_handoff,
+)
 from .server import (  # noqa: F401
     LLMConfig,
     LLMServer,
@@ -47,4 +53,6 @@ __all__ = [
     "HttpRequestProcessorConfig", "build_http_request_processor",
     "PrefillServer", "DecodeServer", "PDRouter", "build_pd_openai_app",
     "ServeSharding", "resolve_serve_mesh", "tp_bundles",
+    "seal_handoff", "fetch_handoff", "prefix_chain_hashes",
+    "HandoffRegistry",
 ]

@@ -12,6 +12,7 @@ writable tail page is always exclusively owned.
 from __future__ import annotations
 
 import collections
+import hashlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 
@@ -45,7 +46,12 @@ class PageAllocator:
         self._page_to_hash: Dict[int, int] = {}
         self._evictable: "collections.OrderedDict[int, None]" = \
             collections.OrderedDict()
+        # bumped whenever the set of cached hashes changes, so frontier
+        # publishers (the cluster prefix registry) can skip unchanged
+        # snapshots
+        self._rev = 0
         self.stats = {"allocated": 0, "cache_hits": 0, "evictions": 0,
+                      "prefix_token_lookups": 0, "prefix_token_hits": 0,
                       "shard_degree": self.shard_degree}
 
     # ------------------------------------------------------------ queries
@@ -56,7 +62,35 @@ class PageAllocator:
     @staticmethod
     def chain_hash(prev_hash: Optional[int],
                    tokens: Sequence[int]) -> int:
-        return hash((prev_hash, tuple(tokens)))
+        """Content-chained page hash, stable ACROSS processes (blake2b,
+        not the salted builtin hash): the cluster prefix registry matches
+        router-computed hashes against replica-published frontiers, so
+        every process must agree on the value for the same content."""
+        h = hashlib.blake2b(digest_size=8)
+        if prev_hash is None:
+            h.update(b"\x00")
+        else:
+            h.update(b"\x01")
+            h.update(prev_hash.to_bytes(8, "little"))
+        for t in tokens:
+            h.update(int(t).to_bytes(8, "little", signed=True))
+        return int.from_bytes(h.digest(), "little")
+
+    def frontier_snapshot(self) -> Dict[str, object]:
+        """Snapshot of the cached chain-hash set for the cluster prefix
+        registry. ``rev`` lets publishers/registries skip unchanged
+        payloads (batched publication)."""
+        return {"rev": self._rev, "hashes": list(self._hash_to_page)}
+
+    def note_prefix_lookup(self, n_tokens: int, n_hit: int) -> None:
+        """Account one admitted request's prefix-cache outcome (token
+        granularity — feeds the rtpu_kv_prefix_hit_rate gauge)."""
+        self.stats["prefix_token_lookups"] += int(n_tokens)
+        self.stats["prefix_token_hits"] += int(n_hit)
+
+    def prefix_hit_rate(self) -> float:
+        lookups = self.stats["prefix_token_lookups"]
+        return self.stats["prefix_token_hits"] / lookups if lookups else 0.0
 
     def match_prefix(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
         """Longest cached prefix of `tokens` in FULL pages. Returns
@@ -131,9 +165,11 @@ class PageAllocator:
             return h
         self._hash_to_page[h] = page
         self._page_to_hash[page] = h
+        self._rev += 1
         return h
 
     def _uncache(self, page: int) -> None:
         h = self._page_to_hash.pop(page, None)
         if h is not None and self._hash_to_page.get(h) == page:
             del self._hash_to_page[h]
+            self._rev += 1

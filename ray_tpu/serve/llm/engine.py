@@ -84,6 +84,8 @@ class Request:
     last_page_hash: Optional[int] = None
     n_hashed: int = 0            # tokens already entered into prefix cache
     arrival_t: float = dataclasses.field(default_factory=time.monotonic)
+    dispatched_t: Optional[float] = None  # first prefill dispatch (TTFT
+                                          # queue/prefill split)
     slot: int = -1               # decode slot while RUNNING
     planned_out: int = 0         # tokens dispatched (>= len(output_ids))
     decode_ready: bool = False   # prefill harvested; slot may decode
@@ -394,6 +396,7 @@ class LLMEngine:
             self.allocator.stats["cache_hits"] -= len(cached_pages)
             return None
         self.waiting.pop(0)
+        self.allocator.note_prefix_lookup(len(req.prompt_ids), n_cached)
         new_pages = self.allocator.allocate(need)
         req.pages = cached_pages + new_pages
         req.n_cached = n_cached
@@ -580,6 +583,10 @@ class LLMEngine:
             bt[i, :len(req.pages)] = req.pages
             total[i] = len(req.prompt_ids)
             gather[i] = n_new - 1
+        now = time.monotonic()
+        for req in group:
+            if req.dispatched_t is None:
+                req.dispatched_t = now
         cp = (self.max_pages_per_seq
               if any(req.n_cached for req in group) else 0)
         fn = self._jit("prefill", (sb, rb, cp))
@@ -755,6 +762,7 @@ class LLMEngine:
         req.n_hashed = 0
         req.planned_out = 0
         req.decode_ready = False
+        req.dispatched_t = None  # re-prefill measures its own queue wait
         req.state = WAITING
         self.waiting.insert(0, req)
 
@@ -868,12 +876,20 @@ class LLMEngine:
 
     def _gather_kv(self, req: Request) -> Dict[str, Any]:
         idx = np.asarray(req.pages, np.int32)
+        now = time.monotonic()
+        disp = req.dispatched_t if req.dispatched_t is not None \
+            else req.arrival_t
         return {
             # [L, n_pages, Hkv, page, 2*D] — page axis 1 in the combined
             # page-major layout; both disagg engines must agree on it
             "kv": np.asarray(self.kv_pages[:, idx]),
             "prompt_ids": list(req.prompt_ids),
             "output_ids": list(req.output_ids),
+            # TTFT split for the disagg router: time queued before the
+            # prefill dispatch vs prefill compute (handoff cost is the
+            # caller's to measure — it happens after this gather)
+            "queued_s": max(0.0, disp - req.arrival_t),
+            "prefill_s": max(0.0, now - disp),
         }
 
     def extract_kv(self, request_id: str) -> Dict[str, Any]:

@@ -1,0 +1,234 @@
+"""Distributed KV-cache plane: bulk-plane prefill→decode KV handoff.
+
+The disaggregated serving path used to hand the entire KV blob from
+PrefillServer to DecodeServer as a pickled host-numpy dict riding actor-call
+RPCs — through the PDRouter, so the dense pages crossed the control plane
+TWICE (prefill→router result, router→decode argument). This module is the
+data-plane replacement (ref: Mooncake-style KV-centric disaggregation;
+vLLM's KV connector contract):
+
+- ``seal_handoff``: the prefill side seals the extracted KV pages into the
+  LOCAL shared-memory object store (always the pool, never the inline
+  memory store — the pool is what the bulk stream serves ``sendfile`` from)
+  and returns a small descriptor: object ref + layout metadata + timing.
+  Only the descriptor crosses the control RPC.
+- ``fetch_handoff``: the decode side resolves the descriptor through the
+  runtime's normal object path — same host: direct mmap of the shared
+  pool; cross-host: ``core.pull_manager`` chunk streams striped across the
+  advertised replicas, with the ``om_read`` RPC fallback behind the
+  existing ``bulk_transfer_enabled`` knob. The returned blob feeds
+  ``LLMEngine.inject_request`` unchanged.
+- ``HandoffRegistry``: TTL'd ref pinning on the prefill side so a decode
+  caller that dies between seal and pull can never leak dense KV on a
+  long-lived replica (mirrors the engine's ``extracted_ttl_s`` contract).
+- ``prefix_chain_hashes``: the router-side half of the cluster prefix
+  registry — the page-chain hashes of a prompt, computed with the same
+  process-stable hash the ``PageAllocator`` keys its prefix cache with, so
+  a router can match a prompt against frontiers replicas published.
+
+Metrics (``rtpu_kv_*``) flow through ``util/metrics.py`` into the normal
+worker→controller channel and the dashboard's ``/metrics`` exposition.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+# ---------------------------------------------------------------- metrics
+_metrics = None
+
+
+def _get_metrics():
+    global _metrics
+    if _metrics is None:
+        from ...util.metrics import Counter, Gauge, Histogram
+
+        _metrics = {
+            "handoff_bytes": Counter(
+                "rtpu_kv_handoff_bytes_total",
+                "KV bytes moved prefill→decode", ("path",)),
+            "seal_s": Histogram(
+                "rtpu_kv_handoff_seal_seconds",
+                "time to seal a KV blob into the local object store"),
+            "pull_s": Histogram(
+                "rtpu_kv_handoff_pull_seconds",
+                "time for the decode side to pull a sealed KV blob"),
+            "gb_s": Gauge(
+                "rtpu_kv_handoff_gb_s",
+                "throughput of the most recent KV handoff pull"),
+            "hit_rate": Gauge(
+                "rtpu_kv_prefix_hit_rate",
+                "fraction of prompt tokens served from this replica's "
+                "prefix cache"),
+            "ttft_queue_s": Histogram(
+                "rtpu_kv_ttft_queue_seconds",
+                "TTFT component: engine queue wait before prefill"),
+            "ttft_prefill_s": Histogram(
+                "rtpu_kv_ttft_prefill_seconds",
+                "TTFT component: prefill compute"),
+            "ttft_handoff_s": Histogram(
+                "rtpu_kv_ttft_handoff_seconds",
+                "TTFT component: KV seal + decode-side pull"),
+        }
+    return _metrics
+
+
+# ---------------------------------------------------- prefix chain hashes
+def prefix_chain_hashes(tokens: Sequence[int], page_size: int,
+                        limit_pages: Optional[int] = None) -> List[int]:
+    """Cumulative page-chain hashes of a prompt's FULL pages, matching
+    ``PageAllocator.match_prefix``'s walk (including its never-match-the-
+    whole-prompt rule). hashes[i] covers pages 0..i; a replica whose
+    published frontier contains hashes[i] holds that whole prefix."""
+    from .cache import PageAllocator
+
+    n = max(0, (len(tokens) - 1) // page_size)
+    if limit_pages is not None:
+        n = min(n, limit_pages)
+    hashes: List[int] = []
+    h: Optional[int] = None
+    for i in range(n):
+        h = PageAllocator.chain_hash(
+            h, tokens[i * page_size:(i + 1) * page_size])
+        hashes.append(h)
+    return hashes
+
+
+# ------------------------------------------------------- handoff registry
+class HandoffRegistry:
+    """TTL'd pin of sealed handoff refs on the prefill side.
+
+    The prefill worker OWNS the sealed object; holding the ref here keeps
+    it alive until the decode side pulls it. Entries drop after a TTL or
+    past a count cap so an abandoned handoff (decode caller died between
+    seal and pull) cannot leak dense KV on a long-lived replica; the
+    sweep also rides the controller's kv_frontier poll (EngineDriverMixin
+    calls evict() there), so an IDLE replica still releases its last
+    blobs on TTL. The cap is a burst backstop well above the router's
+    per-replica ongoing cap — cap eviction of a still-in-flight handoff
+    fails that request's pull, so it must never bind in normal traffic
+    (tune via LLMConfig.kv_handoff_cap / kv_handoff_ttl_s).
+
+    Thread-safe: seals run on executor threads while the serving
+    coroutines evict from the event-loop thread — racing unlocked evicts
+    could desync the order list from the entries and pin a ref forever."""
+
+    def __init__(self, ttl_s: float = 120.0, cap: int = 256):
+        import threading
+
+        self.ttl_s = ttl_s
+        self.cap = cap
+        self._lock = threading.Lock()
+        self._entries: Dict[str, tuple] = {}  # request_id -> (ref, ts)
+        self._order: List[str] = []
+
+    def add(self, request_id: str, ref: Any) -> None:
+        with self._lock:
+            self._entries[request_id] = (ref, time.monotonic())
+            self._order.append(request_id)
+            self._evict_locked()
+
+    def evict(self) -> None:
+        with self._lock:
+            self._evict_locked()
+
+    def _evict_locked(self) -> None:
+        now = time.monotonic()
+        while self._order:
+            rid = self._order[0]
+            entry = self._entries.get(rid)
+            if entry is None:
+                self._order.pop(0)
+                continue
+            if (len(self._order) > self.cap
+                    or now - entry[1] > self.ttl_s):
+                self._order.pop(0)
+                self._entries.pop(rid, None)
+            else:
+                break
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+# ------------------------------------------------------------ seal / pull
+def seal_handoff(blob: Dict[str, Any], *, registry: Optional[HandoffRegistry]
+                 = None, request_id: Optional[str] = None) -> Dict[str, Any]:
+    """Seal an extracted KV blob (from ``pop_extracted``/``extract_kv``)
+    into the local shm object store; returns the small handoff descriptor
+    that replaces the dense blob on the control RPC.
+
+    The KV always lands in the POOL (never the inline memory store,
+    whatever its size): the pool is what the bulk stream serves, so the
+    decode side's pull rides chunk streams cross-host and a bare mmap
+    same-host."""
+    from ...runtime.core import get_core
+
+    kv = np.ascontiguousarray(blob["kv"])
+    t0 = time.perf_counter()
+    ref = get_core().put(kv, force_pool=True)
+    seal_s = time.perf_counter() - t0
+    m = _get_metrics()
+    m["handoff_bytes"].inc(kv.nbytes, tags={"path": "store"})
+    m["seal_s"].observe(seal_s)
+    desc = {
+        "done": False,
+        "kv_ref": ref,
+        "kv_nbytes": int(kv.nbytes),
+        "prompt_ids": list(blob["prompt_ids"]),
+        "output_ids": list(blob["output_ids"]),
+        "queued_s": float(blob.get("queued_s", 0.0)),
+        "prefill_s": float(blob.get("prefill_s", 0.0)),
+        "seal_s": seal_s,
+    }
+    if registry is not None and request_id is not None:
+        registry.add(request_id, ref)
+    return desc
+
+
+def fetch_handoff(msg: Dict[str, Any], *,
+                  timeout_s: float = 60.0) -> Dict[str, Any]:
+    """Resolve a handoff message into an injectable blob.
+
+    Accepts both the descriptor form (``kv_ref``) and the legacy inline
+    form (``kv`` carried in the message itself — ``bulk_kv_handoff=False``
+    or pre-descriptor peers), so the plane is strictly additive. Blocking;
+    callers on an event loop run it in an executor."""
+    if "kv" in msg:
+        m = _get_metrics()
+        kv = np.asarray(msg["kv"])
+        m["handoff_bytes"].inc(kv.nbytes, tags={"path": "inline"})
+        out = dict(msg)
+        out.setdefault("pull_s", 0.0)
+        out.setdefault("kv_nbytes", int(kv.nbytes))
+        return out
+    import ray_tpu
+
+    t0 = time.perf_counter()
+    kv = ray_tpu.get(msg["kv_ref"], timeout=timeout_s)
+    pull_s = time.perf_counter() - t0
+    nbytes = int(msg.get("kv_nbytes") or kv.nbytes)
+    m = _get_metrics()
+    m["pull_s"].observe(pull_s)
+    if pull_s > 0:
+        m["gb_s"].set(nbytes / pull_s / 1e9)
+    return {
+        "kv": kv,
+        "prompt_ids": msg["prompt_ids"],
+        "output_ids": msg["output_ids"],
+        "pull_s": pull_s,
+        "kv_nbytes": nbytes,
+    }
+
+
+def observe_ttft(queue_s: float, prefill_s: float, handoff_s: float) -> None:
+    """Record the disagg TTFT breakdown histograms (PDRouter calls this
+    once per completed request)."""
+    m = _get_metrics()
+    m["ttft_queue_s"].observe(max(0.0, queue_s))
+    m["ttft_prefill_s"].observe(max(0.0, prefill_s))
+    m["ttft_handoff_s"].observe(max(0.0, handoff_s))

@@ -47,6 +47,22 @@ class LLMConfig:
     # guaranteed ICI-adjacent chips. Off by default — CPU smoke
     # deployments and single-chip replicas need no reservation.
     reserve_tpu_bundle: bool = False
+    # KV-cache plane (kv_transfer.py): prefill→decode handoff rides the
+    # bulk data plane (seal into the shm pool, ship only a descriptor on
+    # the control RPC, decode pulls over the chunk stream). False restores
+    # the legacy pickled-blob-in-RPC handoff.
+    bulk_kv_handoff: bool = True
+    # cache-aware routing: the ingress/PD router computes the prompt's
+    # page-chain hashes and routes to the replica whose published prefix
+    # frontier matches the longest prefix (cluster registry on the serve
+    # controller), falling back to least-outstanding-requests.
+    prefix_routing: bool = True
+    # sealed-handoff lifetime on the prefill side (HandoffRegistry): a
+    # blob the decode tier never pulls is released after the TTL; the cap
+    # is a burst backstop and must stay well above max_ongoing_requests
+    # (cap-evicting an in-flight handoff fails that request's pull)
+    kv_handoff_ttl_s: float = 120.0
+    kv_handoff_cap: int = 256
 
 
 class EngineDriverMixin:
@@ -95,6 +111,36 @@ class EngineDriverMixin:
             yield delta
             if delta.finished:
                 return
+
+    def engine_stats(self) -> Dict[str, Any]:
+        return self.engine.stats()
+
+    def kv_frontier(self,
+                    known_rev: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        """Prefix-cache frontier snapshot for the cluster registry: the
+        allocator's cached chain-hash set + rev, and the replica's
+        running prefix hit rate (published to the rtpu_kv_prefix_hit_rate
+        gauge). When the caller already holds `known_rev` and the
+        frontier has not changed, the hash list is omitted — the
+        steady-state poll ships O(1) bytes, not the whole cache."""
+        engine = getattr(self, "engine", None)
+        if engine is None:
+            return None
+        registry = getattr(self, "_handoffs", None)
+        if registry is not None:
+            # the controller polls this every second: a free TTL sweep,
+            # so an idle prefill replica still releases its sealed blobs
+            registry.evict()
+        from .kv_transfer import _get_metrics
+
+        rate = engine.allocator.prefix_hit_rate()
+        _get_metrics()["hit_rate"].set(rate)
+        snap = engine.allocator.frontier_snapshot()
+        out = {"page_size": engine.config.page_size,
+               "hit_rate": rate, "rev": snap["rev"]}
+        if known_rev is None or known_rev != snap["rev"]:
+            out["hashes"] = snap["hashes"]
+        return out
 
 
 @deployment
@@ -157,9 +203,6 @@ class LLMServer(EngineDriverMixin):
             "ttft_s": ttft,
         }
 
-    def engine_stats(self) -> Dict[str, Any]:
-        return self.engine.stats()
-
     async def check_health(self) -> bool:
         return True
 
@@ -178,10 +221,18 @@ class OpenAIIngress:
     /v1/completions, /v1/models (ref: llm/_internal/serve/deployments/
     routers/router.py)."""
 
-    def __init__(self, llm_handle, model_id: str = "default-llm"):
+    def __init__(self, llm_handle, model_id: str = "default-llm",
+                 llm_config: Optional[LLMConfig] = None):
         self.llm = llm_handle
         self.model_id = model_id
         self._ids = itertools.count()
+        # with the LLMConfig, the ingress tokenizes once and routes by
+        # the prompt's page-chain hashes against the cluster prefix
+        # registry (KV plane); without it, rendezvous string-prefix
+        # affinity is the fallback policy
+        self.config = llm_config
+        self._tokenizer = (get_tokenizer(llm_config.tokenizer)
+                           if llm_config is not None else None)
 
     async def __call__(self, request):
         path = request.path
@@ -199,15 +250,31 @@ class OpenAIIngress:
             return {"error": {"message": f"unknown path {path}",
                               "type": "invalid_request_error"}}
         # prefix-aware routing: requests sharing a prompt prefix hit the
-        # replica whose prefix cache already holds it
+        # replica whose prefix cache already holds it. Cache-aware when
+        # the registry has frontiers (longest matched page chain), string
+        # rendezvous affinity otherwise.
         prefix_key = prompt[:256]
-        out = await self.llm.options(
-            method_name="generate", routing_key=prefix_key).remote(
-            prompt,
+        call_kwargs = dict(
             max_tokens=int(body.get("max_tokens", 64)),
             temperature=float(body.get("temperature", 0.0)),
             seed=(int(body["seed"]) if body.get("seed") is not None
                   else None))
+        prefix_hashes = None
+        if (self._tokenizer is not None
+                and getattr(self.config, "prefix_routing", True)):
+            from .kv_transfer import prefix_chain_hashes
+
+            prompt_ids = self._tokenizer.encode(prompt)
+            prefix_hashes = prefix_chain_hashes(
+                prompt_ids, self.config.engine.page_size) or None
+            call_kwargs["prompt_ids"] = prompt_ids
+            out = await self.llm.options(
+                method_name="generate", routing_key=prefix_key,
+                prefix_hashes=prefix_hashes).remote(**call_kwargs)
+        else:
+            out = await self.llm.options(
+                method_name="generate", routing_key=prefix_key).remote(
+                prompt, **call_kwargs)
         created = int(time.time())
         if kind == "chat.completion":
             choice = {"index": 0, "finish_reason": out["finish_reason"],
@@ -250,4 +317,4 @@ def build_openai_app(llm_config: LLMConfig):
         **placement_options(llm_config),
     ).bind(llm_config)
     return OpenAIIngress.options(name="OpenAIIngress").bind(
-        server, llm_config.model_id)
+        server, llm_config.model_id, llm_config)

@@ -98,6 +98,24 @@ class ReplicaActor:
         return {"ongoing": self._ongoing, "total": self._total,
                 "uptime_s": time.time() - self._started_at}
 
+    async def kv_frontier(self, known_rev: Any = None
+                          ) -> Optional[Dict[str, Any]]:
+        """KV prefix-cache frontier of the hosted callable (None when the
+        deployment exposes none — the controller stops polling then).
+        `known_rev` is forwarded when the callable accepts it, letting it
+        omit the hash list for an unchanged frontier."""
+        fn = getattr(self._user_callable, "kv_frontier", None)
+        if fn is None:
+            return None
+        try:
+            takes_rev = bool(inspect.signature(fn).parameters)
+        except (TypeError, ValueError):
+            takes_rev = False
+        out = fn(known_rev) if takes_rev else fn()
+        if inspect.isawaitable(out):
+            out = await out
+        return out
+
     async def check_health(self) -> bool:
         fn = getattr(self._user_callable, "check_health", None)
         if fn is not None:

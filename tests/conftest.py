@@ -40,6 +40,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "perf: microbench-style smoke tests (timing-sensitive; "
         "also marked slow so tier-1 stays within budget)")
+    config.addinivalue_line(
+        "markers", "llm_kv: distributed KV-cache plane (bulk handoff + "
+        "prefix registry) tests; tier-1 on the CPU tiny-model config")
 
 
 @pytest.fixture

@@ -1,0 +1,590 @@
+"""Distributed KV-cache plane tests.
+
+Covers the three legs of the subsystem (serve/llm/kv_transfer.py):
+- PageAllocator invariants the cluster prefix registry builds on
+  (partial-page match, refcounted release of shared cached pages,
+  OutOfPages under cache pressure, eviction/_uncache LRU ordering, and
+  process-stable chain hashes);
+- bulk-plane KV handoff: seal → descriptor-only control RPC → decode-side
+  pull (same-host mmap / cross-host chunk stream), token parity vs the
+  colocated engine, zero KV bytes over the control RPC, and mid-pull
+  stream loss falling back to the om_read RPC path;
+- the cluster prefix registry + cache-aware router: replicas publish
+  frontiers through the controller, repeated-prefix traffic lands on the
+  warm replica, and the PD router reports the split TTFT and probes its
+  tiers' health.
+
+All tests run under JAX_PLATFORMS=cpu with the tiny model config
+(tier-1-eligible; marker: llm_kv).
+"""
+
+import asyncio
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.serve.llm import (EngineConfig, LLMEngine, PageAllocator,
+                               SamplingParams, fetch_handoff,
+                               prefix_chain_hashes, seal_handoff)
+from ray_tpu.serve.llm.cache import OutOfPages
+from ray_tpu.serve.llm.kv_transfer import HandoffRegistry
+
+pytestmark = pytest.mark.llm_kv
+
+ENGINE_CFG = dict(
+    model="tiny", page_size=8, num_pages=64, max_model_len=128,
+    max_batch=4, prefill_buckets=(16, 32, 64, 128), dtype="float32",
+    model_overrides={"vocab_size": 512},
+)
+
+
+def _collect(engine, want_ids, max_steps=500):
+    done = {}
+    for _ in range(max_steps):
+        for delta in engine.step():
+            rec = done.setdefault(delta.request_id, {"ids": [], "fin": None})
+            rec["ids"].extend(delta.new_token_ids)
+            if delta.finished:
+                rec["fin"] = delta.finish_reason
+        if all(done.get(r, {}).get("fin") for r in want_ids):
+            break
+    return done
+
+
+# ------------------------------------------------- allocator invariants
+
+def test_match_prefix_partial_page():
+    """Only FULL cached pages match, and never the entire prompt (one
+    token must stay uncached so prefill has a query position)."""
+    alloc = PageAllocator(num_pages=8, page_size=4)
+    pages = alloc.allocate(2)
+    h0 = alloc.register_full_page(pages[0], None, [1, 2, 3, 4])
+    alloc.register_full_page(pages[1], h0, [5, 6, 7, 8])
+    assert alloc.match_prefix([1, 2, 3]) == ([], 0)       # sub-page prompt
+    assert alloc.match_prefix([1, 2, 3, 4]) == ([], 0)    # whole = 1 page
+    m, n = alloc.match_prefix([1, 2, 3, 4, 5, 6])         # page + tail
+    assert m == [pages[0]] and n == 4
+    alloc.release(m)
+    m, n = alloc.match_prefix([1, 2, 3, 4, 5, 6, 7, 8, 9])
+    assert m == pages and n == 8
+    alloc.release(m)
+    # prompt exactly == both cached pages: whole-prompt rule caps at 1
+    m, n = alloc.match_prefix([1, 2, 3, 4, 5, 6, 7, 8])
+    assert m == [pages[0]] and n == 4
+    alloc.release(m)
+    # diverging second page stops the chain after the first
+    m, n = alloc.match_prefix([1, 2, 3, 4, 9, 9, 9, 9, 1])
+    assert m == [pages[0]] and n == 4
+    alloc.release(m)
+    alloc.release(pages)
+    assert alloc.num_free() == 7
+
+
+def test_release_refcount_shared_cached_pages():
+    """Shared cached pages refcount across matchers; they become
+    evictable (but stay matchable) only when every reference drops."""
+    alloc = PageAllocator(num_pages=8, page_size=4)
+    (p,) = alloc.allocate(1)                      # rc 1 (owner)
+    alloc.register_full_page(p, None, [1, 2, 3, 4])
+    m1, _ = alloc.match_prefix([1, 2, 3, 4, 9])   # rc 2
+    m2, _ = alloc.match_prefix([1, 2, 3, 4, 8])   # rc 3
+    assert m1 == m2 == [p]
+    alloc.release(m1)                             # rc 2
+    alloc.release([p])                            # owner done, rc 1
+    assert p not in alloc._evictable              # still referenced
+    m3, _ = alloc.match_prefix([1, 2, 3, 4, 7])
+    assert m3 == [p]
+    alloc.release(m3)
+    alloc.release(m2)                             # rc 0
+    assert p in alloc._evictable                  # cached, unreferenced
+    m4, n4 = alloc.match_prefix([1, 2, 3, 4, 6])
+    assert m4 == [p] and n4 == 4
+    assert p not in alloc._evictable              # re-referenced
+    alloc.release(m4)
+    assert alloc.num_free() == 7                  # evictables count free
+
+
+def test_out_of_pages_under_cache_pressure():
+    alloc = PageAllocator(num_pages=6, page_size=4)   # 5 usable
+    held = alloc.allocate(3)
+    cached = alloc.allocate(2)
+    h = None
+    for i, p in enumerate(cached):
+        h = alloc.register_full_page(p, h, [10 + i] * 4)
+    alloc.release(cached)                         # both cached+evictable
+    assert alloc.num_free() == 2
+    with pytest.raises(OutOfPages):
+        alloc.allocate(3)
+    got = alloc.allocate(2)                       # evicts both LRU pages
+    assert alloc.stats["evictions"] == 2
+    assert alloc.match_prefix([10, 10, 10, 10, 0]) == ([], 0)
+    alloc.release(got)
+    alloc.release(held)
+    assert alloc.num_free() == 5
+
+
+def test_eviction_uncache_lru_ordering():
+    """Eviction pops the LRU cached page; a match moves a page to MRU; an
+    evicted page's hash no longer matches (_uncache)."""
+    alloc = PageAllocator(num_pages=6, page_size=4)
+    cached = alloc.allocate(3)
+    for i, p in enumerate(cached):
+        alloc.register_full_page(p, None, [20 + i] * 4)
+    held = alloc.allocate(2)                      # free list now empty
+    alloc.release(cached)                         # LRU order: 0, 1, 2
+    (a,) = alloc.allocate(1)                      # evicts cached[0]
+    assert a == cached[0]
+    assert alloc.match_prefix([20, 20, 20, 20, 0]) == ([], 0)
+    m, _ = alloc.match_prefix([21, 21, 21, 21, 0])
+    assert m == [cached[1]]
+    alloc.release(m)                              # cached[1] now MRU
+    (b,) = alloc.allocate(1)                      # evicts cached[2] (LRU)
+    assert b == cached[2]
+    assert alloc.match_prefix([22, 22, 22, 22, 0]) == ([], 0)
+    m, _ = alloc.match_prefix([21, 21, 21, 21, 0])
+    assert m == [cached[1]]                       # survivor still cached
+    alloc.release(m)
+    alloc.release([a, b])
+    alloc.release(held)
+
+
+def test_duplicate_content_keeps_existing_mapping():
+    """Registering duplicate content keeps the first page's mapping; the
+    duplicate stays uncached and frees to the free list on release."""
+    alloc = PageAllocator(num_pages=8, page_size=4)
+    p1, p2 = alloc.allocate(2)
+    h1 = alloc.register_full_page(p1, None, [1, 2, 3, 4])
+    h2 = alloc.register_full_page(p2, None, [1, 2, 3, 4])
+    assert h1 == h2
+    alloc.release([p2])
+    assert p2 not in alloc._evictable             # uncached: plain free
+    m, _ = alloc.match_prefix([1, 2, 3, 4, 5])
+    assert m == [p1]
+    alloc.release(m)
+    alloc.release([p1])
+
+
+def test_chain_hash_process_stable():
+    """Chain hashes must be identical across processes (the router
+    matches its own hashes against replica-published frontiers): pinned
+    to a blake2b-derived golden value, independent of PYTHONHASHSEED and
+    of numpy vs python int tokens."""
+    golden = 9121524398691793932
+    assert PageAllocator.chain_hash(None, [1, 2, 3, 4]) == golden
+    assert PageAllocator.chain_hash(
+        None, list(np.asarray([1, 2, 3, 4], np.int64))) == golden
+    chained = PageAllocator.chain_hash(golden, [5, 6, 7, 8])
+    assert prefix_chain_hashes([1, 2, 3, 4, 5, 6, 7, 8, 9], 4) \
+        == [golden, chained]
+    # whole-prompt rule: exactly two pages hash only the first
+    assert prefix_chain_hashes([1, 2, 3, 4, 5, 6, 7, 8], 4) == [golden]
+    assert prefix_chain_hashes([1, 2], 4) == []
+
+
+def test_handoff_registry_ttl_and_cap():
+    reg = HandoffRegistry(ttl_s=1000.0, cap=3)
+    for i in range(5):
+        reg.add(f"r{i}", object())
+    assert len(reg) == 3                          # cap evicts oldest
+    reg2 = HandoffRegistry(ttl_s=0.0, cap=8)
+    reg2.add("a", object())
+    time.sleep(0.01)
+    reg2.evict()
+    assert len(reg2) == 0                         # TTL expiry
+    # concurrent add/evict from many threads must never desync the
+    # order list from the entries (the event-loop/executor race)
+    import threading
+
+    reg3 = HandoffRegistry(ttl_s=0.05, cap=4)
+
+    def churn(k):
+        for i in range(50):
+            reg3.add(f"t{k}-{i}", object())
+            reg3.evict()
+
+    threads = [threading.Thread(target=churn, args=(k,)) for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    time.sleep(0.06)
+    reg3.evict()
+    assert len(reg3) == 0 and not reg3._order     # fully drained
+
+
+# ---------------------------------------------------- bulk-plane handoff
+
+def test_handoff_seal_fetch_inject_parity(shared_cluster):
+    """Prefill → seal (descriptor, no dense KV in the message) → fetch →
+    inject → decode reproduces the colocated greedy output token for
+    token."""
+    cfg = EngineConfig(**ENGINE_CFG, seed=0)
+    prompt = list(range(1, 40))
+
+    ref = LLMEngine(cfg)
+    ref.add_request("ref", prompt, SamplingParams(max_tokens=10))
+    ref_out = _collect(ref, ["ref"])["ref"]["ids"]
+
+    prefill = LLMEngine(cfg)
+    prefill.add_request(
+        "r", prompt, SamplingParams(max_tokens=10, prefill_only=True))
+    out = _collect(prefill, ["r"])
+    assert out["r"]["fin"] == "prefill_done"
+    first = out["r"]["ids"]
+    blob = prefill.pop_extracted("r")
+    assert blob["prefill_s"] >= 0.0 and blob["queued_s"] >= 0.0
+
+    desc = seal_handoff(blob)
+    assert "kv" not in desc                       # descriptor only
+    assert desc["kv_nbytes"] == blob["kv"].nbytes > 0
+    assert desc["seal_s"] >= 0.0
+
+    fetched = fetch_handoff(desc)
+    np.testing.assert_array_equal(np.asarray(fetched["kv"]),
+                                  np.asarray(blob["kv"]))
+
+    decode = LLMEngine(cfg)
+    decode.inject_request("r2", fetched, SamplingParams(max_tokens=10))
+    got = list(first) + _collect(decode, ["r2"])["r2"]["ids"]
+    assert got == ref_out
+
+
+@pytest.fixture
+def two_host_session(tmp_path):
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    session = ray_tpu.init(num_cpus=2)
+    host_b_pool = str(tmp_path / "hostB_shm")
+    os.makedirs(host_b_pool, exist_ok=True)
+    node_b = session.add_node(
+        num_cpus=2,
+        env={"RTPU_HOST_ID": "kv-host-b",
+             "RTPU_SHM_ROOT": host_b_pool})
+    yield session, node_b
+    ray_tpu.shutdown()
+
+
+def _on_node(node_id):
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    return NodeAffinitySchedulingStrategy(node_id=node_id)
+
+
+def _synthetic_blob(nbytes: int, seed: int = 0):
+    elems = nbytes // 4
+    kv = np.random.default_rng(seed).standard_normal(
+        elems).astype(np.float32).reshape(2, elems // (2 * 8), 8)
+    return {"kv": kv, "prompt_ids": list(range(64)), "output_ids": [7],
+            "queued_s": 0.0, "prefill_s": 0.0}
+
+
+def test_kv_handoff_rides_bulk_stream(two_host_session):
+    """Tier-1 zero-copy check: the KV crosses hosts over the bulk chunk
+    stream; ZERO KV bytes ride the control RPC (only the descriptor
+    does)."""
+    session, node_b = two_host_session
+    blob = _synthetic_blob(2 << 20)
+    desc = seal_handoff(blob)
+    want = float(np.asarray(blob["kv"], np.float64).sum())
+
+    @ray_tpu.remote
+    def fetch(d):
+        from ray_tpu.runtime.core import get_core
+        from ray_tpu.serve.llm.kv_transfer import fetch_handoff as fh
+
+        got = fh(d)
+        stats = get_core().pull_manager.stats()
+        return {"sum": float(np.asarray(got["kv"], np.float64).sum()),
+                "nbytes": int(np.asarray(got["kv"]).nbytes),
+                "stats": stats,
+                "host": os.environ.get("RTPU_HOST_ID")}
+
+    out = ray_tpu.get(fetch.options(
+        scheduling_strategy=_on_node(node_b)).remote(desc), timeout=120)
+    assert out["host"] == "kv-host-b"
+    assert out["sum"] == want and out["nbytes"] == desc["kv_nbytes"]
+    assert out["stats"]["bulk_bytes_in"] >= desc["kv_nbytes"], out["stats"]
+    assert out["stats"]["rpc_bytes_in"] == 0, out["stats"]
+
+
+def test_kv_handoff_chaos_midpull_falls_back_to_rpc(two_host_session):
+    """Mid-pull stream loss (the bulk connection dies after the first
+    chunk) downgrades the remaining chunks to the om_read RPC path; the
+    handoff still completes byte-exact."""
+    session, node_b = two_host_session
+    blob = _synthetic_blob(4 << 20, seed=3)
+    desc = seal_handoff(blob)
+    want = float(np.asarray(blob["kv"], np.float64).sum())
+
+    @ray_tpu.remote
+    def chaos_fetch(d):
+        from ray_tpu.runtime import transfer
+        from ray_tpu.runtime.config import get_config
+        from ray_tpu.runtime.core import get_core
+        from ray_tpu.serve.llm.kv_transfer import fetch_handoff as fh
+
+        get_config().bulk_chunk_size = 256 << 10  # many chunks
+        calls = {"n": 0}
+        orig = transfer._BulkConn.fetch_into
+
+        async def flaky(self, oid, off, ln, view):
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                raise ConnectionResetError("chaos: stream cut mid-pull")
+            return await orig(self, oid, off, ln, view)
+
+        transfer._BulkConn.fetch_into = flaky
+        try:
+            got = fh(d)
+        finally:
+            transfer._BulkConn.fetch_into = orig
+        stats = get_core().pull_manager.stats()
+        return {"sum": float(np.asarray(got["kv"], np.float64).sum()),
+                "stats": stats, "stream_calls": calls["n"]}
+
+    out = ray_tpu.get(chaos_fetch.options(
+        scheduling_strategy=_on_node(node_b)).remote(desc), timeout=120)
+    assert out["sum"] == want
+    assert out["stats"]["rpc_bytes_in"] > 0, out["stats"]   # fell back
+    assert out["stream_calls"] >= 2                         # loss was mid-pull
+
+
+# ------------------------------------- prefix registry + cache routing
+
+def test_router_pick_by_prefix_unit():
+    """Pure routing-policy unit: longest matched chain wins, ties break
+    toward the less-loaded replica, and the imbalance guard / ongoing
+    cap force the least-outstanding fallback (pick returns None)."""
+    from ray_tpu.serve.handle import _PREFIX_IMBALANCE, _Router
+
+    router = _Router("unit-app", "unit-dep")
+
+    class H:
+        def __init__(self, aid):
+            self.actor_id = aid
+
+    a, b = H("a"), H("b")
+    router.kv_replicas = {"a": frozenset({1}), "b": frozenset({1, 2})}
+    router.inflight = {}
+    router.max_ongoing = 0
+    assert router._pick_by_prefix([a, b], [1, 2, 3]) is b  # longest chain
+    router.inflight = {"b": 1}
+    assert router._pick_by_prefix([a, b], [1]) is a        # tie: less load
+    assert router._pick_by_prefix([a, b], [9, 1]) is None  # no match
+    router.kv_replicas = {"b": frozenset({1})}
+    router.inflight = {"b": _PREFIX_IMBALANCE + 1}
+    assert router._pick_by_prefix([a, b], [1]) is None     # imbalance
+    router.max_ongoing = 5
+    router.kv_replicas = {"a": frozenset({1})}
+    router.inflight = {"a": 5}
+    assert router._pick_by_prefix([a, b], [1]) is None     # ongoing cap
+
+
+def _wait_registry(app, deployment, predicate, timeout_s=30.0):
+    from ray_tpu.actor import get_actor
+    from ray_tpu.serve.config import CONTROLLER_NAME
+
+    ctrl = get_actor(CONTROLLER_NAME)
+    deadline = time.time() + timeout_s
+    table = None
+    while time.time() < deadline:
+        table = ray_tpu.get(ctrl.kv_registry_get.remote(app, deployment))
+        if predicate(table):
+            return table
+        time.sleep(0.25)
+    return table
+
+
+def test_prefix_registry_e2e_routing(shared_cluster):
+    """End-to-end registry plumbing without engines: replicas publish
+    per-replica frontiers (ReplicaActor.kv_frontier → controller poll →
+    kv_registry_get), and prefix-hash requests route to the replica
+    whose published frontier matches."""
+    from ray_tpu import serve
+    from ray_tpu.actor import ActorHandle
+
+    @serve.deployment
+    class FrontierEcho:
+        def __init__(self):
+            import uuid
+
+            self.rid = uuid.uuid4().hex
+            base = int(self.rid[:8], 16)
+            self.hashes = [base, base + 1, base + 2]
+
+        def kv_frontier(self):
+            return {"page_size": 4, "rev": 1, "hashes": self.hashes}
+
+        async def whoami(self):
+            return self.rid
+
+        async def __call__(self, *a, **k):
+            return self.rid
+
+    app = FrontierEcho.options(num_replicas=2,
+                               name="FrontierEcho").bind()
+    handle = serve.run(app, name="kvreg", route_prefix="/kvreg",
+                       wait_timeout_s=120)
+    try:
+        table = _wait_registry(
+            "kvreg", "FrontierEcho",
+            lambda t: t and len(t.get("replicas", {})) == 2
+            and all(t["replicas"].values()))
+        assert table and len(table["replicas"]) == 2, table
+        assert table["page_size"] == 4
+
+        # map actor_id -> replica id via a direct probe, then check that
+        # prefix-hash routing lands every request on the matching replica
+        for aid, hashes in table["replicas"].items():
+            rid = ray_tpu.get(ActorHandle(aid).handle_request.remote(
+                "whoami", (), {}), timeout=60)
+            for _ in range(3):
+                got = handle.options(
+                    method_name="whoami",
+                    prefix_hashes=list(hashes)).remote().result(
+                    timeout_s=60)
+                assert got == rid, (got, rid)
+        # unmatched hashes still route somewhere (least-outstanding)
+        got = handle.options(method_name="whoami",
+                             prefix_hashes=[123456789]).remote().result(
+            timeout_s=60)
+        assert got in {ray_tpu.get(ActorHandle(a).handle_request.remote(
+            "whoami", (), {}), timeout=60)
+            for a in table["replicas"]}
+    finally:
+        serve.delete("kvreg")
+
+
+@pytest.mark.slow
+def test_llm_cache_aware_routing_two_replicas(shared_cluster):
+    """Full-stack A/B (slow tier): with two LLM replicas, repeated-prefix
+    traffic concentrates on the warm replica — nonzero prefix-token hits
+    on exactly one of them — once its frontier reaches the registry."""
+    import json
+
+    from ray_tpu import serve
+    from ray_tpu.actor import ActorHandle, get_actor
+    from ray_tpu.serve.config import CONTROLLER_NAME
+    from ray_tpu.serve.llm import LLMConfig, build_openai_app
+    from ray_tpu.serve.replica import Request
+
+    cfg = LLMConfig(
+        model_id="tiny-kv",
+        num_replicas=2,
+        warmup=False,
+        engine=EngineConfig(**{**ENGINE_CFG, "prefill_buckets": (64,)}))
+    app = build_openai_app(cfg)
+    handle = serve.run(app, name="kvroute", route_prefix="/kvroute",
+                       wait_timeout_s=240)
+    deployment = "LLMServer:tiny-kv"
+    try:
+        body = json.dumps({
+            "model": "tiny-kv", "max_tokens": 2,
+            "messages": [{"role": "user",
+                          "content": "alpha bravo charlie delta"}],
+        }).encode()
+        req = Request(method="POST", path="/v1/chat/completions", body=body)
+        handle.remote(req).result(timeout_s=240)   # warms ONE replica
+
+        table = _wait_registry(
+            "kvroute", deployment,
+            lambda t: t and any(t["replicas"].values()))
+        assert table and any(len(h) > 0 for h in
+                             table["replicas"].values()), table
+        assert table["page_size"] == cfg.engine.page_size
+
+        for _ in range(3):                          # repeated prefixes
+            handle.remote(req).result(timeout_s=120)
+
+        ctrl = get_actor(CONTROLLER_NAME)
+        rt = ray_tpu.get(ctrl.get_routing_table.remote(
+            "kvroute", deployment))
+        hits = {}
+        for aid in rt["replicas"]:
+            stats = ray_tpu.get(ActorHandle(aid).handle_request.remote(
+                "engine_stats", (), {}), timeout=60)
+            hits[aid] = stats["prefix_token_hits"]
+        assert len(hits) == 2
+        # cache-aware routing concentrated the shared prefix on ONE
+        # replica: its hits cover the followups, the other stayed cold
+        assert max(hits.values()) >= 2 * cfg.engine.page_size, hits
+        assert min(hits.values()) == 0, hits
+    finally:
+        serve.delete("kvroute")
+
+
+def test_pd_router_parity_breakdown_and_health(shared_cluster):
+    """Disagg e2e over serve: PDRouter generation with the bulk-plane
+    handoff is token-identical to the colocated engine (greedy); the
+    response carries the split TTFT and the handoff byte count; and the
+    rewritten check_health probes both tiers."""
+    from ray_tpu import serve
+    from ray_tpu.serve.handle import DeploymentHandle
+    from ray_tpu.serve.llm import LLMConfig, build_pd_openai_app
+    from ray_tpu.serve.llm.disagg import PDRouter
+
+    engine_cfg = {**ENGINE_CFG, "prefill_buckets": (64,)}
+    cfg = LLMConfig(model_id="tiny-pd-kv", warmup=False,
+                    engine=EngineConfig(**engine_cfg))
+    app = build_pd_openai_app(cfg)
+    serve.run(app, name="pdkv", route_prefix="/pdkv", wait_timeout_s=240)
+    try:
+        router = PDRouter.func_or_class(
+            DeploymentHandle("pdkv", "PrefillServer:tiny-pd-kv"),
+            DeploymentHandle("pdkv", "DecodeServer:tiny-pd-kv"), cfg)
+        prompt_ids = list(range(1, 40))
+        out = asyncio.run(router.generate(prompt_ids=prompt_ids,
+                                          max_tokens=8))
+
+        ref = LLMEngine(EngineConfig(**engine_cfg))
+        ref.add_request("ref", prompt_ids, SamplingParams(max_tokens=8))
+        ref_out = _collect(ref, ["ref"])["ref"]["ids"]
+        assert out["token_ids"] == ref_out
+        assert out["finish_reason"] in ("length", "stop")
+        # the control RPC carried a descriptor, not the dense KV
+        assert out["usage"]["kv_handoff_bytes"] > 0
+        bd = out["ttft_breakdown"]
+        assert set(bd) == {"queue_s", "prefill_s", "handoff_s", "rpc_s"}
+        assert all(v >= 0.0 for v in bd.values())
+        assert bd["handoff_s"] > 0.0               # seal + pull happened
+
+        # the prefill replica's frontier reaches the cluster registry,
+        # and a repeated-prefix request hits its real prefix cache
+        from ray_tpu.actor import ActorHandle
+
+        table = _wait_registry(
+            "pdkv", "PrefillServer:tiny-pd-kv",
+            lambda t: t and any(t["replicas"].values()))
+        assert table and any(len(h) > 0 for h in
+                             table["replicas"].values()), table
+        out2 = asyncio.run(router.generate(prompt_ids=prompt_ids,
+                                           max_tokens=8))
+        assert out2["token_ids"] == ref_out        # cache hit, same tokens
+        (aid,) = table["replicas"]
+        stats = ray_tpu.get(ActorHandle(aid).handle_request.remote(
+            "engine_stats", (), {}), timeout=60)
+        assert stats["prefix_token_hits"] > 0, stats
+
+        assert asyncio.run(router.check_health()) is True
+    finally:
+        serve.delete("pdkv")
+
+
+def test_pd_check_health_surfaces_missing_tier(shared_cluster):
+    """check_health must FAIL (raise) when a tier has no ready replicas —
+    the old stub returned True unconditionally."""
+    from ray_tpu.serve.handle import DeploymentHandle
+    from ray_tpu.serve.llm import LLMConfig
+    from ray_tpu.serve.llm.disagg import PDRouter
+
+    router = PDRouter.func_or_class(
+        DeploymentHandle("no-such-app", "PrefillServer:x"),
+        DeploymentHandle("no-such-app", "DecodeServer:x"),
+        LLMConfig(model_id="x"))
+    with pytest.raises(Exception):
+        asyncio.run(router.check_health())
