@@ -240,7 +240,7 @@ def bench_runtime() -> dict:
     out = subprocess.run(
         [sys.executable, os.path.join(here, "benchmarks", "ray_perf.py"),
          "--scale", "0.5"],
-        capture_output=True, text=True, timeout=240, cwd=here)
+        capture_output=True, text=True, timeout=300, cwd=here)
     for line in reversed(out.stdout.strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
@@ -392,6 +392,11 @@ def main():
     if time.perf_counter() - start < 480:
         try:
             result["detail"]["runtime"] = bench_runtime()
+            # hoist the scheduling-plane headline (argument GB/s with
+            # locality-aware placement) next to the other plane keys
+            if "multi_locality_gb_s" in result["detail"]["runtime"]:
+                result["detail"]["multi_locality_gb_s"] = \
+                    result["detail"]["runtime"]["multi_locality_gb_s"]
         except Exception as e:  # noqa: BLE001
             result["detail"]["runtime"] = {"error": repr(e)[:200]}
 

@@ -58,7 +58,7 @@ def main():
 
     import ray_tpu
 
-    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    session = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
     results = {}
 
     # ---- tasks/s (ref: ray_perf.py "multi client tasks async")
@@ -203,6 +203,22 @@ def main():
             mean * nbig * m * (mb << 20) / 1e9, 3)
         results[f"multi_put_gb_per_s_c{m}_best"] = round(
             best * nbig * m * (mb << 20) / 1e9, 3)
+
+    # ---- scheduling plane: spill-path counters + the locality A/B
+    # (multi_locality_gb_s — argument GB/s when large-arg tasks go to
+    # the bytes vs the bytes crossing hosts). LAST: it adds a second
+    # (simulated-host) node, which would change the sections above.
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        if here not in sys.path:
+            sys.path.insert(0, here)
+        from scale import bench_scheduling_plane
+
+        # compact sizing: this rides inside bench.py's runtime budget
+        results.update(bench_scheduling_plane(session, n_tasks=100,
+                                              n_objects=4))
+    except Exception as e:  # noqa: BLE001 — never lose the core keys
+        results["scheduling_plane_error"] = repr(e)[:200]
 
     print(json.dumps(results))
     if args.out:

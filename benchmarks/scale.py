@@ -6,9 +6,12 @@ many_pgs record creation throughput and time-to-drain at cluster scale)
 at a size this box can host: 100k queued tasks, 2k registered actors,
 200 placement groups. The point is the SHAPE — submission and drain must
 stay linear in queue depth (the nodelet queue is a deque with O(1)
-dispatch pops; the controller's pick_node is O(nodes) per spillback
-decision, O(1) amortized dispatch otherwise) — not the absolutes of a
-1-vCPU container.
+dispatch pops; cross-node spill decisions run nodelet-side against the
+gossiped resource view, zero controller RPCs in steady state) — not the
+absolutes of a 1-vCPU container. A final two-node tier reports the
+spill-path counters (p2p vs controller spills, hop p99) and the
+locality A/B (argument GB/s with tasks-to-the-bytes placement vs
+bytes-across-hosts).
 
 Run: `python benchmarks/scale.py [--tasks N] [--actors N] [--pgs N]
 [--out scale.json]`. Prints one JSON line.
@@ -118,6 +121,144 @@ def bench_many_pgs(n: int) -> dict:
     }
 
 
+def _wait_view(session, node_id, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if node_id in session.nodelet_inproc.cluster_view:
+            return
+        time.sleep(0.05)
+    raise TimeoutError("gossiped view never converged")
+
+
+def _hist_p99(hist) -> int:
+    total = sum(hist.values())
+    if not total:
+        return 0
+    acc = 0
+    for hop in sorted(hist):
+        acc += hist[hop]
+        if acc >= 0.99 * total:
+            return hop
+    return max(hist)
+
+
+def _cluster_sched_counters(session) -> dict:
+    """Aggregate spill-path counters + the hop histogram across every
+    nodelet (the head in-process, extra nodes over RPC)."""
+    from ray_tpu.runtime.rpc import RpcClient
+
+    sched = {}
+    hist = {}
+
+    def fold(info):
+        for k, v in (info.get("sched") or {}).items():
+            sched[k] = sched.get(k, 0) + v
+        for h, c in (info.get("spill_hops_hist") or {}).items():
+            hist[int(h)] = hist.get(int(h), 0) + c
+
+    nodes = session.core.controller.call("list_nodes")
+    for nid, snap in nodes.items():
+        if nid == session.node_id:
+            fold({"sched": session.nodelet_inproc.sched_counters,
+                  "spill_hops_hist": session.nodelet_inproc.spill_hops_hist})
+            continue
+        if not snap.get("alive"):
+            continue
+        client = RpcClient(snap["address"])
+        try:
+            fold(client.call("get_node_info", _timeout=10))
+        except Exception:
+            pass
+        finally:
+            client.close()
+    return {"sched": sched, "hist": hist}
+
+
+def bench_scheduling_plane(session, n_tasks=200, n_objects=6,
+                           mb=8) -> dict:
+    """Decentralized scheduling-plane tier on a two-node (simulated
+    two-host) cluster: a spill burst reports the p2p/controller spill
+    split + hop percentiles (steady state must be pick_node-free), and
+    a locality A/B runs large-arg consumers WITH locality-aware
+    placement (tasks go to the bytes) vs pinned away from them (bytes
+    cross hosts per task), reporting argument GB/s either way."""
+    import tempfile
+
+    import ray_tpu
+    from ray_tpu.runtime.config import get_config
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    pool = tempfile.mkdtemp(prefix="rtpu_scale_hostb_")
+    node_b = session.add_node(
+        num_cpus=max(4, n_objects),
+        env={"RTPU_HOST_ID": "scale-host-b", "RTPU_SHM_ROOT": pool})
+    _wait_view(session, node_b)
+    out = {}
+
+    # ---- spill burst: short tasks past local capacity
+    @ray_tpu.remote
+    def spin(ms):
+        time.sleep(ms / 1e3)
+        return 0
+
+    t0 = time.perf_counter()
+    ray_tpu.get([spin.remote(30) for _ in range(n_tasks)], timeout=600)
+    out["spill_burst_tasks_per_s"] = round(
+        n_tasks / (time.perf_counter() - t0), 1)
+    agg = _cluster_sched_counters(session)
+    out["p2p_spills"] = agg["sched"].get("p2p_spills", 0)
+    out["controller_spills"] = agg["sched"].get("controller_spills", 0)
+    out["pick_node_rpcs"] = agg["sched"].get("pick_node_rpcs", 0)
+    out["spill_bounces"] = agg["sched"].get("spill_bounces", 0)
+    out["spill_hops_p99"] = _hist_p99(agg["hist"])
+
+    # ---- locality A/B: large-arg consumers with/without the
+    # locality-aware picker
+    import numpy as np
+
+    @ray_tpu.remote
+    def produce(n):
+        return np.ones(n << 20, dtype=np.uint8)
+
+    @ray_tpu.remote
+    def consume(a):
+        return int(a[-1])
+
+    aff_b = NodeAffinitySchedulingStrategy(node_id=node_b)
+    aff_head = NodeAffinitySchedulingStrategy(node_id=session.node_id)
+    refs = [produce.options(scheduling_strategy=aff_b).remote(mb)
+            for _ in range(n_objects)]
+    ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=300,
+                            fetch_local=False)
+    assert len(ready) == len(refs)
+    nbytes = n_objects * (mb << 20)
+    # ON: the picker sends each consumer to the replica-holding node
+    t0 = time.perf_counter()
+    assert all(v == 1 for v in ray_tpu.get(
+        [consume.remote(r) for r in refs], timeout=300))
+    dt_on = time.perf_counter() - t0
+    # OFF: weight zeroed and consumers pinned to the head — every
+    # argument payload crosses hosts instead
+    cfg = get_config()
+    saved = cfg.locality_weight
+    cfg.locality_weight = 0.0
+    try:
+        t1 = time.perf_counter()
+        assert all(v == 1 for v in ray_tpu.get(
+            [consume.options(scheduling_strategy=aff_head).remote(r)
+             for r in refs], timeout=300))
+        dt_off = time.perf_counter() - t1
+    finally:
+        cfg.locality_weight = saved
+    out["locality_n_objects"] = n_objects
+    out["locality_arg_mb"] = mb
+    out["multi_locality_gb_s"] = round(nbytes / dt_on / 1e9, 3)
+    out["multi_locality_gb_s_remote"] = round(nbytes / dt_off / 1e9, 3)
+    out["locality_speedup"] = round(dt_off / max(dt_on, 1e-9), 2)
+    return out
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--tasks", type=int, default=100_000)
@@ -128,11 +269,17 @@ def main():
 
     import ray_tpu
 
-    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    session = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
     results = {}
     results["many_tasks"] = bench_many_tasks(args.tasks)
     results["many_pgs"] = bench_many_pgs(args.pgs)
     results["many_actors"] = bench_many_actors(args.actors)
+    # LAST: adds a second (simulated-host) node, which would change the
+    # single-node tiers above
+    try:
+        results["scheduling_plane"] = bench_scheduling_plane(session)
+    except Exception as e:  # noqa: BLE001 — never lose the other tiers
+        results["scheduling_plane"] = {"error": repr(e)[:200]}
     print(json.dumps(results))
     if args.out:
         with open(args.out, "w") as f:

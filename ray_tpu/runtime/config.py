@@ -54,6 +54,24 @@ class RuntimeConfig:
     heartbeat_interval_s: float = 1.0
     node_death_timeout_s: float = 10.0
 
+    # --- decentralized scheduling plane (p2p spill; nodelet.py) ---
+    # Nodelets keep a gossiped per-node resource view (piggybacked on
+    # heartbeat replies, version-stamped per node) and make spill
+    # decisions locally against it — zero controller pick_node RPCs in
+    # steady state. False restores the controller-routed spill path.
+    p2p_spill_enabled: bool = True
+    # Heartbeat/gossip cadence while the cluster has peers (the beat
+    # carries the view deltas); clamped to heartbeat_interval_s above.
+    view_gossip_interval_s: float = 0.5
+    # Bounded spillback: a receiver that is infeasible-or-busy under a
+    # stale view may re-spill at most this many times before the task
+    # parks in its queue (terminates spill ping-pong).
+    spill_max_hops: int = 3
+    # Locality-aware placement: how strongly resident argument bytes
+    # discount a candidate node's utilization score (0 disables; 1.0
+    # means a node holding all argument bytes beats any emptier node).
+    locality_weight: float = 1.0
+
     # --- workers / scheduling ---
     worker_idle_timeout_s: float = 60.0
     worker_start_timeout_s: float = 60.0

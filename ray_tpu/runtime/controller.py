@@ -44,6 +44,21 @@ class NodeInfo:
         # last applied resource-view version (ref: ray_syncer.h:83):
         # views with version <= this are stale/reordered and dropped
         self.resource_version = 0
+        # controller-global revision at which this entry last changed:
+        # heartbeat replies gossip only entries newer than the asking
+        # nodelet's known revision (delta semantics, ref: ray_syncer's
+        # per-component snapshot taken/consumed versions)
+        self.entry_rev = 0
+        self.queue_depth = 0
+
+    def view_wire(self) -> dict:
+        """This node's gossip entry (the per-node versioned view shipped
+        to nodelets so spill decisions run peer-side)."""
+        return {"node_id": self.node_id, "address": self.address,
+                "total": self.total_resources,
+                "available": self.available_resources,
+                "labels": self.labels, "version": self.resource_version,
+                "queue_depth": self.queue_depth, "alive": self.alive}
 
     def snapshot(self):
         return {
@@ -133,6 +148,11 @@ class Controller:
         self.task_index: "collections.OrderedDict[str, dict]" = \
             collections.OrderedDict()
         self.metrics: Dict[str, Any] = {}
+        # monotonically increasing cluster-view revision (bumped whenever
+        # any node's gossip entry changes); nodelets echo the last
+        # revision they applied and heartbeat replies ship only newer
+        # entries
+        self._view_rev = 0
         self._server = RpcServer(address, self._handlers(), on_disconnect=self._on_disconnect)
         self._health_task: Optional[asyncio.Task] = None
         self.start_time = time.time()
@@ -292,25 +312,42 @@ class Controller:
         await self._server.stop()
 
     # ------------------------------------------------------------------ nodes
+    def _bump_view(self, node: NodeInfo) -> None:
+        self._view_rev += 1
+        node.entry_rev = self._view_rev
+
+    def _view_delta(self, known_rev: int, exclude: str = None) -> List[dict]:
+        """Gossip entries that changed since the asking nodelet's last
+        applied revision (its own entry is omitted — it IS the source)."""
+        return [n.view_wire() for n in self.nodes.values()
+                if n.entry_rev > known_rev and n.node_id != exclude]
+
     async def register_node(self, node_id: str, address: str,
                             resources: Dict[str, float],
                             labels: Dict[str, str] = None):
         info = NodeInfo(node_id, address, resources, labels or {})
         info.client = RpcClient(address)
         self.nodes[node_id] = info
+        self._bump_view(info)
         await self._publish("node", {"event": "node_added", "node": info.snapshot()})
         return {"session_name": self.session_name,
-                "n_nodes": sum(1 for n in self.nodes.values() if n.alive)}
+                "n_nodes": sum(1 for n in self.nodes.values() if n.alive),
+                # seed the new nodelet's cluster view at registration so
+                # p2p spill works before the first gossip beat
+                "view": self._view_delta(0, exclude=node_id),
+                "view_rev": self._view_rev}
 
     async def heartbeat(self, node_id: str,
                         available_resources: Optional[Dict[str, float]],
                         load: Dict[str, Any] = None,
-                        resource_version: int = 0):
+                        resource_version: int = 0,
+                        known_view_rev: Optional[int] = None):
         node = self.nodes.get(node_id)
         if node is None:
             return {"registered": False}
         node.last_heartbeat = time.monotonic()
         want_full = False
+        changed = False
         if available_resources is not None:
             # versioned merge: apply a newer OR equal-version view (a
             # full view is authoritative and idempotent — the periodic
@@ -318,20 +355,41 @@ class Controller:
             # strictly OLDER view (reconnect after partition, reordered
             # transport) is dropped, so it cannot roll back the table
             if resource_version >= node.resource_version:
-                node.available_resources = available_resources
+                # gossip only on a real value change: the periodic full
+                # view (every 10th beat, same version) would otherwise
+                # bump entry_rev and re-ship an identical entry to every
+                # peer — O(N^2) churn in a quiescent cluster
+                if available_resources != node.available_resources:
+                    node.available_resources = available_resources
+                    changed = True
                 node.resource_version = resource_version
         elif resource_version > node.resource_version:
             # delta beat claims a version we have not seen (e.g. this
             # controller restarted and lost the table): ask for a full
             # view instead of scheduling against stale numbers
             want_full = True
+        queued = (load or {}).get("queued")
+        if queued is not None and queued != node.queue_depth:
+            node.queue_depth = queued
+            changed = True
         if not node.alive:
             node.alive = True
+            changed = True
+        if changed:
+            self._bump_view(node)
         reply = {"registered": True,
                  "n_nodes": sum(1 for n in self.nodes.values()
                                 if n.alive)}
         if want_full:
             reply["want_full"] = True
+        if known_view_rev is not None:
+            # piggyback the gossiped cluster view: version-stamped
+            # per-node deltas since the nodelet's last applied revision
+            # (ref: ray_syncer.h:83 — spill decisions then run nodelet-
+            # side with zero pick_node round trips in steady state)
+            reply["view"] = self._view_delta(known_view_rev,
+                                             exclude=node_id)
+            reply["view_rev"] = self._view_rev
         return reply
 
     async def list_nodes(self):
@@ -346,6 +404,7 @@ class Controller:
         # on the draining node (ref: node drain protocol in
         # gcs_node_manager.cc HandleDrainNode).
         node.alive = False
+        self._bump_view(node)  # death propagates through the gossip too
         if node.client is not None:
             await node.client.notify_async("shutdown")
         # same observable event as a health-sweep death: owners with
@@ -367,6 +426,7 @@ class Controller:
             for node in self.nodes.values():
                 if node.alive and now - node.last_heartbeat > cfg.node_death_timeout_s:
                     node.alive = False
+                    self._bump_view(node)
                     await self._publish(
                         "node", {"event": "node_dead", "node": node.snapshot()}
                     )
@@ -588,12 +648,15 @@ class Controller:
     # ------------------------------------------------------------------ scheduling
     async def pick_node(self, resources: Dict[str, float], strategy: str = "HYBRID",
                         exclude: List[str] = None,
-                        placement_group_id: str = None, bundle_index: int = -1):
+                        placement_group_id: str = None, bundle_index: int = -1,
+                        arg_locs: Dict[str, int] = None,
+                        locality_weight: float = 0.0):
         node = scheduling.pick_node_for(
             [n for n in self.nodes.values() if not exclude or n.node_id not in exclude],
             resources, strategy=strategy,
             pg=self.placement_groups.get(placement_group_id or ""),
             bundle_index=bundle_index,
+            arg_locs=arg_locs, locality_weight=locality_weight,
         )
         if node is None:
             # Record unmet demand for the autoscaler (ref: the reference's

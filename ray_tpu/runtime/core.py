@@ -166,7 +166,8 @@ class _RemoteShm:
 
 class _PendingTask:
     __slots__ = ("spec", "return_ids", "retries_left", "arg_refs",
-                 "submitted_at", "stream_received", "node_hint")
+                 "submitted_at", "stream_received", "node_hint",
+                 "hint_seq")
 
     def __init__(self, spec, return_ids, retries_left, arg_refs):
         self.spec = spec
@@ -176,6 +177,7 @@ class _PendingTask:
         self.submitted_at = time.time()
         self.stream_received = 0  # streaming generators: items seen
         self.node_hint = None  # node executing it, when known (spills)
+        self.hint_seq = 0  # placement seq of node_hint (max wins)
 
 
 _END_OF_STREAM = object()  # streaming-generator terminator marker
@@ -1182,6 +1184,46 @@ class CoreWorker:
         arg_refs.append(ObjectRef(oid, owner_addr=self.address))
         return {"args_oid": oid.binary(), "args_owner": self.address}
 
+    def _arg_locations(self, arg_refs: List["ObjectRef"],
+                       spec: Dict[str, Any]) -> Optional[Dict[str, int]]:
+        """Owner-side locality directory for a task spec: nodelet
+        address -> resident argument bytes, for shm-resident arguments
+        only (inline args travel with the spec). The nodelet-side spill
+        picker weighs candidate nodes by these bytes so tasks go to the
+        bytes instead of the bytes to the tasks (ref: the reference's
+        locality-aware lease policy). Zero cost for the common
+        inline-args case."""
+        if not arg_refs and "args_oid" not in spec:
+            return None
+        # set, not list: _pack_args both appends the packed-args ref to
+        # arg_refs AND stamps args_oid on the spec — counting that oid
+        # twice doubled the local node's resident bytes and suppressed
+        # legitimate locality pulls
+        oids = {r.id() for r in arg_refs}
+        if "args_oid" in spec:
+            oids.add(ObjectID(spec["args_oid"]))
+        locs: Dict[str, int] = {}
+        for oid in oids:
+            v = self.memory_store.get(oid, _MISSING)
+            if isinstance(v, _RemoteShm):
+                size = v.size or 0
+                if v.node_addr and size:
+                    locs[v.node_addr] = locs.get(v.node_addr, 0) + size
+                for rep in v.replicas or ():
+                    addr = (rep.get("addr") if isinstance(rep, dict)
+                            else rep[1])
+                    # the directory may list the primary too (cf. the
+                    # puller's addr != node_addr guard) — counting it
+                    # twice would skew the locality weighting
+                    if addr and size and addr != v.node_addr:
+                        locs[addr] = locs.get(addr, 0) + size
+            elif v is _IN_SHM and self.nodelet_addr:
+                size = self.store.size_of(oid) or 0
+                if size:
+                    locs[self.nodelet_addr] = \
+                        locs.get(self.nodelet_addr, 0) + size
+        return locs or None
+
     def make_task_template(self, fn_key: str,
                            opts: Dict[str, Any]) -> Dict[str, Any]:
         """Pre-build the invariant TaskSpecification fields for a remote
@@ -1238,6 +1280,9 @@ class CoreWorker:
                               attributes={"task_id": task_id.hex()}):
                 spec["trace_ctx"] = tracing.current_context()
         spec.update(self._pack_args(args, kwargs, arg_refs))
+        locs = self._arg_locations(arg_refs, spec)
+        if locs:
+            spec["arg_locs"] = locs
         for oid in return_ids:
             self.owned.add(oid)
             # create events eagerly ON THIS THREAD: a sync get() may arm
@@ -1394,10 +1439,18 @@ class CoreWorker:
     # the placement so that node's death fails the task over (ref: the
     # owner-side lease in normal_task_submitter.cc observes raylet death;
     # the push model needs this one notification instead)
-    async def _h_task_spilled(self, task_id: bytes, node_id: str):
+    async def _h_task_spilled(self, task_id: bytes, node_id: str,
+                              seq: int = 0):
         pending = self.pending_tasks.get(TaskID(task_id))
         if pending is not None:
-            pending.node_hint = node_id
+            # multi-hop spills notify from DIFFERENT nodelets over
+            # unordered links: only the highest placement seq (stamped
+            # per transfer by the holding nodelet) is the live location
+            # — a reordered stale hint must not overwrite it, or the
+            # failover below watches the wrong node
+            if seq >= pending.hint_seq:
+                pending.node_hint = node_id
+                pending.hint_seq = seq
             await self._ensure_node_sub()
         return True
 
@@ -1559,7 +1612,11 @@ class CoreWorker:
         return True
 
     async def _resubmit(self, pending: _PendingTask):
-        pending.node_hint = None  # re-placed from scratch
+        # re-placed from scratch: the resubmitted spec restarts its
+        # placement seq at 0 (the nodelet-side copy carried the old
+        # count), so the hint watermark must restart with it
+        pending.node_hint = None
+        pending.hint_seq = 0
         await asyncio.sleep(get_config().task_retry_delay_s)
         try:
             await self.nodelet.call_async("submit_task", spec=pending.spec)

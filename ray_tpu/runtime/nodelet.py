@@ -7,8 +7,12 @@ DispatchScheduledTasksToWorkers; worker pool src/ray/raylet/worker_pool.cc).
 
 Differences by design: tasks are *pushed* (submit → queue → dispatch to an
 idle worker) rather than leased back to the submitter — one fewer round trip
-per task on a fabric where all workers are trusted peers; spillback to other
-nodes goes through the controller's pick_node (the reference spills via
+per task on a fabric where all workers are trusted peers. Cross-node spill
+is peer-to-peer against a gossiped, version-stamped cluster resource view
+(piggybacked on heartbeat replies; ref: ray_syncer.h:83 + the hybrid spill
+policy, hybrid_scheduling_policy.h:50) with zero controller round trips in
+steady state; the controller's pick_node stays authoritative for placement
+groups, slice gangs, and NODE_AFFINITY validation (the reference spills via
 ClusterTaskManager::ScheduleOnNode, cluster_task_manager.cc:422).
 
 Can run in-process with the driver (single host) or standalone via
@@ -245,6 +249,33 @@ class Nodelet:
         self._resource_version = 1
         self._resource_version_sent = 0
         self._respill_tick = 0
+        # --- decentralized scheduling plane ---
+        # gossiped per-PEER resource view (node_id -> NodeView), fed by
+        # version-stamped deltas piggybacked on heartbeat replies and by
+        # direct peer spillback hints; spill decisions run against this
+        # cache with zero controller round trips in steady state
+        self.cluster_view: Dict[str, Any] = {}
+        self._view_rev = 0  # last controller view revision applied
+        # outstanding optimistic debits per peer: (monotonic t0,
+        # {resource: amount}, staged count) — restored by
+        # _expire_view_debits unless a fresh gossip entry supersedes
+        # the cached values first
+        self._view_debits: Dict[str, list] = {}
+        # pooled peer-nodelet clients (same LRU pattern as
+        # _owner_clients: dial-per-spill was one connect + fd per
+        # spilled task)
+        self._peer_clients: Dict[str, RpcClient] = {}
+        # per-peer spill coalescing: a burst of spills to one peer in a
+        # single loop pass ships as ONE submit_task_batch frame
+        self._spill_staged: Dict[str, tuple] = {}
+        self._spill_drain_armed = False
+        self._dispatch_seq = 0  # stamps pushes so workers dedupe dups
+        # spill-path observability (benchmarks/scale.py + tests assert
+        # the zero-pick_node steady state on these)
+        self.sched_counters = {"p2p_spills": 0, "controller_spills": 0,
+                               "pick_node_rpcs": 0, "spill_bounces": 0,
+                               "spills_received": 0}
+        self.spill_hops_hist: Dict[int, int] = {}
         self._factory_proc = None
         self._factory_path = os.path.join(
             session_dir, "sock", f"factory-{node_id[:8]}.sock")
@@ -283,6 +314,7 @@ class Nodelet:
             "cancel_task": self.cancel_task,
             "object_sealed": self.object_sealed,
             "object_deleted": self.object_deleted,
+            "view_update": self.view_update,
             "get_node_info": self.get_node_info,
             "shutdown": self._on_shutdown,
             "ping": lambda: "pong",
@@ -298,6 +330,10 @@ class Nodelet:
             resources=self.total_resources,
             labels=dict(self.labels, **{"rtpu.host_id": self.host_id}))
         self.cluster_nodes = reply.get("n_nodes", 1)
+        # seed the gossiped cluster view from the registration reply so
+        # p2p spill is live before the first heartbeat
+        self._apply_view_entries(reply.get("view"))
+        self._view_rev = reply.get("view_rev", 0)
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._bg.append(asyncio.ensure_future(self._reap_loop()))
         self._bg.append(asyncio.ensure_future(self._memory_monitor_loop()))
@@ -323,6 +359,9 @@ class Nodelet:
         for client in self._owner_clients.values():
             client.close()
         self._owner_clients.clear()
+        for client in self._peer_clients.values():
+            client.close()
+        self._peer_clients.clear()
         bulk_srv = self._om_bulk.get("server")
         if bulk_srv is not None:
             try:
@@ -339,7 +378,14 @@ class Nodelet:
         cfg = get_config()
         beats = 0
         while True:
-            await asyncio.sleep(cfg.heartbeat_interval_s)
+            # with live peers the beat doubles as the gossip carrier, so
+            # it runs at the (faster) gossip cadence; a single-node
+            # session keeps the slow liveness-only rhythm
+            interval = cfg.heartbeat_interval_s
+            if cfg.p2p_spill_enabled and self.cluster_nodes > 1:
+                interval = min(interval,
+                               max(0.05, cfg.view_gossip_interval_s))
+            await asyncio.sleep(interval)
             beats += 1
             try:
                 # delta semantics: the resource view ships only when its
@@ -348,14 +394,20 @@ class Nodelet:
                 version = self._resource_version
                 send_view = (version != self._resource_version_sent
                              or beats % 10 == 0)
-                reply = await self.controller.call_async(
-                    "heartbeat", node_id=self.node_id,
+                kwargs = dict(
+                    node_id=self.node_id,
                     available_resources=(dict(self.available)
                                          if send_view else None),
                     resource_version=version,
                     load={"queued": len(self.queue),
                           "workers": len(self.workers),
                           "object_bytes": self.object_bytes})
+                if cfg.p2p_spill_enabled:
+                    # ask for the gossiped view delta since the last
+                    # revision we applied (piggybacks on the reply)
+                    kwargs["known_view_rev"] = self._view_rev
+                reply = await self.controller.call_async(
+                    "heartbeat", **kwargs)
                 if send_view and reply.get("registered"):
                     self._resource_version_sent = version
                 if reply.get("want_full"):
@@ -363,8 +415,92 @@ class Nodelet:
                     # the authoritative full view on the next beat
                     self._resource_version_sent = 0
                 self.cluster_nodes = reply.get("n_nodes", 1)
+                if "view_rev" in reply:
+                    self._apply_view_entries(reply.get("view"))
+                    self._view_rev = reply["view_rev"]
             except Exception:
                 pass
+            # runs even on a controller hiccup: debit heal must not
+            # depend on the gossip stream being up
+            self._expire_view_debits()
+
+    # ------------------------------------------------------ cluster view
+    def _apply_view_entries(self, entries) -> None:
+        """Merge gossiped per-node view entries into the peer cache.
+        Stale versions (reordered transport, a hint racing a fresher
+        heartbeat delta) are dropped per node; dead entries evict."""
+        from . import scheduling
+
+        for d in entries or ():
+            nid = d.get("node_id")
+            if not nid or nid == self.node_id:
+                continue
+            if not d.get("alive", True):
+                # death evicts the pooled link too — a node re-registered
+                # at the same address must get a fresh dial, not a dead
+                # peer's stale socket
+                stale = self.cluster_view.pop(nid, None)
+                if stale is not None:
+                    self._drop_peer_client(stale.address)
+                if d.get("address"):
+                    self._drop_peer_client(d["address"])
+                self._view_debits.pop(nid, None)
+                continue
+            view = self.cluster_view.get(nid)
+            if view is None or view.address != d.get("address"):
+                # new node — or a re-registration at a fresh address,
+                # whose version counter restarted (plain merge would
+                # reject it against the dead incarnation's high version)
+                self.cluster_view[nid] = scheduling.NodeView.from_wire(d)
+                self._view_debits.pop(nid, None)
+            elif view.merge(d):
+                # the entry replaced the cached values wholesale — any
+                # outstanding optimistic debit is gone with them, so the
+                # restore record must not double-credit later
+                self._view_debits.pop(nid, None)
+
+    def _expire_view_debits(self) -> None:
+        """Restore optimistic _stage_spill debits that no fresh gossip
+        entry has superseded within ~2 gossip rounds. The debit only
+        exists to spread a single burst; the delta gossip stream is
+        value-thinned (a quiescent controller re-delivers nothing), so
+        without this expiry a debited peer whose availability never
+        changed at the controller would look saturated forever."""
+        if not self._view_debits:
+            return
+        ttl = max(1.0, 2 * get_config().view_gossip_interval_s)
+        now = time.monotonic()
+        for nid in list(self._view_debits):
+            t0, debits, qd = self._view_debits[nid]
+            if now - t0 < ttl:
+                continue
+            del self._view_debits[nid]
+            view = self.cluster_view.get(nid)
+            if view is None:
+                continue
+            for key, amount in debits.items():
+                view.available_resources[key] = \
+                    view.available_resources.get(key, 0.0) + amount
+            view.queue_depth = max(0, view.queue_depth - qd)
+
+    async def view_update(self, entry: dict):
+        """Direct peer hint: a spill receiver that was busier than our
+        cached view claimed pushes its true state back, so the stale
+        entry self-corrects without waiting out a gossip round."""
+        self._apply_view_entries([entry])
+        return True
+
+    def _self_view_wire(self) -> dict:
+        # labels must match what registration advertises (NodeView.merge
+        # replaces them wholesale — a hint with fewer labels would strip
+        # rtpu.host_id from the peer's cached entry)
+        return {"node_id": self.node_id, "address": self.address,
+                "total": self.total_resources,
+                "available": dict(self.available),
+                "labels": dict(self.labels,
+                               **{"rtpu.host_id": self.host_id}),
+                "version": self._resource_version,
+                "queue_depth": len(self.queue), "alive": True}
 
     async def _reap_loop(self):
         """Detect dead worker processes and idle-timeout extras (ref:
@@ -957,37 +1093,48 @@ class Nodelet:
     # ------------------------------------------------------------ task path
     async def submit_task_batch(self, specs: List[dict]):
         """A whole staged submission burst in one frame (owner side
-        coalesces in core._drain_staged). Each spec gets its own task —
-        created in list order, so fast-path specs append to the queue in
-        submission order (FIFO), while a spill-bound spec awaiting
-        pick_node/remote submit cannot head-of-line-block the rest of
-        the burst (the legacy per-frame dispatch was concurrent too).
-        Chaos consults the per-logical-request `submit_task` rules for
-        EACH spec — fault-tolerance tests keyed on submit_task keep
-        exercising real drops on this fast path (a dropped spec is lost
-        exactly like a dropped submit_task frame)."""
+        coalesces in core._drain_staged). Fast-path specs — runnable
+        right here, no spill/affinity/locality decision to make — append
+        to the queue synchronously in list order (FIFO, and no per-spec
+        coroutine on the hot path); anything needing placement takes the
+        full submit_task path concurrently, so a spill-bound spec cannot
+        head-of-line-block the rest of the burst. Chaos consults the
+        per-logical-request `submit_task` rules for EACH spec —
+        fault-tolerance tests keyed on submit_task keep exercising real
+        drops on this fast path (a dropped spec is lost exactly like a
+        dropped submit_task frame)."""
         from .rpc import chaos_should_drop
 
-        tasks = [asyncio.ensure_future(
-                     self.submit_task(spec, _defer_dispatch=True))
-                 for spec in specs
-                 if not chaos_should_drop("submit_task")]
-        if not tasks:
-            return True
-        # one loop pass lets every fast-path spec run to its queue
-        # append; dispatch them NOW instead of waiting out a straggler
-        await asyncio.sleep(0)
+        slow = []
+        for raw in specs:
+            if chaos_should_drop("submit_task"):
+                continue
+            spec = self._prep_spec(raw)
+            if spec is None:
+                continue  # cancelled before arrival: already reported
+            if self._fast_path_ok(spec):
+                self.queue.append(spec)
+            else:
+                slow.append(spec)
         self._dispatch()
-        results = await asyncio.gather(*tasks, return_exceptions=True)
-        for res in results:
-            if isinstance(res, BaseException):
-                traceback.print_exception(type(res), res, res.__traceback__)
-        self._dispatch()
+        if slow:
+            tasks = [asyncio.ensure_future(
+                         self.submit_task(spec, _defer_dispatch=True,
+                                          _prepped=True))
+                     for spec in slow]
+            results = await asyncio.gather(*tasks, return_exceptions=True)
+            for res in results:
+                if isinstance(res, BaseException):
+                    traceback.print_exception(type(res), res,
+                                              res.__traceback__)
+            self._dispatch()
         return True
 
-    async def submit_task(self, spec: dict, _defer_dispatch: bool = False):
-        # shallow-copy: with in-process dispatch the caller's spec dict
-        # arrives by reference, and we annotate it (_spilled/_bundle_key)
+    def _prep_spec(self, spec: dict) -> Optional[dict]:
+        """Shallow-copy + annotate a submitted spec (with in-process
+        dispatch the caller's dict arrives by reference, and we mutate
+        it: _spilled/_bundle_key/...). None if it was already cancelled
+        (reported to the owner)."""
         spec = dict(spec)
         if "_env_key" not in spec:
             from .runtime_env import env_key as _env_key
@@ -995,83 +1142,350 @@ class Nodelet:
             spec["_env_key"] = _env_key(spec.get("runtime_env"))
         if spec["task_id"] in self.cancelled:
             self.cancelled.discard(spec["task_id"])
-            await self._report_cancelled(spec)
-            return True
+            asyncio.ensure_future(self._report_cancelled(spec))
+            return None
+        return spec
+
+    def _fast_path_ok(self, spec: dict) -> bool:
+        """True when the spec simply joins the local queue — the common
+        case, kept coroutine-free on the batched path."""
         strategy = spec.get("scheduling_strategy") or ""
-        affinity_elsewhere = (
-            strategy.startswith("NODE_AFFINITY:")
-            and strategy.split(":")[1] != self.node_id)
-        # load-based spill: runnable here eventually, but busy NOW while
-        # other nodes exist — let the controller place it (ref: the
-        # hybrid policy spills past the local critical threshold,
-        # hybrid_scheduling_policy.h:50)
-        # capacity-based spill: local resources exhausted NOW while other
-        # nodes exist — let the controller place it (ref: the hybrid
-        # policy spills past the local critical threshold,
-        # hybrid_scheduling_policy.h:50). Backlogged-but-feasible work is
-        # handled by the periodic respill in the reap loop instead, so
-        # warm single-burst submissions stay local.
-        busy_spill = (self.cluster_nodes > 1
-                      and not strategy.startswith("NODE_AFFINITY:")
-                      and not self._feasible_now(spec))
-        if (affinity_elsewhere or busy_spill
-                or not self._feasible_ever(spec)) \
-                and not spec.get("_spilled"):
-            # not runnable here (or pinned elsewhere): route via the
-            # controller (ref: cluster_task_manager.cc:422 ScheduleOnNode)
-            try:
-                target = await self.controller.call_async(
-                    "pick_node", resources=spec.get("resources", {}),
-                    strategy=strategy or "HYBRID",
-                    placement_group_id=spec.get("placement_group_id"),
-                    bundle_index=spec.get("bundle_index", -1),
-                    _timeout=30)
-            except Exception:
-                target = None  # controller hiccup: keep the task local
-            if target is not None and target["node_id"] != self.node_id:
-                client = RpcClient(target["address"])
-                try:
-                    spec["_spilled"] = True
-                    await client.call_async("submit_task", spec=spec,
-                                            _timeout=30)
-                    # tell the owner where the task went so it can fail
-                    # it over if that node dies (the owner only ever
-                    # talks to ITS nodelet; remote placement is the one
-                    # hop it cannot see)
-                    self._owner_client(spec["owner_addr"]).notify_nowait(
-                        "task_spilled", task_id=spec["task_id"],
-                        node_id=target["node_id"])
-                    return True
-                except Exception:
-                    # target unreachable mid-spill: NEVER drop the task —
-                    # fall through to the local queue / retry paths
-                    spec.pop("_spilled", None)
-                finally:
-                    client.close()
-            if affinity_elsewhere and not strategy.endswith(":soft") and (
-                    target is None or target["node_id"] != self.node_id):
-                # hard affinity to a node that cannot take it right now:
-                # fail fast if the target is dead/unknown, else retry
-                # instead of running in the wrong place
-                target_node = strategy.split(":")[1]
-                try:
-                    nodes = await self.controller.call_async("list_nodes")
-                    info = nodes.get(target_node)
-                except Exception:
-                    info = {"alive": True}  # controller hiccup: keep trying
-                if info is None or not info.get("alive"):
-                    await self._report_failure(
-                        spec, f"NODE_AFFINITY target {target_node} is dead "
-                              "or was never registered")
-                    return True
-                loop = asyncio.get_running_loop()
-                loop.call_later(0.5, lambda: asyncio.ensure_future(
-                    self.submit_task(spec)))
+        if strategy.startswith("NODE_AFFINITY:"):
+            return False
+        if spec.get("_spilled") or spec.get("_spill_hops"):
+            return False  # arrival accounting + bounce logic
+        if self.cluster_nodes > 1:
+            if not self._feasible_now(spec):
+                return False  # spill consideration
+            cfg = get_config()
+            if spec.get("arg_locs") and self.cluster_view \
+                    and cfg.p2p_spill_enabled and cfg.locality_weight > 0:
+                return False  # locality-pull consideration
+            return True
+        return self._feasible_ever(spec)
+
+    async def submit_task(self, spec: dict, _defer_dispatch: bool = False,
+                          _prepped: bool = False):
+        if not _prepped:
+            spec = self._prep_spec(spec)
+            if spec is None:
                 return True
+        cfg = get_config()
+        strategy = spec.get("scheduling_strategy") or ""
+        affinity = strategy.startswith("NODE_AFFINITY:")
+        affinity_elsewhere = (affinity
+                              and strategy.split(":")[1] != self.node_id)
+        hops = spec.get("_spill_hops", 0)
+        spilled_in = bool(spec.get("_spilled")) or hops > 0
+        # a local re-entry after a peer dial failure arrives with
+        # _hop_counted already set — only a genuine remote arrival
+        # counts toward spills_received (and, below, spill_bounces)
+        fresh_arrival = spilled_in and not spec.get("_hop_counted")
+        if fresh_arrival:
+            spec["_hop_counted"] = True  # once per arrival, not per retry
+            self.sched_counters["spills_received"] += 1
+            self.spill_hops_hist[hops] = \
+                self.spill_hops_hist.get(hops, 0) + 1
+        # p2p fast path covers plain tasks only: the controller stays
+        # authoritative for placement groups, slice gangs, and
+        # NODE_AFFINITY validation
+        p2p_ok = (cfg.p2p_spill_enabled and bool(self.cluster_view)
+                  and not affinity and not spec.get("placement_group_id"))
+        # load/capacity spill: local resources exhausted NOW while other
+        # nodes exist (ref: the hybrid policy spills past the local
+        # critical threshold, hybrid_scheduling_policy.h:50).
+        # Backlogged-but-feasible work re-enters placement via the
+        # periodic respill in the reap loop, so warm single-burst
+        # submissions stay local.
+        busy_spill = (self.cluster_nodes > 1 and not affinity
+                      and not self._feasible_now(spec))
+        locality_target = None
+        if p2p_ok and not spilled_in and not busy_spill \
+                and spec.get("arg_locs"):
+            # locality pull: send the task to the bytes when a peer
+            # holds far more of its argument payload than this node
+            locality_target = self._locality_pull_target(spec)
+        want_spill = (affinity_elsewhere or busy_spill
+                      or locality_target is not None
+                      or not self._feasible_ever(spec))
+        if want_spill:
+            if spilled_in:
+                # a spilled task landed on a busy/infeasible node: the
+                # sender acted on a stale view. Hint our true state back
+                # so its cache self-corrects, then re-spill under a
+                # bounded hop budget — the cap terminates spill
+                # ping-pong; past it the task parks here. A dial-failure
+                # re-entry (not a fresh arrival) skips the counter and
+                # the hint: the sender's link died, its view didn't lie.
+                if fresh_arrival:
+                    self.sched_counters["spill_bounces"] += 1
+                    self._hint_sender(spec)
+                if p2p_ok and hops < cfg.spill_max_hops:
+                    target = self._pick_peer_for(spec)
+                    if target is not None:
+                        self._stage_spill(target, spec)
+                        return True
+            else:
+                if p2p_ok:
+                    target = locality_target or self._pick_peer_for(spec)
+                    if target is not None:
+                        self._stage_spill(target, spec)
+                        return True
+                if not p2p_ok or affinity_elsewhere \
+                        or not self._feasible_ever(spec):
+                    # controller-authoritative placement: PG specs,
+                    # affinity, work this node can never run, or p2p
+                    # disabled / view still empty
+                    if await self._controller_spill(
+                            spec, strategy, affinity_elsewhere, hops):
+                        return True
+                # else: busy-but-feasible with no feasible peer in the
+                # current view — park locally; the periodic respill
+                # re-enters placement as the gossip converges (zero
+                # pick_node RPCs in the saturated steady state)
+        if spilled_in:
+            # parked here: shed the spill markers so the task is a
+            # native local one from now on — the periodic respill (which
+            # skips _spilled specs) may then re-place it with a fresh
+            # hop budget once the gossip has converged; keeping the
+            # markers stranded it behind this node's backlog forever
+            for key in ("_spilled", "_spill_hops", "_spill_from",
+                        "_hop_counted", "_spill_via"):
+                spec.pop(key, None)
         self.queue.append(spec)
         if not _defer_dispatch:
             self._dispatch()
         return True
+
+    async def _controller_spill(self, spec: dict, strategy: str,
+                                affinity_elsewhere: bool,
+                                hops: int) -> bool:
+        """Controller-routed placement (ref: cluster_task_manager.cc:422
+        ScheduleOnNode). Returns True when the task was fully handled
+        (spilled remotely, failed, or re-queued for retry); False means
+        the caller should queue it locally."""
+        cfg = get_config()
+        self.sched_counters["pick_node_rpcs"] += 1
+        try:
+            target = await self.controller.call_async(
+                "pick_node", resources=spec.get("resources", {}),
+                strategy=strategy or "HYBRID",
+                placement_group_id=spec.get("placement_group_id"),
+                bundle_index=spec.get("bundle_index", -1),
+                arg_locs=spec.get("arg_locs"),
+                locality_weight=cfg.locality_weight,
+                _timeout=30)
+        except Exception:
+            target = None  # controller hiccup: keep the task local
+        if target is not None and target["node_id"] != self.node_id:
+            try:
+                spec["_spilled"] = True
+                spec["_spill_hops"] = hops + 1
+                spec["_spill_from"] = self.address
+                spec["_placement_seq"] = \
+                    spec.get("_placement_seq", 0) + 1
+                await self._peer_client(target["address"]).call_async(
+                    "submit_task", spec=spec, _timeout=30)
+                self.sched_counters["controller_spills"] += 1
+                # tell the owner where the task went so it can fail
+                # it over if that node dies (the owner only ever
+                # talks to ITS nodelet; remote placement is the one
+                # hop it cannot see)
+                self._owner_client(spec["owner_addr"]).notify_nowait(
+                    "task_spilled", task_id=spec["task_id"],
+                    node_id=target["node_id"],
+                    seq=spec["_placement_seq"])
+                return True
+            except Exception:
+                # target unreachable mid-spill: NEVER drop the task —
+                # fall through to the local queue / retry paths
+                spec.pop("_spilled", None)
+                spec["_spill_hops"] = hops
+                self._drop_peer_client(target["address"])
+        if affinity_elsewhere and not strategy.endswith(":soft") and (
+                target is None or target["node_id"] != self.node_id):
+            # hard affinity to a node that cannot take it right now:
+            # fail fast if the target is dead/unknown, else retry
+            # instead of running in the wrong place
+            target_node = strategy.split(":")[1]
+            try:
+                nodes = await self.controller.call_async("list_nodes")
+                info = nodes.get(target_node)
+            except Exception:
+                info = {"alive": True}  # controller hiccup: keep trying
+            if info is None or not info.get("alive"):
+                await self._report_failure(
+                    spec, f"NODE_AFFINITY target {target_node} is dead "
+                          "or was never registered")
+                return True
+            loop = asyncio.get_running_loop()
+            loop.call_later(0.5, lambda: asyncio.ensure_future(
+                self.submit_task(spec)))
+            return True
+        return False
+
+    # ------------------------------------------------------ p2p spill
+    _LOCALITY_PULL_MIN = 1 << 20  # bytes; below this, move the bytes
+
+    def _pick_peer_for(self, spec: dict):
+        """A feasible peer from the gossiped view (locality-discounted
+        hybrid order), or None. Zero RPCs — this IS the spill fast
+        path."""
+        from . import scheduling
+
+        exclude = set(spec.get("_spill_via") or ())
+        exclude.add(self.node_id)
+        nodes = [v for nid, v in self.cluster_view.items()
+                 if nid not in exclude]
+        if not nodes:
+            return None
+        return scheduling.pick_node_for(
+            nodes, spec.get("resources", {}),
+            strategy=spec.get("scheduling_strategy") or "HYBRID",
+            arg_locs=spec.get("arg_locs"),
+            locality_weight=get_config().locality_weight,
+            queue_tiebreak=True)
+
+    _LOCALITY_MAX_QUEUE = 8  # pull into at most this much backlog
+
+    def _locality_pull_target(self, spec: dict):
+        """The peer holding strictly more of this task's argument bytes
+        than this node (and at least _LOCALITY_PULL_MIN — below that,
+        pulling the bytes beats a cross-node dispatch). Eligibility is
+        capacity (can EVER run it) with a bounded queue, not instant
+        availability: the gossiped view is up to a round stale, and the
+        byte-holding peer very often just freed its slots by finishing
+        the producer — forfeiting the pull on that stale reading sends
+        the bytes across hosts to dodge a sub-second queue wait. A peer
+        that really is busy bounces or parks the task where the bytes
+        are, which is still the cheaper outcome for large arguments."""
+        if get_config().locality_weight <= 0:
+            return None
+        locs = spec.get("arg_locs") or {}
+        req = spec.get("resources", {})
+        best = None
+        best_bytes = max(locs.get(self.address, 0),
+                         self._LOCALITY_PULL_MIN - 1)
+        for view in self.cluster_view.values():
+            b = locs.get(view.address, 0)
+            if b > best_bytes and (
+                    _leq(req, view.available_resources)
+                    or (_leq(req, view.total_resources)
+                        and view.queue_depth <= self._LOCALITY_MAX_QUEUE)):
+                best, best_bytes = view, b
+        return best
+
+    def _hint_sender(self, spec: dict) -> None:
+        """Push this node's true view entry back to the nodelet that
+        spilled here on stale numbers (fire-and-forget)."""
+        addr = spec.pop("_spill_from", None)
+        if addr and addr != self.address:
+            try:
+                self._peer_client(addr).notify_nowait(
+                    "view_update", entry=self._self_view_wire())
+            except Exception:
+                pass
+
+    def _stage_spill(self, view, spec: dict) -> None:
+        """Queue a spec for spill to `view`'s node: spills staged to the
+        same peer within one loop pass coalesce into ONE
+        submit_task_batch frame over the pooled peer link (the owner→
+        nodelet staging pattern applied to the nodelet→peer hop)."""
+        spec["_spill_hops"] = spec.get("_spill_hops", 0) + 1
+        spec["_spilled"] = True
+        spec["_spill_from"] = self.address
+        # total order over this task's placement transfers (survives
+        # marker shedding on purpose): the owner keeps the max-seq
+        # task_spilled hint, so reordered notifies from different hops
+        # cannot leave it watching a node the task already left
+        spec["_placement_seq"] = spec.get("_placement_seq", 0) + 1
+        spec.pop("_hop_counted", None)
+        via = list(spec.get("_spill_via") or ())
+        via.append(self.node_id)
+        spec["_spill_via"] = via[-8:]
+        # optimistic local debit so one burst doesn't dog-pile a single
+        # peer; short-lived by design — a fresh gossip entry supersedes
+        # it, and _expire_view_debits restores it otherwise
+        req = spec.get("resources", {})
+        _sub(view.available_resources, req)
+        view.queue_depth += 1
+        rec = self._view_debits.get(view.node_id)
+        if rec is None:
+            rec = self._view_debits[view.node_id] = \
+                [time.monotonic(), {}, 0]
+        for key, amount in req.items():
+            rec[1][key] = rec[1].get(key, 0.0) + amount
+        rec[2] += 1
+        entry = self._spill_staged.get(view.address)
+        if entry is None:
+            entry = self._spill_staged[view.address] = (view.node_id, [])
+        entry[1].append(spec)
+        if not self._spill_drain_armed:
+            self._spill_drain_armed = True
+            asyncio.get_running_loop().call_soon(self._drain_spills)
+
+    def _drain_spills(self) -> None:
+        self._spill_drain_armed = False
+        staged, self._spill_staged = self._spill_staged, {}
+        for addr, (node_id, specs) in staged.items():
+            asyncio.ensure_future(self._send_spills(addr, node_id, specs))
+
+    async def _send_spills(self, addr: str, node_id: str,
+                           specs: List[dict]) -> None:
+        client = self._peer_client(addr)
+        try:
+            if len(specs) == 1:
+                await client.call_async("submit_task", spec=specs[0],
+                                        _timeout=30)
+            else:
+                await client.call_async("submit_task_batch", specs=specs,
+                                        _timeout=30)
+        except Exception:
+            # peer unreachable mid-spill: NEVER drop a task. Evict the
+            # peer from the view and the client pool, then re-place
+            # every spec — each re-enters the p2p pick against the
+            # pruned view, the controller path, or the local queue.
+            self.cluster_view.pop(node_id, None)
+            self._view_debits.pop(node_id, None)
+            self._drop_peer_client(addr)
+            for spec in specs:
+                spec.pop("_spilled", None)
+                spec.pop("_spill_from", None)
+                # undo the staging hop: a dead link is not a stale-view
+                # bounce — re-entry must not inflate the bounce counter
+                # or burn the hop budget on local dial failures
+                hops = spec.get("_spill_hops", 1) - 1
+                if hops > 0:
+                    spec["_spill_hops"] = hops
+                    spec["_hop_counted"] = True  # re-entry, not an arrival
+                else:
+                    spec.pop("_spill_hops", None)
+                    spec.pop("_hop_counted", None)
+                asyncio.ensure_future(self.submit_task(spec,
+                                                       _prepped=True))
+            return
+        self.sched_counters["p2p_spills"] += len(specs)
+        for spec in specs:
+            self._owner_client(spec["owner_addr"]).notify_nowait(
+                "task_spilled", task_id=spec["task_id"], node_id=node_id,
+                seq=spec.get("_placement_seq", 0))
+
+    def _peer_client(self, address: str) -> RpcClient:
+        """Pooled peer-nodelet link (same LRU pattern as _owner_client;
+        dial-per-spill cost one connect + fd per spilled task)."""
+        client = self._peer_clients.pop(address, None)
+        if client is None:
+            while len(self._peer_clients) >= 128:
+                old_addr = next(iter(self._peer_clients))
+                self._peer_clients.pop(old_addr).close_when_drained()
+            client = RpcClient(address)
+        self._peer_clients[address] = client
+        return client
+
+    def _drop_peer_client(self, address: str) -> None:
+        client = self._peer_clients.pop(address, None)
+        if client is not None:
+            client.close()
 
     def _idle_pool(self, key: str) -> collections.deque:
         pool = self.idle.get(key)
@@ -1215,7 +1629,11 @@ class Nodelet:
 
     async def _notify_worker(self, ws: WorkerState, method: str, **kw):
         """Prefer the worker's inbound connection (no dial-back fd);
-        fall back to the client if the push channel is gone."""
+        fall back to the client if the push channel is gone. The
+        fallback can DOUBLE-deliver (a concurrent notify's failure flips
+        `closed` after this send already drained) — harmless, because
+        workers dedupe execute_task/create_actor pushes by
+        (task_id, _dispatch_seq) (worker.Executor.h_execute_task)."""
         if ws.conn is not None and not ws.conn.closed:
             await ws.conn.notify(method, **kw)
             if not ws.conn.closed:
@@ -1223,12 +1641,20 @@ class Nodelet:
         await ws.client.notify_async(method, **kw)
 
     async def _push_to_worker(self, ws: WorkerState, spec: dict):
+        # per-dispatch stamp: the worker dedupes a push delivered twice
+        # (the drain-then-fallback race in _notify_worker) by
+        # (task_id, _dispatch_seq), while a genuine retry of the same
+        # task_id gets a fresh stamp and executes
+        self._dispatch_seq += 1
+        spec["_dispatch_seq"] = self._dispatch_seq
         try:
             await self._notify_worker(ws, "execute_task", spec=spec)
         except Exception:
             await self._on_worker_death(ws)
 
     async def _push_actor_to_worker(self, ws: WorkerState, spec: dict):
+        self._dispatch_seq += 1
+        spec["_dispatch_seq"] = self._dispatch_seq
         try:
             await self._attach_cls_blob(spec)
             await self._notify_worker(ws, "create_actor", spec=spec)
@@ -1435,6 +1861,12 @@ class Nodelet:
             "available": self.available,
             "workers": len(self.workers),
             "queued": len(self.queue),
+            # scheduling-plane observability: spill-path counters + the
+            # hop histogram (benchmarks/scale.py derives spill_hops_p99)
+            "sched": dict(self.sched_counters),
+            "spill_hops_hist": dict(self.spill_hops_hist),
+            "cluster_view": {nid: v.version
+                             for nid, v in self.cluster_view.items()},
         }
 
 

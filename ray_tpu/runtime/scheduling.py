@@ -26,6 +26,56 @@ STRICT_SPREAD = "STRICT_SPREAD"
 SLICE_PACK = "SLICE_PACK"
 
 
+class NodeView:
+    """One gossiped per-node resource view entry — the nodelet-side cache
+    of the cluster state (ref: ray_syncer.h:83 — every update carries a
+    monotonically increasing per-node version; receivers drop stale or
+    reordered views). Shaped like the controller's NodeInfo so
+    ``pick_node_for`` runs identically against either table."""
+
+    __slots__ = ("node_id", "address", "total_resources",
+                 "available_resources", "labels", "alive", "version",
+                 "queue_depth")
+
+    def __init__(self, node_id: str, address: str,
+                 total: Dict[str, float], available: Dict[str, float],
+                 labels: Optional[Dict[str, str]] = None, version: int = 0,
+                 queue_depth: int = 0, alive: bool = True):
+        self.node_id = node_id
+        self.address = address
+        self.total_resources = dict(total)
+        self.available_resources = dict(available)
+        self.labels = labels or {}
+        self.version = version
+        self.queue_depth = queue_depth
+        self.alive = alive
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "NodeView":
+        return cls(d["node_id"], d["address"], d.get("total", {}),
+                   d.get("available", {}), d.get("labels"),
+                   d.get("version", 0), d.get("queue_depth", 0),
+                   d.get("alive", True))
+
+    def merge(self, d: dict) -> bool:
+        """Apply a wire update if it is not stale (version >= cached —
+        equal-version full views are idempotent and heal divergence, the
+        same merge rule as the controller's heartbeat table). Returns
+        True when applied."""
+        if d.get("version", 0) < self.version:
+            return False
+        self.address = d.get("address", self.address)
+        self.total_resources = dict(d.get("total", self.total_resources))
+        self.available_resources = dict(
+            d.get("available", self.available_resources))
+        if d.get("labels") is not None:
+            self.labels = d["labels"]
+        self.version = d.get("version", self.version)
+        self.queue_depth = d.get("queue_depth", self.queue_depth)
+        self.alive = d.get("alive", True)
+        return True
+
+
 def _feasible(avail: Dict[str, float], req: Dict[str, float]) -> bool:
     for key, amount in req.items():
         if amount > 0 and avail.get(key, 0.0) < amount - 1e-9:
@@ -46,8 +96,18 @@ def _utilization_after(node, req: Dict[str, float]) -> float:
 
 def pick_node_for(nodes: Sequence, resources: Dict[str, float],
                   strategy: str = "HYBRID", pg: Optional[dict] = None,
-                  bundle_index: int = -1):
-    """Pick one node for a task/actor. Returns the node object or None."""
+                  bundle_index: int = -1,
+                  arg_locs: Optional[Dict[str, int]] = None,
+                  locality_weight: float = 0.0,
+                  queue_tiebreak: bool = False):
+    """Pick one node for a task/actor. Returns the node object or None.
+
+    ``arg_locs`` (node address -> resident argument bytes, threaded from
+    the owner's object directory) makes the HYBRID order locality-aware:
+    a candidate's utilization score is discounted by ``locality_weight ×
+    (its resident fraction of the argument bytes)``, so tasks go to the
+    bytes instead of the bytes to the tasks (ref: the reference's
+    locality-aware lease policy, locality_scheduling_policy.cc)."""
     alive = [n for n in nodes if n.alive]
     if pg is not None and pg.get("placement"):
         placement = pg["placement"]
@@ -67,23 +127,45 @@ def pick_node_for(nodes: Sequence, resources: Dict[str, float],
         if not soft:
             return None
         strategy = "HYBRID"
-    native = _native_pick(alive, resources, strategy)
-    if native is _NO_NODE:
-        return None
-    if native is not None:
-        return native
+    total_loc = sum(arg_locs.values()) if arg_locs else 0
+    use_loc = locality_weight > 0 and total_loc > 0
+    if not use_loc:  # the native scorer does not model locality
+        native = _native_pick(alive, resources, strategy)
+        if native is _NO_NODE:
+            return None
+        if native is not None:
+            return native
     feasible = [n for n in alive if _feasible(n.available_resources, resources)]
     if not feasible:
         return None
     if strategy == "SPREAD":
         # least-loaded first (ref: spread policy round-robins over feasible)
         return min(feasible, key=lambda n: _utilization_after(n, resources))
+
+    def _score(n) -> float:
+        s = _utilization_after(n, resources)
+        if use_loc:
+            s -= locality_weight * (
+                arg_locs.get(getattr(n, "address", None), 0) / total_loc)
+        return s
+
     # HYBRID / DEFAULT: pack onto busiest feasible node below the critical
-    # utilization threshold, randomize among top candidates
+    # utilization threshold — discounted by resident argument bytes when
+    # locality is in play — randomize among top candidates
     # (ref: hybrid_scheduling_policy.h:50).
-    scored = sorted(feasible, key=lambda n: _utilization_after(n, resources))
-    top = [n for n in scored if _utilization_after(n, resources)
-           <= _utilization_after(scored[0], resources) + 1e-9]
+    scored = sorted(feasible, key=_score)
+    top = [n for n in scored if _score(n) <= _score(scored[0]) + 1e-9]
+    if queue_tiebreak:
+        # break utilization ties on gossiped queue depth: availability
+        # alone cannot see a backlog, so among equally-utilized
+        # candidates prefer the shallowest queue instead of dog-piling
+        # one peer. Only the nodelet's p2p picker opts in — its
+        # _stage_spill debit keeps queue_depth live between picks; the
+        # controller's table is static until the next heartbeat, where
+        # this narrowing would concentrate a whole burst on one node
+        # that random.choice used to spread
+        qmin = min(getattr(n, "queue_depth", 0) for n in top)
+        top = [n for n in top if getattr(n, "queue_depth", 0) <= qmin]
     return random.choice(top)
 
 

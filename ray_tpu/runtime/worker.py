@@ -105,6 +105,15 @@ class Executor:
         self._outstanding = 0
         self._count_lock = threading.Lock()
         self._draining = False
+        # dispatch dedupe: the nodelet's push can double-deliver when a
+        # concurrent send flips the registered connection `closed` after
+        # this dispatch's drain already succeeded (it then re-sends over
+        # the dial-back client). Running tasks dedupe by task_id alone;
+        # finished ones by (task_id, _dispatch_seq) so a genuine retry
+        # of the same task_id (fresh stamp from the nodelet) still runs.
+        self._running_tasks: set = set()
+        self._done_dispatches: set = set()
+        self._done_order: collections.deque = collections.deque()
 
     def handlers(self):
         return {
@@ -117,7 +126,26 @@ class Executor:
         }
 
     # ------------------------------------------------------------ plain tasks
+    def _is_duplicate_dispatch(self, spec: dict) -> bool:
+        tid = spec["task_id"]
+        if tid in self._running_tasks:
+            return True
+        return (tid, spec.get("_dispatch_seq")) in self._done_dispatches
+
+    def _note_dispatch_done(self, spec: dict) -> None:
+        key = (spec["task_id"], spec.get("_dispatch_seq"))
+        self._done_dispatches.add(key)
+        self._done_order.append(key)
+        while len(self._done_order) > 128:  # dup window, not a history
+            self._done_dispatches.discard(self._done_order.popleft())
+
     async def h_execute_task(self, spec: dict):
+        if self._is_duplicate_dispatch(spec):
+            # double-delivered push (nodelet drain-then-fallback race):
+            # executing it again would double-run user code and
+            # double-free the nodelet's resource accounting
+            return True
+        self._running_tasks.add(spec["task_id"])
         self.exec_pool.submit(self._run_task, spec)
         return True
 
@@ -180,6 +208,11 @@ class Executor:
             self._flush_spans(spec)
             done_sent = self._send_error(spec, e)
         finally:
+            # done-window entry BEFORE dropping the running mark: the
+            # reverse order left a gap where a double-delivered push
+            # passed both dedupe checks and re-ran the task
+            self._note_dispatch_done(spec)
+            self._running_tasks.discard(task_id)
             if not done_sent:
                 try:
                     self.core.nodelet.notify_nowait(
@@ -337,6 +370,11 @@ class Executor:
 
     # ------------------------------------------------------------ actors
     async def h_create_actor(self, spec: dict):
+        if self.actor_id is not None or self._is_duplicate_dispatch(spec):
+            # one worker hosts at most one actor; a second create for
+            # the same id is the nodelet's double-delivered push
+            return True
+        self._running_tasks.add(spec["task_id"])
         self.exec_pool.submit(self._create_actor, spec)
         return True
 

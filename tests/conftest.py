@@ -43,6 +43,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "llm_kv: distributed KV-cache plane (bulk handoff + "
         "prefix registry) tests; tier-1 on the CPU tiny-model config")
+    config.addinivalue_line(
+        "markers", "sched: decentralized scheduling plane (gossiped "
+        "views, p2p spill, locality) tests")
 
 
 @pytest.fixture
