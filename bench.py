@@ -430,6 +430,26 @@ def main():
                 result["detail"]["kv_handoff_gb_s"] = pd["kv_handoff_gb_s"]
         except Exception as e:  # noqa: BLE001
             result["detail"]["pd_handoff"] = {"error": repr(e)[:200]}
+
+    # 7. static analysis: rtpulint over the runtime layers (cheap, ~2s).
+    # lint_clean records when the tree regresses on a concurrency
+    # invariant; unsuppressed_findings is the count behind it.
+    try:
+        import os as _os
+
+        from tools.rtpulint import run as _lint_run
+
+        _repo = _os.path.dirname(_os.path.abspath(__file__))
+        _findings, _ = _lint_run(
+            [_os.path.join(_repo, "ray_tpu", "runtime"),
+             _os.path.join(_repo, "ray_tpu", "serve")])
+        _bad = sum(1 for f in _findings if not f.suppressed)
+        result["detail"]["lint_clean"] = _bad == 0
+        result["detail"]["lint_unsuppressed_findings"] = _bad
+    except Exception as e:  # noqa: BLE001
+        result["detail"]["lint_clean"] = False
+        result["detail"]["lint_unsuppressed_findings"] = -1
+        result["detail"]["lint_error"] = repr(e)[:200]
     print(json.dumps(result))
 
 

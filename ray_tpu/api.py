@@ -53,6 +53,19 @@ def shutdown() -> None:
     if session is not None:
         _node.set_session(None)
         session.shutdown()
+        from .runtime import procutil
+
+        if procutil.orphan_check_enabled():
+            # Runtime sanitizer (asyncio-debug companion to rtpulint
+            # RTPU003): after a clean teardown no fire-and-forget task
+            # may still be pending — a survivor here is a leaked loop or
+            # a drain that never completes, invisible in normal runs.
+            leaked = procutil.pending_spawned(grace_s=2.0)
+            if leaked:
+                raise AssertionError(
+                    "orphan fire-and-forget tasks still pending after "
+                    f"shutdown: {leaked} (spawned via "
+                    "procutil.spawn_logged; RTPU003 debug check)")
 
 
 def is_initialized() -> bool:

@@ -120,6 +120,13 @@ class RuntimeConfig:
     enable_timeline: bool = True
     event_buffer_size: int = 10000
     metrics_report_interval_s: float = 5.0
+    # Event-loop stall watchdog: >0 arms asyncio debug mode on the
+    # process's io loop with slow_callback_duration set to this many
+    # milliseconds — callbacks that hold the loop longer are logged by
+    # asyncio and counted into the rtpu_loop_stall_total metric (the
+    # runtime-sanitizer companion to rtpulint RTPU001). 0 = off: debug
+    # mode wraps every callback and is too heavy for production loops.
+    loop_watchdog_ms: int = 0
 
     # --- logging ---
     log_to_driver: bool = True

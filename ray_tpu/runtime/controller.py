@@ -26,6 +26,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from . import scheduling
+from .procutil import log, spawn_logged
 from .ids import ActorID, NodeID, PlacementGroupID
 from .rpc import RpcClient, RpcServer, ServerConn
 
@@ -290,16 +291,17 @@ class Controller:
         # re-register
         for info in self.actors.values():
             if info.state == ACTOR_RESTARTING:
-                asyncio.ensure_future(self._schedule_actor(info))
+                spawn_logged(self._schedule_actor(info),
+                             name="controller.schedule_actor")
         for pg in self.placement_groups.values():
             if pg.get("state") == "PENDING":
-                asyncio.ensure_future(self._retry_pg(pg))
+                spawn_logged(self._retry_pg(pg), name="controller.retry_pg")
 
     async def stop(self):
         if self._store_backend is not None:
             try:
                 self._store_backend.close()
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — shutdown teardown is best-effort
                 pass
         if self._health_task:
             self._health_task.cancel()
@@ -307,7 +309,7 @@ class Controller:
             if node.client is not None:
                 try:
                     await node.client.notify_async("shutdown")
-                except Exception:
+                except Exception:  # rtpulint: ignore[RTPU006] — a nodelet that is already gone needs no shutdown notice
                     pass
         await self._server.stop()
 
@@ -485,7 +487,8 @@ class Controller:
         if name:
             self.named_actors[(namespace, name)] = actor_id
             self._persist()
-        asyncio.ensure_future(self._schedule_actor(info))
+        spawn_logged(self._schedule_actor(info),
+                     name="controller.schedule_actor")
         return {"status": "registered", "actor_id": actor_id}
 
     async def _schedule_actor(self, info: ActorInfo):
@@ -532,8 +535,10 @@ class Controller:
             try:
                 client = RpcClient(address)
                 await client.notify_async("drain_exit")
-            except Exception:
-                pass
+            except Exception as e:
+                # a lost drain_exit leaves the actor running until its
+                # owner-handle fate-sharing path fires
+                log.debug("drain_exit to %s undeliverable: %r", address, e)
         return True
 
     async def actor_died(self, actor_id: str, reason: str = "",
@@ -547,7 +552,8 @@ class Controller:
             info.state = ACTOR_RESTARTING
             info.address = None
             await self._publish(f"actor:{actor_id}", info.snapshot())
-            asyncio.ensure_future(self._schedule_actor(info))
+            spawn_logged(self._schedule_actor(info),
+                         name="controller.schedule_actor")
         else:
             info.state = ACTOR_DEAD
             info.death_cause = reason
@@ -638,8 +644,9 @@ class Controller:
                 client = RpcClient(info.address)
                 await client.notify_async("drain_exit" if drain
                                           else "kill_self")
-            except Exception:
-                pass
+            except Exception as e:
+                log.debug("kill/drain to %s undeliverable: %r",
+                          info.address, e)
         if info.state != ACTOR_ALIVE:
             await self.actor_died(actor_id, reason="killed via kill_actor",
                                   worker_failed=not no_restart)
@@ -684,7 +691,7 @@ class Controller:
                   "strategy": strategy, "name": name, "placement": None}
             self.placement_groups[pg_id] = pg
             self._persist()
-            asyncio.ensure_future(self._retry_pg(pg))
+            spawn_logged(self._retry_pg(pg), name="controller.retry_pg")
             return {"state": "PENDING"}
         ok = await self._reserve_placement(pg_id, bundles, placement)
         if not ok:
@@ -692,7 +699,7 @@ class Controller:
                   "strategy": strategy, "name": name, "placement": None}
             self.placement_groups[pg_id] = pg
             self._persist()
-            asyncio.ensure_future(self._retry_pg(pg))
+            spawn_logged(self._retry_pg(pg), name="controller.retry_pg")
             return {"state": "PENDING"}
         self.placement_groups[pg_id] = {
             "pg_id": pg_id, "state": "CREATED", "bundles": bundles,
@@ -718,7 +725,7 @@ class Controller:
                     try:
                         await rnode.client.call_async(
                             "return_bundle", pg_id=pg_id, bundle_index=ridx)
-                    except Exception:
+                    except Exception:  # rtpulint: ignore[RTPU006] — rollback on a node that just failed its prepare; its bundle state resets on re-registration
                         pass
                 return False
             reserved.append((idx, node_id))
@@ -749,7 +756,7 @@ class Controller:
                     try:
                         await node.client.call_async(
                             "return_bundle", pg_id=pg_id, bundle_index=idx)
-                    except Exception:
+                    except Exception:  # rtpulint: ignore[RTPU006] — pg removal on a dead/leaving node; its resources died with it
                         pass
         return True
 

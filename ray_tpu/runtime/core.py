@@ -32,6 +32,7 @@ from . import serialization
 from .config import get_config
 from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from .object_store import host_id as _get_host_id, make_store_client
+from .procutil import log, spawn_logged
 from .rpc import EventLoopThread, RpcClient, RpcServer, ConnectionLost, RemoteHandlerError
 
 _core_lock = threading.Lock()
@@ -114,7 +115,7 @@ class ObjectRef:
             if core is not None and not core._shutting_down:
                 try:
                     core._remove_local_ref(self._oid)
-                except Exception:
+                except Exception:  # rtpulint: ignore[RTPU006] — GC finalizer: raising/logging here can fire at interpreter teardown with modules half-dead
                     pass
 
     def future(self):
@@ -333,8 +334,8 @@ class CoreWorker:
             # log_monitor.py -> GcsLogSubscriber -> driver print)
             try:
                 self.subscribe("logs", self._print_worker_logs)
-            except Exception:
-                pass
+            except Exception as e:
+                log.debug("worker log streaming unavailable: %r", e)
 
     @staticmethod
     def _print_worker_logs(msg):
@@ -428,7 +429,7 @@ class CoreWorker:
                 # only a DELIVERED snapshot suppresses the resend — a
                 # failed report retries on the next tick
                 last = snap
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — periodic retry loop: a log per failed tick spams for as long as the controller is down
                 pass
 
     def shutdown(self):
@@ -444,14 +445,14 @@ class CoreWorker:
                     "report_metrics",
                     node_id=f"{self.node_id}/{self.worker_id.hex()[:8]}",
                     metrics=snap, _timeout=2)
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — shutdown teardown is best-effort; metrics are droppable
                 pass
         # best-effort: release our borrows so owners' deferred deletes run
         for oid, owner in list(self._borrowed_owners.items()):
             try:
                 self.client_for(owner).notify_nowait(
                     "borrow_dec", oid=oid.binary(), borrower=self.address)
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — exit path; a dead owner no longer needs our borrow release
                 pass
         if self._borrowed_owners:
             time.sleep(0.1)  # let the scheduled dec sends flush
@@ -461,14 +462,14 @@ class CoreWorker:
         if bulk_srv is not None:
             try:
                 EventLoopThread.get().run(bulk_srv.stop(), timeout=3)
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — shutdown teardown is best-effort
                 pass
         try:
             if self._server is not None:
                 # bounded: peers (e.g. live workers on other nodes) may
                 # still hold connections open
                 EventLoopThread.get().run(self._server.stop(), timeout=5)
-        except Exception:
+        except Exception:  # rtpulint: ignore[RTPU006] — shutdown teardown is best-effort
             pass
         # staged/fire-and-forget frames (task results, stream
         # terminators) must reach the socket before close — a frame
@@ -481,7 +482,7 @@ class CoreWorker:
                     asyncio.gather(*(c.drain_async(2.0) for c in clients),
                                    return_exceptions=True),
                     timeout=4.0)
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — bounded drain at exit; undeliverable frames die with the peers
                 pass
         for c in clients:
             c.close()
@@ -522,8 +523,9 @@ class CoreWorker:
                         self.client_for(owner).notify_nowait(
                             "borrow_dec", oid=oid.binary(),
                             borrower=self.address)
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        log.debug("borrow_dec to %s undeliverable: %r",
+                                  owner, e)
         else:
             self.local_refs[oid] = count
 
@@ -536,8 +538,10 @@ class CoreWorker:
         try:
             self.client_for(owner_addr).notify_nowait(
                 "borrow_inc", oid=oid.binary(), borrower=self.address)
-        except Exception:
-            pass
+        except Exception as e:
+            # an unregistered borrow means the owner may delete early and
+            # this process later sees ObjectLost — worth a trace
+            log.debug("borrow_inc to %s undeliverable: %r", owner_addr, e)
 
     # owner-side borrow bookkeeping
     async def _h_borrow_inc(self, oid: bytes, borrower: str):
@@ -617,7 +621,7 @@ class CoreWorker:
             if ev is not None:
                 try:
                     EventLoopThread.get().loop.call_soon_threadsafe(ev.set)
-                except Exception:
+                except Exception:  # rtpulint: ignore[RTPU006] — __del__ path: the loop may already be closed at interpreter exit
                     pass
             return
         self._pending_delete.discard(oid)
@@ -647,7 +651,7 @@ class CoreWorker:
             self._stream_pins.discard(oid)
             try:
                 self.store.unpin(oid)
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — unpin of an entry the store already evicted/forgot is a no-op
                 pass
         self.store.delete(oid)
 
@@ -700,10 +704,11 @@ class CoreWorker:
         if sw[0] <= 0:
             sw[1].set()
         for oid in recover:
-            self._spawn_threadsafe(self._recover_and_resolve(oid))
+            self._spawn_threadsafe(self._recover_and_resolve(oid),
+                                   name="core.recover")
 
-    def _spawn_threadsafe(self, coro):
-        """ensure_future on the CORE's io loop from any thread — the
+    def _spawn_threadsafe(self, coro, name: str = "core.threadsafe"):
+        """spawn_logged on the CORE's io loop from any thread — the
         caller may itself be inside some other running loop (a user
         calling a sync get() from their own async code), so identity
         matters, not merely 'a loop is running'."""
@@ -713,10 +718,10 @@ class CoreWorker:
         except RuntimeError:
             running = None
         if running is loop:
-            asyncio.ensure_future(coro)
+            spawn_logged(coro, name=name)
         else:
             loop.call_soon_threadsafe(
-                lambda c=coro: asyncio.ensure_future(c))
+                lambda c=coro: spawn_logged(c, name=name))
 
     async def _recover_and_resolve(self, oid: ObjectID):
         try:
@@ -1358,19 +1363,24 @@ class CoreWorker:
                 if task_specs:
                     # flush so global staging order also holds across
                     # the task/actor interleave
-                    asyncio.ensure_future(
-                        self._submit_batch_to_nodelet(task_specs))
+                    spawn_logged(
+                        self._submit_batch_to_nodelet(task_specs),
+                        name="core.submit_batch")
                     task_specs = []
-                asyncio.ensure_future(self._send_actor_task(actor_id, spec))
+                spawn_logged(self._send_actor_task(actor_id, spec),
+                             name="core.actor_send")
         if task_specs:
-            asyncio.ensure_future(self._submit_batch_to_nodelet(task_specs))
+            spawn_logged(self._submit_batch_to_nodelet(task_specs),
+                         name="core.submit_batch")
         if staged:
             # past the per-pass cap: keep the loop responsive, drain the
-            # rest on the next pass
+            # rest on the next pass. _drain_staged only ever runs ON the
+            # loop (call_soon_threadsafe / call_later / the sync bridge),
+            # so the running loop IS the right one to re-arm.
             with self._stage_lock:
                 if not self._stage_armed:
                     self._stage_armed = True
-                    (self._loop or EventLoopThread.get().loop).call_soon(
+                    asyncio.get_running_loop().call_soon(
                         self._drain_staged)
 
     def _flush_staged(self):
@@ -1381,7 +1391,7 @@ class CoreWorker:
             return
         try:
             EventLoopThread.get().run(self._drain_staged_async())
-        except Exception:
+        except Exception:  # rtpulint: ignore[RTPU006] — loop gone at interpreter exit; staged specs die with the process
             pass
 
     def _drain_staged_fully(self):
@@ -1404,7 +1414,7 @@ class CoreWorker:
 
     def _register_and_submit(self, task_id, spec, return_ids, arg_refs):
         self._register_pending(task_id, spec, return_ids, arg_refs)
-        asyncio.ensure_future(self._submit_to_nodelet(spec))
+        spawn_logged(self._submit_to_nodelet(spec), name="core.submit")
 
     async def _submit_to_nodelet(self, spec):
         await self._submit_batch_to_nodelet([spec])
@@ -1473,10 +1483,11 @@ class CoreWorker:
         dead = msg["node"]["node_id"]
         for tid, pending in list(self.pending_tasks.items()):
             if getattr(pending, "node_hint", None) == dead:
-                asyncio.ensure_future(self._h_task_result(
+                spawn_logged(self._h_task_result(
                     tid.binary() if hasattr(tid, "binary") else tid,
                     "system_error",
-                    error=f"node {dead[:8]} died with the task in flight"))
+                    error=f"node {dead[:8]} died with the task in flight"),
+                    name="core.node_death_result")
 
     # handler: streaming task pushed one yielded item to us (the owner)
     async def _h_task_stream_item(self, task_id: bytes, index: int,
@@ -1501,8 +1512,11 @@ class CoreWorker:
                 try:
                     if self.store.pin(oid):
                         self._stream_pins.add(oid)
-                except Exception:
-                    pass
+                except Exception as e:
+                    # an unpinned streamed return can LRU-evict while the
+                    # owner still references it — surfaced as ObjectLost
+                    log.debug("stream-return pin failed for %s: %r",
+                              oid.hex()[:8], e)
             self._resolve(oid, marker)
         return True
 
@@ -1539,7 +1553,8 @@ class CoreWorker:
             inflight.discard(task_id)
             if not inflight and actor_id in self._kill_when_drained:
                 self._kill_when_drained.discard(actor_id)
-                asyncio.ensure_future(self._drain_kill(actor_id))
+                spawn_logged(self._drain_kill(actor_id),
+                             name="core.drain_kill")
         if pending.spec.get("num_returns") in ("streaming", "dynamic"):
             # terminate the stream: sentinel (ok) or the error, placed at
             # the first slot the consumer hasn't received. Streaming
@@ -1803,10 +1818,10 @@ class CoreWorker:
             try:
                 await self.controller.call_async("register_actor",
                                                  **kwargs)
-            except Exception:
-                pass  # resolve will report the actor as unknown
+            except Exception:  # rtpulint: ignore[RTPU006] — resolve reports the actor as unknown; the error surfaces there
+                pass
 
-        asyncio.ensure_future(redeliver())
+        spawn_logged(redeliver(), name="core.reregister_actor")
 
     async def _resolve_actor(self, actor_id: str) -> str:
         addr = self._actor_addr.get(actor_id)
@@ -1921,7 +1936,8 @@ class CoreWorker:
     def _register_and_send_actor(self, task_id, spec, return_ids, arg_refs,
                                  actor_id):
         self._register_pending(task_id, spec, return_ids, arg_refs)
-        asyncio.ensure_future(self._send_actor_task(actor_id, spec))
+        spawn_logged(self._send_actor_task(actor_id, spec),
+                     name="core.actor_send")
 
     async def _ensure_actor_sub(self, actor_id: str):
         """Watch actor state so in-flight calls fail fast when it dies
@@ -1955,8 +1971,9 @@ class CoreWorker:
             failed, inflight_left = list(inflight), set()
             self._actor_inflight[actor_id] = inflight_left
             for tid in failed:
-                asyncio.ensure_future(self._h_task_result(
-                    tid, "app_error", error=serialization.dumps_inline(err)))
+                spawn_logged(self._h_task_result(
+                    tid, "app_error", error=serialization.dumps_inline(err)),
+                    name="core.actor_death_result")
 
     async def _send_actor_task(self, actor_id: str, spec: dict, attempt: int = 0):
         try:
@@ -1999,7 +2016,7 @@ class CoreWorker:
         try:
             loop = EventLoopThread.get().loop
             loop.call_soon_threadsafe(self._release_actor_handle, actor_id)
-        except Exception:
+        except Exception:  # rtpulint: ignore[RTPU006] — handle __del__ at interpreter exit: loop already closed, fate-sharing kill is moot
             pass
 
     def _release_actor_handle(self, actor_id: str):
@@ -2010,14 +2027,15 @@ class CoreWorker:
         if self._actor_inflight.get(actor_id):
             self._kill_when_drained.add(actor_id)
         else:
-            asyncio.ensure_future(self._drain_kill(actor_id))
+            spawn_logged(self._drain_kill(actor_id), name="core.drain_kill")
 
     async def _drain_kill(self, actor_id: str):
         try:
             await self.controller.call_async(
                 "kill_actor", actor_id=actor_id, no_restart=True, drain=True)
-        except Exception:
-            pass
+        except Exception as e:
+            # a lost drain-kill leaks the actor until session teardown
+            log.debug("drain-kill of %s undeliverable: %r", actor_id, e)
 
     # ------------------------------------------------------------ misc
     def cancel(self, ref: ObjectRef, force: bool = False):
@@ -2066,7 +2084,7 @@ class CoreWorker:
                     futs = self._event_flush_futs = set()
                 futs.add(fut)
                 fut.add_done_callback(futs.discard)
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — task events are droppable telemetry; loop may be gone at exit
                 pass
 
     def flush_events(self):
@@ -2077,13 +2095,13 @@ class CoreWorker:
         for fut in list(getattr(self, "_event_flush_futs", ()) or ()):
             try:
                 fut.result(timeout=10)
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — a failed event batch is droppable telemetry
                 pass
         if self._task_events:
             batch, self._task_events = self._task_events, []
             try:
                 self.controller.call("add_task_events", events=batch)
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — a failed event batch is droppable telemetry
                 pass
 
 

@@ -163,7 +163,7 @@ class Session:
     def _atexit(self):
         try:
             self.shutdown()
-        except Exception:
+        except Exception:  # rtpulint: ignore[RTPU006] — atexit hook: raising here masks the interpreter's own exit path
             pass
 
     def start_client_proxy(self, port: int = 0) -> str:
@@ -182,7 +182,7 @@ class Session:
         if proxy is not None:
             try:
                 EventLoopThread.get().run(proxy.stop(), timeout=3)
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — shutdown teardown is best-effort
                 pass
         core = get_core(required=False)
         if core is not None:
@@ -190,23 +190,23 @@ class Session:
                 core.flush_events()
                 core.controller.call("mark_job_finished",
                                      job_id=core.job_id.hex(), _timeout=2)
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — controller may already be down at shutdown; job state dies with the session
                 pass
         loop_thread = EventLoopThread.get()
         if self.nodelet_inproc is not None:
             try:
                 loop_thread.run(self.nodelet_inproc.stop(), timeout=5)
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — shutdown teardown is best-effort
                 pass
         for proc in self._extra_nodelet_procs:
             try:
                 proc.terminate()
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — extra nodelet may already be dead
                 pass
         if self.controller_inproc is not None:
             try:
                 loop_thread.run(self.controller_inproc.stop(), timeout=5)
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — shutdown teardown is best-effort
                 pass
         if core is not None:
             core.shutdown()

@@ -36,6 +36,7 @@ from .. import exceptions
 from . import serialization
 from .config import get_config
 from .ids import NodeID, ObjectID, TaskID, WorkerID
+from .procutil import log, spawn_logged
 from .procutil import proc_start_time as _proc_start_time
 from .rpc import RpcClient, RpcServer, ServerConn
 
@@ -87,7 +88,7 @@ async def _ensure_proc_dead(proc, pid: int = -1, grace: float = 2.0,
             proc.kill()
         elif pid > 0:
             _identity_signal(pid, 9, start_time)
-    except Exception:
+    except Exception:  # rtpulint: ignore[RTPU006] — SIGKILL escalation: every failure mode here means the process is already gone
         pass
 
 
@@ -122,6 +123,59 @@ class WorkerState:
     @property
     def is_actor(self):
         return self.actor_id is not None
+
+
+def _scan_worker_logs(log_dir: str, prefixes: List[str],
+                      offsets: Dict[str, int], node_id: str) -> List[dict]:
+    """One log-monitor tick's blocking work: stat + read the owned worker
+    log files and cut whole published lines. Runs on an EXECUTOR thread —
+    the hub loop must never do file I/O (rtpulint RTPU001). `offsets` is
+    owned by the single in-flight tick (the caller awaits each scan), so
+    mutating it here is race-free.
+
+    Semantics (regression-tested in tests/test_lint_invariants.py):
+    only whole \n-terminated lines ship; partials carry to the next
+    tick; a single unterminated line filling the whole 256KiB window is
+    force-consumed (else it wedges the tail forever); at most 200 lines
+    per file per tick with the offset advanced exactly past what was
+    published."""
+    batch: List[dict] = []
+    for prefix in prefixes:
+        path = os.path.join(log_dir, f"worker-{prefix}.log")
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            continue
+        pos = offsets.get(path, 0)
+        if size <= pos:
+            continue
+        try:
+            with open(path, "rb") as f:
+                f.seek(pos)
+                data = f.read(min(size - pos, 256 << 10))
+        except OSError:
+            continue
+        cut = data.rfind(b"\n")
+        if cut < 0:
+            if len(data) >= (256 << 10):
+                offsets[path] = pos + len(data)
+                batch.append({
+                    "worker": prefix, "node_id": node_id,
+                    "lines": [data[:4096].decode("utf-8", "replace")
+                              + " ...[unterminated line truncated]"]})
+            continue
+        raw_lines = data[:cut].split(b"\n")      # \n-only: matches the
+        if len(raw_lines) > 200:                 # offset arithmetic
+            consumed = sum(len(l) + 1 for l in raw_lines[:200])
+            raw_lines = raw_lines[:200]
+            offsets[path] = pos + consumed
+        else:
+            offsets[path] = pos + cut + 1
+        lines = [l.decode("utf-8", "replace") for l in raw_lines]
+        if lines:
+            batch.append({"worker": prefix, "node_id": node_id,
+                          "lines": lines})
+    return batch
 
 
 class _TaskQueue:
@@ -350,7 +404,7 @@ class Nodelet:
         if self._factory_proc is not None:
             try:
                 self._factory_proc.terminate()
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — shutdown teardown is best-effort
                 pass
             try:
                 os.unlink(self._factory_path)
@@ -366,13 +420,13 @@ class Nodelet:
         if bulk_srv is not None:
             try:
                 await bulk_srv.stop()
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — shutdown teardown is best-effort
                 pass
         await self._server.stop()
 
     def _on_shutdown(self):
         if not self._stopping:
-            asyncio.ensure_future(self.stop())
+            spawn_logged(self.stop(), name="nodelet.stop")
 
     async def _heartbeat_loop(self):
         cfg = get_config()
@@ -418,7 +472,7 @@ class Nodelet:
                 if "view_rev" in reply:
                     self._apply_view_entries(reply.get("view"))
                     self._view_rev = reply["view_rev"]
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — periodic beat: a controller hiccup self-heals next beat, and logging every missed beat spams while it is down
                 pass
             # runs even on a controller hiccup: debit heal must not
             # depend on the gossip stream being up
@@ -557,7 +611,7 @@ class Nodelet:
                         self.queue.remove(spec)
                     except ValueError:
                         continue
-                    asyncio.ensure_future(self.submit_task(spec))
+                    self._spawn_resubmit(spec)
 
     # ------------------------------------------------------------ logs
     async def _log_monitor_loop(self):
@@ -566,7 +620,12 @@ class Nodelet:
         them (ref: python/ray/_private/log_monitor.py tailing -> GCS log
         pubsub). Logs are cluster-scoped (workers serve tasks from any
         job); at most 200 lines per file per tick, with the offset only
-        advanced past what was actually published."""
+        advanced past what was actually published.
+
+        The stat+read scan runs on an executor thread: up to 256 files x
+        256KiB of file I/O per tick on the hub loop stalled dispatch and
+        owner fetches under load (rtpulint RTPU001 caught it; the loop
+        only sleeps, slices the rotor, and ships the batch)."""
         offsets: Dict[str, int] = {}
         log_dir = os.path.join(self.session_dir, "logs")
         rotor = 0
@@ -579,7 +638,6 @@ class Nodelet:
             n_owned = len(self._log_owned)
             await asyncio.sleep(0.5 if n_owned <= 256
                                 else min(5.0, 0.5 * n_owned / 256))
-            batch = []
             # only workers this nodelet started — session dirs are shared
             # by every nodelet of a (multi-node-on-one-box) session.
             # Rotate a bounded slice per tick: stat()ing thousands of log
@@ -591,52 +649,14 @@ class Nodelet:
                 rotor = (rotor + 256) % len(owned)
             else:
                 sl = owned
-            for prefix in sl:
-                path = os.path.join(log_dir, f"worker-{prefix}.log")
-                try:
-                    size = os.path.getsize(path)
-                except OSError:
-                    continue
-                pos = offsets.get(path, 0)
-                if size <= pos:
-                    continue
-                try:
-                    with open(path, "rb") as f:
-                        f.seek(pos)
-                        data = f.read(min(size - pos, 256 << 10))
-                except OSError:
-                    continue
-                # only whole \n-terminated lines; carry partials to the
-                # next tick. A single unterminated line filling the whole
-                # window is force-consumed (else it wedges the tail
-                # forever), and at most 200 lines go per tick with the
-                # offset advanced exactly past what was published.
-                cut = data.rfind(b"\n")
-                if cut < 0:
-                    if len(data) >= (256 << 10):
-                        offsets[path] = pos + len(data)
-                        batch.append({
-                            "worker": prefix, "node_id": self.node_id[:8],
-                            "lines": [data[:4096].decode("utf-8", "replace")
-                                      + " ...[unterminated line truncated]"]})
-                    continue
-                raw_lines = data[:cut].split(b"\n")  # \n-only: matches the
-                if len(raw_lines) > 200:             # offset arithmetic
-                    consumed = sum(len(l) + 1 for l in raw_lines[:200])
-                    raw_lines = raw_lines[:200]
-                    offsets[path] = pos + consumed
-                else:
-                    offsets[path] = pos + cut + 1
-                lines = [l.decode("utf-8", "replace") for l in raw_lines]
-                if lines:
-                    batch.append({"worker": prefix,
-                                  "node_id": self.node_id[:8],
-                                  "lines": lines})
+            batch = await asyncio.get_running_loop().run_in_executor(
+                None, _scan_worker_logs, log_dir, sl, offsets,
+                self.node_id[:8])
             if batch:
                 try:
                     await self.controller.call_async(
                         "publish", channel="logs", message=batch)
-                except Exception:
+                except Exception:  # rtpulint: ignore[RTPU006] — log lines are droppable telemetry; the next tick retries the channel
                     pass
 
     # ------------------------------------------------------------ memory
@@ -959,30 +979,36 @@ class Nodelet:
                     ws.proc.terminate()
                 else:
                     _identity_signal(ws.pid, 15, ws.start_time)
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — the worker may already be dead/reaped; the SIGKILL escalation below still runs
                 pass
             # escalate to SIGKILL: user code may install SIGTERM handlers
             # (jax.distributed's preemption notifier does) that keep the
             # process alive past terminate()
             try:
-                asyncio.get_running_loop().create_task(
-                    _ensure_proc_dead(ws.proc, ws.pid,
-                                      start_time=ws.start_time))
+                # probe the loop BEFORE creating the coroutine: the
+                # no-loop fallback below must not strand an unawaited
+                # coroutine object. spawn_logged (not a bare
+                # create_task): a swallowed failure here is a worker
+                # process that outlives its kill (RTPU003)
+                asyncio.get_running_loop()
+                spawn_logged(_ensure_proc_dead(ws.proc, ws.pid,
+                                               start_time=ws.start_time),
+                             name="nodelet.proc_kill")
             except RuntimeError:
                 if ws.proc is not None:
                     try:
                         ws.proc.wait(timeout=2)
-                    except Exception:
+                    except Exception:  # rtpulint: ignore[RTPU006] — wait timeout/ECHILD: escalate to kill below
                         try:
                             ws.proc.kill()
-                        except Exception:
+                        except Exception:  # rtpulint: ignore[RTPU006] — SIGKILL escalation: every failure mode means the process is already gone
                             pass
                 elif _pid_alive(ws.pid, ws.start_time):
                     time.sleep(0.2)
                     if _pid_alive(ws.pid, ws.start_time):
                         try:
                             _identity_signal(ws.pid, 9, ws.start_time)
-                        except Exception:
+                        except Exception:  # rtpulint: ignore[RTPU006] — SIGKILL escalation: every failure mode means the process is already gone
                             pass
 
     async def _on_worker_death(self, ws: WorkerState):
@@ -999,8 +1025,11 @@ class Nodelet:
                 await self.controller.call_async(
                     "actor_died", actor_id=ws.actor_id,
                     reason=f"worker {ws.worker_id[:8]} died", worker_failed=True)
-            except Exception:
-                pass
+            except Exception as e:
+                # an unreported actor death leaves clients waiting on a
+                # ghost until the controller's own liveness sweep
+                log.debug("actor_died report for %s undeliverable: %r",
+                          ws.actor_id, e)
         elif ws.current_task and ws.current_task.get("placeholder"):
             self._dec_starting(ws.env_key)
         elif ws.current_task is not None:
@@ -1142,7 +1171,8 @@ class Nodelet:
             spec["_env_key"] = _env_key(spec.get("runtime_env"))
         if spec["task_id"] in self.cancelled:
             self.cancelled.discard(spec["task_id"])
-            asyncio.ensure_future(self._report_cancelled(spec))
+            spawn_logged(self._report_cancelled(spec),
+                         name="nodelet.report_cancelled")
             return None
         return spec
 
@@ -1319,8 +1349,10 @@ class Nodelet:
                           "or was never registered")
                 return True
             loop = asyncio.get_running_loop()
-            loop.call_later(0.5, lambda: asyncio.ensure_future(
-                self.submit_task(spec)))
+            # _spawn_resubmit, not a bare ensure_future: a submit_task
+            # exception here would silently lose the parked spec (the
+            # RTPU003 respill bug class)
+            loop.call_later(0.5, lambda: self._spawn_resubmit(spec))
             return True
         return False
 
@@ -1383,7 +1415,7 @@ class Nodelet:
             try:
                 self._peer_client(addr).notify_nowait(
                     "view_update", entry=self._self_view_wire())
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — advisory staleness hint; gossip self-heals without it
                 pass
 
     def _stage_spill(self, view, spec: dict) -> None:
@@ -1424,11 +1456,29 @@ class Nodelet:
             self._spill_drain_armed = True
             asyncio.get_running_loop().call_soon(self._drain_spills)
 
+    def _spawn_resubmit(self, spec: dict, **submit_kw) -> None:
+        """Fire-and-forget re-entry of a spec ALREADY removed from its
+        queue (respill tick, dead-peer spill recovery). A bare
+        ensure_future here swallowed submit_task exceptions and silently
+        LOST the task — the owner's get() then hung forever (rtpulint
+        RTPU003). Any failure now fails the task to its owner instead."""
+
+        async def _run():
+            try:
+                await self.submit_task(spec, **submit_kw)
+            except Exception as e:  # noqa: BLE001 — surfaced to the owner
+                await self._report_failure(
+                    spec, f"resubmission failed on node "
+                          f"{self.node_id[:8]}: {e!r}")
+
+        spawn_logged(_run(), name="nodelet.resubmit")
+
     def _drain_spills(self) -> None:
         self._spill_drain_armed = False
         staged, self._spill_staged = self._spill_staged, {}
         for addr, (node_id, specs) in staged.items():
-            asyncio.ensure_future(self._send_spills(addr, node_id, specs))
+            spawn_logged(self._send_spills(addr, node_id, specs),
+                         name="nodelet.send_spills")
 
     async def _send_spills(self, addr: str, node_id: str,
                            specs: List[dict]) -> None:
@@ -1461,8 +1511,7 @@ class Nodelet:
                 else:
                     spec.pop("_spill_hops", None)
                     spec.pop("_hop_counted", None)
-                asyncio.ensure_future(self.submit_task(spec,
-                                                       _prepped=True))
+                self._spawn_resubmit(spec, _prepped=True)
             return
         self.sched_counters["p2p_spills"] += len(specs)
         for spec in specs:
@@ -1509,6 +1558,7 @@ class Nodelet:
         worker built for its environment."""
         if self._stopping:
             return
+        # rtpulint: ignore[RTPU007] — _TaskQueue.keys() returns a snapshot list, not a live view; popleft/append under it are safe
         for key in self.queue.keys():
             pool = self.idle.get(key)
             # bounded look-ahead: resource-BLOCKED specs consume a
@@ -1524,7 +1574,8 @@ class Nodelet:
                 if spec["task_id"] in self.cancelled:
                     self.cancelled.discard(spec["task_id"])
                     self.queue.popleft(key)
-                    asyncio.ensure_future(self._report_cancelled(spec))
+                    spawn_logged(self._report_cancelled(spec),
+                                 name="nodelet.report_cancelled")
                     continue
                 if not pool:
                     break
@@ -1551,7 +1602,8 @@ class Nodelet:
                 self.queue.popleft(key)
                 ws.current_task = spec
                 self.running_tasks[spec["task_id"]] = worker_id
-                asyncio.ensure_future(self._push_to_worker(ws, spec))
+                spawn_logged(self._push_to_worker(ws, spec),
+                             name="nodelet.push_task")
             n_left = self.queue.count(key)
             if n_left and not self.idle.get(key):
                 self._request_worker(key, self.queue.peek(key), n_left)
@@ -1574,7 +1626,8 @@ class Nodelet:
             ws = self.workers[worker_id]
             ws.actor_id = actor_id
             ws.current_task = spec
-            asyncio.ensure_future(self._push_actor_to_worker(ws, spec))
+            spawn_logged(self._push_actor_to_worker(ws, spec),
+                         name="nodelet.push_actor")
         # actor workers are demand-driven and bounded by resources, not by
         # the task-pool cap (each actor is an explicit user-created process)
         if self.pending_actor_leases:
@@ -1758,8 +1811,11 @@ class Nodelet:
                 error=serialization.dumps_inline(
                     exceptions.TaskCancelledError("task was cancelled")))
             client.close()
-        except Exception:
-            pass
+        except Exception as e:
+            # the owner resolves cancelled refs locally; this ack is a
+            # fast-path courtesy, but a drop is still worth a trace
+            log.debug("cancel ack to %s undeliverable: %r",
+                      spec.get("owner_addr"), e)
 
     # ------------------------------------------------------------ actors
     async def lease_worker_for_actor(self, spec: dict, actor_id: str):
@@ -1810,8 +1866,9 @@ class Nodelet:
             await self.controller.call_async(
                 "actor_died", actor_id=actor_id, reason=reason,
                 worker_failed=not intended)
-        except Exception:
-            pass
+        except Exception as e:
+            log.debug("actor_died report for %s undeliverable: %r",
+                      actor_id, e)
         return True
 
     # ------------------------------------------------------------ bundles

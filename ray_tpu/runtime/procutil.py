@@ -1,14 +1,138 @@
-"""Process-identity helpers shared by the nodelet, worker, and factory.
+"""Process/task plumbing shared by the nodelet, worker, and factory.
 
-A pid alone is not an identity: the worker factory runs with
-SIGCHLD=SIG_IGN (auto-reap), so a dead fork's pid can be recycled by an
-unrelated process. (pid, /proc/<pid>/stat starttime) is unique for the
-machine's uptime and is what liveness checks and kill signals compare.
+Process identity: a pid alone is not an identity — the worker factory
+runs with SIGCHLD=SIG_IGN (auto-reap), so a dead fork's pid can be
+recycled by an unrelated process. (pid, /proc/<pid>/stat starttime) is
+unique for the machine's uptime and is what liveness checks and kill
+signals compare.
+
+Task identity: `spawn_logged` is the runtime's fire-and-forget
+primitive. A bare ``asyncio.ensure_future(coro)`` whose handle is
+dropped swallows the task's exception until the GC happens to collect
+it (rtpulint RTPU003); spawn_logged attaches a done-callback that logs
+the exception, bumps the ``rtpu_task_exceptions_total`` counter, and
+keeps the task registered until it finishes so ``ray_tpu.shutdown()``
+can assert (under asyncio debug mode) that nothing leaked.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+import asyncio
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+log = logging.getLogger("ray_tpu")
+
+_tracked_lock = threading.Lock()
+# pending spawn_logged tasks (all loops). STRONG references on purpose:
+# the event loop only weakly references suspended tasks, so a
+# fire-and-forget task with no other holder can be garbage-collected
+# mid-flight (the asyncio-documented footgun) — tracking here is what
+# keeps it alive until the done-callback discards it. Strays on a
+# STOPPED loop (EventLoopThread.reset in tests) can never finish, so
+# _prune_dead_loops drops them once the set grows.
+_tracked: set = set()
+_exception_counts: Dict[str, int] = {}
+_exc_counter = None  # lazy util.metrics Counter
+
+
+def _get_exc_counter():
+    global _exc_counter
+    if _exc_counter is None:
+        from ..util.metrics import Counter
+
+        _exc_counter = Counter(
+            "rtpu_task_exceptions_total",
+            "exceptions raised by fire-and-forget runtime tasks",
+            ("task",))
+    return _exc_counter
+
+
+def spawn_logged(coro, *, name: str) -> "asyncio.Task":
+    """ensure_future for fire-and-forget call sites: the handle may be
+    dropped — exceptions are logged and counted instead of swallowed.
+    Must be called on (or from a callback of) the loop that will run the
+    coroutine, exactly like asyncio.ensure_future."""
+    task = asyncio.ensure_future(coro)
+    try:
+        task.set_name(f"rtpu:{name}")
+    except AttributeError:
+        pass
+    with _tracked_lock:
+        _tracked.add(task)
+        if len(_tracked) > 256:
+            _prune_dead_loops()
+    task.add_done_callback(_on_task_done)
+    return task
+
+
+def _prune_dead_loops() -> None:
+    """Drop tasks whose loop is no longer running (stopped, never
+    closed — EventLoopThread.reset in tests): they can never finish, so
+    holding them would leak their frames forever. Caller holds the
+    lock."""
+    for t in list(_tracked):
+        try:
+            dead = not t.done() and not t.get_loop().is_running()
+        except Exception:
+            dead = True
+        if dead:
+            _tracked.discard(t)
+
+
+def _task_name(task) -> str:
+    get_name = getattr(task, "get_name", None)
+    return get_name() if get_name is not None else repr(task)
+
+
+def _on_task_done(task) -> None:
+    with _tracked_lock:
+        _tracked.discard(task)
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is None:
+        return
+    name = _task_name(task)
+    with _tracked_lock:
+        _exception_counts[name] = _exception_counts.get(name, 0) + 1
+    try:
+        _get_exc_counter().inc(tags={"task": name})
+    except Exception:  # rtpulint: ignore[RTPU006] — metrics must never mask the log line below
+        pass
+    log.error("fire-and-forget task %s failed", name, exc_info=exc)
+
+
+def spawn_exception_counts() -> Dict[str, int]:
+    """Per-task-name exception totals (tests / diagnostics)."""
+    with _tracked_lock:
+        return dict(_exception_counts)
+
+
+def pending_spawned(grace_s: float = 0.0) -> List[str]:
+    """Names of spawn_logged tasks not yet finished, after waiting up to
+    `grace_s` for in-flight ones (shutdown drains need a beat to land)."""
+    deadline = time.monotonic() + grace_s
+    while True:
+        with _tracked_lock:
+            pending = [t for t in list(_tracked) if not t.done()]
+        if not pending or time.monotonic() >= deadline:
+            return sorted(_task_name(t) for t in pending)
+        time.sleep(0.02)
+
+
+def orphan_check_enabled() -> bool:
+    """The shutdown orphan-task assertion arms under asyncio debug mode
+    (PYTHONASYNCIODEBUG) or explicitly via RTPU_ORPHAN_CHECK=1; it is the
+    runtime-sanitizer companion to rtpulint's static RTPU003."""
+    if os.environ.get("RTPU_ORPHAN_CHECK", "") in ("1", "true"):
+        return True
+    if os.environ.get("RTPU_ORPHAN_CHECK", "") in ("0", "false"):
+        return False
+    return bool(os.environ.get("PYTHONASYNCIODEBUG"))
 
 
 def proc_start_time(pid: int) -> Optional[int]:

@@ -27,6 +27,7 @@ import traceback
 from typing import Any, Awaitable, Callable, Dict, List, Optional
 
 from . import serialization
+from .procutil import spawn_logged
 
 _LEN = struct.Struct(">Q")
 
@@ -110,6 +111,46 @@ async def _hang_forever():
 # --------------------------------------------------------------------------
 # Event loop thread
 # --------------------------------------------------------------------------
+_stall_metric = None
+_stall_handler_installed = False
+
+
+def _arm_loop_watchdog(loop: asyncio.AbstractEventLoop, watchdog_ms: int):
+    """Arm asyncio's slow-callback detector on `loop`: debug mode logs
+    every callback that holds the loop past slow_callback_duration, and a
+    handler on the asyncio logger counts those records into the
+    rtpu_loop_stall_total metric (so benches/tests can assert on stalls
+    without scraping stderr)."""
+    global _stall_handler_installed
+    loop.slow_callback_duration = watchdog_ms / 1000.0
+    loop.set_debug(True)
+    if _stall_handler_installed:
+        return
+    _stall_handler_installed = True
+
+    import logging
+
+    class _StallCounter(logging.Handler):
+        def emit(self, record):
+            # asyncio's slow-callback records read "Executing <...> took
+            # 0.123 seconds"; everything else on the logger passes through
+            try:
+                if str(record.msg).startswith("Executing"):
+                    global _stall_metric
+                    if _stall_metric is None:
+                        from ..util.metrics import Counter
+
+                        _stall_metric = Counter(
+                            "rtpu_loop_stall_total",
+                            "event-loop callbacks that exceeded "
+                            "loop_watchdog_ms")
+                    _stall_metric.inc()
+            except Exception:  # rtpulint: ignore[RTPU006] — a metrics failure must never break asyncio's logging path
+                pass
+
+    logging.getLogger("asyncio").addHandler(_StallCounter())
+
+
 class EventLoopThread:
     """One asyncio loop on a daemon thread, shared per process."""
 
@@ -118,6 +159,11 @@ class EventLoopThread:
 
     def __init__(self):
         self.loop = asyncio.new_event_loop()
+        from .config import get_config
+
+        watchdog_ms = get_config().loop_watchdog_ms
+        if watchdog_ms > 0:
+            _arm_loop_watchdog(self.loop, watchdog_ms)
         self.thread = threading.Thread(
             target=self._run, name="rtpu-io", daemon=True
         )
@@ -278,12 +324,12 @@ class RpcServer:
             self._server.close()
             try:
                 await self._server.wait_closed()
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — server teardown is best-effort; the listener fd is closed either way
                 pass
         for conn in list(self.conns):
             try:
                 conn.writer.close()
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — peer may already be gone at stop; nothing to report
                 pass
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
@@ -296,10 +342,14 @@ class RpcServer:
                 kind = msg[0]
                 if kind == REQ:
                     _, msg_id, method, kwargs = msg
-                    asyncio.ensure_future(self._dispatch(conn, msg_id, method, kwargs))
+                    spawn_logged(
+                        self._dispatch(conn, msg_id, method, kwargs),
+                        name="rpc.dispatch")
                 elif kind == NTF:
                     _, method, kwargs = msg
-                    asyncio.ensure_future(self._dispatch(conn, None, method, kwargs))
+                    spawn_logged(
+                        self._dispatch(conn, None, method, kwargs),
+                        name="rpc.dispatch")
         except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
             pass
         finally:
@@ -314,7 +364,7 @@ class RpcServer:
                     traceback.print_exc()
             try:
                 writer.close()
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — transport already torn down by the disconnect we are handling
                 pass
 
     async def _dispatch(self, conn: ServerConn, msg_id, method: str, kwargs):
@@ -391,7 +441,7 @@ class _LocalConn:
             try:
                 res = handler(**kwargs)
                 if asyncio.iscoroutine(res):
-                    asyncio.ensure_future(res)
+                    spawn_logged(res, name="rpc.local_notify")
             except Exception:
                 traceback.print_exc()
 
@@ -499,7 +549,8 @@ class RpcClient:
                     f"could not connect to {self.address}: {last_err}"
                 )
             self._wlock = asyncio.Lock()
-            asyncio.ensure_future(self._read_loop(self._reader))
+            spawn_logged(self._read_loop(self._reader),
+                         name="rpc.read_loop")
 
     async def _read_loop(self, reader):
         try:
@@ -522,7 +573,7 @@ class RpcClient:
                         try:
                             res = handler(**kwargs)
                             if asyncio.iscoroutine(res):
-                                asyncio.ensure_future(res)
+                                spawn_logged(res, name="rpc.notify_handler")
                         except Exception:
                             traceback.print_exc()
         except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError, OSError):
@@ -583,7 +634,8 @@ class RpcClient:
         await asyncio.shield(self._wbuf_fut)
 
     def _schedule_flush(self):
-        asyncio.ensure_future(self._flush_wbuf())
+        # runs on the loop (scheduled via loop.call_soon in notify_async)
+        spawn_logged(self._flush_wbuf(), name="rpc.flush_wbuf")
 
     async def _flush_wbuf(self):
         buf, fut = self._wbuf, self._wbuf_fut
@@ -658,7 +710,8 @@ class RpcClient:
         # notify still sitting in the task queue
         self._inflight_notifies += 1
         try:
-            asyncio.ensure_future(self._notify_swallow(method, kwargs))
+            spawn_logged(self._notify_swallow(method, kwargs),
+                         name="rpc.notify_swallow")
         except BaseException:
             # loop closing at shutdown: keep the counter honest or every
             # later close_when_drained stalls out its full timeout
@@ -723,7 +776,7 @@ class RpcClient:
             return  # cannot block the loop; staged frames drain in-pass
         try:
             elt.run(self.drain_async(timeout), timeout=timeout + 1.0)
-        except Exception:
+        except Exception:  # rtpulint: ignore[RTPU006] — drain is advisory at exit; close() proceeds regardless
             pass
 
     def close_when_drained(self, timeout: float = 10.0):
@@ -739,10 +792,11 @@ class RpcClient:
 
         elt = EventLoopThread.get()
         if threading.current_thread() is elt.thread:
-            asyncio.ensure_future(_drain_then_close())
+            spawn_logged(_drain_then_close(), name="rpc.drain_close")
         else:
             elt.loop.call_soon_threadsafe(
-                lambda: asyncio.ensure_future(_drain_then_close()))
+                lambda: spawn_logged(_drain_then_close(),
+                                     name="rpc.drain_close"))
 
     def close(self):
         self._closed = True
@@ -756,19 +810,19 @@ class RpcClient:
                         res = srv.on_disconnect(self._local_conn)
                         if asyncio.iscoroutine(res):
                             await res
-                    except Exception:
+                    except Exception:  # rtpulint: ignore[RTPU006] — a disconnect callback must never block close; server-side state self-heals on reconnect
                         pass
             if self._writer is not None:
                 try:
                     self._writer.close()
-                except Exception:
+                except Exception:  # rtpulint: ignore[RTPU006] — socket may already be dead at close
                     pass
 
         elt = EventLoopThread.get()
         try:
             if threading.current_thread() is elt.thread:
-                asyncio.ensure_future(_close())
+                spawn_logged(_close(), name="rpc.close")
             else:
                 elt.run(_close())
-        except Exception:
+        except Exception:  # rtpulint: ignore[RTPU006] — close() runs on interpreter-exit paths where the loop may already be gone
             pass

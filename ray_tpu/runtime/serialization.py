@@ -44,7 +44,7 @@ def _convert_jax_arrays(obj: Any) -> Any:
 
         if isinstance(obj, jax.Array):
             return np.asarray(obj)
-    except Exception:
+    except Exception:  # rtpulint: ignore[RTPU006] — exotic array types that fail np.asarray serialize via cloudpickle instead
         pass
     return obj
 

@@ -133,7 +133,7 @@ class BulkServer:
             self._server.close()
             try:
                 await self._server.wait_closed()
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — server teardown is best-effort
                 pass
 
     async def _on_conn(self, reader: asyncio.StreamReader,
@@ -157,7 +157,7 @@ class BulkServer:
         finally:
             try:
                 writer.close()
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — puller already disconnected; nothing to flush
                 pass
 
     async def _serve_range(self, writer, oid: ObjectID, off: int, ln: int):

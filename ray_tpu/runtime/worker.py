@@ -31,6 +31,7 @@ from . import serialization
 from .config import get_config
 from .core import CoreWorker, ObjectRef, set_core
 from .ids import ObjectID, TaskID, WorkerID
+from .procutil import log
 from .rpc import EventLoopThread
 
 
@@ -218,8 +219,10 @@ class Executor:
                     self.core.nodelet.notify_nowait(
                         "task_finished", worker_id=self.core.worker_id.hex(),
                         task_id=task_id)
-                except Exception:
-                    pass
+                except Exception as e:
+                    # a lost task_finished strands this worker's slot on
+                    # the nodelet until the reaper notices
+                    log.debug("task_finished undeliverable: %r", e)
 
     def _package(self, value: Any):
         sv = serialization.serialize(value)
@@ -244,7 +247,7 @@ class Executor:
                 # push an honest flush past 2s)
                 self.core.controller.call("add_trace_spans", spans=spans,
                                           _timeout=3)
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — spans are droppable telemetry; results are not and must not wait on a dead controller
                 pass
 
     def _stream_results(self, spec: dict, gen) -> None:
@@ -289,7 +292,7 @@ class Executor:
             try:
                 self.core.nodelet.notify_nowait(
                     "object_sealed", oid=oid.binary(), size=size)
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — seal notice is advisory accounting; readers locate the object via the result payload
                 pass
             owner.notify_nowait("task_stream_item", task_id=spec["task_id"],
                                 index=index, kind="shm",
@@ -321,7 +324,7 @@ class Executor:
                 try:
                     self.core.nodelet.notify_nowait(
                         "object_sealed", oid=oid.binary(), size=size)
-                except Exception:
+                except Exception:  # rtpulint: ignore[RTPU006] — seal notice is advisory accounting; readers locate the object via the result payload
                     pass
                 # location rides with the result: a cross-host owner pulls
                 # from this host's nodelet (object-manager tier)
@@ -427,8 +430,10 @@ class Executor:
                     "actor_exited", worker_id=self.core.worker_id.hex(),
                     actor_id=self.actor_id,
                     reason=f"creation failed: {tb}", intended=False)
-            except Exception:
-                pass
+            except Exception as e:
+                # unreported creation failure leaves the actor PENDING
+                # until the nodelet reaps this exiting process
+                log.debug("actor_exited report undeliverable: %r", e)
             self.shutdown_event.set()
 
     async def h_actor_call(self, spec: dict):
@@ -590,7 +595,7 @@ class Executor:
             self.core.nodelet.notify_nowait(
                 "actor_exited", worker_id=self.core.worker_id.hex(),
                 actor_id=self.actor_id, reason=reason, intended=True)
-        except Exception:
+        except Exception:  # rtpulint: ignore[RTPU006] — worker is exiting; the nodelet's reaper detects the death regardless
             pass
         self.shutdown_event.set()
 
@@ -611,7 +616,7 @@ class Executor:
                 await self.core.nodelet.call_async(
                     "actor_exited", worker_id=self.core.worker_id.hex(),
                     actor_id=self.actor_id, reason="killed", intended=False)
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — worker is exiting on kill; the nodelet's reaper detects the death regardless
                 pass
         self.shutdown_event.set()
         return True

@@ -66,7 +66,7 @@ def _restore_preload() -> None:
     sys.path[:0] = dirs
     try:
         import sitecustomize  # noqa: F401 — the preload itself
-    except Exception:
+    except Exception:  # rtpulint: ignore[RTPU006] — hosts without the preload hook simply warm-import lazily
         pass
 
 
@@ -418,8 +418,8 @@ def serve(args) -> None:
         from .._native import get_lib as _get_lib
 
         _get_lib()
-    except Exception:
-        pass  # workers fall back to their own (pure-python) path
+    except Exception:  # rtpulint: ignore[RTPU006] — workers fall back to their own (pure-python) store path
+        pass
 
     # Prefork hygiene (the Instagram trick): move every existing object
     # into the permanent generation so children's GC passes never sweep
@@ -568,7 +568,7 @@ def serve(args) -> None:
         finally:
             try:
                 conn.close()
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — requester already gone; the fork reply died with it
                 pass
 
 

@@ -196,12 +196,12 @@ def shutdown() -> None:
     try:
         controller = _get_controller(create=False)
         ray_tpu.get(controller.shutdown.remote(), timeout=30)
-    except Exception:
-        pass  # controller already gone; still clean up proxy below
+    except Exception:  # rtpulint: ignore[RTPU006] — controller already gone; proxy cleanup below still runs
+        pass
     for actor_name in (PROXY_NAME, GRPC_PROXY_NAME, CONTROLLER_NAME):
         try:
             ray_tpu.kill(ray_tpu.get_actor(actor_name))
-        except Exception:
+        except Exception:  # rtpulint: ignore[RTPU006] — actor may never have been started (no grpc proxy, already-dead controller)
             pass
     # Wait for the names to clear so a subsequent serve.start() is clean.
     deadline = time.time() + 15

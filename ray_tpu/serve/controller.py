@@ -222,7 +222,7 @@ class ServeControllerActor:
                         remove_placement_group)
 
                     remove_placement_group(pg)
-                except Exception:
+                except Exception:  # rtpulint: ignore[RTPU006] — rollback of a group that may not have committed; the raise below carries the real error
                     pass
             raise
         state.replicas[replica_id] = _ReplicaState(replica_id, handle,
@@ -248,11 +248,11 @@ class ServeControllerActor:
                 asyncio.wrap_future(
                     rep.handle.prepare_for_shutdown.remote().future()),
                 timeout=config.graceful_shutdown_timeout_s + 1)
-        except Exception:
+        except Exception:  # rtpulint: ignore[RTPU006] — graceful-drain timeout/refusal falls through to the hard kill below
             pass
         try:
             ray_tpu.kill(rep.handle)
-        except Exception:
+        except Exception:  # rtpulint: ignore[RTPU006] — replica already dead; pg cleanup below still runs
             pass
         self._remove_replica_pg(rep)
 
@@ -264,7 +264,7 @@ class ServeControllerActor:
             from ..util.placement_group import remove_placement_group
 
             remove_placement_group(rep.pg)
-        except Exception:
+        except Exception:  # rtpulint: ignore[RTPU006] — group may already be removed with the session; leaking it here only outlives us by the session
             pass
         rep.pg = None
 
@@ -322,7 +322,7 @@ class ServeControllerActor:
             import ray_tpu
 
             ray_tpu.kill(rep.handle)
-        except Exception:
+        except Exception:  # rtpulint: ignore[RTPU006] — the replica just failed its health check; it is usually already dead
             pass
         self._remove_replica_pg(rep)
 

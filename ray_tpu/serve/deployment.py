@@ -163,12 +163,13 @@ def flatten_app(app: Application, app_name: str) -> List[DeploymentSpec]:
 
     def visit(node: Application) -> DeploymentHandle:
         name = node.deployment.name
+        # rtpulint: ignore[RTPU005] — id() keys live in-process DAG nodes only (duplicate-binding detection); nothing crosses the wire
         if name_to_node.get(name, id(node)) != id(node):
             raise ValueError(
                 f"two distinct bindings share the deployment name {name!r}; "
                 f"rename one with .options(name=...)")
         if name not in specs:
-            name_to_node[name] = id(node)
+            name_to_node[name] = id(node)  # rtpulint: ignore[RTPU005] — same in-process identity map as above
             args = tuple(_sub(a) for a in node.args)
             kwargs = {k: _sub(v) for k, v in node.kwargs.items()}
             specs[name] = DeploymentSpec(

@@ -22,6 +22,7 @@ Usage:
 from __future__ import annotations
 
 import dataclasses
+import itertools
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -39,6 +40,11 @@ from .tokenizer import get_tokenizer
 # the batching loop below is identical in both modes.
 _ENGINE_CACHE: Dict[str, LLMEngine] = {}
 
+
+
+# process-wide request-id sequence for the engine stage (stable,
+# collision-free across batches — unlike id())
+_BATCH_SEQ = itertools.count()
 
 def _get_engine(config: EngineConfig) -> LLMEngine:
     key = repr(dataclasses.asdict(config))
@@ -109,8 +115,13 @@ class Processor:
         engine = _get_engine(self.config.engine)
         sampling = self.config.sampling
         by_id: Dict[str, dict] = {}
+        # monotonic batch tag, NOT id(rows): the engine is cached across
+        # batches, and a recycled list address colliding with a stale
+        # request id from an earlier batch would cross-wire their tokens
+        # (rtpulint RTPU005 — the PR 4 chain-hash bug class)
+        batch_tag = next(_BATCH_SEQ)
         for i, row in enumerate(rows):
-            rid = f"batch-{id(rows)}-{i}"
+            rid = f"batch-{batch_tag}-{i}"
             row = dict(row)
             by_id[rid] = row
             max_new = int(row.get("max_tokens", sampling.max_tokens))

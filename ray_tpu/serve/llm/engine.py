@@ -597,7 +597,7 @@ class LLMEngine:
             jnp.asarray(gather), temp, topk, keys)
         try:
             tokens.copy_to_host_async()
-        except Exception:  # noqa: BLE001 — CPU backends may not support it
+        except Exception:  # noqa: BLE001  # rtpulint: ignore[RTPU006] — optional D2H prefetch: CPU backends lack it; harvest blocks on the array either way
             pass
         for req in group:
             req.planned_out = 1
@@ -707,7 +707,7 @@ class LLMEngine:
             jnp.asarray(keys_steps))
         try:
             toks.copy_to_host_async()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001  # rtpulint: ignore[RTPU006] — optional D2H prefetch: CPU backends lack it; harvest blocks on the array either way
             pass
         self._inflight.append({
             "kind": "decode", "toks": toks, "slots": chunk_slots,

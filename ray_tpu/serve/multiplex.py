@@ -71,7 +71,7 @@ def multiplexed(func: Optional[Callable] = None, *,
                     if callable(del_fn):
                         try:
                             del_fn()
-                        except Exception:
+                        except Exception:  # rtpulint: ignore[RTPU006] — user-model destructor: its failures are the model's business, eviction proceeds
                             pass
             return model
 

@@ -46,6 +46,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "sched: decentralized scheduling plane (gossiped "
         "views, p2p spill, locality) tests")
+    config.addinivalue_line(
+        "markers", "lint: rtpulint static-analysis tier (analyzer "
+        "self-tests + the zero-unsuppressed-findings gate over "
+        "ray_tpu/runtime and ray_tpu/serve)")
 
 
 @pytest.fixture

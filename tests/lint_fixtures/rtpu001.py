@@ -1,0 +1,63 @@
+"""RTPU001 fixture: blocking calls inside `async def`.
+
+Lines that must flag carry a trailing EXPECT-marker comment naming the
+rule; everything else must stay clean. (This file is analyzer input,
+never imported.)
+"""
+import asyncio
+import subprocess
+import time
+
+
+async def bad_sleep():
+    time.sleep(1)  # EXPECT[RTPU001]
+
+
+async def bad_subprocess():
+    subprocess.run(["true"])  # EXPECT[RTPU001]
+
+
+async def bad_file_io(path):
+    with open(path) as f:  # EXPECT[RTPU001]
+        return f.read()
+
+
+async def bad_result_chain(handle):
+    return handle.remote().future().result()  # EXPECT[RTPU001]
+
+
+async def bad_result_from_executor(pool, fn):
+    fut = pool.submit(fn)
+    return fut.result()  # EXPECT[RTPU001]
+
+
+async def bad_socket(sock, buf):
+    sock.recv_into(buf)  # EXPECT[RTPU001]
+
+
+def ok_sync_sleep():
+    time.sleep(1)  # sync frame: blocking is the caller's business
+
+
+async def ok_async_sleep():
+    await asyncio.sleep(1)
+
+
+async def ok_executor_offload(loop, ref):
+    # the canonical fix: blocking .result() runs on an executor thread
+    return await loop.run_in_executor(
+        None, lambda: ref.future().result(timeout=10))
+
+
+async def ok_done_checked_result(futs):
+    # .result() on a done()-checked asyncio future does not block
+    done, _ = await asyncio.wait(futs, timeout=1.0)
+    return [f.result() for f in done]
+
+
+async def ok_loop_sock(loop, sock, view):
+    return await loop.sock_recv_into(sock, view)
+
+
+async def suppressed_sleep():
+    time.sleep(0.001)  # rtpulint: ignore[RTPU001] — fixture: intentional one-ms pause, demonstrates suppression

@@ -1,0 +1,48 @@
+"""RTPU002 fixture: threading lock held across an `await`."""
+import asyncio
+import threading
+
+_lock = threading.Lock()
+_alock = asyncio.Lock()
+
+
+async def bad_lock_across_await(client):
+    with _lock:  # EXPECT[RTPU002]
+        await client.call_async("ping")
+
+
+async def bad_self_lock(self):
+    with self._sync_lock:  # EXPECT[RTPU002]
+        await asyncio.sleep(0)
+
+
+async def ok_asyncio_lock(client):
+    async with _alock:
+        await client.call_async("ping")
+
+
+async def ok_no_await_inside():
+    with _lock:
+        x = 1
+    await asyncio.sleep(0)
+    return x
+
+
+async def ok_await_only_in_nested_def(registry):
+    # the helper's await runs LATER, outside the lock — defining it
+    # under the lock holds nothing across an await
+    with _lock:
+        async def helper(client):
+            await client.call_async("ping")
+
+        registry["cb"] = helper
+
+
+def ok_sync_holder():
+    with _lock:
+        return 1
+
+
+async def suppressed(client):
+    with _lock:  # rtpulint: ignore[RTPU002] — fixture: demonstrates suppression with reason
+        await client.call_async("ping")

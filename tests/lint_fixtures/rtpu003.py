@@ -1,0 +1,46 @@
+"""RTPU003 fixture: fire-and-forget task handle dropped."""
+import asyncio
+
+from ray_tpu.runtime.procutil import spawn_logged
+
+
+async def work():
+    pass
+
+
+def bad_dropped_handle():
+    asyncio.ensure_future(work())  # EXPECT[RTPU003]
+
+
+def bad_create_task():
+    asyncio.create_task(work())  # EXPECT[RTPU003]
+
+
+def bad_loop_handle(loop):
+    # a held loop handle in a sync frame also trips RTPU004 (no
+    # threadsafe entry / identity guard) — two rules, one bad line
+    loop.create_task(work())  # EXPECT[RTPU003] # EXPECT[RTPU004]
+
+
+def bad_running_loop():
+    asyncio.get_running_loop().create_task(work())  # EXPECT[RTPU003]
+
+
+def ok_spawn_logged():
+    spawn_logged(work(), name="fixture.work")
+
+
+def ok_handle_kept(tasks):
+    t = asyncio.ensure_future(work())
+    tasks.add(t)
+    t.add_done_callback(tasks.discard)
+    return t
+
+
+async def ok_gathered():
+    futs = [asyncio.ensure_future(work()) for _ in range(3)]
+    await asyncio.gather(*futs)
+
+
+def suppressed():
+    asyncio.ensure_future(work())  # rtpulint: ignore[RTPU003] — fixture: demonstrates suppression with reason

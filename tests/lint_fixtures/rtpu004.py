@@ -1,0 +1,49 @@
+"""RTPU004 fixture: loop mutation from non-loop code without a
+threadsafe entry point."""
+import asyncio
+import threading
+
+
+class Holder:
+    def __init__(self, loop):
+        self._loop = loop
+
+    def bad_call_soon(self, cb):
+        self._loop.call_soon(cb)  # EXPECT[RTPU004]
+
+    def bad_create_task(self, coro):
+        # the dropped handle also trips RTPU003 — two rules, one bad line
+        self._loop.create_task(coro)  # EXPECT[RTPU004] # EXPECT[RTPU003]
+
+    def bad_guard_only_in_nested_frame(self, cb):
+        # a guard inside a nested lambda/def is that frame's guard, not
+        # this one's — the outer call_soon is still unproven
+        probe = lambda: threading.current_thread().name  # noqa: E731
+        self._loop.call_soon(cb)  # EXPECT[RTPU004]
+        return probe
+
+    def ok_threadsafe(self, cb):
+        self._loop.call_soon_threadsafe(cb)
+
+    def ok_identity_guarded(self, coro):
+        # referencing get_running_loop proves the author checked
+        # loop-thread identity (the core._spawn_threadsafe pattern)
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is self._loop:
+            asyncio.ensure_future(coro).cancel()
+        else:
+            self._loop.call_soon_threadsafe(lambda: None)
+
+    def ok_thread_guarded(self, elt, cb):
+        if threading.current_thread() is elt.thread:
+            elt.loop.call_soon(cb)
+
+    async def ok_on_loop_already(self, cb):
+        # async frames run ON the loop; RTPU004 targets sync code
+        asyncio.get_running_loop().call_soon(cb)
+
+    def suppressed(self, cb):
+        self._loop.call_soon(cb)  # rtpulint: ignore[RTPU004] — fixture: demonstrates suppression with reason

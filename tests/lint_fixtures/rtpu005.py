@@ -1,0 +1,31 @@
+"""RTPU005 fixture: process-unstable hash()/id() flowing into data."""
+import hashlib
+
+
+def bad_routing_key(prefix_tokens):
+    return hash(tuple(prefix_tokens))  # EXPECT[RTPU005]
+
+
+def bad_identity_key(obj, registry):
+    registry[id(obj)] = obj  # EXPECT[RTPU005]
+    return registry
+
+
+def ok_stable_digest(prefix_tokens):
+    h = hashlib.blake2b(digest_size=8)
+    for t in prefix_tokens:
+        h.update(t.to_bytes(4, "little"))
+    return h.hexdigest()
+
+
+class OkDunder:
+    def __init__(self, oid):
+        self._oid = oid
+
+    def __hash__(self):
+        return hash(self._oid)  # __hash__ is in-process by definition
+
+
+def suppressed(obj, cache):
+    cache[id(obj)] = 1  # rtpulint: ignore[RTPU005] — fixture: in-process identity map, demonstrates suppression
+    return cache

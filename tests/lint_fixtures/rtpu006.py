@@ -1,0 +1,46 @@
+"""RTPU006 fixture: blanket `except: pass` with no log or counter."""
+import logging
+
+log = logging.getLogger("fixture")
+
+
+def bad_blanket(fn):
+    try:
+        fn()
+    except Exception:  # EXPECT[RTPU006]
+        pass
+
+
+def bad_bare(fn):
+    try:
+        fn()
+    except:  # noqa: E722  # EXPECT[RTPU006]
+        pass
+
+
+def bad_base_exception(fn):
+    try:
+        fn()
+    except BaseException:  # EXPECT[RTPU006]
+        pass
+
+
+def ok_narrow(d, k):
+    try:
+        del d[k]
+    except KeyError:  # narrow catches encode intent; not blanket
+        pass
+
+
+def ok_logged(fn):
+    try:
+        fn()
+    except Exception as e:
+        log.debug("fixture call failed: %r", e)
+
+
+def suppressed(fn):
+    try:
+        fn()
+    except Exception:  # rtpulint: ignore[RTPU006] — fixture: demonstrates suppression with reason
+        pass

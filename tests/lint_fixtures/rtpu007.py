@@ -1,0 +1,52 @@
+"""RTPU007 fixture: container mutated while iterating it.
+
+RTPU007 findings attach to the `for` header line (one pragma there
+covers every mutation inside the loop).
+"""
+
+
+def bad_del_while_iterating(d):
+    for k in d:  # EXPECT[RTPU007]
+        if k.startswith("stale"):
+            del d[k]
+
+
+def bad_items_view(entries, now, ttl):
+    for aid, e in entries.items():  # EXPECT[RTPU007]
+        if now - e["ts"] > ttl:
+            entries.pop(aid)
+
+
+def bad_set_add(seen, items):
+    for s in seen:  # EXPECT[RTPU007]
+        if s in items:
+            seen.add(s + "!")
+
+
+def ok_snapshot(d):
+    for k in list(d):
+        if k.startswith("stale"):
+            del d[k]
+
+
+def ok_mutation_only_in_nested_def(handlers, register):
+    # the callback's pop runs after iteration, via register — a function
+    # DEFINED in the loop body is not this loop's mutation
+    for k in handlers.keys():
+        def on_done(k=k):
+            handlers.pop(k)
+
+        register(on_done)
+
+
+def ok_mutate_then_return(q, spec):
+    for item in q:
+        if item["task_id"] == spec["task_id"]:
+            q.remove(item)
+            return item
+    return None
+
+
+def suppressed(d):
+    for k in d:  # rtpulint: ignore[RTPU007] — fixture: demonstrates suppression with reason
+        d.pop(k)

@@ -68,9 +68,84 @@ def test_cluster_workload_clean_under_asyncio_debug():
     out = subprocess.run([sys.executable, "-c", DRIVER],
                          capture_output=True, text=True, timeout=420,
                          env=env)
+    # PYTHONASYNCIODEBUG also arms the shutdown orphan-task assertion
+    # (api.shutdown -> procutil.pending_spawned), so a leaked
+    # fire-and-forget task fails this run even without a visible race
     assert out.returncode == 0, out.stdout[-800:] + out.stderr[-3000:]
     assert "ASYNC-DEBUG-OK" in out.stdout
     combined = out.stdout + out.stderr
     # the race class debug mode exists to catch: loop mutation from a
     # non-loop thread without the threadsafe entry points
     assert "Non-thread-safe operation" not in combined, combined[-3000:]
+
+
+ORPHAN_DRIVER = """
+import asyncio
+import ray_tpu
+from ray_tpu.runtime import procutil
+from ray_tpu.runtime.rpc import EventLoopThread
+
+ray_tpu.init(num_cpus=1)
+
+async def wedged():
+    await asyncio.Event().wait()  # never finishes
+
+EventLoopThread.get().loop.call_soon_threadsafe(
+    lambda: procutil.spawn_logged(wedged(), name="test.wedged"))
+import time; time.sleep(0.2)
+try:
+    ray_tpu.shutdown()
+except AssertionError as e:
+    assert "test.wedged" in str(e), e
+    print("ORPHAN-CAUGHT")
+else:
+    print("ORPHAN-MISSED")
+"""
+
+
+def test_shutdown_asserts_on_orphan_spawned_task():
+    """The RTPU003 runtime sanitizer: a spawn_logged task still pending
+    after a clean shutdown trips an AssertionError naming the task."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["RTPU_ORPHAN_CHECK"] = "1"
+    out = subprocess.run([sys.executable, "-c", ORPHAN_DRIVER],
+                         capture_output=True, text=True, timeout=180,
+                         env=env)
+    assert out.returncode == 0, out.stdout[-800:] + out.stderr[-3000:]
+    assert "ORPHAN-CAUGHT" in out.stdout, out.stdout + out.stderr[-2000:]
+
+
+WATCHDOG_DRIVER = """
+import time
+from ray_tpu.runtime.rpc import EventLoopThread
+from ray_tpu.util import metrics
+
+elt = EventLoopThread.get()
+assert elt.loop.get_debug(), "watchdog must arm asyncio debug mode"
+assert abs(elt.loop.slow_callback_duration - 0.05) < 1e-9
+
+async def stall():
+    time.sleep(0.2)  # deliberate on-loop stall past the 50ms watchdog
+
+elt.run(stall())
+time.sleep(0.1)  # asyncio logs the slow callback after it returns
+snap = metrics.snapshot()
+total = sum(v for k, v in snap.items()
+            if k.startswith("rtpu_loop_stall_total"))
+assert total >= 1, snap
+print("WATCHDOG-COUNTED", total)
+"""
+
+
+def test_loop_watchdog_counts_stalls():
+    """loop_watchdog_ms arms slow_callback_duration on the io loop and
+    feeds asyncio's slow-callback records into rtpu_loop_stall_total."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["RTPU_loop_watchdog_ms"] = "50"
+    out = subprocess.run([sys.executable, "-c", WATCHDOG_DRIVER],
+                         capture_output=True, text=True, timeout=120,
+                         env=env)
+    assert out.returncode == 0, out.stdout[-800:] + out.stderr[-3000:]
+    assert "WATCHDOG-COUNTED" in out.stdout

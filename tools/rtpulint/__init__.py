@@ -1,0 +1,2 @@
+from .analyzer import (RULES, Finding, analyze_file, analyze_source,  # noqa: F401
+                       iter_python_files, render_human, render_json, run)

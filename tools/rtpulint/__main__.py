@@ -1,0 +1,5 @@
+import sys
+
+from .analyzer import main
+
+sys.exit(main())

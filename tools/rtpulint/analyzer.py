@@ -1,0 +1,667 @@
+"""rtpulint: AST-based concurrency-invariant analyzer for the ray_tpu
+runtime.
+
+Every rule here encodes an invariant this codebase has already paid to
+re-learn by hand (see the rule table in the repo README for the PR that
+motivated each one). The analyzer is stdlib-only (``ast`` + ``re``) and
+runs in tier-1 via tests/test_lint_invariants.py: zero unsuppressed
+findings over ray_tpu/runtime + ray_tpu/serve.
+
+Intentional violations are suppressed in place with a pragma that MUST
+carry a reason::
+
+    risky_call()  # rtpulint: ignore[RTPU001] — reason it is safe here
+
+A pragma applies to findings on its own line or the line directly below
+(so it can sit above a multi-line statement). A pragma with no reason is
+itself reported (RTPU000): the whole point is that suppressions leave a
+recorded argument behind, not a bare mute.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+# ---------------------------------------------------------------- rules
+#: code -> (severity, one-line description)
+RULES: Dict[str, Tuple[str, str]] = {
+    "RTPU000": ("error", "malformed rtpulint pragma (missing rule list "
+                         "or reason)"),
+    "RTPU001": ("error", "blocking call inside `async def` stalls the "
+                         "event loop"),
+    "RTPU002": ("error", "threading lock held across an `await` "
+                         "(lock-order deadlock across loop and threads)"),
+    "RTPU003": ("warning", "fire-and-forget task handle dropped: "
+                           "exceptions are swallowed silently"),
+    "RTPU004": ("error", "event-loop mutation from non-loop code without "
+                         "a threadsafe entry point"),
+    "RTPU005": ("error", "process-unstable hash()/id() may leak into "
+                         "wire payloads, cache keys or routing"),
+    "RTPU006": ("warning", "blanket `except: pass` without a log or "
+                           "counter hides real failures"),
+    "RTPU007": ("error", "container mutated while iterating it"),
+}
+
+# pragma grammar: "# rtpulint: ignore[RTPU001,RTPU003] — reason text"
+_PRAGMA_RE = re.compile(
+    r"#\s*rtpulint:\s*ignore\[([A-Za-z0-9,\s]*)\]\s*(?:[—–-]+\s*(.*))?")
+
+# RTPU001: dotted call names that block the calling thread
+_BLOCKING_NAMES = {
+    "time.sleep", "os.system", "os.wait", "os.waitpid",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.getoutput",
+    "os.path.getsize", "os.stat", "os.listdir", "os.scandir", "os.walk",
+    "shutil.rmtree", "shutil.copy", "shutil.copyfile", "shutil.copytree",
+    "shutil.move",
+}
+# RTPU001: sync-socket methods (flagged when the receiver looks like a
+# socket object; loop.sock_* coroutines have different attribute names)
+_SOCKET_ATTRS = {"connect", "accept", "recv", "recv_into", "sendall"}
+# RTPU007: container methods that change size/shape
+_MUTATORS = {"pop", "popitem", "clear", "update", "setdefault", "add",
+             "remove", "discard", "appendleft", "popleft"}
+_ITER_WRAPPERS = {"list", "tuple", "sorted", "set", "frozenset", "dict"}
+# RTPU004: guard evidence — a sync function that inspects its thread or
+# loop identity (or uses the threadsafe entry points) has thought about
+# cross-thread delivery; the rule targets the ones that have not.
+_THREAD_GUARDS = {"get_running_loop", "current_thread",
+                  "call_soon_threadsafe", "run_coroutine_threadsafe"}
+
+
+@dataclasses.dataclass
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    suppressed: bool = False
+    reason: Optional[str] = None
+
+    @property
+    def severity(self) -> str:
+        return RULES[self.rule][0]
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path, "line": self.line, "col": self.col,
+            "rule": self.rule, "severity": self.severity,
+            "message": self.message, "suppressed": self.suppressed,
+            "reason": self.reason,
+        }
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse failure is cosmetic
+        return "<expr>"
+
+
+def _walk_frame(node: ast.AST):
+    """ast.walk that does NOT descend into nested function frames
+    (def/async def/lambda): their bodies execute later, in their own
+    frame — an await/mutation/guard inside one says nothing about the
+    code being scanned."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        sub = stack.pop()
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            continue
+        yield sub
+        stack.extend(ast.iter_child_nodes(sub))
+
+
+def _dotted(func: ast.AST) -> str:
+    """'time.sleep' for Attribute chains over Names, '?.attr' otherwise."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        parts = [func.attr]
+        cur = func.value
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name):
+            parts.append(cur.id)
+            return ".".join(reversed(parts))
+        return "?." + ".".join(reversed(parts))
+    return ""
+
+
+class _Frame:
+    """One function scope (def / async def / lambda)."""
+
+    def __init__(self, node, is_async: bool, name: str):
+        self.node = node
+        self.is_async = is_async
+        self.name = name
+        # Name -> source text it was last assigned from (RTPU001 .result()
+        # provenance: futures born from executor.submit / .future() /
+        # run_coroutine_threadsafe block when .result() is called)
+        self.assigned_from: Dict[str, str] = {}
+        self.has_thread_guard = False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str, findings: List[Finding]):
+        self.path = path
+        self.findings = findings
+        self.frames: List[_Frame] = []
+        self.class_stack: List[str] = []
+
+    # -------------------------------------------------------- helpers
+    def _emit(self, node: ast.AST, rule: str, message: str):
+        self.findings.append(Finding(
+            self.path, getattr(node, "lineno", 0),
+            getattr(node, "col_offset", 0), rule, message))
+
+    def _frame(self) -> Optional[_Frame]:
+        return self.frames[-1] if self.frames else None
+
+    def _in_async(self) -> bool:
+        f = self._frame()
+        return f is not None and f.is_async
+
+    # -------------------------------------------------------- scopes
+    def _enter_function(self, node, is_async: bool):
+        frame = _Frame(node, is_async, getattr(node, "name", "<lambda>"))
+        # pre-scan THIS frame for thread-identity guards (RTPU004
+        # exemption) — nested defs/lambdas are separate frames and must
+        # not vouch for their enclosing function
+        frame.has_thread_guard = self._frame_has_guard(node)
+        self.frames.append(frame)
+        self.generic_visit(node)
+        self.frames.pop()
+
+    @staticmethod
+    def _frame_has_guard(func_node) -> bool:
+        for sub in _walk_frame(func_node):
+            if isinstance(sub, ast.Attribute) and sub.attr in _THREAD_GUARDS:
+                return True
+            if isinstance(sub, ast.Name) and sub.id in _THREAD_GUARDS:
+                return True
+        return False
+
+    def visit_FunctionDef(self, node):
+        self._enter_function(node, False)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._enter_function(node, True)
+
+    def visit_Lambda(self, node):
+        # a lambda body does NOT run inline where it is written: treat it
+        # as a sync frame (e.g. `lambda: fut.result()` handed to
+        # run_in_executor is the CORRECT pattern, not a violation)
+        self._enter_function(node, False)
+
+    def visit_ClassDef(self, node):
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def visit_Assign(self, node):
+        frame = self._frame()
+        if frame is not None and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            frame.assigned_from[node.targets[0].id] = _unparse(node.value)
+        self.generic_visit(node)
+
+    # -------------------------------------------------------- RTPU002
+    def visit_With(self, node):
+        if self._in_async():
+            for item in node.items:
+                ctx = _unparse(item.context_expr)
+                if "lock" in ctx.lower() and "asyncio" not in ctx:
+                    # _walk_frame (+ root-level def skip): an await
+                    # inside a function merely DEFINED under the lock
+                    # runs later, lock released
+                    if any(isinstance(sub, (ast.Await, ast.AsyncFor,
+                                            ast.AsyncWith))
+                           for stmt in node.body
+                           if not isinstance(stmt, (ast.FunctionDef,
+                                                    ast.AsyncFunctionDef))
+                           for sub in _walk_frame(stmt)):
+                        self._emit(node, "RTPU002",
+                                   f"threading lock `{ctx}` held across an "
+                                   "await; the loop thread parks inside "
+                                   "the critical section while other "
+                                   "threads spin on the lock")
+                        break
+        self.generic_visit(node)
+
+    # -------------------------------------------------------- RTPU006
+    def visit_ExceptHandler(self, node):
+        if len(node.body) == 1 and isinstance(node.body[0], ast.Pass):
+            if self._is_blanket(node.type):
+                caught = _unparse(node.type) if node.type else "<bare>"
+                self._emit(node, "RTPU006",
+                           f"`except {caught}: pass` swallows every "
+                           "failure with no log or counter")
+        self.generic_visit(node)
+
+    @staticmethod
+    def _is_blanket(type_node) -> bool:
+        if type_node is None:
+            return True
+        names = []
+        if isinstance(type_node, ast.Name):
+            names = [type_node.id]
+        elif isinstance(type_node, ast.Tuple):
+            names = [e.id for e in type_node.elts if isinstance(e, ast.Name)]
+        return any(n in ("Exception", "BaseException") for n in names)
+
+    # -------------------------------------------------------- RTPU003
+    def visit_Expr(self, node):
+        call = node.value
+        if isinstance(call, ast.Call) and self._is_spawn(call):
+            self._emit(node, "RTPU003",
+                       f"`{_dotted(call.func)}(...)` handle dropped: an "
+                       "exception in the task is swallowed; use "
+                       "procutil.spawn_logged(coro, name=...) or keep the "
+                       "handle with a done-callback")
+        self.generic_visit(node)
+
+    @staticmethod
+    def _is_spawn(call: ast.Call) -> bool:
+        name = _dotted(call.func)
+        if name in ("asyncio.ensure_future", "asyncio.create_task",
+                    "ensure_future"):
+            return True
+        # alternative spellings: loop.create_task(...) on a held loop
+        # handle or a get_running_loop()/get_event_loop() chain — the
+        # handle is dropped all the same
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr in ("create_task", "ensure_future"):
+            recv = call.func.value
+            if isinstance(recv, ast.Call) and _dotted(recv.func).endswith(
+                    ("get_running_loop", "get_event_loop")):
+                return True
+            if "loop" in _unparse(recv).lower():
+                return True
+        return False
+
+    # -------------------------------------------------------- RTPU007
+    def _check_for(self, node):
+        container = self._iter_container(node.iter)
+        if container is not None:
+            self._scan_mutations(node, container, node.body)
+        self.generic_visit(node)
+
+    visit_For = _check_for
+    visit_AsyncFor = _check_for
+
+    @staticmethod
+    def _iter_container(it: ast.AST) -> Optional[str]:
+        """Text of the container a `for` iterates LIVE, or None when the
+        iterable is a snapshot (list(...)/sorted(...)/etc.)."""
+        if isinstance(it, ast.Call):
+            fname = _dotted(it.func)
+            if fname in _ITER_WRAPPERS:
+                return None
+            if isinstance(it.func, ast.Attribute) and \
+                    it.func.attr in ("keys", "values", "items"):
+                return _unparse(it.func.value)
+            if fname in ("enumerate", "reversed") and it.args:
+                return _Visitor._iter_container(it.args[0])
+            return None
+        if isinstance(it, (ast.Name, ast.Attribute)):
+            return _unparse(it)
+        return None
+
+    def _scan_mutations(self, loop_node, container: str, body: List):
+        def block_exits_after(stmts: List, idx: int) -> bool:
+            """A mutation is safe when its statement block leaves the
+            loop before the iterator advances (q.remove(x); return x)."""
+            return any(isinstance(s, (ast.Return, ast.Break, ast.Raise))
+                       for s in stmts[idx:])
+
+        mutations: List[Tuple[int, str]] = []
+
+        def scan_block(stmts: List):
+            for i, stmt in enumerate(stmts):
+                mutated = self._stmt_mutates(stmt, container)
+                if mutated and not block_exits_after(stmts, i):
+                    mutations.append((stmt.lineno, mutated))
+                # recurse into compound statements (incl. nested loops:
+                # mutations inside them relative to THIS loop still count)
+                for sub_block in self._sub_blocks(stmt):
+                    scan_block(sub_block)
+
+        scan_block(body)
+        if mutations:
+            # one finding, attached to the loop header, so a single
+            # pragma there covers every mutation site inside it
+            where = ", ".join(f"line {ln} ({how})"
+                              for ln, how in mutations[:4])
+            self._emit(loop_node, "RTPU007",
+                       f"`{container}` is mutated while this `for` "
+                       f"iterates it [{where}]; snapshot with "
+                       "list(...) first")
+
+    @staticmethod
+    def _sub_blocks(stmt) -> List[List]:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a function DEFINED in the loop body runs later, after
+            # iteration — its mutations are not this loop's problem
+            return []
+        blocks = []
+        for attr in ("body", "orelse", "finalbody"):
+            b = getattr(stmt, attr, None)
+            if b and all(isinstance(s, ast.stmt) for s in b):
+                blocks.append(b)
+        for h in getattr(stmt, "handlers", []) or []:
+            blocks.append(h.body)
+        return blocks
+
+    @staticmethod
+    def _stmt_mutates(stmt, container: str) -> Optional[str]:
+        """Mutation of `container` directly in `stmt` (not in nested
+        statement blocks — those are scanned separately so the
+        exits-after check sees the right block)."""
+        direct_exprs: List[ast.AST] = []
+        if isinstance(stmt, ast.Expr):
+            direct_exprs.append(stmt.value)
+        elif isinstance(stmt, ast.Assign):
+            direct_exprs.extend(stmt.targets)
+            direct_exprs.append(stmt.value)
+        elif isinstance(stmt, ast.Delete):
+            direct_exprs.extend(stmt.targets)
+        elif isinstance(stmt, ast.AugAssign):
+            direct_exprs.extend([stmt.target, stmt.value])
+        for expr in direct_exprs:
+            for sub in [expr, *_walk_frame(expr)]:
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr in _MUTATORS and \
+                        _unparse(sub.func.value) == container:
+                    return f".{sub.func.attr}()"
+                if isinstance(sub, ast.Subscript) and \
+                        isinstance(sub.ctx, (ast.Store, ast.Del)) and \
+                        _unparse(sub.value) == container:
+                    return ("del [...]" if isinstance(sub.ctx, ast.Del)
+                            else "[...] assignment")
+        return None
+
+    # -------------------------------------------------------- calls
+    def visit_Call(self, node):
+        name = _dotted(node.func)
+        frame = self._frame()
+
+        # ---- RTPU005: process-unstable identity in data
+        if isinstance(node.func, ast.Name) and node.func.id in ("hash", "id") \
+                and len(node.args) == 1:
+            fname = frame.name if frame else ""
+            if fname not in ("__hash__",):
+                self._emit(node, "RTPU005",
+                           f"builtin {node.func.id}() is process-unstable "
+                           "(PYTHONHASHSEED / address reuse): never let it "
+                           "reach wire payloads, cache keys or routing; "
+                           "use hashlib/blake2 or stable ids")
+
+        if frame is not None and frame.is_async:
+            self._check_blocking(node, name)
+        elif frame is not None:
+            self._check_loop_mutation(node, name, frame)
+        self.generic_visit(node)
+
+    # -------------------------------------------------------- RTPU001
+    def _check_blocking(self, node: ast.Call, name: str):
+        if name in _BLOCKING_NAMES:
+            self._emit(node, "RTPU001",
+                       f"`{name}()` blocks the event loop inside `async "
+                       f"def {self._frame().name}`; use the asyncio "
+                       "equivalent or run_in_executor")
+            return
+        if isinstance(node.func, ast.Name) and node.func.id == "open":
+            self._emit(node, "RTPU001",
+                       f"file I/O (`open`) inside `async def "
+                       f"{self._frame().name}` blocks the event loop; "
+                       "offload to run_in_executor")
+            return
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            recv = _unparse(node.func.value)
+            if attr in _SOCKET_ATTRS and "sock" in recv.lower():
+                self._emit(node, "RTPU001",
+                           f"sync socket op `{recv}.{attr}()` inside "
+                           f"`async def {self._frame().name}`; use "
+                           "loop.sock_* / asyncio streams")
+                return
+            if attr == "result":
+                self._check_result_call(node, recv)
+
+    def _check_result_call(self, node: ast.Call, recv: str):
+        """.result() that blocks: concurrent futures from .future(),
+        executor.submit or run_coroutine_threadsafe. (.result() on a
+        done()-checked asyncio future is fine and not matched here.)"""
+        blocking_src = None
+        base = node.func.value
+        if isinstance(base, ast.Call) and \
+                isinstance(base.func, ast.Attribute) and \
+                base.func.attr == "future":
+            blocking_src = f"{recv}"
+        elif isinstance(base, ast.Name):
+            src = self._frame().assigned_from.get(base.id, "")
+            if (".submit(" in src or "run_coroutine_threadsafe(" in src
+                    or ".future()" in src):
+                blocking_src = src
+        if blocking_src is not None:
+            self._emit(node, "RTPU001",
+                       f"`.result()` on `{blocking_src}` blocks the event "
+                       f"loop inside `async def {self._frame().name}`; "
+                       "await asyncio.wrap_future(...) instead")
+
+    # -------------------------------------------------------- RTPU004
+    def _check_loop_mutation(self, node: ast.Call, name: str,
+                             frame: _Frame):
+        if not isinstance(node.func, ast.Attribute):
+            return
+        if node.func.attr not in ("call_soon", "create_task"):
+            return
+        recv_node = node.func.value
+        # loop obtained via get_running_loop() proves on-loop execution
+        if isinstance(recv_node, ast.Call) and \
+                _dotted(recv_node.func).endswith("get_running_loop"):
+            return
+        recv = _unparse(recv_node)
+        if "loop" not in recv.lower():
+            return
+        if frame.has_thread_guard:
+            return
+        self._emit(node, "RTPU004",
+                   f"`{recv}.{node.func.attr}()` from sync code holding a "
+                   "loop handle: if the caller is not the loop thread this "
+                   "corrupts loop state; use call_soon_threadsafe / "
+                   "run_coroutine_threadsafe (or prove identity with "
+                   "get_running_loop)")
+
+
+# ------------------------------------------------------------------ api
+def _comment_lines(source: str) -> Dict[int, str]:
+    """lineno -> comment text, via the tokenizer — pragma-shaped text
+    inside string literals/docstrings must neither arm a suppression nor
+    trip RTPU000. Falls back to a whole-line scan on tokenize errors."""
+    import io
+    import tokenize
+
+    out: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        for lineno, line in enumerate(source.splitlines(), start=1):
+            if "#" in line:
+                out[lineno] = line[line.index("#"):]
+    return out
+
+
+def _parse_pragmas(source: str, path: str,
+                   findings: List[Finding]) -> Dict[int, Tuple[Set[str], str]]:
+    pragmas: Dict[int, Tuple[Set[str], str]] = {}
+    for lineno, line in sorted(_comment_lines(source).items()):
+        m = _PRAGMA_RE.search(line)
+        if not m:
+            if "rtpulint:" in line and "ignore" in line:
+                findings.append(Finding(
+                    path, lineno, 0, "RTPU000",
+                    "unparseable rtpulint pragma: expected "
+                    "`# rtpulint: ignore[RTPUxxx] — reason`"))
+            continue
+        rules = {r.strip().upper() for r in m.group(1).split(",") if r.strip()}
+        reason = (m.group(2) or "").strip()
+        if not rules or not reason:
+            findings.append(Finding(
+                path, lineno, 0, "RTPU000",
+                "rtpulint pragma must name at least one rule AND carry a "
+                "reason: `# rtpulint: ignore[RTPUxxx] — why this is safe`"))
+            continue
+        unknown = rules - set(RULES)
+        if unknown:
+            findings.append(Finding(
+                path, lineno, 0, "RTPU000",
+                f"pragma names unknown rule(s): {sorted(unknown)}"))
+        pragmas[lineno] = (rules, reason)
+    return pragmas
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   select: Optional[Set[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    pragmas = _parse_pragmas(source, path, findings)
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        findings.append(Finding(path, e.lineno or 0, 0, "RTPU000",
+                                f"syntax error: {e.msg}"))
+        return findings
+    _Visitor(path, findings).visit(tree)
+    for f in findings:
+        if f.rule == "RTPU000":
+            continue  # pragma problems are never self-suppressable
+        for lineno in (f.line, f.line - 1):
+            entry = pragmas.get(lineno)
+            if entry and f.rule in entry[0]:
+                f.suppressed = True
+                f.reason = entry[1]
+                break
+    if select:
+        findings = [f for f in findings
+                    if f.rule in select or f.rule == "RTPU000"]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def analyze_file(path: str,
+                 select: Optional[Set[str]] = None) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return analyze_source(fh.read(), path, select=select)
+
+
+def iter_python_files(paths: List[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        if not os.path.isdir(p):
+            # a typo'd path must never read as "clean over 0 files"
+            raise FileNotFoundError(f"no such file or directory: {p!r}")
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", ".git", "node_modules")]
+            out.extend(os.path.join(root, f) for f in sorted(files)
+                       if f.endswith(".py"))
+    return sorted(dict.fromkeys(out))
+
+
+def run(paths: List[str], select: Optional[Set[str]] = None
+        ) -> Tuple[List[Finding], int]:
+    """Analyze every .py under `paths`. Returns (findings, n_files)."""
+    findings: List[Finding] = []
+    files = iter_python_files(paths)
+    for fp in files:
+        findings.extend(analyze_file(fp, select=select))
+    return findings, len(files)
+
+
+def render_human(findings: List[Finding], n_files: int,
+                 show_suppressed: bool = False) -> str:
+    lines = []
+    unsuppressed = [f for f in findings if not f.suppressed]
+    shown = findings if show_suppressed else unsuppressed
+    for f in shown:
+        tag = " (suppressed: %s)" % f.reason if f.suppressed else ""
+        lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule} "
+                     f"[{f.severity}] {f.message}{tag}")
+    counts: Dict[str, int] = {}
+    for f in unsuppressed:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    summary = ", ".join(f"{r}={n}" for r, n in sorted(counts.items())) \
+        or "clean"
+    n_sup = sum(1 for f in findings if f.suppressed)
+    lines.append(f"rtpulint: {len(unsuppressed)} finding(s) over {n_files} "
+                 f"file(s) [{summary}]; {n_sup} suppressed by pragma")
+    return "\n".join(lines)
+
+
+def render_json(findings: List[Finding], n_files: int) -> str:
+    unsuppressed = [f for f in findings if not f.suppressed]
+    counts: Dict[str, int] = {}
+    for f in unsuppressed:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return json.dumps({
+        "version": 1,
+        "files_scanned": n_files,
+        "findings": [f.to_dict() for f in findings],
+        "counts": counts,
+        "unsuppressed": len(unsuppressed),
+        "suppressed": sum(1 for f in findings if f.suppressed),
+        "rules": {code: {"severity": sev, "description": desc}
+                  for code, (sev, desc) in RULES.items()},
+    }, indent=None, sort_keys=True)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.rtpulint",
+        description="AST concurrency-invariant analyzer for the ray_tpu "
+                    "runtime (rules RTPU001-RTPU007)")
+    parser.add_argument("paths", nargs="+",
+                        help="files or directories to analyze")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    parser.add_argument("--select", default="",
+                        help="comma-separated rule codes to run "
+                             "(default: all)")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also print pragma-suppressed findings")
+    args = parser.parse_args(argv)
+    select = {r.strip().upper() for r in args.select.split(",")
+              if r.strip()} or None
+    try:
+        findings, n_files = run(args.paths, select=select)
+    except FileNotFoundError as e:
+        print(f"rtpulint: error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(render_json(findings, n_files))
+    else:
+        print(render_human(findings, n_files,
+                           show_suppressed=args.show_suppressed))
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
