@@ -295,6 +295,29 @@ def bench_pd_handoff() -> dict:
         f"pd_handoff produced no JSON: {out.stderr[-300:]}")
 
 
+def bench_dag() -> dict:
+    """Compiled-graph cross-host data plane on the simulated two-host
+    setup (benchmarks/dag_pipeline.py): steady-state per-step latency
+    (`dag_step_us`, zero-RPC asserted), stage-handoff GB/s compiled vs
+    the actor-RPC DAG path (`dag_handoff_gb_s` / `dag_handoff_gb_s_rpc`),
+    and the cross-host ring allreduce with exactness check."""
+    import os
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = subprocess.run(
+        [sys.executable, os.path.join(here, "benchmarks",
+                                      "dag_pipeline.py"),
+         "--size-mb", "4", "--steps", "16"],
+        capture_output=True, text=True, timeout=600, cwd=here)
+    for line in reversed(out.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(
+        f"dag_pipeline produced no JSON: {out.stderr[-300:]}")
+
+
 def bench_train(on_tpu: bool) -> dict:
     import jax
     import jax.numpy as jnp
@@ -431,7 +454,20 @@ def main():
         except Exception as e:  # noqa: BLE001
             result["detail"]["pd_handoff"] = {"error": repr(e)[:200]}
 
-    # 7. static analysis: rtpulint over the runtime layers (cheap, ~2s).
+    # 7. compiled-graph data plane: per-step latency + cross-host stage
+    # handoff GB/s, compiled channels vs the actor-RPC DAG path
+    # (dag_step_us / dag_handoff_gb_s keys), same time guard
+    if time.perf_counter() - start < 470:
+        try:
+            dag = bench_dag()
+            result["detail"]["dag_pipeline"] = dag
+            for key in ("dag_step_us", "dag_handoff_gb_s"):
+                if key in dag:
+                    result["detail"][key] = dag[key]
+        except Exception as e:  # noqa: BLE001
+            result["detail"]["dag_pipeline"] = {"error": repr(e)[:200]}
+
+    # 8. static analysis: rtpulint over the runtime layers (cheap, ~2s).
     # lint_clean records when the tree regresses on a concurrency
     # invariant; unsuppressed_findings is the count behind it.
     try:
@@ -442,7 +478,8 @@ def main():
         _repo = _os.path.dirname(_os.path.abspath(__file__))
         _findings, _ = _lint_run(
             [_os.path.join(_repo, "ray_tpu", "runtime"),
-             _os.path.join(_repo, "ray_tpu", "serve")])
+             _os.path.join(_repo, "ray_tpu", "serve"),
+             _os.path.join(_repo, "ray_tpu", "dag")])
         _bad = sum(1 for f in _findings if not f.suppressed)
         result["detail"]["lint_clean"] = _bad == 0
         result["detail"]["lint_unsuppressed_findings"] = _bad

@@ -1,0 +1,153 @@
+"""Cross-host compiled-graph pipeline A/B.
+
+Two-stage pipeline on the simulated two-host localhost setup (an extra
+nodelet with its own RTPU_HOST_ID + RTPU_SHM_ROOT, as in
+benchmarks/transfer.py): stage A on the head host, stage B on host B, so
+the A->B and B->driver edges cross hosts. Three measurements:
+
+- ``dag_step_us``: steady-state per-execute latency of the compiled DAG
+  on a tiny payload — the control-plane floor (channel frames only; the
+  run also asserts, counter-backed via rpc.transport_sends(), that the
+  driver issues ZERO non-ambient RPC frames across the timed loop).
+- ``dag_handoff_gb_s`` vs ``dag_handoff_gb_s_rpc``: cross-host stage
+  handoff throughput on multi-MiB array frames, compiled channels vs the
+  same DAG executed through the per-call actor-RPC path (`dag.execute`
+  uncompiled). The acceptance bar is >= 2x.
+- ``dag_allreduce_ms`` + ``allreduce_exact``: a cross-host ring
+  allreduce over the same channels, with bit-parity vs reduce_values.
+
+Run: ``python benchmarks/dag_pipeline.py [--size-mb 4] [--steps 20]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # runnable from anywhere
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--size-mb", type=int, default=4)
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args()
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.dag import InputNode, MultiOutputNode, allreduce
+    from ray_tpu.dag.collective import reduce_values
+    from ray_tpu.runtime import rpc
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    session = ray_tpu.init(num_cpus=2)
+    pool = tempfile.mkdtemp(prefix="rtpu_dagbench_")
+    node_b = session.add_node(
+        num_cpus=2,
+        env={"RTPU_HOST_ID": "dagbench-host-b", "RTPU_SHM_ROOT": pool})
+
+    @ray_tpu.remote
+    class Stage:
+        def fwd(self, x):
+            return x
+
+    stage_a = Stage.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id=session.node_id)).remote()
+    stage_b = Stage.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id=node_b)).remote()
+
+    with InputNode() as inp:
+        dag = stage_b.fwd.bind(stage_a.fwd.bind(inp))
+    cdag = dag.experimental_compile(
+        buffer_size_bytes=(args.size_mb << 20) + (1 << 16))
+    results = {"size_mb": args.size_mb, "steps": args.steps,
+               "edge_plan": [k for _, _, k in cdag.edge_plan]}
+
+    # --- control-plane floor: tiny payload per-step latency ------------
+    small = np.zeros(16, dtype=np.float64)
+    for _ in range(3):
+        cdag.execute(small).get()  # warm the streams
+    ambient = {"heartbeat", "report_metrics", "view_update"}
+    before = rpc.transport_sends()
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        cdag.execute(small).get()
+    dt = time.perf_counter() - t0
+    after = rpc.transport_sends()
+    steady_rpc = {k: after[k] - before.get(k, 0) for k in after
+                  if after[k] != before.get(k, 0) and k not in ambient}
+    results["dag_step_us"] = round(dt / args.steps * 1e6, 1)
+    results["steady_state_rpc_frames"] = sum(steady_rpc.values())
+    assert not steady_rpc, f"steady-state execute issued RPCs: {steady_rpc}"
+
+    # --- cross-host handoff throughput: compiled vs actor-RPC DAG ------
+    nbytes = args.size_mb << 20
+    payload = np.random.default_rng(0).integers(
+        0, 255, nbytes // 8, dtype=np.int64)  # >= 1 MiB array frames
+    hops = sum(1 for k in results["edge_plan"] if k == "remote")
+    cdag.execute(payload).get()  # warm the big-frame path
+    t0 = time.perf_counter()
+    for _ in range(max(3, args.steps // 4)):
+        out = cdag.execute(payload).get()
+    n_big = max(3, args.steps // 4)
+    dt_compiled = (time.perf_counter() - t0) / n_big
+    assert np.array_equal(out, payload)
+    results["dag_big_step_ms"] = round(dt_compiled * 1e3, 2)
+    results["dag_handoff_gb_s"] = round(
+        payload.nbytes * hops / dt_compiled / 1e9, 3)
+    cdag.teardown()
+
+    # the same DAG through per-call actor RPC (uncompiled execute)
+    ray_tpu.get(dag.execute(payload))  # warm
+    t0 = time.perf_counter()
+    for _ in range(max(3, args.steps // 4)):
+        out = ray_tpu.get(dag.execute(payload), timeout=300)
+    dt_rpc = (time.perf_counter() - t0) / n_big
+    assert np.array_equal(out, payload)
+    results["dag_big_step_ms_rpc"] = round(dt_rpc * 1e3, 2)
+    results["dag_handoff_gb_s_rpc"] = round(
+        payload.nbytes * hops / dt_rpc / 1e9, 3)
+    if results["dag_handoff_gb_s_rpc"] > 0:
+        results["dag_speedup"] = round(
+            results["dag_handoff_gb_s"] / results["dag_handoff_gb_s_rpc"],
+            2)
+
+    # --- ring allreduce over the same channels, cross-host -------------
+    with InputNode() as inp:
+        ra, rb = allreduce.bind(
+            [stage_a.fwd.bind(inp), stage_b.fwd.bind(inp)], op="sum",
+            topology="ring")
+        rdag = MultiOutputNode([ra, rb]).experimental_compile(
+            buffer_size_bytes=(args.size_mb << 20) + (1 << 16))
+    grad = np.random.default_rng(1).standard_normal(
+        nbytes // 8).astype(np.float32)
+    va, _ = rdag.execute(grad).get()  # warm
+    t0 = time.perf_counter()
+    for _ in range(3):
+        va, vb = rdag.execute(grad).get()
+    results["dag_allreduce_ms"] = round(
+        (time.perf_counter() - t0) / 3 * 1e3, 2)
+    want = reduce_values([grad, grad], "sum")
+    results["allreduce_exact"] = bool(
+        np.array_equal(va, want) and np.array_equal(vb, want))
+    rdag.teardown()
+
+    print(json.dumps(results))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=2)
+    ray_tpu.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,17 +4,22 @@ Parity with the reference's Compiled Graphs (ref: python/ray/dag/ —
 DAGNode/ClassMethodNode/InputNode/MultiOutputNode in dag_node.py /
 class_node.py; CompiledDAG compiled_dag_node.py:808, execute :2547): a DAG
 of bound actor methods compiles into pre-provisioned per-actor execution
-loops connected by shared-memory channels (runtime/channel.py), bypassing
-per-call task submission entirely. Where the reference moves GPU tensors
-over NCCL channels, colocated TPU actors hand off arrays through the same
-shm channels (host round-trip) — cross-chip device-to-device transfer
-rides the mesh inside jit, not the actor dataplane.
+loops connected by channels (runtime/channel.py), bypassing per-call task
+submission entirely. Edge transport is picked once at compile time from
+actor placement: colocated actors hand off through shm rings (host
+round-trip); cross-host edges ride a credit-based RemoteChannel stream
+into the consumer host's ring, with a chan_push RPC fallback — the
+reference's shm-vs-NCCL channel split, with the bulk transfer plane
+standing in for NCCL. Cross-chip device-to-device transfer rides the
+mesh inside jit, not the actor dataplane.
 
-Collectives-in-DAG (`allreduce.bind([...])`, collective.py — ref:
-collective_node.py:144) lower onto the same channels with an overlapped
-schedule: contributions are sent at the earliest point and results
-received at the latest, so ops independent of the collective run while
-peers' contributions are in flight (ref: dag_node_operation.py).
+Collectives-in-DAG (`allreduce.bind([...])` / `allgather.bind([...])`,
+collective.py — ref: collective_node.py:144) lower onto the same
+channels: the leader topology with an overlapped schedule (contributions
+sent at the earliest point, results received at the latest — ref:
+dag_node_operation.py), or `topology="ring"` for neighbor-only chunk
+exchange whose per-link traffic stays flat as the group grows (the shape
+for cross-host gradient reduction).
 """
 
 from .dag_node import (  # noqa: F401
@@ -23,9 +28,13 @@ from .dag_node import (  # noqa: F401
     InputNode,
     MultiOutputNode,
 )
-from .collective import CollectiveOutputNode, allreduce  # noqa: F401
+from .collective import (  # noqa: F401
+    CollectiveOutputNode,
+    allgather,
+    allreduce,
+)
 from .compiled_dag import CompiledDAG, CompiledDAGRef  # noqa: F401
 
 __all__ = ["InputNode", "MultiOutputNode", "DAGNode", "ClassMethodNode",
-           "CompiledDAG", "CompiledDAGRef", "allreduce",
+           "CompiledDAG", "CompiledDAGRef", "allreduce", "allgather",
            "CollectiveOutputNode"]

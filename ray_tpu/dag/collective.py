@@ -6,18 +6,27 @@ one CollectiveOutputNode per participant; experimental_compile lowers
 them onto NCCL channels; the compute/comm overlap schedule lives in
 dag_node_operation.py). TPU-first differences:
 
-- The dataplane is the framework's own shm channels, not NCCL: each
-  group lowers to contribute channels (participant -> leader), a
-  host-tier reduction on the leader, and result channels back. Device
-  arrays ride the channels' zero-copy array frames; chip-to-chip
-  reduction at scale belongs INSIDE jit over the mesh (psum on ICI) —
-  the DAG tier reduces across actor processes, where the host hop is
-  the only portable transport.
-- Overlap is a SCHEDULE, like the reference's: each participant's
-  contribution is sent at the earliest point (right after its producer
-  op) and the result is received at the latest (just before its first
-  consumer), so ops independent of the collective run while peers'
-  contributions are still in flight (see compiled_dag.py placement).
+- The dataplane is the framework's own channels, not NCCL: shm rings
+  between colocated actors, RemoteChannel bulk streams across hosts
+  (runtime/channel.py), so the SAME lowering serves single-host and
+  multi-node groups. Device arrays ride the channels' zero-copy array
+  frames; chip-to-chip reduction at scale belongs INSIDE jit over the
+  mesh (psum on ICI) — the DAG tier reduces across actor processes,
+  where the host hop is the only portable transport.
+- Two topologies. ``leader`` (default): contributions gather on the
+  first participant, reduce there, results fan back — sends placed as
+  EARLY as possible and recvs as LATE as possible, the reference's
+  compute/comm overlap schedule. ``ring``: participants exchange chunks
+  with their ring neighbors only, so no single link carries the whole
+  group's traffic — the shape that makes cross-host gradient reduction
+  scale (each inter-host link moves ~2x the array instead of the
+  leader's (n-1)x fan-in).
+- The ring pipelines chunks rank 0 → 1 → ... → n-1 and broadcasts the
+  finals back around, accumulating in STRICT rank order — bit-exact
+  parity with :func:`reduce_values`' left fold on float inputs. The
+  classic rotated-start ring moves 2(n-1)/n of the array per link but
+  folds each chunk in a different rank order, so results differ run to
+  run across placements; deterministic numerics win here.
 """
 
 from __future__ import annotations
@@ -25,11 +34,13 @@ from __future__ import annotations
 import itertools
 from typing import Any, List, Sequence
 
+from ..runtime.channel import ChannelClosed
 from .dag_node import DAGNode
 
 _group_counter = itertools.count()
 
 REDUCE_OPS = ("sum", "mean", "max", "min", "prod")
+TOPOLOGIES = ("leader", "ring")
 
 
 def reduce_values(values: Sequence[Any], op: str):
@@ -46,28 +57,182 @@ def reduce_values(values: Sequence[Any], op: str):
         import numpy as xp
     acc = values[0]
     for v in values[1:]:
-        if op in ("sum", "mean"):
-            acc = acc + v
-        elif op == "max":
-            acc = xp.maximum(acc, v)
-        elif op == "min":
-            acc = xp.minimum(acc, v)
-        elif op == "prod":
-            acc = acc * v
-        else:
-            raise ValueError(f"unknown reduce op {op!r}")
+        acc = _combine(acc, v, op, xp)
     if op == "mean":
         acc = acc / len(values)
     return acc
 
 
-class CollectiveGroup:
-    """One logical collective: N participant nodes, one reduce op."""
+def _combine(acc, v, op: str, xp=None):
+    """One left-fold step, shared by the driver-tier and ring reductions
+    so both produce bit-identical accumulation order."""
+    if xp is None:
+        import numpy as xp
+    if op in ("sum", "mean"):
+        return acc + v
+    if op == "max":
+        return xp.maximum(acc, v)
+    if op == "min":
+        return xp.minimum(acc, v)
+    if op == "prod":
+        return acc * v
+    raise ValueError(f"unknown reduce op {op!r}")
 
-    def __init__(self, inputs: List[DAGNode], op: str):
+
+# ------------------------------------------------------------ ring runtime
+# Executed inside each participant's DAG loop (loop_runner op kind
+# "ring"). Every iteration runs a status phase first — one tiny frame per
+# link per step, n-1 steps — so a participant whose upstream failed can
+# propagate its error marker around the ring instead of leaving peers
+# parked on data frames that will never come (the ring analogue of the
+# leader schedule's one-item-per-iteration invariant).
+
+
+def ring_status_phase(spec: dict, err=None, meta=None):
+    """Circulate per-rank status tokens around the ring: each rank sends
+    its own (origin, err, meta) token first, then forwards what it
+    receives, so after world-1 steps EVERY rank has seen every rank's
+    token. All ranks then compute the same global verdict locally — the
+    lowest-origin error marker, plus the per-rank contribution metas for
+    the shape/dtype consistency check. Frame counts are identical
+    whether or not anyone failed, so the ring's channels stay aligned
+    across iterations."""
+    send, recv, world, index = (spec["send"], spec["recv"], spec["world"],
+                                spec["index"])
+    if world <= 1 or send is None:
+        return err, {spec["index"]: meta}
+    tokens = {index: (err, meta)}
+    cur = (index, err, meta)
+    for _ in range(world - 1):
+        send.write(cur)
+        cur = recv.read()
+        tokens[cur[0]] = (cur[1], cur[2])
+    first_err = None
+    for rank in sorted(tokens):
+        if tokens[rank][0] is not None:
+            first_err = tokens[rank][0]
+            break
+    return first_err, {rank: m for rank, (_, m) in tokens.items()}
+
+
+def ring_execute(value, spec: dict):
+    """This participant's half of one ring collective iteration. Returns
+    the result, or a loop_runner._DagLoopError marker when any
+    participant failed or the contributions are incompatible — the
+    caller aborts the iteration with it (every rank reaches the SAME
+    verdict from the same status tokens, with zero data frames moved,
+    so the rings stay aligned). An unexpected failure DURING the data
+    exchange raises RingDesyncError: the ring's frame counts can no
+    longer be trusted, so the loop tears the whole DAG down instead of
+    running desynchronized."""
+    import traceback
+
+    import numpy as np
+
+    from .loop_runner import RingDesyncError, _DagLoopError
+
+    world, index = spec["world"], spec["index"]
+    if world <= 1:
+        if spec["coll"] == "allgather":
+            return [np.asarray(value)]
+        return reduce_values([value], spec["op"])
+    x = np.asarray(value)
+    err, metas = ring_status_phase(
+        spec, meta=(tuple(x.shape), x.dtype.str))
+    if err is not None:
+        return err
+    if spec["coll"] != "allgather" and len(set(metas.values())) != 1:
+        # deterministic at every rank: same tokens, same verdict, no
+        # data frames exchanged anywhere — channels stay aligned
+        return _DagLoopError(
+            f"ring {spec['coll']} contributions disagree on shape/dtype "
+            f"(rank -> (shape, dtype)): {metas} — every participant "
+            "must contribute an identical-layout array")
+    try:
+        if spec["coll"] == "allgather":
+            return _ring_allgather(x, index, world, spec["send"],
+                                   spec["recv"])
+        return _ring_allreduce(x, index, world, spec["send"],
+                               spec["recv"], spec["op"])
+    except ChannelClosed:
+        raise
+    except Exception:
+        raise RingDesyncError(
+            f"ring {spec['coll']} failed mid-exchange on rank {index}; "
+            "the ring's channels may be misaligned — tearing the DAG "
+            f"down:\n{traceback.format_exc()}") from None
+
+
+def _ring_allreduce(value, index: int, world: int, send, recv, op: str):
+    """Order-exact pipelined ring allreduce.
+
+    Reduce phase: chunks flow 0 → 1 → ... → world-1, each rank folding
+    its own contribution onto the incoming partial — chunk c's final is
+    ((v0 ⊕ v1) ⊕ ...) ⊕ v_{n-1}, the exact left fold reduce_values
+    computes. Gather phase: rank world-1 sends the finals around the
+    wrap link and every rank forwards, so all ranks finish with the full
+    result. Chunking (world chunks) pipelines the phases: rank 1 folds
+    chunk 0 while rank 0 is still sending chunk 1."""
+    import numpy as np
+
+    x = np.asarray(value)
+    orig_shape = x.shape
+    flat = np.ascontiguousarray(x).reshape(-1)
+    parts = list(np.array_split(flat, world))
+    if index == 0:
+        for c in parts:
+            send.write(np.ascontiguousarray(c))
+    else:
+        for ci in range(world):
+            partial = recv.read()
+            parts[ci] = _combine(partial, parts[ci], op)
+            if index < world - 1:
+                send.write(parts[ci])
+    if index == world - 1:
+        if op == "mean":
+            parts = [c / world for c in parts]
+        for c in parts:
+            send.write(np.ascontiguousarray(c))
+    else:
+        finals = []
+        for _ in range(world):
+            c = recv.read()
+            finals.append(c)
+            if index < world - 2:
+                send.write(c)
+        parts = finals
+    out = np.concatenate([np.asarray(c).reshape(-1) for c in parts])
+    return out.reshape(orig_shape)
+
+
+def _ring_allgather(value, index: int, world: int, send, recv):
+    """Classic ring allgather: each rank's value circulates world-1
+    hops; returns the list of per-rank values in rank order (identical
+    on every participant)."""
+    import numpy as np
+
+    x = np.ascontiguousarray(np.asarray(value))
+    out: List[Any] = [None] * world
+    out[index] = x
+    cur = x
+    for step in range(world - 1):
+        send.write(cur)
+        cur = recv.read()
+        out[(index - 1 - step) % world] = cur
+    return out
+
+
+class CollectiveGroup:
+    """One logical collective: N participant nodes, one reduce op, and
+    the lowering topology (leader fan-in or neighbor ring)."""
+
+    def __init__(self, inputs: List[DAGNode], op: str,
+                 topology: str = "leader", coll: str = "allreduce"):
         self.gid = next(_group_counter)
         self.inputs = inputs
         self.op = op
+        self.topology = topology
+        self.coll = coll
 
 
 class CollectiveOutputNode(DAGNode):
@@ -84,7 +249,7 @@ class CollectiveOutputNode(DAGNode):
         self.group = group
         self.index = index
         self.actor = upstream.actor
-        self.method_name = f"allreduce_{group.op}"  # repr/debug only
+        self.method_name = f"{group.coll}_{group.op}"  # repr/debug only
 
     def _execute_uncompiled(self, results, input_args):
         # one reduction per group, cached under the group id so every
@@ -95,42 +260,70 @@ class CollectiveOutputNode(DAGNode):
         if cache_key not in results:
             values = ray_tpu.get(
                 [results[n.uid] for n in self.group.inputs])
-            results[cache_key] = ray_tpu.put(
-                reduce_values(values, self.group.op))
+            if self.group.coll == "allgather":
+                import numpy as np
+
+                result = [np.asarray(v) for v in values]
+            else:
+                result = reduce_values(values, self.group.op)
+            results[cache_key] = ray_tpu.put(result)
         results[self.uid] = results[cache_key]
 
     def __repr__(self):
-        return (f"CollectiveOutputNode({self.group.op}"
+        return (f"CollectiveOutputNode({self.method_name}"
                 f"[{self.index}/{len(self.group.inputs)}])")
 
 
-class _AllReduce:
-    """`allreduce.bind([n1, n2, ...], op=...)` -> one output node per
-    input, each bound to its input's actor (ref: collective_node.py:144
-    AllReduceWrapper)."""
+def _validated_nodes(nodes, what: str) -> List[DAGNode]:
+    if isinstance(nodes, DAGNode):
+        nodes = [nodes]
+    nodes = list(nodes)
+    if not nodes:
+        raise ValueError(f"{what}.bind needs at least one node")
+    actors = []
+    for n in nodes:
+        if not isinstance(n, DAGNode) or not hasattr(n, "actor"):
+            raise ValueError(
+                f"{what} participants must be bound actor-method "
+                f"nodes, got {n!r}")
+        actors.append(n.actor.actor_id)
+    if len(set(actors)) != len(actors):
+        raise ValueError(
+            f"{what} participants must live on distinct actors "
+            "(same-actor values need no collective)")
+    return nodes
 
-    def bind(self, nodes, op: str = "sum") -> List[CollectiveOutputNode]:
-        if isinstance(nodes, DAGNode):
-            nodes = [nodes]
-        nodes = list(nodes)
-        if not nodes:
-            raise ValueError("allreduce.bind needs at least one node")
+
+class _AllReduce:
+    """`allreduce.bind([n1, n2, ...], op=..., topology=...)` -> one
+    output node per input, each bound to its input's actor (ref:
+    collective_node.py:144 AllReduceWrapper)."""
+
+    def bind(self, nodes, op: str = "sum",
+             topology: str = "leader") -> List[CollectiveOutputNode]:
+        nodes = _validated_nodes(nodes, "allreduce")
         if op not in REDUCE_OPS:
             raise ValueError(f"op must be one of {REDUCE_OPS}, got {op!r}")
-        actors = []
-        for n in nodes:
-            if not isinstance(n, DAGNode) or not hasattr(n, "actor"):
-                raise ValueError(
-                    "allreduce participants must be bound actor-method "
-                    f"nodes, got {n!r}")
-            actors.append(n.actor.actor_id)
-        if len(set(actors)) != len(actors):
+        if topology not in TOPOLOGIES:
             raise ValueError(
-                "allreduce participants must live on distinct actors "
-                "(same-actor values need no collective)")
-        group = CollectiveGroup(nodes, op)
+                f"topology must be one of {TOPOLOGIES}, got {topology!r}")
+        group = CollectiveGroup(nodes, op, topology=topology)
+        return [CollectiveOutputNode(group, i, n)
+                for i, n in enumerate(nodes)]
+
+
+class _AllGather:
+    """`allgather.bind([n1, n2, ...])` -> one output node per input;
+    every participant receives the full list of values in rank order.
+    Always lowers onto the ring (there is no reduction to centralize)."""
+
+    def bind(self, nodes) -> List[CollectiveOutputNode]:
+        nodes = _validated_nodes(nodes, "allgather")
+        group = CollectiveGroup(nodes, "sum", topology="ring",
+                                coll="allgather")
         return [CollectiveOutputNode(group, i, n)
                 for i, n in enumerate(nodes)]
 
 
 allreduce = _AllReduce()
+allgather = _AllGather()

@@ -1,4 +1,4 @@
-"""CompiledDAG: pre-provisioned actor loops over shm channels.
+"""CompiledDAG: pre-provisioned actor loops over the channel data plane.
 
 Parity with the reference's CompiledDAG (ref: python/ray/dag/
 compiled_dag_node.py:808; execute :2547): compilation walks the bound DAG,
@@ -6,6 +6,21 @@ allocates one SPSC channel per cross-process edge, ships each actor an
 ordered op list, and starts a long-running loop in each actor that reads
 inputs, runs the bound methods, and writes outputs — no per-call task
 submission, no control plane on the hot path.
+
+Edges pick their transport ONCE, at compile time, from actor placement
+(the reference's shm-vs-NCCL channel split, shared_memory_channel.py vs
+torch_tensor_nccl_channel.py):
+
+- producer and consumer on the same host → one shm ring (`Channel`);
+- different hosts → the consumer materializes the ring on ITS host (a
+  `ChannelHandle` shipped in the op list) and the producer writes through
+  a `RemoteChannel` — a persistent credit-based socket stream into the
+  consumer process's `transfer.ChannelServer`, with a chan_push RPC
+  fallback behind `bulk_transfer_enabled`.
+
+Steady-state execute() therefore moves ZERO control-plane RPCs — only
+channel frames (rpc.transport_sends() is the counter the tests and the
+dag_pipeline benchmark assert against).
 """
 
 from __future__ import annotations
@@ -14,11 +29,19 @@ import itertools
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..runtime.channel import Channel, ChannelClosed
+from ..runtime.channel import (
+    Channel,
+    ChannelClosed,
+    ChannelHandle,
+    RemoteChannel,
+)
+from ..runtime.config import get_config
 from .collective import CollectiveOutputNode
 from .dag_node import ClassMethodNode, DAGNode, InputNode, MultiOutputNode
 
 _dag_counter = itertools.count()
+
+_DRIVER = "driver"
 
 
 class CompiledDAGRef:
@@ -39,15 +62,18 @@ class CompiledDAGRef:
 
 
 class CompiledDAG:
-    def __init__(self, root: DAGNode, buffer_size_bytes: int = 4 << 20,
+    def __init__(self, root: DAGNode,
+                 buffer_size_bytes: Optional[int] = None,
                  max_inflight_executions: int = 4):
         import ray_tpu
         from ..runtime.core import get_core
 
+        core = get_core()
+        cfg = get_config()
         self._root = root
-        self._session = get_core().session_name
+        self._session = core.session_name
         self._dag_id = f"{next(_dag_counter)}-{uuid.uuid4().hex[:6]}"
-        self._buffer = buffer_size_bytes
+        self._buffer = buffer_size_bytes or cfg.dag_buffer_size
         # Channel slot count == the in-flight bound, so execute() never
         # parks on a full ring (a blocked single-threaded driver that has
         # not read its outputs would deadlock otherwise; the reference
@@ -85,8 +111,8 @@ class CompiledDAG:
                                   for c in coll_nodes)]
             if missing:
                 raise ValueError(
-                    f"allreduce group {group.gid}: outputs {missing} are "
-                    "not reachable from the DAG root — every "
+                    f"{group.coll} group {group.gid}: outputs {missing} "
+                    "are not reachable from the DAG root — every "
                     "participant's output must be consumed (route unused "
                     "ones through MultiOutputNode)")
         if isinstance(root, MultiOutputNode):
@@ -106,36 +132,102 @@ class CompiledDAG:
         if self._input is None:
             raise ValueError("compiled DAGs require an InputNode")
 
-        # ----------------------------------------------- channel planning
-        def edge_channel(producer_uid: int, consumer_uid) -> Channel:
-            return Channel(self._session,
-                           f"dag{self._dag_id}-{producer_uid}-{consumer_uid}",
-                           item_size=self._buffer,
-                           num_slots=self._max_inflight)
+        # ------------------------------------------- placement resolution
+        # One probe per actor at COMPILE time (never per execute): the
+        # worker reports its host identity, and — only for actors that
+        # turn out to consume a cross-host edge — its channel endpoint.
+        actor_handles: Dict[str, Any] = {}
+        for node in compute_nodes + coll_nodes:
+            actor_handles[node.actor.actor_id] = node.actor
+        self._owner_host: Dict[str, str] = {_DRIVER: core.host_id}
+        for actor_id in actor_handles:
+            info = core.actor_channel_info(actor_id, start=False)
+            self._owner_host[actor_id] = info["host"]
+        endpoint_cache: Dict[str, dict] = {}
 
-        self._input_channels: List[Channel] = []
+        def consumer_endpoint(owner: str) -> dict:
+            info = endpoint_cache.get(owner)
+            if info is None:
+                info = core.actor_channel_info(
+                    None if owner == _DRIVER else owner, start=True)
+                endpoint_cache[owner] = info
+            return info
+
+        # ----------------------------------------------- channel planning
+        # edge_plan: [(producer_owner, consumer_owner, "shm"|"remote")]
+        # — introspection for tests/benchmarks, frozen at compile time.
+        self.edge_plan: List[Tuple[str, str, str]] = []
+        self._local_channels: List[Channel] = []   # rings on THIS host
+        self._remote_channels: List[RemoteChannel] = []
+
+        def edge_pair(name: str, producer: str, consumer: str):
+            """(writer_end, reader_end) for one edge. Same host: one shm
+            ring serves both ends — materialized here only when the
+            driver shares that host (else a ChannelHandle, so the ring
+            file exists solely on the actors' host and the consumer's
+            loop unlinks it at exit; a driver-side mmap would be a
+            phantom file this host can never clean up). Cross-host: the
+            producer gets a RemoteChannel and the consumer a
+            ChannelHandle that materializes the ring on ITS host at
+            unpickle time (the driver materializes its own reader rings
+            directly)."""
+            if self._owner_host[producer] == self._owner_host[consumer]:
+                self.edge_plan.append((producer, consumer, "shm"))
+                if _DRIVER in (producer, consumer) or \
+                        self._owner_host[producer] == \
+                        self._owner_host[_DRIVER]:
+                    ch = Channel(self._session, name,
+                                 item_size=self._buffer,
+                                 num_slots=self._max_inflight)
+                    self._local_channels.append(ch)
+                    return ch, ch
+                handle = ChannelHandle(self._session, name,
+                                       item_size=self._buffer,
+                                       num_slots=self._max_inflight)
+                return handle, handle
+            info = consumer_endpoint(consumer)
+            writer = RemoteChannel(
+                self._session, name, info["endpoint"], info["addr"],
+                item_size=self._buffer, num_slots=self._max_inflight,
+                credit_window=cfg.channel_credit_window)
+            self._remote_channels.append(writer)
+            if consumer == _DRIVER:
+                reader: Any = Channel(self._session, name,
+                                      item_size=self._buffer,
+                                      num_slots=self._max_inflight)
+                self._local_channels.append(reader)
+            else:
+                reader = ChannelHandle(self._session, name,
+                                       item_size=self._buffer,
+                                       num_slots=self._max_inflight)
+            self.edge_plan.append((producer, consumer, "remote"))
+            return writer, reader
+
+        self._input_channels: List[Any] = []  # writer ends, driver-held
         # per-actor ordered ops
         actor_ops: Dict[str, List[dict]] = {}
-        actor_handles: Dict[str, Any] = {}
-        consumers: Dict[int, List[Tuple[str, int]]] = {}  # producer uid
+        consumers: Dict[int, List[Any]] = {}  # producer uid -> writer ends
 
         for node in compute_nodes:
             actor_id = node.actor.actor_id
-            actor_handles[actor_id] = node.actor
             arg_specs = []
             for arg in node.args:
                 if isinstance(arg, InputNode):
-                    ch = edge_channel(arg.uid, node.uid)
-                    self._input_channels.append(ch)
-                    arg_specs.append(("chan", ch))
+                    w, r = edge_pair(
+                        f"dag{self._dag_id}-{arg.uid}-{node.uid}",
+                        _DRIVER, actor_id)
+                    self._input_channels.append(w)
+                    arg_specs.append(("chan", r))
                 elif isinstance(arg, (ClassMethodNode,
                                       CollectiveOutputNode)):
                     if arg.actor.actor_id == actor_id:
                         arg_specs.append(("local", arg.uid))
                     else:
-                        ch = edge_channel(arg.uid, node.uid)
-                        consumers.setdefault(arg.uid, []).append(ch)
-                        arg_specs.append(("chan", ch))
+                        w, r = edge_pair(
+                            f"dag{self._dag_id}-{arg.uid}-{node.uid}",
+                            arg.actor.actor_id, actor_id)
+                        consumers.setdefault(arg.uid, []).append(w)
+                        arg_specs.append(("chan", r))
                 elif isinstance(arg, DAGNode):
                     raise ValueError(f"unsupported upstream {arg!r}")
                 else:
@@ -144,20 +236,25 @@ class CompiledDAG:
                 "kind": "call", "uid": node.uid,
                 "method": node.method_name, "args": arg_specs, "out": []})
 
-        self._output_channels: List[Channel] = []
+        self._output_channels: List[Channel] = []  # reader ends (driver)
         for out_node in outputs:
-            ch = edge_channel(out_node.uid, "driver")
-            consumers.setdefault(out_node.uid, []).append(ch)
-            self._output_channels.append(ch)
+            w, r = edge_pair(f"dag{self._dag_id}-{out_node.uid}-driver",
+                             out_node.actor.actor_id, _DRIVER)
+            consumers.setdefault(out_node.uid, []).append(w)
+            self._output_channels.append(r)
 
         # --------------------------------------- collective lowering
-        # Each group becomes: per-participant SEND ops (contribution to
-        # the leader) placed as EARLY as possible, a leader REDUCE op
-        # and per-participant RECV ops placed as LATE as possible —
-        # the compute/comm overlap schedule: ops independent of the
+        # leader groups: per-participant SEND ops (contribution to the
+        # leader) placed as EARLY as possible, a leader REDUCE op and
+        # per-participant RECV ops placed as LATE as possible — the
+        # compute/comm overlap schedule: ops independent of the
         # collective run while peers' contributions are in flight (ref:
         # dag_node_operation.py's read/compute/write scheduling).
-        coll_channels: List[Channel] = []
+        # ring groups: ONE op per participant exchanging chunks with its
+        # ring neighbors, placed right after its contribution producer
+        # (every rank must reach the ring as soon as its input is ready —
+        # the ring is a barrier, so late placement could deadlock it
+        # against peers' unrelated channel reads).
 
         # forward adjacency over the whole DAG, for downstream closures:
         # a recv/reduce must land before the first op that TRANSITIVELY
@@ -197,30 +294,31 @@ class CompiledDAG:
             group = groups[gid]
             outs = sorted((n for n in coll_nodes if n.group is group),
                           key=lambda n: n.index)
+            if group.topology == "ring":
+                self._lower_ring(group, outs, actor_ops, edge_pair,
+                                 insert_after_producer)
+                continue
             leader = outs[0]
             leader_args = [("local", group.inputs[leader.index].uid)]
             result_chans = []
             for out in outs[1:]:
                 aid = out.actor.actor_id
-                contrib = Channel(
-                    self._session,
+                contrib_w, contrib_r = edge_pair(
                     f"dag{self._dag_id}-g{group.gid}c{out.index}",
-                    item_size=self._buffer, num_slots=self._max_inflight)
-                result = Channel(
-                    self._session,
+                    aid, leader.actor.actor_id)
+                result_w, result_r = edge_pair(
                     f"dag{self._dag_id}-g{group.gid}r{out.index}",
-                    item_size=self._buffer, num_slots=self._max_inflight)
-                coll_channels += [contrib, result]
-                leader_args.append(("chan", contrib))
-                result_chans.append(result)
+                    leader.actor.actor_id, aid)
+                leader_args.append(("chan", contrib_r))
+                result_chans.append(result_w)
                 in_uid = group.inputs[out.index].uid
                 insert_after_producer(actor_ops[aid], in_uid, {
                     "kind": "send", "uid": None,
-                    "args": [("local", in_uid)], "out": [contrib]})
+                    "args": [("local", in_uid)], "out": [contrib_w]})
                 insert_before_closure(
                     actor_ops[aid], downstream_closure(out.uid), {
                         "kind": "recv", "uid": out.uid,
-                        "args": [("chan", result)], "out": []})
+                        "args": [("chan", result_r)], "out": []})
             insert_before_closure(
                 actor_ops[leader.actor.actor_id],
                 downstream_closure(leader.uid), {
@@ -228,14 +326,11 @@ class CompiledDAG:
                     "args": leader_args, "out": list(result_chans)})
 
         # attach consumer channels to the producing ops (extend: reduce/
-        # recv ops carry their collective channels already)
+        # recv/ring ops carry their collective channels already)
         for ops in actor_ops.values():
             for op in ops:
                 if op.get("uid") is not None:
                     op["out"] = op["out"] + consumers.get(op["uid"], [])
-
-        self._all_channels = list(self._input_channels) + coll_channels + [
-            ch for chans in consumers.values() for ch in chans]
 
         # ------------------------------------------------- start the loops
         self._loop_refs = []
@@ -245,6 +340,30 @@ class CompiledDAG:
             ref = handle._actor_method("__rtpu_dag_loop__").remote(ops)
             self._loop_refs.append(ref)
         ray_tpu.get(self._loop_refs)  # loops confirmed started
+
+    def _lower_ring(self, group, outs, actor_ops, edge_pair,
+                    insert_after_producer):
+        """Ring lowering: neighbor channels i -> (i+1) % world and one
+        "ring" op per participant (collective.ring_execute does the
+        status + chunk exchange inside the actor loop)."""
+        world = len(outs)
+        send_of: Dict[int, Any] = {}
+        recv_of: Dict[int, Any] = {}
+        if world > 1:
+            for i in range(world):
+                j = (i + 1) % world
+                w, r = edge_pair(
+                    f"dag{self._dag_id}-g{group.gid}ring{i}to{j}",
+                    outs[i].actor.actor_id, outs[j].actor.actor_id)
+                send_of[i] = w
+                recv_of[j] = r
+        for i, out in enumerate(outs):
+            in_uid = group.inputs[out.index].uid
+            insert_after_producer(actor_ops[out.actor.actor_id], in_uid, {
+                "kind": "ring", "uid": out.uid, "coll": group.coll,
+                "op": group.op, "index": i, "world": world,
+                "args": [("local", in_uid)],
+                "send": send_of.get(i), "recv": recv_of.get(i), "out": []})
 
     # --------------------------------------------------------------- run
 
@@ -291,8 +410,10 @@ class CompiledDAG:
         for ch in self._input_channels:
             try:
                 ch.write(None, sentinel=True, timeout=5)
-            except Exception:
-                ch.close()
+            except Exception:  # rtpulint: ignore[RTPU006] — a wedged/full input ring falls back to the hard close below
+                close = getattr(ch, "close", None)
+                if close is not None:
+                    close()
         # Drain each output until its sentinel propagates through.
         for ch in self._output_channels:
             for _ in range(64):
@@ -300,14 +421,20 @@ class CompiledDAG:
                     ch.read(timeout=10)
                 except (ChannelClosed, TimeoutError):
                     break
-                except Exception:
+                except Exception:  # rtpulint: ignore[RTPU006] — a malformed final frame must not block unlink of the session rings
                     break
-        for ch in self._all_channels:
+        # Cross-host edges: drop the streams (remote rings are unlinked
+        # by the consumer host's ChannelServer once the sentinel lands).
+        for ch in self._remote_channels:
+            ch.close()
+        # This host's rings: close AND unlink — leaked .ch files in
+        # /dev/shm otherwise accumulate per compile in long-lived drivers.
+        for ch in self._local_channels:
             ch.close()
             ch.unlink()
 
     def __del__(self):
         try:
             self.teardown()
-        except Exception:
+        except Exception:  # rtpulint: ignore[RTPU006] — gc/interpreter-exit finalizer: nothing above can handle a failure here
             pass

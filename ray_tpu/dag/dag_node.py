@@ -52,8 +52,12 @@ class DAGNode:
     def _execute_uncompiled(self, results, input_args):
         raise NotImplementedError
 
-    def experimental_compile(self, buffer_size_bytes: int = 4 << 20,
+    def experimental_compile(self,
+                             buffer_size_bytes: Optional[int] = None,
                              ) -> "Any":
+        """Compile into per-actor channel loops (CompiledDAG). The
+        per-edge ring buffer defaults to config.dag_buffer_size; one
+        slot must hold the largest frame crossing any edge."""
         from .compiled_dag import CompiledDAG
 
         return CompiledDAG(self, buffer_size_bytes=buffer_size_bytes)

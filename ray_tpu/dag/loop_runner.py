@@ -5,10 +5,17 @@ reference provisions per-actor executables the same way,
 compiled_dag_node.py _get_or_compile → actor loop tasks). Invariant: every
 iteration consumes EXACTLY ONE item from each input channel and produces
 exactly one item (value or error marker) on each output channel, so
-channels across the whole DAG stay in lockstep. A sentinel anywhere
-propagates to all outputs and ends the loop; a user exception travels
-downstream as a _DagLoopError so the driver raises it, and later
-executions still run (per-execution error semantics, like the reference).
+channels across the whole DAG stay in lockstep. Ring-collective channels
+("ring" ops) carry a fixed per-iteration frame count instead — the status
+phase runs every iteration whether or not anyone failed, so their rings
+stay aligned too. A sentinel anywhere propagates to all outputs (ring
+links included) and ends the loop; a user exception travels downstream as
+a _DagLoopError so the driver raises it, and later executions still run
+(per-execution error semantics, like the reference).
+
+Output channels may be cross-host RemoteChannels (runtime/channel.py):
+same write contract, so the loop is transport-blind; their streams are
+closed when the loop exits.
 """
 
 from __future__ import annotations
@@ -16,7 +23,7 @@ from __future__ import annotations
 import traceback
 from typing import Any, Dict, List
 
-from ..runtime.channel import ChannelClosed
+from ..runtime.channel import Channel, ChannelClosed, RemoteChannel
 
 
 class _DagLoopError:
@@ -31,11 +38,48 @@ class _Abort(Exception):
         self.err = err
 
 
+class RingDesyncError(Exception):
+    """A ring collective failed mid-exchange: its channels' frame counts
+    can no longer be trusted, so per-execution error recovery is off the
+    table — the loop propagates sentinels and tears the DAG down."""
+
+
+def _op_out_channels(op: dict) -> List[Any]:
+    chans = list(op["out"])
+    if op.get("send") is not None:
+        chans.append(op["send"])
+    return chans
+
+
 def run_dag_loop(instance: Any, ops: List[dict]) -> None:
+    try:
+        _run_dag_loop(instance, ops)
+    finally:
+        # cross-host edges: drop the producer-side streams so the
+        # consumer's ChannelServer can unlink its rings
+        for op in ops:
+            for ch in _op_out_channels(op):
+                if isinstance(ch, RemoteChannel):
+                    ch.close()
+        # this host's half of teardown: the CONSUMER unlinks each ring it
+        # read from (its producer has already sent the sentinel by the
+        # time the loop exits, so the file is dead). The driver unlinks
+        # the rings on ITS host; without this, actor<->actor shm edges on
+        # a remote host would leak their .ch files per compile.
+        for op in ops:
+            for kind, spec in op["args"]:
+                if kind == "chan" and isinstance(spec, Channel):
+                    spec.unlink()
+            if isinstance(op.get("recv"), Channel):
+                op["recv"].unlink()
+
+
+def _run_dag_loop(instance: Any, ops: List[dict]) -> None:
     while True:
         local: Dict[int, Any] = {}
         written: set = set()  # channel names written this iteration
         consumed: set = set()  # channel names read this iteration
+        rings_run: set = set()  # ring ops that ran their exchange
         closed = False
         try:
             for op_i, op in enumerate(ops):
@@ -63,8 +107,19 @@ def run_dag_loop(instance: Any, ops: List[dict]) -> None:
                         from .collective import reduce_values
 
                         result = reduce_values(args, op["op"])
+                    elif kind == "ring":
+                        from .collective import ring_execute
+
+                        rings_run.add(op_i)
+                        result = ring_execute(args[0], op)
+                        if isinstance(result, _DagLoopError):
+                            # a peer failed: its marker circulated
+                            # through the status phase
+                            raise _Abort(result)
                     else:
                         raise ValueError(f"unknown op kind {kind!r}")
+                except (_Abort, ChannelClosed, RingDesyncError):
+                    raise
                 except Exception:
                     err = _DagLoopError(traceback.format_exc())
                     raise _Abort(err)
@@ -84,27 +139,56 @@ def run_dag_loop(instance: Any, ops: List[dict]) -> None:
         except ChannelClosed:
             _propagate_sentinel(ops)
             return
+        except RingDesyncError:
+            # misaligned ring channels poison every later iteration:
+            # shut the whole DAG down loudly (peers parked in their ring
+            # reads unblock on the sentinel) instead of wedging silently
+            _propagate_sentinel(ops)
+            raise
         except _Abort as abort:
-            # Keep the one-item-per-iteration invariant BOTH ways: the
-            # error marker goes to every output channel not already
-            # written, and every input channel not already read is
-            # drained of its one item — a skipped read (local op
-            # failure, or a collective recv after an abort) would
-            # otherwise desynchronize the whole DAG's rings off-by-one
-            # for every later execution. Peers' own abort handling
-            # guarantees the drained items arrive (as values or error
-            # markers).
+            # Keep the per-iteration invariant BOTH ways: ring ops that
+            # have not run yet still circulate the error marker around
+            # their ring (peers may be parked inside their own status
+            # phase waiting for our frame), the error marker goes to
+            # every output channel not already written, and every input
+            # channel not already read is drained of its one item — a
+            # skipped read (local op failure, or a collective recv after
+            # an abort) would otherwise desynchronize the whole DAG's
+            # rings off-by-one for every later execution. Peers' own
+            # abort handling guarantees the drained items arrive (as
+            # values or error markers).
+            closed = _abort_rings(ops, rings_run, abort.err) or closed
             for op in ops:
                 for ch in op["out"]:
                     if ch.name not in written:
                         try:
                             ch.write(abort.err)
-                        except Exception:
+                        except Exception:  # rtpulint: ignore[RTPU006] — a peer torn down mid-abort cannot receive its marker; the drain below keeps this loop aligned
                             pass
             closed = _drain_unconsumed(ops, consumed) or closed
             if closed:
                 _propagate_sentinel(ops)
                 return
+
+
+def _abort_rings(ops: List[dict], rings_run: set, err: _DagLoopError) -> bool:
+    """Run the status phase (with our error) for every ring op that did
+    not execute this iteration, so ring peers unblock and observe the
+    failure. Returns True if a sentinel was hit."""
+    from .collective import ring_status_phase
+
+    closed = False
+    for op_i, op in enumerate(ops):
+        if op.get("kind") != "ring" or op_i in rings_run:
+            continue
+        rings_run.add(op_i)
+        try:
+            ring_status_phase(op, err=err)
+        except ChannelClosed:
+            closed = True
+        except Exception:  # rtpulint: ignore[RTPU006] — a dead ring peer mid-abort: the driver's teardown is the only recovery either way
+            pass
+    return closed
 
 
 def _drain_unconsumed(ops: List[dict], consumed: set) -> bool:
@@ -141,18 +225,18 @@ def _drain_unconsumed(ops: List[dict], consumed: set) -> bool:
                         "a peer never produced its item this iteration; "
                         "tearing the DAG down instead of running "
                         "desynchronized") from None
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — a corrupt frame still advanced the ring's read counter, which is all alignment needs
                 pass
     return closed
 
 
 def _propagate_sentinel(ops: List[dict]) -> None:
     for op in ops:
-        for ch in op["out"]:
+        for ch in _op_out_channels(op):
             try:
                 ch.write(None, sentinel=True, timeout=5)
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — receiver already gone/ring full at shutdown: fall back to hard-closing the channel
                 try:
                     ch.close()
-                except Exception:
+                except Exception:  # rtpulint: ignore[RTPU006] — close on a torn-down mmap/socket: nothing left to release
                     pass

@@ -1,4 +1,4 @@
-"""Shared-memory channels: the compiled-graph data plane.
+"""Shared-memory + cross-host channels: the compiled-graph data plane.
 
 TPU-native equivalent of the reference's mutable plasma objects +
 SharedMemoryChannel (ref: src/ray/core_worker/
@@ -10,27 +10,60 @@ hop, just mapped memory and counters (Linux mmap MAP_SHARED gives
 cross-process visibility; the GIL orders the counter writes after payload
 writes within each process).
 
-Layout: [write_count u64][read_count u64][closed u8][pad..64] then
+Cross-host edges use the same ring on the CONSUMER's host, fed by that
+process's ``transfer.ChannelServer`` over a persistent length-prefixed
+socket stream; the producer holds a :class:`RemoteChannel` — the writer
+half with the same ``write``/``write_array``/``close`` contract, credit-
+based so it parks when the remote ring is full instead of buffering
+unboundedly (the reference splits the same way: shm channels intra-host,
+NCCL/object channels across — torch_tensor_nccl_channel.py:49).
+
+Frames are typed so array payloads never touch a serializer: FLAG_ARRAY
+frames carry a tiny pickled (dtype, shape) header plus the raw buffer
+bytes, copied straight between the array and the ring (and, across hosts,
+sent straight from the array buffer into the socket and received straight
+into the remote ring slot). All other items ride FLAG_DATA frames through
+``serialization.dumps_frame`` (C pickler, protocol 5, cloudpickle
+fallback) — the same envelope fast path the RPC layer uses.
+
+Ring layout: [write_count u64][read_count u64][closed u8][pad..64] then
 `num_slots` slots of [flag u8][len u32][payload item_size bytes].
 """
 
 from __future__ import annotations
 
+import collections
 import mmap
 import os
 import pickle
+import socket
 import struct
+import sys
+import threading
 import time
-from typing import Any, Optional
+from typing import Any, List, Optional
+
+from .serialization import dumps_frame
 
 _HEADER = 64
 _SLOT_META = 5  # flag u8 + len u32
 FLAG_DATA = 0
 FLAG_SENTINEL = 1
-FLAG_ARRAY = 2  # DeviceChannel raw-buffer frames
+FLAG_ARRAY = 2  # raw-buffer frames (numpy/jax payloads)
 
 DEFAULT_ITEM_SIZE = 4 << 20
 DEFAULT_SLOTS = 2
+
+# --- cross-host stream protocol (RemoteChannel <-> transfer.ChannelServer)
+# hello : magic b"RC", version, name_len u16, item_size u64, num_slots u32,
+#         then name_len bytes of channel name; server replies ACK(delivered)
+# frame : flag u8, seq u64, body_len u64, then body_len bytes
+# ack   : delivered seq u64 (one per deposited frame; also the hello reply)
+CH_MAGIC = b"RC"
+CH_VERSION = 1
+CH_HELLO = struct.Struct(">2sBHQI")
+CH_FRAME = struct.Struct(">BQQ")
+CH_ACK = struct.Struct(">Q")
 
 
 class ChannelClosed(Exception):
@@ -42,9 +75,84 @@ class ChannelFull(Exception):
 
 
 def _channel_dir(session_name: str) -> str:
-    base = ("/dev/shm" if os.path.isdir("/dev/shm") else "/tmp")
-    # same root as the object store's segments (object_store.py _shm_dir)
+    # same root override as the object store's segments (object_store.py
+    # _shm_dir): RTPU_SHM_ROOT gives a simulated host its own channel
+    # namespace, so cross-"host" edges genuinely cannot share a ring
+    base = os.environ.get(
+        "RTPU_SHM_ROOT",
+        "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp")
     return os.path.join(base, f"rtpu_{session_name}", "channels")
+
+
+# ------------------------------------------------------------- frame codec
+def _as_host_array(value: Any):
+    """numpy view of an array value eligible for raw FLAG_ARRAY framing
+    (C-contiguous, non-object dtype), or None to fall back to pickling.
+
+    jax.Arrays are converted to host numpy — the same policy as the RPC
+    serializer (serialization._convert_jax_arrays): a device buffer is
+    not addressable from another process, so the consumer receives host
+    numpy either way. ndarray SUBCLASSES (np.matrix, masked arrays, ...)
+    are excluded: the raw frame reconstructs a base ndarray, so they
+    keep their pickle fidelity instead."""
+    np = sys.modules.get("numpy")
+    if np is None:
+        return None
+    jax = sys.modules.get("jax")
+    try:
+        if jax is not None and isinstance(value, jax.Array):
+            value = np.asarray(value)  # device->host DMA
+    except Exception:  # rtpulint: ignore[RTPU006] — exotic array types that fail np.asarray pickle instead
+        return None
+    if type(value) is np.ndarray and value.dtype != object:
+        return value if value.flags.c_contiguous \
+            else np.ascontiguousarray(value)
+    return None
+
+
+def _coerce_host_array(array):
+    """Shared write_array conversion: host numpy, C-contiguous."""
+    import numpy as np
+
+    host = np.asarray(array)  # device->host DMA for jax arrays
+    if not host.flags.c_contiguous:
+        host = np.ascontiguousarray(host)
+    return host
+
+
+def _array_frame_parts(host) -> List[Any]:
+    """FLAG_ARRAY body: [u32 header_len][pickled (dtype, shape)][raw
+    buffer]. The raw buffer is passed through as the array itself so
+    writers copy it exactly once (into the ring or the socket)."""
+    header = pickle.dumps((host.dtype.str, host.shape), protocol=5)
+    return [struct.pack("<I", len(header)) + header, host]
+
+
+def _decode_array(buf, *, copy: bool = True):
+    """Reconstruct the array from a FLAG_ARRAY body (memoryview or
+    bytes). With copy=False the result aliases `buf`."""
+    import numpy as np
+
+    (hlen,) = struct.unpack_from("<I", buf, 0)
+    dtype_str, shape = pickle.loads(bytes(buf[4:4 + hlen]))
+    view = np.frombuffer(buf, dtype=np.uint8, offset=4 + hlen)
+    arr = view.view(np.dtype(dtype_str)).reshape(shape)
+    return arr.copy() if copy else arr
+
+
+def _encode_item(value: Any, sentinel: bool = False):
+    """(flag, parts) for one channel frame; parts are buffer-protocol
+    objects written back to back."""
+    if sentinel:
+        return FLAG_SENTINEL, []
+    host = _as_host_array(value)
+    if host is not None:
+        return FLAG_ARRAY, _array_frame_parts(host)
+    return FLAG_DATA, [dumps_frame(value)]
+
+
+def _parts_len(parts) -> int:
+    return sum(memoryview(p).nbytes for p in parts)
 
 
 class Channel:
@@ -91,60 +199,116 @@ class Channel:
         except OSError:
             pass
 
+    # ------------------------------------------------- slot-level interface
+    # Used by transfer.ChannelServer to deposit stream frames straight
+    # into the ring (recv_into the slot view — no intermediate buffer).
+
+    def _slot_base(self, count: int) -> int:
+        return (count % self.num_slots) * self._slot_stride + _HEADER
+
+    def free_write_slot(self) -> Optional[int]:
+        """The next write_count if a slot is free, else None. Raises
+        ChannelClosed once the ring is marked closed AND full (a closed
+        ring still accepts the frames the reader will drain)."""
+        write_count, read_count = self._get_counts()
+        if write_count - read_count < self.num_slots:
+            return write_count
+        if self._closed():
+            raise ChannelClosed(self.name)
+        return None
+
+    def stage_frame(self, write_count: int, flag: int,
+                    length: int) -> memoryview:
+        """Write the slot meta and return a writable view over the
+        payload region; commit_frame publishes it to the reader."""
+        if length > self.item_size:
+            raise ChannelFull(
+                f"frame of {length} bytes exceeds channel item_size "
+                f"{self.item_size}")
+        base = self._slot_base(write_count)
+        struct.pack_into("<BI", self._mm, base, flag, length)
+        start = base + _SLOT_META
+        return memoryview(self._mm)[start:start + length]
+
+    def commit_frame(self, write_count: int) -> None:
+        # publish AFTER the payload is in place
+        struct.pack_into("<Q", self._mm, 0, write_count + 1)
+
     # ------------------------------------------------------------- write
 
-    def write(self, value: Any, timeout: Optional[float] = None,
-              sentinel: bool = False) -> None:
-        payload = b"" if sentinel else pickle.dumps(value, protocol=5)
-        if len(payload) > self.item_size:
-            raise ChannelFull(
-                f"serialized value of {len(payload)} bytes exceeds channel "
-                f"item_size {self.item_size}; pass a larger "
-                f"buffer_size_bytes at compile time")
-        deadline = None if timeout is None else time.monotonic() + timeout
+    def _wait_write_slot(self, deadline: Optional[float]) -> int:
         spin = 0
         while True:
-            write_count, read_count = self._get_counts()
-            if write_count - read_count < self.num_slots:
-                break
-            if self._closed():
-                raise ChannelClosed(self.name)
+            wc = self.free_write_slot()
+            if wc is not None:
+                return wc
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(f"channel {self.name} write timeout")
             spin += 1
             time.sleep(0 if spin < 100 else 0.0002)
-        slot = (write_count % self.num_slots) * self._slot_stride + _HEADER
-        flag = FLAG_SENTINEL if sentinel else FLAG_DATA
-        struct.pack_into("<BI", self._mm, slot, flag, len(payload))
-        self._mm[slot + _SLOT_META:slot + _SLOT_META + len(payload)] = payload
-        # publish AFTER the payload is in place
-        struct.pack_into("<Q", self._mm, 0, write_count + 1)
+
+    def _write_parts(self, flag: int, parts: List[Any],
+                     timeout: Optional[float]) -> None:
+        total = _parts_len(parts)
+        if total + _SLOT_META > self._slot_stride:
+            raise ChannelFull(
+                f"serialized value of {total} bytes exceeds channel "
+                f"item_size {self.item_size}; pass a larger "
+                f"buffer_size_bytes at compile time")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        wc = self._wait_write_slot(deadline)
+        view = self.stage_frame(wc, flag, total)
+        try:
+            off = 0
+            for part in parts:
+                mv = memoryview(part).cast("B")
+                n = mv.nbytes
+                view[off:off + n] = mv
+                off += n
+        finally:
+            view.release()
+        self.commit_frame(wc)
+
+    def write(self, value: Any, timeout: Optional[float] = None,
+              sentinel: bool = False) -> None:
+        flag, parts = _encode_item(value, sentinel=sentinel)
+        self._write_parts(flag, parts, timeout)
 
     # -------------------------------------------------------------- read
 
-    def read(self, timeout: Optional[float] = None) -> Any:
-        """Returns the value; raises ChannelClosed on sentinel/close."""
-        deadline = None if timeout is None else time.monotonic() + timeout
+    def _wait_read_slot(self, deadline: Optional[float]) -> int:
         spin = 0
         while True:
             write_count, read_count = self._get_counts()
             if read_count < write_count:
-                break
+                return read_count
             if self._closed():
                 raise ChannelClosed(self.name)
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(f"channel {self.name} read timeout")
             spin += 1
             time.sleep(0 if spin < 100 else 0.0002)
-        slot = (read_count % self.num_slots) * self._slot_stride + _HEADER
-        flag, length = struct.unpack_from("<BI", self._mm, slot)
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        """Returns the value; raises ChannelClosed on sentinel/close."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        read_count = self._wait_read_slot(deadline)
+        base = self._slot_base(read_count)
+        flag, length = struct.unpack_from("<BI", self._mm, base)
         if flag == FLAG_SENTINEL:
             struct.pack_into("<Q", self._mm, 8, read_count + 1)
             raise ChannelClosed(self.name)
-        payload = bytes(
-            self._mm[slot + _SLOT_META:slot + _SLOT_META + length])
+        start = base + _SLOT_META
+        if flag == FLAG_ARRAY:
+            view = memoryview(self._mm)[start:start + length]
+            try:
+                value = _decode_array(view, copy=True)
+            finally:
+                view.release()
+        else:
+            value = pickle.loads(self._mm[start:start + length])
         struct.pack_into("<Q", self._mm, 8, read_count + 1)
-        return pickle.loads(payload)
+        return value
 
     def __reduce__(self):
         return (type(self), (self.session_name, self.name, self.item_size,
@@ -152,6 +316,30 @@ class Channel:
 
     def __repr__(self):
         return f"Channel({self.name})"
+
+
+class ChannelHandle:
+    """Deferred Channel: pickles to coordinates and materializes the
+    mmap ring only in the process that UNPICKLES it. Compiled DAGs ship
+    these as the consumer side of cross-host edges — the ring file must
+    be created on the consumer's host, never the compiling driver's."""
+
+    __slots__ = ("session_name", "name", "item_size", "num_slots")
+
+    def __init__(self, session_name: str, name: str,
+                 item_size: int = DEFAULT_ITEM_SIZE,
+                 num_slots: int = DEFAULT_SLOTS):
+        self.session_name = session_name
+        self.name = name
+        self.item_size = item_size
+        self.num_slots = num_slots
+
+    def __reduce__(self):
+        return (Channel, (self.session_name, self.name, self.item_size,
+                          self.num_slots))
+
+    def __repr__(self):
+        return f"ChannelHandle({self.name})"
 
 
 class DeviceChannel(Channel):
@@ -170,75 +358,30 @@ class DeviceChannel(Channel):
     """
 
     def write_array(self, array, timeout: Optional[float] = None) -> None:
-        import numpy as np
-
-        host = np.asarray(array)  # device->host DMA for jax arrays
-        if not host.flags.c_contiguous:
-            host = np.ascontiguousarray(host)
-        header = pickle.dumps((host.dtype.str, host.shape), protocol=5)
-        total = 4 + len(header) + host.nbytes
-        if total > self.item_size:
-            raise ChannelFull(
-                f"array of {host.nbytes} bytes exceeds channel item_size "
-                f"{self.item_size}")
-        deadline = None if timeout is None else time.monotonic() + timeout
-        spin = 0
-        while True:
-            write_count, read_count = self._get_counts()
-            if write_count - read_count < self.num_slots:
-                break
-            if self._closed():
-                raise ChannelClosed(self.name)
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError(f"channel {self.name} write timeout")
-            spin += 1
-            time.sleep(0 if spin < 100 else 0.0002)
-        slot = (write_count % self.num_slots) * self._slot_stride + _HEADER
-        struct.pack_into("<BI", self._mm, slot, FLAG_ARRAY, total)
-        base = slot + _SLOT_META
-        struct.pack_into("<I", self._mm, base, len(header))
-        self._mm[base + 4:base + 4 + len(header)] = header
-        dst = np.frombuffer(self._mm, dtype=np.uint8,
-                            count=host.nbytes,
-                            offset=base + 4 + len(header))
-        dst[:] = host.reshape(-1).view(np.uint8)  # single memcpy
-        struct.pack_into("<Q", self._mm, 0, write_count + 1)
+        host = _coerce_host_array(array)
+        self._write_parts(FLAG_ARRAY, _array_frame_parts(host), timeout)
 
     def read_array(self, timeout: Optional[float] = None, *, device=None,
                    copy: bool = True):
         """Read the next array. With copy=False the result is a numpy
         view over the ring slot — valid ONLY until the next read (the
         slot is released to the writer lazily, at the next read call)."""
-        import numpy as np
-
         if getattr(self, "_deferred_release", None) is not None:
             struct.pack_into("<Q", self._mm, 8, self._deferred_release)
             self._deferred_release = None
         deadline = None if timeout is None else time.monotonic() + timeout
-        spin = 0
-        while True:
-            write_count, read_count = self._get_counts()
-            if read_count < write_count:
-                break
-            if self._closed():
-                raise ChannelClosed(self.name)
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError(f"channel {self.name} read timeout")
-            spin += 1
-            time.sleep(0 if spin < 100 else 0.0002)
-        slot = (read_count % self.num_slots) * self._slot_stride + _HEADER
-        flag, total = struct.unpack_from("<BI", self._mm, slot)
+        read_count = self._wait_read_slot(deadline)
+        base = self._slot_base(read_count)
+        flag, length = struct.unpack_from("<BI", self._mm, base)
         if flag == FLAG_SENTINEL:
             struct.pack_into("<Q", self._mm, 8, read_count + 1)
             raise ChannelClosed(self.name)
-        base = slot + _SLOT_META
-        (hlen,) = struct.unpack_from("<I", self._mm, base)
-        dtype_str, shape = pickle.loads(
-            self._mm[base + 4:base + 4 + hlen])
-        nbytes = total - 4 - hlen
-        view = np.frombuffer(self._mm, dtype=np.uint8, count=nbytes,
-                             offset=base + 4 + hlen)
-        arr = view.view(np.dtype(dtype_str)).reshape(shape)
+        import numpy as np
+
+        start = base + _SLOT_META
+        view = np.frombuffer(self._mm, dtype=np.uint8, count=length,
+                             offset=start)
+        arr = _decode_array(view, copy=False)
         if device is not None:
             import jax
 
@@ -254,3 +397,320 @@ class DeviceChannel(Channel):
             return arr
         struct.pack_into("<Q", self._mm, 8, read_count + 1)
         return out
+
+
+# ---------------------------------------------------------------- remote
+# chan_push fallback clients, pooled per target address (PR-6 pattern:
+# pooled peer links, not dial-per-write). The owning core's client pool
+# is preferred when one exists so connections are shared with the rest
+# of the runtime.
+_push_pool: dict = {}
+_push_lock = threading.Lock()
+
+
+def _client_for_push(addr: str):
+    from .core import get_core
+
+    core = get_core(required=False)
+    if core is not None and not core._shutting_down:
+        return core.client_for(addr)
+    with _push_lock:
+        client = _push_pool.get(addr)
+        if client is None:
+            from .rpc import RpcClient
+
+            client = _push_pool[addr] = RpcClient(addr)
+        return client
+
+
+class RemoteChannel:
+    """Writer half of a cross-host compiled-graph edge.
+
+    The consumer side is a plain shm ring on the consumer's host, fed by
+    that process's ``transfer.ChannelServer``. This end keeps ONE
+    lazily-dialed persistent stream per edge and is credit-based: the
+    server acks each frame only once it is IN the ring, and the writer
+    parks once ``credit_window`` frames are in flight — exactly the
+    remote ring's depth by default, so a full remote ring exerts
+    backpressure here instead of buffering unboundedly.
+
+    Frames carry monotonically increasing sequence numbers and stay
+    buffered until acked; on any stream failure the writer falls back to
+    the ``chan_push`` RPC (om_read-style, behind ``bulk_transfer_
+    enabled``) and replays every unacked frame — the server dedupes by
+    sequence, so a frame delivered but un-acked when the stream died is
+    dropped on replay: exactly-once, in order, across transport flips.
+
+    Reading happens only at the consumer's ring; this object has no
+    ``read``.
+    """
+
+    def __init__(self, session_name: str, name: str,
+                 endpoint: Optional[str], push_addr: str,
+                 item_size: int = DEFAULT_ITEM_SIZE,
+                 num_slots: int = DEFAULT_SLOTS,
+                 credit_window: int = 0):
+        self.session_name = session_name
+        self.name = name
+        self.endpoint = endpoint  # "tcp:host:port" of the ChannelServer
+        self.push_addr = push_addr  # consumer RPC addr (chan_push path)
+        self.item_size = item_size
+        self.num_slots = num_slots
+        self._window = credit_window if credit_window > 0 else num_slots
+        self._sock: Optional[socket.socket] = None
+        self._seq = 0  # seq of the most recently accepted frame
+        self._acked = 0  # highest seq the consumer confirmed in-ring
+        self._unacked: collections.deque = collections.deque()
+        self._ack_buf = bytearray()
+        self._retry_at = 0.0  # stream redial backoff after a failure
+        self.stats = {"stream_frames": 0, "rpc_frames": 0, "reconnects": 0}
+
+    # ------------------------------------------------------------- public
+
+    def write(self, value: Any, timeout: Optional[float] = None,
+              sentinel: bool = False) -> None:
+        flag, parts = _encode_item(value, sentinel=sentinel)
+        total = _parts_len(parts)
+        if total > self.item_size:
+            raise ChannelFull(
+                f"serialized value of {total} bytes exceeds channel "
+                f"item_size {self.item_size}; pass a larger "
+                f"buffer_size_bytes at compile time")
+        self._send(flag, parts, timeout)
+
+    def write_array(self, array, timeout: Optional[float] = None) -> None:
+        host = _coerce_host_array(array)
+        parts = _array_frame_parts(host)
+        if _parts_len(parts) > self.item_size:
+            raise ChannelFull(
+                f"array of {host.nbytes} bytes exceeds channel item_size "
+                f"{self.item_size}")
+        self._send(FLAG_ARRAY, parts, timeout)
+
+    def close(self) -> None:
+        """Drop the stream: bounded ack flush first, then a bounded RPC
+        replay of anything still unacked — a sentinel handed to a dying
+        stream must not strand the consumer's loop."""
+        if self._sock is not None:
+            deadline = time.monotonic() + 0.5
+            try:
+                while self._unacked and time.monotonic() < deadline:
+                    if not self._pump_acks(0.05):
+                        time.sleep(0.01)
+            except OSError:
+                pass
+            self._drop_stream()
+        if self._unacked:
+            try:
+                self._push_rpc(time.monotonic() + 2.0)
+            except Exception:  # rtpulint: ignore[RTPU006] — consumer already gone at teardown; its server unlinks the ring regardless
+                pass
+
+    def __reduce__(self):
+        return (type(self), (self.session_name, self.name, self.endpoint,
+                             self.push_addr, self.item_size,
+                             self.num_slots,
+                             0 if self._window == self.num_slots
+                             else self._window))
+
+    def __repr__(self):
+        return f"RemoteChannel({self.name} -> {self.endpoint or self.push_addr})"
+
+    # ------------------------------------------------------------ internals
+
+    def _inflight(self) -> int:
+        return (self._seq - 1) - self._acked  # excludes the unsent frame
+
+    def _send(self, flag: int, parts: List[Any],
+              timeout: Optional[float]) -> None:
+        from .config import get_config
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        self._seq += 1
+        self._unacked.append((self._seq, flag, parts))
+        if get_config().bulk_transfer_enabled and self.endpoint and \
+                (self._sock is not None
+                 or time.monotonic() >= self._retry_at):
+            try:
+                self._stream_send(deadline)
+                return
+            except _CreditTimeout:
+                # backpressure, not transport failure: the frame never
+                # left — surface the same TimeoutError the shm ring does
+                self._unacked.pop()
+                self._seq -= 1
+                raise TimeoutError(
+                    f"channel {self.name} write timeout (remote ring "
+                    f"full, writer parked)") from None
+            except (OSError, ConnectionError, EOFError):
+                # broken stream: bounded backoff before re-dialing, so a
+                # dead endpoint does not cost a connect timeout per write
+                self._retry_at = time.monotonic() + 5.0
+                self._drop_stream()
+        self._push_rpc(deadline)
+
+    def _stream_send(self, deadline: Optional[float]) -> None:
+        dialed = self._ensure_stream()
+        if dialed:
+            # the fresh dial replayed every unacked frame INCLUDING the
+            # caller's newest: it is already in flight, so there is no
+            # pre-send credit park here — and nothing a _CreditTimeout
+            # could safely retract (popping a transmitted frame would
+            # reuse its seq and the server would dedupe-drop the retry)
+            self.stats["stream_frames"] += 1
+            self._pump_acks(0.0)
+            return
+        # park while the credit window is exhausted: every in-flight
+        # frame occupies (or is about to occupy) a remote ring slot.
+        # The newest frame has NOT been transmitted yet, so timing out
+        # here genuinely means "the frame never left".
+        while self._inflight() >= self._window:
+            if deadline is not None and time.monotonic() > deadline:
+                raise _CreditTimeout()
+            wait = 0.2
+            if deadline is not None:
+                wait = min(wait, max(0.001, deadline - time.monotonic()))
+            self._pump_acks(wait)
+        seq, flag, parts = self._unacked[-1]
+        self._send_frame(seq, flag, parts)
+        self.stats["stream_frames"] += 1
+        self._pump_acks(0.0)  # opportunistic credit harvest
+
+    def _send_frame(self, seq: int, flag: int, parts: List[Any]) -> None:
+        sock = self._sock
+        sock.settimeout(60.0)
+        sock.sendall(CH_FRAME.pack(flag, seq, _parts_len(parts)))
+        for part in parts:
+            sock.sendall(memoryview(part).cast("B"))
+
+    def _ensure_stream(self) -> bool:
+        """Dial the consumer's ChannelServer if not connected. Returns
+        True when this call dialed (and therefore already replayed every
+        unacked frame, including the caller's newest one)."""
+        if self._sock is not None:
+            return False
+        from .config import get_config
+        from .transfer import _parse_tcp
+
+        host, port = _parse_tcp(self.endpoint)
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            cfg = get_config()
+            bufsz = cfg.bulk_socket_buffer
+            if bufsz:
+                # same tuning as the bulk object stream (transfer.py):
+                # a window-sized SNDBUF lets sendall push a whole array
+                # frame per syscall; must be set before connect
+                try:
+                    sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF,
+                                    bufsz)
+                except OSError:
+                    pass
+            sock.settimeout(cfg.rpc_connect_timeout_s)
+            sock.connect((host, port))
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            name = self.name.encode()
+            sock.sendall(CH_HELLO.pack(CH_MAGIC, CH_VERSION, len(name),
+                                       self.item_size, self.num_slots)
+                         + name)
+            reply = b""
+            while len(reply) < CH_ACK.size:
+                chunk = sock.recv(CH_ACK.size - len(reply))
+                if not chunk:
+                    raise ConnectionResetError("channel hello rejected")
+                reply += chunk
+        except BaseException:
+            sock.close()
+            raise
+        self._sock = sock
+        self._ack_buf.clear()
+        self.stats["reconnects"] += 1
+        (delivered,) = CH_ACK.unpack(reply)
+        self._note_acked(delivered)
+        # replay frames the consumer has not confirmed (deduped by seq
+        # server-side, so replaying an actually-delivered one is safe)
+        for seq, flag, parts in list(self._unacked):
+            self._send_frame(seq, flag, parts)
+        return True
+
+    def _drop_stream(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        self._ack_buf.clear()
+
+    def _note_acked(self, delivered: int) -> None:
+        if delivered > self._acked:
+            self._acked = delivered
+            while self._unacked and self._unacked[0][0] <= delivered:
+                self._unacked.popleft()
+
+    def _pump_acks(self, timeout: float) -> bool:
+        """Read available ack bytes within `timeout` seconds (0 = poll).
+        Raises ConnectionResetError/OSError when the stream is dead."""
+        sock = self._sock
+        if sock is None:
+            return False
+        sock.settimeout(timeout if timeout > 0 else 0.0)
+        try:
+            data = sock.recv(4096)
+        except (BlockingIOError, InterruptedError, socket.timeout):
+            return False
+        if not data:
+            raise ConnectionResetError(
+                f"channel stream {self.name} closed by consumer")
+        self._ack_buf += data
+        advanced = False
+        while len(self._ack_buf) >= CH_ACK.size:
+            (delivered,) = CH_ACK.unpack_from(self._ack_buf, 0)
+            del self._ack_buf[:CH_ACK.size]
+            self._note_acked(delivered)
+            advanced = True
+        return advanced
+
+    def _push_rpc(self, deadline: Optional[float]) -> None:
+        """om_read-style fallback: replay every unacked frame over the
+        consumer's RPC server (chan_push dedupes by seq and parks
+        server-side while the ring is full)."""
+        import asyncio
+
+        client = _client_for_push(self.push_addr)
+        while self._unacked:
+            seq, flag, parts = self._unacked[0]
+            payload = b"".join(
+                memoryview(p).cast("B").tobytes() for p in parts)
+            # per-attempt cap kept BELOW the server handler's own 60s
+            # slot-wait, so an untimed write's park surfaces client-side
+            # as asyncio.TimeoutError (retried below) rather than as the
+            # handler's error
+            remaining = 30.0
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"channel {self.name} write timeout (RPC "
+                        f"fallback)")
+            try:
+                delivered = client.call(
+                    "chan_push", name=self.name, seq=seq, flag=flag,
+                    payload=payload, item_size=self.item_size,
+                    num_slots=self.num_slots, _timeout=remaining)
+            except asyncio.TimeoutError:
+                if deadline is None:
+                    # shm-ring parity: timeout=None parks until the
+                    # consumer drains, it never errors — retry the push
+                    continue
+                # normalize to the shm ring's timeout type (3.10 still
+                # distinguishes asyncio.TimeoutError from TimeoutError)
+                raise TimeoutError(
+                    f"channel {self.name} write timeout (remote ring "
+                    f"full on the RPC fallback)") from None
+            self.stats["rpc_frames"] += 1
+            self._note_acked(max(delivered, seq))
+
+
+class _CreditTimeout(Exception):
+    """Internal: the credit park outlived the caller's write timeout."""

@@ -104,6 +104,18 @@ class RuntimeConfig:
     pull_conns_per_link: int = 2  # stream connections per replica
     pull_chunk_timeout_s: float = 60.0  # per-chunk fetch deadline
 
+    # --- compiled-graph channels (dag/; channel.py + ChannelServer) ---
+    # Default per-edge ring buffer when experimental_compile is not
+    # given an explicit buffer_size_bytes (one slot must hold the
+    # largest frame crossing that edge).
+    dag_buffer_size: int = 4 << 20
+    # Credit window for cross-host edges: max frames in flight on a
+    # RemoteChannel stream before the writer parks. 0 = the consumer
+    # ring's slot count (num_slots), i.e. a full remote ring is exactly
+    # what parks the writer. The stream itself rides
+    # bulk_transfer_enabled; False pushes frames over the chan_push RPC.
+    channel_credit_window: int = 0
+
     # --- memory monitor (ref: src/ray/common/memory_monitor.h:52 —
     # cgroup/rss watcher; kill policy raylet/worker_killing_policy.cc) ---
     memory_usage_threshold: float = 0.95

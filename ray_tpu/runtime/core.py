@@ -246,6 +246,8 @@ class CoreWorker:
         self._pulls: Dict[ObjectID, asyncio.Future] = {}
         self._pull_manager = None  # lazy (transfer.PullManager)
         self._om_bulk: Dict[str, Any] = {}  # lazily-started BulkServer
+        # lazily-created ChannelServer (compiled-graph cross-host edges)
+        self._chan_plane: Dict[str, Any] = {}
         # broadcast directory (owner side): oid -> {addr: [host,
         # outstanding, last_assign_ts]} of pull-capable replicas
         self._replica_dirs: Dict[ObjectID, Dict[str, list]] = {}
@@ -315,8 +317,12 @@ class CoreWorker:
             "ping": lambda: "pong",
         }
         from .object_store import om_handlers
+        from .transfer import chan_handlers
 
         handlers.update(om_handlers(lambda: self.store, self._om_bulk))
+        handlers.update(chan_handlers(self.session_name, self.host_id,
+                                      self._chan_plane,
+                                      lambda: self.address))
         if extra_handlers:
             handlers.update(extra_handlers)
         # the nodelet pushes dispatches back over this worker's OWN
@@ -462,6 +468,12 @@ class CoreWorker:
         if bulk_srv is not None:
             try:
                 EventLoopThread.get().run(bulk_srv.stop(), timeout=3)
+            except Exception:  # rtpulint: ignore[RTPU006] — shutdown teardown is best-effort
+                pass
+        chan_srv = self._chan_plane.get("server")
+        if chan_srv is not None:
+            try:
+                EventLoopThread.get().run(chan_srv.stop(), timeout=3)
             except Exception:  # rtpulint: ignore[RTPU006] — shutdown teardown is best-effort
                 pass
         try:
@@ -937,6 +949,22 @@ class CoreWorker:
         if isinstance(value, _RemoteShm) or value is _MISSING:
             return EventLoopThread.get().run(self._materialize_async(oid))
         return value
+
+    # ------------------------------------------ compiled-graph channel plane
+    def actor_channel_info(self, actor_id: Optional[str],
+                           start: bool = False) -> dict:
+        """Host identity + channel endpoint of an actor's worker process
+        (or of THIS process, for actor_id=None) — the compile-time
+        placement probe compiled DAGs use to pick shm vs remote per edge
+        and to dial cross-host consumers. start=True lazily binds the
+        consumer's ChannelServer listener; a probe-only call never
+        starts sockets anywhere."""
+        if actor_id is None:
+            handler = self._server.handlers["chan_endpoint"]
+            return EventLoopThread.get().run(handler(start=start))
+        addr = EventLoopThread.get().run(self._resolve_actor(actor_id))
+        return self.client_for(addr).call("chan_endpoint", start=start,
+                                          _timeout=30)
 
     # ---------------------------------------------- cross-host object pull
     @property

@@ -345,10 +345,19 @@ class Nodelet:
             self.labels.setdefault(key, value)
 
     def _handlers(self):
+        from .object_store import host_id as _host_id
         from .object_store import om_handlers
+        from .transfer import chan_handlers
 
         self._om_bulk = {}  # lazily-started bulk stream server
         handlers = om_handlers(lambda: self.store, self._om_bulk)
+        # compiled-graph channel tier: the nodelet advertises the same
+        # chan_endpoint/chan_push surface as workers (rings are host
+        # shm files, so the host agent can serve any local consumer)
+        self._chan_plane = {}
+        handlers.update(chan_handlers(self.session_name, _host_id(),
+                                      self._chan_plane,
+                                      lambda: self.address))
         handlers.update(self._base_handlers())
         return handlers
 
@@ -420,6 +429,12 @@ class Nodelet:
         if bulk_srv is not None:
             try:
                 await bulk_srv.stop()
+            except Exception:  # rtpulint: ignore[RTPU006] — shutdown teardown is best-effort
+                pass
+        chan_srv = getattr(self, "_chan_plane", {}).get("server")
+        if chan_srv is not None:
+            try:
+                await chan_srv.stop()
             except Exception:  # rtpulint: ignore[RTPU006] — shutdown teardown is best-effort
                 pass
         await self._server.stop()

@@ -690,11 +690,21 @@ def om_handlers(get_store, bulk: Optional[dict] = None) -> dict:
 
 
 def cleanup_session(session_name: str):
+    # recursive: the session root holds SUBDIRS too (channels/ — the
+    # compiled-graph rings), and a flat unlink sweep silently skipped
+    # them, leaking .ch files in /dev/shm across sessions
     for d in (_shm_dir(session_name), _spill_dir(session_name)):
-        if os.path.isdir(d):
-            for name in os.listdir(d):
+        if not os.path.isdir(d):
+            continue
+        for root, dirs, files in os.walk(d, topdown=False):
+            for name in files:
                 try:
-                    os.unlink(os.path.join(d, name))
+                    os.unlink(os.path.join(root, name))
+                except OSError:
+                    pass
+            if root != d:
+                try:
+                    os.rmdir(root)
                 except OSError:
                     pass
             try:

@@ -95,6 +95,20 @@ def chaos_should_drop(method: str) -> bool:
 
 
 # --------------------------------------------------------------------------
+# Per-method count of RPC frames this process ISSUES (requests + notifies,
+# socket and in-process alike). Cheap enough to keep always-on; the
+# compiled-graph plane asserts against it that steady-state execute()
+# moves zero control-plane frames — only channel frames.
+# --------------------------------------------------------------------------
+_send_counts: Dict[str, int] = collections.defaultdict(int)
+
+
+def transport_sends() -> Dict[str, int]:
+    """Snapshot of {method: frames issued} by this process since start."""
+    return dict(_send_counts)
+
+
+# --------------------------------------------------------------------------
 # In-process server registry: when a client and server share a process (the
 # single-host session runs controller + nodelet on the driver's loop), calls
 # dispatch directly on the loop with zero serialization and zero socket hops
@@ -587,6 +601,7 @@ class RpcClient:
             self._pending.clear()
 
     async def call_async(self, method: str, _timeout: Optional[float] = None, **kwargs):
+        _send_counts[method] += 1
         server = self._local_server()
         if server is not None:
             return await self._call_local(server, method, kwargs, _timeout)
@@ -613,6 +628,7 @@ class RpcClient:
         return await fut
 
     async def notify_async(self, method: str, **kwargs):
+        _send_counts[method] += 1
         server = self._local_server()
         if server is not None:
             await self._call_local(server, method, kwargs, None, one_way=True)

@@ -50,6 +50,43 @@ _VERSION = 1
 _NOT_FOUND = -1
 
 
+async def _recv_exact_into(loop, sock: socket.socket, view: memoryview):
+    """recv straight into `view` (zero-copy rx; sub-views get their own
+    release so a stranded traceback can't pin the target buffer)."""
+    got, total = 0, len(view)
+    while got < total:
+        sub = view[got:]
+        try:
+            n = await loop.sock_recv_into(sock, sub)
+        finally:
+            sub.release()
+        if n == 0:
+            raise ConnectionResetError("channel peer closed")
+        got += n
+
+
+async def _recv_exact_bytes(loop, sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    try:
+        await _recv_exact_into(loop, sock, view)
+    finally:
+        view.release()
+    return bytes(buf)
+
+
+async def _discard_exact(loop, sock: socket.socket, n: int):
+    scratch = bytearray(min(n, 1 << 16))
+    left = n
+    while left > 0:
+        view = memoryview(scratch)[:min(left, len(scratch))]
+        try:
+            await _recv_exact_into(loop, sock, view)
+        finally:
+            view.release()
+        left -= min(left, len(scratch))
+
+
 class _RangeGone(Exception):
     """The source answered -1: it no longer holds the object. The
     connection stays protocol-clean (no body follows) and is reusable."""
@@ -561,3 +598,262 @@ class PullManager:
         if tmp is not None:
             writer.write_at(off, tmp)
         return n
+
+
+# ------------------------------------------------- compiled-graph channels
+class ChannelServer:
+    """Consumer-side endpoint of cross-host compiled-graph edges.
+
+    Accepts RemoteChannel streams (see channel.py's protocol constants)
+    and deposits each frame straight into the local shm ring the
+    consumer's DAG loop reads — ``sock_recv_into`` the staged ring slot,
+    so array frames stay zero-copy from the producer's buffer to the
+    consumer's ring. An ack carrying the delivered sequence goes back
+    per frame; acks are the writer's credits, so a full ring (reader not
+    draining) parks the producer instead of buffering here.
+
+    The registry half (``push``) also serves the ``chan_push`` RPC
+    fallback without the listener running — sequence numbers dedupe
+    across transport flips, so a frame delivered right before a stream
+    died is dropped when the writer replays it over RPC.
+
+    Rings whose writer sent the shutdown sentinel are unlinked once the
+    feeding connection closes (the consumer host's half of compiled-DAG
+    teardown; the driver unlinks its own host's rings directly).
+    """
+
+    def __init__(self, session_name: str, host: str = "0.0.0.0"):
+        self._session = session_name
+        self._host = host
+        self._lsock: Optional[socket.socket] = None
+        self._accept_task = None
+        self._conn_tasks: set = set()
+        self.address: Optional[str] = None
+        self._chans: Dict[str, dict] = {}
+        self.stats = {"frames_in": 0, "bytes_in": 0, "push_frames": 0,
+                      "dup_frames": 0, "rings_unlinked": 0}
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> "ChannelServer":
+        lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        bufsz = get_config().bulk_socket_buffer
+        if bufsz:
+            # accepted conns inherit RCVBUF from the listener; a frame-
+            # sized buffer drains an array frame in few recv_into calls
+            try:
+                lsock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                                 bufsz)
+            except OSError:
+                pass
+        lsock.bind((self._host, 0))
+        lsock.listen(128)
+        lsock.setblocking(False)
+        self._lsock = lsock
+        port = lsock.getsockname()[1]
+        from .rpc import advertise_ip
+
+        host = advertise_ip() if self._host in ("0.0.0.0", "") else self._host
+        self.address = f"tcp:{host}:{port}"
+        self._accept_task = asyncio.ensure_future(self._accept_loop())
+        return self
+
+    async def stop(self):
+        if self._accept_task is not None:
+            self._accept_task.cancel()
+            self._accept_task = None
+        if self._lsock is not None:
+            try:
+                self._lsock.close()
+            except OSError:
+                pass
+            self._lsock = None
+        for task in list(self._conn_tasks):
+            task.cancel()
+        self.address = None
+
+    async def _accept_loop(self):
+        loop = asyncio.get_event_loop()
+        while True:
+            try:
+                sock, _ = await loop.sock_accept(self._lsock)
+            except asyncio.CancelledError:
+                return
+            except OSError:
+                return  # listener closed under us (stop())
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            task = asyncio.ensure_future(self._serve_conn(sock))
+            self._conn_tasks.add(task)
+            task.add_done_callback(self._conn_tasks.discard)
+
+    # ------------------------------------------------------------- registry
+    def _entry(self, name: str, item_size: int, num_slots: int) -> dict:
+        ent = self._chans.get(name)
+        if ent is None:
+            from .channel import Channel
+
+            ent = self._chans[name] = {
+                "ring": Channel(self._session, name, item_size=item_size,
+                                num_slots=num_slots),
+                "delivered": 0, "lock": asyncio.Lock(), "sentinel": False}
+        return ent
+
+    def _maybe_unlink(self, name: str):
+        ent = self._chans.get(name)
+        if ent is not None and ent["sentinel"]:
+            ent["ring"].unlink()
+            self._chans.pop(name, None)
+            self.stats["rings_unlinked"] += 1
+
+    async def _claim_slot(self, ring):
+        """Next free write slot, polling while the ring is full (the
+        reader drains it; producers are already credit-bounded). Returns
+        None once the ring is closed — the frame is dropped, matching a
+        ChannelClosed on a local write."""
+        from .channel import ChannelClosed
+
+        while True:
+            try:
+                wc = ring.free_write_slot()
+            except ChannelClosed:
+                return None
+            if wc is not None:
+                return wc
+            await asyncio.sleep(0.0005)
+
+    # -------------------------------------------------------------- stream
+    async def _serve_conn(self, sock: socket.socket):
+        from .channel import (
+            CH_ACK,
+            CH_FRAME,
+            CH_HELLO,
+            CH_MAGIC,
+            CH_VERSION,
+            FLAG_SENTINEL,
+        )
+
+        loop = asyncio.get_event_loop()
+        fed: Optional[str] = None
+        try:
+            hello = await _recv_exact_bytes(loop, sock, CH_HELLO.size)
+            magic, ver, nlen, item_size, num_slots = CH_HELLO.unpack(hello)
+            if magic != CH_MAGIC or ver != CH_VERSION:
+                return
+            name = (await _recv_exact_bytes(loop, sock, nlen)).decode()
+            fed = name
+            ent = self._entry(name, item_size, num_slots)
+            await loop.sock_sendall(sock, CH_ACK.pack(ent["delivered"]))
+            while True:
+                hdr = await _recv_exact_bytes(loop, sock, CH_FRAME.size)
+                flag, seq, length = CH_FRAME.unpack(hdr)
+                if length > ent["ring"].item_size:
+                    return  # protocol violation: hang up
+                async with ent["lock"]:
+                    if seq <= ent["delivered"]:
+                        # replay of a frame that landed before a stream
+                        # flip: consume the body, re-ack
+                        await _discard_exact(loop, sock, length)
+                        self.stats["dup_frames"] += 1
+                    else:
+                        wc = await self._claim_slot(ent["ring"])
+                        if wc is None:
+                            await _discard_exact(loop, sock, length)
+                        else:
+                            view = ent["ring"].stage_frame(wc, flag, length)
+                            try:
+                                await _recv_exact_into(loop, sock, view)
+                            finally:
+                                view.release()
+                            ent["ring"].commit_frame(wc)
+                        ent["delivered"] = seq
+                        if flag == FLAG_SENTINEL:
+                            ent["sentinel"] = True
+                        self.stats["frames_in"] += 1
+                        self.stats["bytes_in"] += length
+                await loop.sock_sendall(sock, CH_ACK.pack(ent["delivered"]))
+        except (ConnectionError, OSError, asyncio.IncompleteReadError):
+            pass
+        except asyncio.CancelledError:
+            raise
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            if fed is not None:
+                self._maybe_unlink(fed)
+
+    # ----------------------------------------------------------- RPC path
+    async def push(self, name: str, seq: int, flag: int, payload: bytes,
+                   item_size: int, num_slots: int,
+                   timeout: float = 60.0) -> int:
+        """chan_push handler body: deposit one frame, dedupe by seq,
+        park (bounded) while the ring is full. Returns the delivered
+        sequence — the writer's ack."""
+        from .channel import FLAG_SENTINEL
+
+        ent = self._entry(name, item_size, num_slots)
+        async with ent["lock"]:
+            if seq > ent["delivered"]:
+                wc = await asyncio.wait_for(self._claim_slot(ent["ring"]),
+                                            timeout)
+                if wc is not None:
+                    view = ent["ring"].stage_frame(wc, flag, len(payload))
+                    try:
+                        view[:] = payload
+                    finally:
+                        view.release()
+                    ent["ring"].commit_frame(wc)
+                ent["delivered"] = seq
+                if flag == FLAG_SENTINEL:
+                    ent["sentinel"] = True
+                self.stats["push_frames"] += 1
+            else:
+                self.stats["dup_frames"] += 1
+        if flag == FLAG_SENTINEL:
+            self._maybe_unlink(name)
+        return ent["delivered"]
+
+
+def chan_handlers(session_name: str, host_id: str, state: dict,
+                  self_addr: Callable[[], str]) -> dict:
+    """RPC handlers for the compiled-graph channel tier, registered by
+    every process that can host a DAG consumer (workers, drivers,
+    nodelets) alongside the om_* object-manager tier.
+
+    `state` is a caller-owned dict holding the lazily-created
+    ChannelServer (key "server"); the caller stops it at shutdown.
+    ``chan_endpoint`` is the compile-time placement probe: it reports
+    this process's host identity (shm-vs-remote edge selection) and —
+    with start=True — lazily binds the stream listener, exactly like
+    ``om_endpoint`` does for the bulk object plane. With
+    ``bulk_transfer_enabled=False`` no listener starts and the endpoint
+    is None: producers then push frames over ``chan_push``."""
+
+    def _server() -> ChannelServer:
+        server = state.get("server")
+        if server is None:
+            server = state["server"] = ChannelServer(session_name)
+        return server
+
+    async def chan_endpoint(start: bool = True):
+        server = _server()
+        enabled = get_config().bulk_transfer_enabled
+        if start and enabled and server.address is None:
+            lock = state.setdefault("lock", asyncio.Lock())
+            async with lock:
+                if server.address is None:
+                    await server.start()
+        return {"host": host_id,
+                "endpoint": server.address if enabled else None,
+                "addr": self_addr()}
+
+    async def chan_push(name: str, seq: int, flag: int, payload: bytes,
+                        item_size: int, num_slots: int):
+        return await _server().push(name, seq, flag, payload, item_size,
+                                    num_slots)
+
+    return {"chan_endpoint": chan_endpoint, "chan_push": chan_push}

@@ -49,7 +49,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "lint: rtpulint static-analysis tier (analyzer "
         "self-tests + the zero-unsuppressed-findings gate over "
-        "ray_tpu/runtime and ray_tpu/serve)")
+        "ray_tpu/runtime, ray_tpu/serve and ray_tpu/dag)")
+    config.addinivalue_line(
+        "markers", "dag: compiled-graph data plane (cross-host "
+        "channels, ring collectives, teardown) tests")
 
 
 @pytest.fixture
