@@ -318,6 +318,28 @@ def bench_dag() -> dict:
         f"dag_pipeline produced no JSON: {out.stderr[-300:]}")
 
 
+def bench_chaos_drill() -> dict:
+    """Robustness signal for the trajectory files: a time-guarded mini
+    failure drill (benchmarks/chaos_drill.py — controller kill+restart
+    under a live actor, then node death with placement failover) emits
+    recovery_controller_ms / recovery_node_death_ms / chaos_drills_green
+    so every round carries recovery time next to throughput."""
+    import os
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = subprocess.run(
+        [sys.executable, os.path.join(here, "benchmarks",
+                                      "chaos_drill.py")],
+        capture_output=True, text=True, timeout=300, cwd=here)
+    for line in reversed(out.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(
+        f"chaos_drill produced no JSON: {out.stderr[-300:]}")
+
+
 def bench_train(on_tpu: bool) -> dict:
     import jax
     import jax.numpy as jnp
@@ -467,7 +489,21 @@ def main():
         except Exception as e:  # noqa: BLE001
             result["detail"]["dag_pipeline"] = {"error": repr(e)[:200]}
 
-    # 8. static analysis: rtpulint over the runtime layers (cheap, ~2s).
+    # 8. failure drill: controller restart + node death recovery times
+    # (chaos_drill keys), same time guard — robustness alongside speed
+    if time.perf_counter() - start < 480:
+        try:
+            drill = bench_chaos_drill()
+            result["detail"]["chaos_drill"] = drill
+            for key in ("recovery_controller_ms",
+                        "recovery_node_death_ms", "chaos_drills_green"):
+                if key in drill:
+                    result["detail"][key] = drill[key]
+        except Exception as e:  # noqa: BLE001
+            result["detail"]["chaos_drill"] = {"error": repr(e)[:200]}
+            result["detail"]["chaos_drills_green"] = False
+
+    # 9. static analysis: rtpulint over the runtime layers (cheap, ~2s).
     # lint_clean records when the tree regresses on a concurrency
     # invariant; unsuppressed_findings is the count behind it.
     try:
@@ -479,7 +515,10 @@ def main():
         _findings, _ = _lint_run(
             [_os.path.join(_repo, "ray_tpu", "runtime"),
              _os.path.join(_repo, "ray_tpu", "serve"),
-             _os.path.join(_repo, "ray_tpu", "dag")])
+             _os.path.join(_repo, "ray_tpu", "dag"),
+             _os.path.join(_repo, "ray_tpu", "data"),
+             _os.path.join(_repo, "ray_tpu", "client.py"),
+             _os.path.join(_repo, "ray_tpu", "client_proxy.py")])
         _bad = sum(1 for f in _findings if not f.suppressed)
         result["detail"]["lint_clean"] = _bad == 0
         result["detail"]["lint_unsuppressed_findings"] = _bad

@@ -1,0 +1,124 @@
+"""Mini failure drill for the bench round: controller restart + node
+death, timed.
+
+Prints ONE JSON line:
+  recovery_controller_ms — wall time from killing the in-proc controller
+      (a BRAND-NEW controller with empty tables takes over the address)
+      until both nodelets have re-registered, the live actor reattached,
+      and a fresh task scheduled through the restarted control plane;
+  recovery_node_death_ms — wall time from SIGKILLing a nodelet until the
+      controller declares it dead AND a task soft-pinned to the dead
+      node completes elsewhere (placement failover);
+  chaos_drills_green — both drills converged inside their deadlines.
+
+The full scripted-disaster catalog lives in tests/test_chaos.py; this
+guarded pair gives every bench round a robustness trend line next to
+the throughput keys.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+CONTROLLER_DEADLINE_S = 30.0
+NODE_DEATH_DEADLINE_S = 45.0
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.parse_args()
+
+    import ray_tpu
+    from ray_tpu.runtime.config import get_config
+    from ray_tpu.runtime.controller import Controller
+    from ray_tpu.runtime.rpc import EventLoopThread
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    out = {"chaos_drills_green": False}
+    cfg = get_config()
+    cfg.node_death_timeout_s = 3.0  # bound the death verdict
+    session = ray_tpu.init(num_cpus=2)
+    try:
+        node_b = session.add_node(num_cpus=2)
+
+        @ray_tpu.remote
+        class Pinger:
+            def ping(self):
+                return "pong"
+
+        @ray_tpu.remote
+        def probe():
+            return "alive"
+
+        pinger = Pinger.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=node_b)).remote()
+        assert ray_tpu.get(pinger.ping.remote(), timeout=60) == "pong"
+
+        # ---- drill 1: controller kill + restart under a live actor
+        elt = EventLoopThread.get()
+        old = session.controller_inproc
+        t0 = time.monotonic()
+        elt.loop.call_soon_threadsafe(old._health_task.cancel)
+        elt.run(old._server.stop())
+        new = Controller(session.session_name, session.controller_addr)
+        elt.run(new.start())
+        session.controller_inproc = new
+        deadline = time.monotonic() + CONTROLLER_DEADLINE_S
+        while time.monotonic() < deadline:
+            nodes = session.core.controller.call("list_nodes",
+                                                 _timeout=10)
+            info = session.core.controller.call(
+                "get_actor", actor_id=pinger._actor_id, _timeout=10)
+            if len(nodes) == 2 and all(n["alive"] for n in nodes.values()) \
+                    and info is not None and info["state"] == "ALIVE":
+                break
+            time.sleep(0.1)
+        else:
+            raise TimeoutError("controller-restart drill never converged")
+        assert ray_tpu.get(probe.remote(), timeout=30) == "alive"
+        assert ray_tpu.get(pinger.ping.remote(), timeout=30) == "pong"
+        out["recovery_controller_ms"] = round(
+            (time.monotonic() - t0) * 1000.0, 1)
+
+        # ---- drill 2: node death → declared dead + placement failover
+        proc = session._extra_nodelet_procs[-1]
+        t0 = time.monotonic()
+        proc.kill()
+        proc.wait(timeout=10)
+        deadline = time.monotonic() + NODE_DEATH_DEADLINE_S
+        while time.monotonic() < deadline:
+            nodes = session.core.controller.call("list_nodes",
+                                                 _timeout=10)
+            if not nodes[node_b]["alive"]:
+                break
+            time.sleep(0.1)
+        else:
+            raise TimeoutError("node death was never declared")
+        # work soft-pinned to the dead node must fail over, not hang
+        got = ray_tpu.get(probe.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=node_b, soft=True)).remote(), timeout=60)
+        assert got == "alive"
+        out["recovery_node_death_ms"] = round(
+            (time.monotonic() - t0) * 1000.0, 1)
+        out["chaos_drills_green"] = True
+    except Exception as e:  # noqa: BLE001 — the bench line reports it
+        out["error"] = repr(e)[:200]
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001 — drill teardown is best-effort
+            pass
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional, Sequence
 from . import exceptions
 from .runtime import serialization
 from .runtime.ids import JobID, ObjectID
+from .runtime.procutil import log
 
 
 class _ControllerProxy:
@@ -77,7 +78,7 @@ class ClientCore:
             try:
                 self._client.notify_nowait("c_heartbeat",
                                            client_id=self.client_id)
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — periodic lease beat: the next tick retries, and logging per miss spams for as long as the proxy is down
                 pass
 
     def flush_events(self) -> None:
@@ -122,8 +123,10 @@ class ClientCore:
         try:
             self._client.notify_nowait("c_decref", client_id=self.client_id,
                                        oid=oid.binary())
-        except Exception:
-            pass
+        except Exception as e:
+            # an undelivered decref pins the server-side ref until the
+            # session lease reaps it — worth a trace
+            log.debug("client c_decref undeliverable: %r", e)
 
     # ------------------------------------------------------------- tasks
 
@@ -169,8 +172,10 @@ class ClientCore:
             self._client.notify_nowait("c_release_actor",
                                        client_id=self.client_id,
                                        actor_id=actor_id)
-        except Exception:
-            pass
+        except Exception as e:
+            # a lost release leaves the actor alive until the session
+            # lease reaps it (fate-sharing is the proxy's job)
+            log.debug("client c_release_actor undeliverable: %r", e)
 
     def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
         self._call("c_kill_actor", actor_id=actor_id, no_restart=no_restart)
@@ -220,7 +225,7 @@ class ClientCore:
         try:
             self._client.call("c_disconnect", _timeout=10,
                               client_id=self.client_id)
-        except Exception:
+        except Exception:  # rtpulint: ignore[RTPU006] — shutdown teardown is best-effort; the proxy's session lease reaps us anyway
             pass
         self._client.close()
 
@@ -244,7 +249,7 @@ class ClientSession:
     def _atexit(self) -> None:
         try:
             self.shutdown()
-        except Exception:
+        except Exception:  # rtpulint: ignore[RTPU006] — atexit hook: raising here masks the interpreter's own exit path
             pass
 
     def shutdown(self) -> None:

@@ -23,6 +23,7 @@ from typing import Dict, Optional
 
 from .runtime import serialization
 from .runtime.ids import ObjectID
+from .runtime.procutil import log
 
 
 class _ClientSession:
@@ -191,8 +192,10 @@ class ClientProxy:
             try:
                 await loop.run_in_executor(
                     None, lambda: self.core.release_actor_handle(actor_id))
-            except Exception:
-                pass
+            except Exception as e:
+                # a failed release leaks the actor until session teardown
+                log.debug("proxy release of actor %s failed: %r",
+                          actor_id, e)
         return True
 
     async def c_get(self, client_id: str, oids, timeout):
@@ -277,8 +280,11 @@ class ClientProxy:
                             None,
                             lambda a=actor_id:
                             self.core.release_actor_handle(a))
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        # session reap path: a failed release leaks the
+                        # client's actor until cluster teardown
+                        log.debug("proxy reap of actor %s failed: %r",
+                                  actor_id, e)
             sess.refs.clear()
         return True
 

@@ -74,6 +74,13 @@ class ChannelFull(Exception):
     pass
 
 
+class ChannelBackpressure(ChannelFull):
+    """Typed server-side answer when a chan_push frame finds the remote
+    ring full past chan_push_timeout_s: the reader is not draining. The
+    writer retries with backoff (see RemoteChannel._push_rpc) instead of
+    the wait pinning the consumer's RPC dispatch task indefinitely."""
+
+
 def _channel_dir(session_name: str) -> str:
     # same root override as the object store's segments (object_store.py
     # _shm_dir): RTPU_SHM_ROOT gives a simulated host its own channel
@@ -463,6 +470,7 @@ class RemoteChannel:
         self._unacked: collections.deque = collections.deque()
         self._ack_buf = bytearray()
         self._retry_at = 0.0  # stream redial backoff after a failure
+        self._redial_delay = 2.5  # doubles per failure, reset on dial
         self.stats = {"stream_frames": 0, "rpc_frames": 0, "reconnects": 0}
 
     # ------------------------------------------------------------- public
@@ -543,9 +551,14 @@ class RemoteChannel:
                     f"channel {self.name} write timeout (remote ring "
                     f"full, writer parked)") from None
             except (OSError, ConnectionError, EOFError):
-                # broken stream: bounded backoff before re-dialing, so a
-                # dead endpoint does not cost a connect timeout per write
-                self._retry_at = time.monotonic() + 5.0
+                # broken stream: exponential jittered backoff before the
+                # next re-dial, so a dead endpoint costs neither a
+                # connect timeout per write nor a lockstep redial storm
+                from .procutil import jitter
+
+                self._retry_at = time.monotonic() \
+                    + jitter(self._redial_delay)
+                self._redial_delay = min(30.0, self._redial_delay * 2)
                 self._drop_stream()
         self._push_rpc(deadline)
 
@@ -624,6 +637,7 @@ class RemoteChannel:
             raise
         self._sock = sock
         self._ack_buf.clear()
+        self._redial_delay = 2.5  # healthy dial: restart the ladder
         self.stats["reconnects"] += 1
         (delivered,) = CH_ACK.unpack(reply)
         self._note_acked(delivered)
@@ -673,19 +687,26 @@ class RemoteChannel:
 
     def _push_rpc(self, deadline: Optional[float]) -> None:
         """om_read-style fallback: replay every unacked frame over the
-        consumer's RPC server (chan_push dedupes by seq and parks
-        server-side while the ring is full)."""
+        consumer's RPC server. chan_push dedupes by seq; a full remote
+        ring now answers within chan_push_timeout_s with the TYPED
+        ChannelBackpressure error (instead of parking the consumer's
+        dispatch task indefinitely), and this writer retries it under
+        exponential backoff with jitter until its own deadline."""
         import asyncio
 
+        from .procutil import jitter
+        from .rpc import RemoteHandlerError
+
         client = _client_for_push(self.push_addr)
+        backoff = 0.05
         while self._unacked:
             seq, flag, parts = self._unacked[0]
             payload = b"".join(
                 memoryview(p).cast("B").tobytes() for p in parts)
-            # per-attempt cap kept BELOW the server handler's own 60s
-            # slot-wait, so an untimed write's park surfaces client-side
-            # as asyncio.TimeoutError (retried below) rather than as the
-            # handler's error
+            # per-attempt cap kept ABOVE the server handler's
+            # chan_push_timeout_s slot-wait, so a full ring surfaces as
+            # the server's typed backpressure answer (retried below),
+            # not as a client-side timeout racing it
             remaining = 30.0
             if deadline is not None:
                 remaining = deadline - time.monotonic()
@@ -698,6 +719,22 @@ class RemoteChannel:
                     "chan_push", name=self.name, seq=seq, flag=flag,
                     payload=payload, item_size=self.item_size,
                     num_slots=self.num_slots, _timeout=remaining)
+            except RemoteHandlerError as e:
+                if getattr(e, "method", "") != "ChannelBackpressure":
+                    raise
+                # typed backpressure: the consumer's ring is still full.
+                # Back off (jittered, capped) and replay — shm-ring
+                # parity: timeout=None parks forever, a deadline
+                # surfaces the same TimeoutError the local ring raises.
+                wait = jitter(backoff)
+                if deadline is not None and \
+                        time.monotonic() + wait >= deadline:
+                    raise TimeoutError(
+                        f"channel {self.name} write timeout (remote "
+                        f"ring full, typed backpressure)") from None
+                time.sleep(wait)
+                backoff = min(1.0, backoff * 2)
+                continue
             except asyncio.TimeoutError:
                 if deadline is None:
                     # shm-ring parity: timeout=None parks until the
@@ -709,6 +746,7 @@ class RemoteChannel:
                     f"channel {self.name} write timeout (remote ring "
                     f"full on the RPC fallback)") from None
             self.stats["rpc_frames"] += 1
+            backoff = 0.05  # progress: restart the backoff ladder
             self._note_acked(max(delivered, seq))
 
 

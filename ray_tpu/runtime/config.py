@@ -31,11 +31,29 @@ def _coerce(value: str, typ):
 class RuntimeConfig:
     # --- RPC / control plane ---
     rpc_connect_timeout_s: float = 10.0
-    rpc_call_timeout_s: float = 0.0  # 0 = no timeout
+    # Default per-attempt deadline for request/response RPCs (0 = none).
+    # Long-poll methods (rpc.UNBOUNDED_METHODS — owner fetches, client
+    # gets) are exempt; everything else converges on a typed
+    # RpcTimeoutError instead of an unbounded hang. The previous default
+    # of 0 meant one unhandled failure anywhere became an infinite wait.
+    rpc_call_timeout_s: float = 60.0
+    # Bounded transparent-retry budget for IDEMPOTENT control-plane
+    # methods (classified per method in rpc.IDEMPOTENT_METHODS, not
+    # blanket): attempts beyond the first, under exponential backoff
+    # with full jitter between rpc_retry_base_s and rpc_retry_max_s.
+    rpc_retry_max: int = 2
+    rpc_retry_base_s: float = 0.1
+    rpc_retry_max_s: float = 2.0
     # Probabilistic RPC fault injection, modeled on the reference's chaos hook
     # "RAY_testing_rpc_failure" (ref: src/ray/rpc/rpc_chaos.cc:30-49,
     # ray_config_def.h:873). Format: "Method=max_failures:req_prob:resp_prob".
     testing_rpc_failure: str = ""
+    # Deterministic fault plane (runtime/faults.py): ';'-separated rules
+    # — drop(method,nth=)/delay(method,ms=)/error(method)/
+    # partition(src->dst)/kill_at(syncpoint) — also settable via the
+    # RTPU_FAULTS env var and mutable at runtime through the
+    # controller's fault_inject admin RPC.
+    testing_faults: str = ""
 
     # --- control-plane submission hot path (owner→nodelet/worker) ---
     # Batched submission: .remote() calls stage into an MPSC queue and a
@@ -115,6 +133,12 @@ class RuntimeConfig:
     # what parks the writer. The stream itself rides
     # bulk_transfer_enabled; False pushes frames over the chan_push RPC.
     channel_credit_window: int = 0
+    # Server-side cap on how long a chan_push (RPC-fallback channel
+    # write) may park waiting for a free ring slot before answering with
+    # the typed ChannelBackpressure error the writer retries with
+    # backoff — an unread full ring must not pin the consumer's RPC
+    # dispatch task indefinitely (PR-8 NOTE).
+    chan_push_timeout_s: float = 5.0
 
     # --- memory monitor (ref: src/ray/common/memory_monitor.h:52 —
     # cgroup/rss watcher; kill policy raylet/worker_killing_policy.cc) ---

@@ -25,7 +25,7 @@ import pickle
 import time
 from typing import Any, Dict, List, Optional
 
-from . import scheduling
+from . import faults, scheduling
 from .procutil import log, spawn_logged
 from .ids import ActorID, NodeID, PlacementGroupID
 from .rpc import RpcClient, RpcServer, ServerConn
@@ -41,6 +41,7 @@ class NodeInfo:
         self.labels = dict(labels)
         self.alive = True
         self.last_heartbeat = time.monotonic()
+        self.died_at = 0.0  # monotonic ts of the last death verdict
         self.client: Optional[RpcClient] = None
         # last applied resource-view version (ref: ray_syncer.h:83):
         # views with version <= this are stale/reordered and dropped
@@ -157,6 +158,10 @@ class Controller:
         self._server = RpcServer(address, self._handlers(), on_disconnect=self._on_disconnect)
         self._health_task: Optional[asyncio.Task] = None
         self.start_time = time.time()
+        # fault-plane addressing: @controller selectors and
+        # partition(...->controller) rules resolve to this process
+        faults.add_identity("controller")
+        faults.register_alias("controller", address)
         if self._store_backend is not None:
             self._replay_persisted()
 
@@ -281,6 +286,9 @@ class Controller:
             "report_metrics": self.report_metrics,
             "get_metrics": self.get_metrics,
             "cluster_status": self.cluster_status,
+            # failure drills / operations
+            "fault_inject": self.fault_inject,
+            "reattach_actor": self.reattach_actor,
             "ping": self.ping,
         }
 
@@ -328,7 +336,27 @@ class Controller:
                             resources: Dict[str, float],
                             labels: Dict[str, str] = None):
         info = NodeInfo(node_id, address, resources, labels or {})
-        info.client = RpcClient(address)
+        old = self.nodes.get(node_id)
+        if old is not None and old.address == address \
+                and old.client is not None:
+            # re-registration (controller restart in a replaced process
+            # keeps the old table empty, but an in-table re-register —
+            # retried RPC, partition heal — must not leak a client per
+            # attempt)
+            info.client = old.client
+        else:
+            info.client = RpcClient(address)
+            if old is not None and old.client is not None:
+                # restarted nodelet, fresh ephemeral port: the old
+                # incarnation's client (socket + read loop) must close,
+                # not dangle one connection per node-restart cycle
+                old.client.close()
+        if old is not None and not old.alive and old.died_at:
+            # the node came back from a death verdict: export how long
+            # the outage lasted (drills assert recovery is bounded)
+            faults.record_recovery(
+                "node_reregister",
+                (time.monotonic() - old.died_at) * 1000.0)
         self.nodes[node_id] = info
         self._bump_view(info)
         await self._publish("node", {"event": "node_added", "node": info.snapshot()})
@@ -375,8 +403,14 @@ class Controller:
             node.queue_depth = queued
             changed = True
         if not node.alive:
+            # heartbeats resumed across a partition/outage: heal the
+            # liveness verdict and export the measured outage
             node.alive = True
             changed = True
+            if node.died_at:
+                faults.record_recovery(
+                    "node_heal", (time.monotonic() - node.died_at) * 1000.0)
+                node.died_at = 0.0
         if changed:
             self._bump_view(node)
         reply = {"registered": True,
@@ -406,6 +440,7 @@ class Controller:
         # on the draining node (ref: node drain protocol in
         # gcs_node_manager.cc HandleDrainNode).
         node.alive = False
+        node.died_at = time.monotonic()
         self._bump_view(node)  # death propagates through the gossip too
         if node.client is not None:
             await node.client.notify_async("shutdown")
@@ -424,10 +459,12 @@ class Controller:
         cfg = get_config()
         while True:
             await asyncio.sleep(cfg.heartbeat_interval_s)
+            faults.syncpoint("controller.health_sweep")
             now = time.monotonic()
             for node in self.nodes.values():
                 if node.alive and now - node.last_heartbeat > cfg.node_death_timeout_s:
                     node.alive = False
+                    node.died_at = now
                     self._bump_view(node)
                     await self._publish(
                         "node", {"event": "node_dead", "node": node.snapshot()}
@@ -539,6 +576,34 @@ class Controller:
                 # a lost drain_exit leaves the actor running until its
                 # owner-handle fate-sharing path fires
                 log.debug("drain_exit to %s undeliverable: %r", address, e)
+        return True
+
+    async def reattach_actor(self, actor_id: str, spec: Dict[str, Any],
+                             address: str, worker_id: str, node_id: str):
+        """A nodelet re-registering after a controller restart (or a
+        healed partition) re-announces its LIVE actor workers: this
+        controller's table may have started empty, and without the
+        reattach every handle resolve after the restart would answer
+        'unknown actor' while the actor process is alive and serving.
+        Idempotent — re-announcing a known actor just refreshes its
+        address/placement (ref: the reference's GCS restart rebuilds the
+        actor table from raylet reconnection the same way)."""
+        info = self.actors.get(actor_id)
+        if info is None:
+            info = ActorInfo(actor_id, spec or {})
+            self.actors[actor_id] = info
+            name = info.spec.get("name")
+            if name:
+                ns = info.spec.get("namespace", "")
+                self.named_actors[(ns, name)] = actor_id
+                self._persist()
+        info.state = ACTOR_ALIVE
+        info.address = address
+        info.worker_id = worker_id
+        info.node_id = node_id
+        info.death_cause = None
+        self._wake_actor_waiters(actor_id)
+        await self._publish(f"actor:{actor_id}", info.snapshot())
         return True
 
     async def actor_died(self, actor_id: str, reason: str = "",
@@ -768,7 +833,12 @@ class Controller:
 
     # ------------------------------------------------------------------ pubsub
     async def subscribe(self, channel: str, _conn: ServerConn = None):
-        self.subscribers[channel].append(_conn)
+        # dedupe: subscribe is classified idempotent (rpc retry budget)
+        # and re-issued wholesale by drivers after a reconnect — a
+        # doubled conn would double-deliver every publish
+        chan = self.subscribers[channel]
+        if _conn not in chan:
+            chan.append(_conn)
         return True
 
     async def publish(self, channel: str, message: Any):
@@ -912,6 +982,45 @@ class Controller:
 
     async def ping(self):
         return "pong"
+
+    # ------------------------------------------------------------ fault plane
+    async def fault_inject(self, spec: str = None, clear=None,
+                           node_id: str = None):
+        """Admin RPC: mutate the fault plane at runtime — no process
+        restart, so drills and operators can flip faults mid-run.
+
+        node_id=None targets this controller process; node_id='*' fans
+        out to every alive nodelet (plus locally); a specific node_id
+        targets that nodelet only. `spec` adds rules (faults.py
+        grammar), `clear` removes one rule by name ('*'/True clears
+        all). Returns {target: rule snapshot} with per-rule counters."""
+        out: Dict[str, Any] = {}
+        applied_local = False
+        if node_id in (None, "*", "controller"):
+            out["controller"] = faults.apply_spec(spec, clear)
+            applied_local = True
+        targets = []
+        if node_id == "*":
+            targets = [n for n in self.nodes.values() if n.alive]
+        elif node_id not in (None, "controller"):
+            node = self.nodes.get(node_id)
+            if node is None:
+                raise ValueError(f"unknown node {node_id!r}")
+            targets = [node]
+        for node in targets:
+            if applied_local and node.client is not None \
+                    and node.client._local_server() is not None:
+                # in-process nodelet (single-host head): one plane per
+                # process — applying through the client would double
+                # every rule we just added locally
+                out[node.node_id] = out["controller"]
+                continue
+            try:
+                out[node.node_id] = await node.client.call_async(
+                    "fault_inject", spec=spec, clear=clear, _timeout=10)
+            except Exception as e:  # noqa: BLE001 — partial fan-out is reported, not fatal
+                out[node.node_id] = {"error": repr(e)}
+        return out
 
 
 def main():

@@ -28,7 +28,7 @@ import traceback
 from typing import Any, Dict, List, Optional, Tuple
 
 from .. import exceptions
-from . import serialization
+from . import faults, serialization
 from .config import get_config
 from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from .object_store import host_id as _get_host_id, make_store_client
@@ -240,7 +240,18 @@ class CoreWorker:
         self.controller = RpcClient(controller_addr,
                                     notify_handlers={"pubsub": self._on_pubsub,
                                                      "shutdown": self._on_shutdown_ntf})
+        # a controller that comes back after a crash/partition accepts
+        # our frames again but lost its subscriber table: re-seed every
+        # channel this process watches (node-death failover, actor
+        # state) the moment the link re-dials
+        self.controller.on_reconnect = self._resubscribe_all
         self.nodelet = RpcClient(nodelet_addr)
+        # fault-plane addressing for @selectors and partition sources
+        faults.add_identity(mode)  # "driver" / "worker"
+        faults.add_identity(self.worker_id.hex())
+        faults.add_identity(node_id)
+        faults.register_alias("controller", controller_addr)
+        faults.register_alias("nodelet", nodelet_addr)
         self.store = make_store_client(session_name)
         self.host_id = _get_host_id()
         self._pulls: Dict[ObjectID, asyncio.Future] = {}
@@ -503,6 +514,25 @@ class CoreWorker:
 
     def _on_shutdown_ntf(self):
         self._shutting_down = True
+
+    def _resubscribe_all(self):
+        """on_reconnect hook of the controller client: replay every
+        pubsub subscription this process holds. The restarted (or
+        partition-healed) controller keeps subscribers per CONNECTION —
+        without the replay a driver silently stops hearing node-death
+        and actor-state events after the first controller outage."""
+
+        async def resub():
+            for channel in list(self._pubsub_handlers):
+                try:
+                    await self.controller.call_async("subscribe",
+                                                     channel=channel,
+                                                     _timeout=10)
+                except Exception as e:
+                    log.debug("resubscribe to %r failed: %r", channel, e)
+
+        if self._pubsub_handlers and not self._shutting_down:
+            spawn_logged(resub(), name="core.resubscribe")
 
     # ------------------------------------------------------------ pubsub
     def _on_pubsub(self, channel: str, message: Any):

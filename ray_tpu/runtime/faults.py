@@ -1,0 +1,496 @@
+"""Deterministic, scriptable fault-injection plane.
+
+Upgrade of the probabilistic chaos hook modeled on the reference's
+RpcFailureManager (ref: src/ray/rpc/rpc_chaos.cc:30-49): where
+``RAY_testing_rpc_failure`` could only drop a method's frames with a
+probability, this plane scripts *reproducible* disasters — drop exactly
+the nth call, delay a method, answer it with an error, blackhole one
+direction of one link, or kill a process at a named code point — and the
+failure-drill suite (tests/test_chaos.py) marches every runtime plane
+through them.
+
+Rule grammar (``RTPU_FAULTS`` env / ``RuntimeConfig.testing_faults`` /
+the controller's ``fault_inject`` admin RPC). Rules are ';'-separated::
+
+    [name:]drop(method[,nth=N][,p=P][,times=T])[@node]
+    [name:]delay(method,ms=M[,nth=N][,p=P][,times=T])[@node]
+    [name:]error(method[,msg=TEXT][,nth=N][,p=P][,times=T])[@node]
+    [name:]partition(src->dst)[,times=T]
+    [name:]kill_at(syncpoint[,nth=N][,times=T][,action=exit|raise])[@node]
+
+- ``method`` is an RPC method name or ``*``. drop/delay/error rules are
+  evaluated at the RECEIVING server's dispatch (socket and in-process
+  paths alike), exactly where the legacy chaos hook ran.
+- ``nth`` fires on the nth *matching* call only (1-based); ``p`` is a
+  firing probability (default 1.0 — deterministic); ``times`` bounds how
+  often the rule may fire (-1 = unlimited; ``kill_at`` defaults to 1, so
+  a planted kill fires exactly once).
+- ``@node`` scopes a rule to processes whose fault identity matches
+  (node id, "controller", "driver", a worker id — prefix match).
+- ``partition(src->dst)`` is one-way: a process whose identity matches
+  ``src`` blackholes every RPC frame it would send toward ``dst`` (an
+  identity alias such as "controller"/"nodelet", or an address
+  substring). Requests hang into their deadline; one-way notifies drop
+  silently — precisely what a dead link looks like from the sender.
+- ``kill_at(syncpoint)`` fires at named points planted in the runtime:
+  ``nodelet.dispatch``, ``transfer.pull``, ``channel.push``,
+  ``serve.reconcile``, ``controller.health_sweep``. ``action=exit``
+  (default) terminates the process with exit code 43; ``action=raise``
+  raises :class:`FaultInjectedError` in place (for in-process tests).
+
+Every injection increments ``rtpu_faults_injected_total{rule=<name>}``;
+``FaultPlane.snapshot()`` (surfaced on ``get_node_info`` and in the
+``fault_inject`` reply) reports per-rule seen/fired counters, so drills
+can assert a fault actually happened, not merely that the test passed.
+
+The legacy ``testing_rpc_failure`` grammar
+("Method=max_failures:req_prob:resp_prob") still parses, into
+equivalent probabilistic drop rules.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import random
+import threading
+from typing import Dict, List, Optional, Tuple
+
+KILL_EXIT_CODE = 43
+
+# named code points where kill_at hooks may be planted (documented set;
+# syncpoint() accepts any name so new planes can add theirs freely)
+SYNCPOINTS = (
+    "nodelet.dispatch",
+    "transfer.pull",
+    "channel.push",
+    "serve.reconcile",
+    "controller.health_sweep",
+)
+
+
+class FaultInjectedError(Exception):
+    """Raised by error(...) rules and kill_at(..., action=raise)."""
+
+
+class FaultSpecError(ValueError):
+    """A fault rule string that does not parse."""
+
+
+# --------------------------------------------------------------- identity
+# Which names this PROCESS answers to for @node selectors and partition
+# sources. A process may hold several (the single-host session runs
+# driver + controller + nodelet on one interpreter).
+_identities: set = set()
+# address -> {alias names}: partition destinations match against these
+# ("controller" matches any frame sent to the controller's address)
+_addr_aliases: Dict[str, set] = {}
+
+
+def add_identity(name: str) -> None:
+    if name:
+        _identities.add(str(name))
+
+
+def register_alias(name: str, address: str) -> None:
+    """Let partition dst selectors address `address` by role name."""
+    if name and address:
+        _addr_aliases.setdefault(address, set()).add(name)
+
+
+def _identity_matches(selector: Optional[str]) -> bool:
+    if not selector or selector == "*":
+        return True
+    return any(ident == selector or ident.startswith(selector)
+               for ident in _identities)
+
+
+def _addr_matches(selector: str, address: str) -> bool:
+    if selector == "*":
+        return True
+    if selector in _addr_aliases.get(address, ()):
+        return True
+    return selector in address
+
+
+# ------------------------------------------------------------------ rules
+class FaultRule:
+    __slots__ = ("name", "kind", "method", "node", "nth", "prob", "times",
+                 "ms", "msg", "action", "src", "dst", "syncpoint",
+                 "source", "seen", "fired")
+
+    def __init__(self, name: str, kind: str, *, method: str = "*",
+                 node: Optional[str] = None, nth: Optional[int] = None,
+                 prob: float = 1.0, times: int = -1, ms: float = 0.0,
+                 msg: str = "", action: str = "exit",
+                 src: str = "*", dst: str = "*", syncpoint: str = "",
+                 source: str = "injected"):
+        self.name = name
+        self.kind = kind  # drop | delay | error | partition | kill_at
+        self.method = method
+        self.node = node
+        self.nth = nth
+        self.prob = prob
+        self.times = times  # remaining fire budget; -1 = unlimited
+        self.ms = ms
+        self.msg = msg
+        self.action = action
+        self.src = src
+        self.dst = dst
+        self.syncpoint = syncpoint
+        self.source = source  # "config" rules are replaced on reload
+        self.seen = 0  # matching calls observed
+        self.fired = 0  # injections actually performed
+
+    def to_dict(self) -> dict:
+        d = {"name": self.name, "kind": self.kind,
+             "seen": self.seen, "fired": self.fired,
+             "times_left": self.times}
+        if self.kind == "partition":
+            d["src"], d["dst"] = self.src, self.dst
+        elif self.kind == "kill_at":
+            d["syncpoint"], d["action"] = self.syncpoint, self.action
+        else:
+            d["method"] = self.method
+        if self.kind == "delay":
+            d["ms"] = self.ms
+        if self.node:
+            d["node"] = self.node
+        if self.nth is not None:
+            d["nth"] = self.nth
+        if self.prob < 1.0:
+            d["p"] = self.prob
+        return d
+
+
+def _parse_one(text: str, auto) -> FaultRule:
+    text = text.strip()
+    name = None
+    head, sep, rest = text.partition("(")
+    if not sep:
+        raise FaultSpecError(f"bad fault rule {text!r}")
+    if ":" in head:
+        name, _, head = head.rpartition(":")
+        name = name.strip()
+    kind = head.strip()
+    if kind not in ("drop", "delay", "error", "partition", "kill_at"):
+        raise FaultSpecError(f"unknown fault kind {kind!r} in {text!r}")
+    body, sep, tail = rest.rpartition(")")
+    if not sep:
+        raise FaultSpecError(f"unclosed fault rule {text!r}")
+    node = None
+    tail = tail.strip()
+    if tail.startswith("@"):
+        node = tail[1:].strip() or None
+    elif tail:
+        raise FaultSpecError(f"trailing junk {tail!r} in {text!r}")
+    parts = [p.strip() for p in body.split(",") if p.strip()]
+    subject = ""
+    kw: Dict[str, str] = {}
+    for i, part in enumerate(parts):
+        if "=" in part:
+            k, _, v = part.partition("=")
+            kw[k.strip()] = v.strip()
+        elif i == 0:
+            subject = part
+        else:
+            raise FaultSpecError(
+                f"positional arg {part!r} after keywords in {text!r}")
+    if name is None:
+        name = f"r{next(auto)}"
+    try:
+        nth = int(kw["nth"]) if "nth" in kw else None
+        prob = float(kw.get("p", 1.0))
+        times = int(kw.get("times", 1 if kind == "kill_at" else -1))
+        ms = float(kw.get("ms", 0.0))
+    except ValueError as e:
+        raise FaultSpecError(f"bad numeric arg in {text!r}: {e}") from None
+    if kind == "partition":
+        src, sep, dst = subject.partition("->")
+        if not sep or not src.strip() or not dst.strip():
+            raise FaultSpecError(
+                f"partition needs 'src->dst', got {subject!r}")
+        return FaultRule(name, kind, src=src.strip(), dst=dst.strip(),
+                         times=times, node=node)
+    if kind == "kill_at":
+        if not subject:
+            raise FaultSpecError(f"kill_at needs a syncpoint in {text!r}")
+        action = kw.get("action", "exit")
+        if action not in ("exit", "raise"):
+            raise FaultSpecError(f"kill_at action must be exit|raise")
+        return FaultRule(name, kind, syncpoint=subject, nth=nth,
+                         times=times, action=action, node=node)
+    if not subject:
+        raise FaultSpecError(f"{kind} needs a method name in {text!r}")
+    if kind == "delay" and ms <= 0:
+        raise FaultSpecError(f"delay needs ms=<positive> in {text!r}")
+    return FaultRule(name, kind, method=subject, node=node, nth=nth,
+                     prob=prob, times=times, ms=ms,
+                     msg=kw.get("msg", f"injected fault {name}"))
+
+
+def parse_rules(spec: str, auto=None) -> List[FaultRule]:
+    auto = auto or itertools.count(1)
+    return [_parse_one(part, auto)
+            for part in (spec or "").split(";") if part.strip()]
+
+
+def parse_legacy(spec: str) -> List[FaultRule]:
+    """'Method=max_failures:req_prob:resp_prob' chaos rules (ref:
+    rpc_chaos.cc) as probabilistic drop rules."""
+    out = []
+    for part in filter(None, (spec or "").split(",")):
+        method, params = part.split("=")
+        mx, req_p, _res_p = params.split(":")
+        out.append(FaultRule(f"chaos:{method}", "drop", method=method,
+                             prob=float(req_p), times=int(mx),
+                             source="config"))
+    return out
+
+
+# ------------------------------------------------------------------ plane
+# module-level fast-path flags, rewritten by _rebuild_index: the
+# per-frame hooks in rpc.py must cost one attribute read when no rule of
+# that class exists
+SEND_ACTIVE = False
+KILL_ACTIVE = False
+
+_metric = None
+
+
+def _count_injection(rule_name: str) -> None:
+    global _metric
+    if _metric is None:
+        from ..util.metrics import Counter
+
+        _metric = Counter("rtpu_faults_injected_total",
+                          "fault-plane injections performed", ("rule",))
+    _metric.inc(tags={"rule": rule_name})
+
+
+def record_recovery(scenario: str, ms: float) -> None:
+    """Export a measured recovery time as rtpu_recovery_ms{scenario=} —
+    the drill suite and the runtime's own heal paths both feed it."""
+    global _recovery_metric
+    if _recovery_metric is None:
+        from ..util.metrics import Gauge
+
+        _recovery_metric = Gauge("rtpu_recovery_ms",
+                                 "observed recovery time per scenario",
+                                 ("scenario",))
+    _recovery_metric.set(ms, tags={"scenario": scenario})
+
+
+_recovery_metric = None
+
+
+class FaultPlane:
+    """Process-wide rule set + match counters. Mutations take the lock
+    and rebuild the per-method index; the hot-path reads are plain dict
+    lookups under the GIL."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rules: Dict[str, FaultRule] = {}
+        self._auto = itertools.count(1)
+        # indexes (rebuilt on every mutation)
+        self._by_method: Dict[str, List[FaultRule]] = {}
+        self._wildcard: List[FaultRule] = []
+        self._partitions: List[FaultRule] = []
+        self._kills: Dict[str, List[FaultRule]] = {}
+        self.load_config_rules()
+
+    # ------------------------------------------------------- mutation
+    def load_config_rules(self) -> None:
+        """(Re)parse config/env-sourced rules, keeping injected ones."""
+        from .config import get_config
+
+        cfg = get_config()
+        with self._lock:
+            for key in [k for k, r in self.rules.items()
+                        if r.source == "config"]:
+                del self.rules[key]
+            rules = parse_legacy(cfg.testing_rpc_failure)
+            spec = os.environ.get("RTPU_FAULTS",
+                                  getattr(cfg, "testing_faults", ""))
+            for rule in parse_rules(spec, self._auto):
+                rule.source = "config"
+                rules.append(rule)
+            for rule in rules:
+                self.rules[rule.name] = rule
+            self._rebuild_index()
+
+    def add_rules(self, spec: str) -> List[str]:
+        rules = parse_rules(spec, self._auto)
+        with self._lock:
+            for rule in rules:
+                self.rules[rule.name] = rule  # same name replaces
+            self._rebuild_index()
+        return [r.name for r in rules]
+
+    def clear(self, name: Optional[str] = None) -> int:
+        with self._lock:
+            if name is None:
+                n = len(self.rules)
+                self.rules.clear()
+            else:
+                n = 1 if self.rules.pop(name, None) is not None else 0
+            self._rebuild_index()
+        return n
+
+    def _rebuild_index(self) -> None:
+        global SEND_ACTIVE, KILL_ACTIVE
+        self._by_method = {}
+        self._wildcard = []
+        self._partitions = []
+        self._kills = {}
+        for rule in self.rules.values():
+            if rule.kind == "partition":
+                self._partitions.append(rule)
+            elif rule.kind == "kill_at":
+                self._kills.setdefault(rule.syncpoint, []).append(rule)
+            elif rule.method == "*":
+                self._wildcard.append(rule)
+            else:
+                self._by_method.setdefault(rule.method, []).append(rule)
+        SEND_ACTIVE = bool(self._partitions)
+        KILL_ACTIVE = bool(self._kills)
+
+    def snapshot(self) -> List[dict]:
+        return [r.to_dict() for r in list(self.rules.values())]
+
+    # ----------------------------------------------------------- hooks
+    def _fire(self, rule: FaultRule) -> bool:
+        if not _identity_matches(rule.node):
+            return False
+        if rule.times == 0:
+            return False
+        rule.seen += 1
+        if rule.nth is not None and rule.seen != rule.nth:
+            return False
+        if rule.prob < 1.0 and random.random() >= rule.prob:
+            return False
+        if rule.times > 0:
+            rule.times -= 1
+        rule.fired += 1
+        _count_injection(rule.name)
+        return True
+
+    def on_dispatch(self, method: str,
+                    drop_only: bool = False) -> Optional[Tuple[str, object]]:
+        """Consulted by the RPC dispatch layer for every inbound request
+        (and per logical sub-request on batched endpoints). Returns None
+        or ("drop", None) / ("delay", seconds) / ("error", message).
+        drop_only skips delay/error rules WITHOUT touching their
+        counters or budgets — the per-spec batched probe can only model
+        frame loss, and merely probing must not burn a scripted
+        delay/error that a real dispatch was meant to inject."""
+        for rule in self._by_method.get(method, ()):
+            if drop_only and rule.kind != "drop":
+                continue
+            if self._fire(rule):
+                return self._action_of(rule)
+        for rule in self._wildcard:
+            if drop_only and rule.kind != "drop":
+                continue
+            if self._fire(rule):
+                return self._action_of(rule)
+        return None
+
+    @staticmethod
+    def _action_of(rule: FaultRule) -> Tuple[str, object]:
+        if rule.kind == "delay":
+            return ("delay", rule.ms / 1000.0)
+        if rule.kind == "error":
+            return ("error", rule.msg)
+        return ("drop", None)
+
+    def should_drop_request(self, method: str) -> bool:
+        """Legacy chaos surface (per-logical-request drops on batched
+        endpoints): evaluates DROP rules only — delay/error rules keep
+        their budgets for real dispatches."""
+        return self.on_dispatch(method, drop_only=True) is not None
+
+    def check_send(self, method: str, address: str) -> bool:
+        """True when an active one-way partition blackholes a frame this
+        process is about to send to `address`."""
+        for rule in self._partitions:
+            if not _identity_matches(rule.src):
+                continue
+            if not _identity_matches(rule.node):
+                continue
+            if not _addr_matches(rule.dst, address):
+                continue
+            if rule.times == 0:
+                continue
+            rule.seen += 1
+            if rule.times > 0:
+                rule.times -= 1
+            rule.fired += 1
+            _count_injection(rule.name)
+            return True
+        return False
+
+    def on_syncpoint(self, name: str) -> None:
+        for rule in self._kills.get(name, ()):
+            if self._fire(rule):
+                if rule.action == "raise":
+                    raise FaultInjectedError(
+                        f"kill_at({name}) [{rule.name}]")
+                os._exit(KILL_EXIT_CODE)
+
+
+# -------------------------------------------------------------- singleton
+_plane: Optional[FaultPlane] = None
+_plane_lock = threading.Lock()
+
+
+def get_plane() -> FaultPlane:
+    global _plane
+    if _plane is None:
+        with _plane_lock:
+            if _plane is None:
+                _plane = FaultPlane()
+    return _plane
+
+
+def apply_spec(spec: Optional[str], clear=None) -> List[dict]:
+    """The fault_inject protocol, in one place: optionally clear (a rule
+    name, or '*'/True for all), optionally add `spec` rules, return the
+    resulting snapshot — shared by the controller's admin RPC and every
+    nodelet's per-node handler so the two cannot drift."""
+    plane = get_plane()
+    if clear is not None:
+        plane.clear(None if clear in ("*", True) else clear)
+    if spec:
+        plane.add_rules(spec)
+    return plane.snapshot()
+
+
+def reload_from_config() -> FaultPlane:
+    """Re-parse the config-sourced rules (tests flip
+    ``cfg.testing_rpc_failure`` and reset the rpc-layer cache)."""
+    plane = get_plane()
+    plane.load_config_rules()
+    return plane
+
+
+def syncpoint(name: str) -> None:
+    """Plant a named kill point. One flag read when no kill_at rules
+    exist; the first call in a process loads the RTPU_FAULTS/config
+    rules so env-scripted kills work without any other plane traffic."""
+    if _plane is None:
+        get_plane()
+    if not KILL_ACTIVE:
+        return
+    get_plane().on_syncpoint(name)
+
+
+def check_send(method: str, address: str) -> bool:
+    """Partition check on the client send path. One flag read when no
+    partition rules exist (first call bootstraps the config rules)."""
+    if _plane is None:
+        get_plane()
+    if not SEND_ACTIVE:
+        return False
+    return get_plane().check_send(method, address)

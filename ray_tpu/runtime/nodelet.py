@@ -33,7 +33,7 @@ import traceback
 from typing import Any, Dict, List, Optional
 
 from .. import exceptions
-from . import serialization
+from . import faults, serialization
 from .config import get_config
 from .ids import NodeID, ObjectID, TaskID, WorkerID
 from .procutil import log, spawn_logged
@@ -44,6 +44,14 @@ from .rpc import RpcClient, RpcServer, ServerConn
 class _SpawnAmbiguous(Exception):
     """A factory spawn request whose outcome is unknown (sent but no
     reply): neither retrying nor cold-starting is safe for that id."""
+
+
+def _spill_timeout() -> float:
+    """Deadline for nodelet→peer/controller spill hops: the unified
+    rpc_call_timeout_s, capped at the legacy 30s — under a drop-storm
+    drill the sender's recovery latency is exactly this bound."""
+    t = get_config().rpc_call_timeout_s
+    return min(30.0, t) if t > 0 else 30.0
 
 
 def _pid_alive(pid: int, start_time: Optional[int] = None) -> bool:
@@ -343,6 +351,11 @@ class Nodelet:
         # feed the controller's slice-aware gang scheduler
         for key, value in detect_host_tpu().items():
             self.labels.setdefault(key, value)
+        # fault-plane addressing: @<node_id> selectors and
+        # partition(<node_id>->...) rules resolve to this process;
+        # partition dst "controller" matches frames toward the head
+        faults.add_identity(node_id)
+        faults.register_alias("controller", controller_addr)
 
     def _handlers(self):
         from .object_store import host_id as _host_id
@@ -379,24 +392,23 @@ class Nodelet:
             "object_deleted": self.object_deleted,
             "view_update": self.view_update,
             "get_node_info": self.get_node_info,
+            "fault_inject": self.fault_inject,
             "shutdown": self._on_shutdown,
             "ping": lambda: "pong",
         }
+
+    async def fault_inject(self, spec: str = None, clear=None):
+        """Runtime-mutable fault plane for THIS node's process (the
+        controller's fault_inject admin RPC routes here per node)."""
+        return faults.apply_spec(spec, clear)
 
     # ------------------------------------------------------------ lifecycle
     async def start(self):
         await self._server.start()
         self.address = self._server.address  # ephemeral tcp port resolved
+        faults.register_alias(self.node_id, self.address)
         self._start_factory()
-        reply = await self.controller.call_async(
-            "register_node", node_id=self.node_id, address=self.address,
-            resources=self.total_resources,
-            labels=dict(self.labels, **{"rtpu.host_id": self.host_id}))
-        self.cluster_nodes = reply.get("n_nodes", 1)
-        # seed the gossiped cluster view from the registration reply so
-        # p2p spill is live before the first heartbeat
-        self._apply_view_entries(reply.get("view"))
-        self._view_rev = reply.get("view_rev", 0)
+        await self._register_with_controller()
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._bg.append(asyncio.ensure_future(self._reap_loop()))
         self._bg.append(asyncio.ensure_future(self._memory_monitor_loop()))
@@ -443,6 +455,54 @@ class Nodelet:
         if not self._stopping:
             spawn_logged(self.stop(), name="nodelet.stop")
 
+    async def _register_with_controller(self):
+        reply = await self.controller.call_async(
+            "register_node", node_id=self.node_id, address=self.address,
+            resources=self.total_resources,
+            labels=dict(self.labels, **{"rtpu.host_id": self.host_id}))
+        self.cluster_nodes = reply.get("n_nodes", 1)
+        # seed the gossiped cluster view from the registration reply so
+        # p2p spill is live before the first heartbeat
+        self._apply_view_entries(reply.get("view"))
+        self._view_rev = reply.get("view_rev", 0)
+        return reply
+
+    async def _reregister(self):
+        """The controller answered a heartbeat with registered=False: it
+        restarted (or reaped us across a partition) and its tables know
+        nothing about this node. Re-register from scratch — the reply
+        re-seeds the gossip view — push the authoritative resource view
+        on the next beat, and re-announce every live actor worker so the
+        restarted controller's actor table heals while the actors keep
+        serving (replicas/drivers reattach instead of resolving ghosts).
+        Before this path existed, a controller restart left every
+        nodelet heartbeating into `registered: False` forever — the
+        cluster never re-formed without restarting all of it (found by
+        the controller-restart failure drill)."""
+        await self._register_with_controller()
+        self._resource_version_sent = 0  # full view on the next beat
+        for ws in list(self.workers.values()):
+            if not ws.is_actor or not ws.address:
+                continue
+            spec = getattr(ws, "actor_spec", None) or (
+                ws.current_task
+                if ws.current_task
+                and not ws.current_task.get("placeholder") else {})
+            try:
+                # cls_blob is droppable (the lease path re-attaches it
+                # from cls_key); args_inline/args_oid must SURVIVE — the
+                # controller keeps this spec, and a later restart of the
+                # reattached actor re-runs __init__ from it
+                await self.controller.call_async(
+                    "reattach_actor", actor_id=ws.actor_id,
+                    spec={k: v for k, v in (spec or {}).items()
+                          if k != "cls_blob"},
+                    address=ws.address, worker_id=ws.worker_id,
+                    node_id=self.node_id)
+            except Exception as e:
+                log.debug("reattach of actor %s undeliverable: %r",
+                          ws.actor_id, e)
+
     async def _heartbeat_loop(self):
         cfg = get_config()
         beats = 0
@@ -475,9 +535,21 @@ class Nodelet:
                     # ask for the gossiped view delta since the last
                     # revision we applied (piggybacks on the reply)
                     kwargs["known_view_rev"] = self._view_rev
+                # explicit SHORT deadline and NO transparent retries: a
+                # blackholed link (one-way partition) must cost one
+                # missed beat — the loop itself is the retry, and a
+                # retried beat would stretch heal detection to
+                # budget × deadline instead of one tick
                 reply = await self.controller.call_async(
-                    "heartbeat", **kwargs)
-                if send_view and reply.get("registered"):
+                    "heartbeat",
+                    _timeout=max(2.0, cfg.node_death_timeout_s / 3.0),
+                    _retry=0, **kwargs)
+                if not reply.get("registered"):
+                    # the controller does not know us: it restarted with
+                    # empty tables (or reaped us) — reattach everything
+                    await self._reregister()
+                    continue
+                if send_view:
                     self._resource_version_sent = version
                 if reply.get("want_full"):
                     # controller restarted or detected staleness: push
@@ -1151,7 +1223,14 @@ class Nodelet:
 
         slow = []
         for raw in specs:
-            if chaos_should_drop("submit_task"):
+            # the per-spec drop artifice models loss of an OWNER's
+            # one-way submission; a SPILLED spec travels request/response
+            # — its only physical loss mode is the whole frame, which the
+            # dispatch-level rules already simulate (a silent per-spec
+            # drop here would ack the batch and lose the task forever,
+            # with no sender timeout to trigger re-placement)
+            if not (raw.get("_spilled") or raw.get("_spill_hops")) \
+                    and chaos_should_drop("submit_task"):
                 continue
             spec = self._prep_spec(raw)
             if spec is None:
@@ -1319,7 +1398,11 @@ class Nodelet:
                 bundle_index=spec.get("bundle_index", -1),
                 arg_locs=spec.get("arg_locs"),
                 locality_weight=cfg.locality_weight,
-                _timeout=30)
+                # no transparent retries: the except-fallback (keep the
+                # task local) IS the retry — a retried pick against a
+                # blackholed controller would stall placement for
+                # budget × deadline instead of one bound
+                _timeout=_spill_timeout(), _retry=0)
         except Exception:
             target = None  # controller hiccup: keep the task local
         if target is not None and target["node_id"] != self.node_id:
@@ -1330,7 +1413,7 @@ class Nodelet:
                 spec["_placement_seq"] = \
                     spec.get("_placement_seq", 0) + 1
                 await self._peer_client(target["address"]).call_async(
-                    "submit_task", spec=spec, _timeout=30)
+                    "submit_task", spec=spec, _timeout=_spill_timeout())
                 self.sched_counters["controller_spills"] += 1
                 # tell the owner where the task went so it can fail
                 # it over if that node dies (the owner only ever
@@ -1501,10 +1584,10 @@ class Nodelet:
         try:
             if len(specs) == 1:
                 await client.call_async("submit_task", spec=specs[0],
-                                        _timeout=30)
+                                        _timeout=_spill_timeout())
             else:
                 await client.call_async("submit_task_batch", specs=specs,
-                                        _timeout=30)
+                                        _timeout=_spill_timeout())
         except Exception:
             # peer unreachable mid-spill: NEVER drop a task. Evict the
             # peer from the view and the client pool, then re-place
@@ -1573,6 +1656,7 @@ class Nodelet:
         worker built for its environment."""
         if self._stopping:
             return
+        faults.syncpoint("nodelet.dispatch")
         # rtpulint: ignore[RTPU007] — _TaskQueue.keys() returns a snapshot list, not a live view; popleft/append under it are safe
         for key in self.queue.keys():
             pool = self.idle.get(key)
@@ -1641,6 +1725,10 @@ class Nodelet:
             ws = self.workers[worker_id]
             ws.actor_id = actor_id
             ws.current_task = spec
+            # kept for the actor's lifetime: a controller restarted with
+            # empty tables rebuilds its actor entry from this spec when
+            # the node re-registers (reattach_actor)
+            ws.actor_spec = spec
             spawn_logged(self._push_actor_to_worker(ws, spec),
                          name="nodelet.push_actor")
         # actor workers are demand-driven and bounded by resources, not by
@@ -1939,6 +2027,9 @@ class Nodelet:
             "spill_hops_hist": dict(self.spill_hops_hist),
             "cluster_view": {nid: v.version
                              for nid, v in self.cluster_view.items()},
+            # active fault rules + per-rule seen/fired counters, so
+            # drills can assert an injection actually happened
+            "faults": faults.get_plane().snapshot(),
         }
 
 

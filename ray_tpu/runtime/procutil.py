@@ -135,6 +135,17 @@ def orphan_check_enabled() -> bool:
     return bool(os.environ.get("PYTHONASYNCIODEBUG"))
 
 
+def jitter(delay: float, frac: float = 0.5) -> float:
+    """The runtime's ONE backoff-jitter policy: scale `delay` uniformly
+    into [1-frac, 1] of itself. Every retry/redial ladder (rpc call
+    retries, channel stream redial + backpressure replay, bulk-stream
+    downgrade re-probe) draws from here so lockstep-storm behavior is
+    tuned in one place, not three hand-rolled variants."""
+    import random
+
+    return delay * (1.0 - frac + random.random() * frac)
+
+
 def proc_start_time(pid: int) -> Optional[int]:
     """starttime (field 22 of /proc/<pid>/stat, clock ticks since boot),
     or None when unreadable (process gone, or a non-procfs platform)."""

@@ -20,13 +20,12 @@ import asyncio
 import collections
 import itertools
 import os
-import random
 import struct
 import threading
 import traceback
 from typing import Any, Awaitable, Callable, Dict, List, Optional
 
-from . import serialization
+from . import faults, serialization
 from .procutil import spawn_logged
 
 _LEN = struct.Struct(">Q")
@@ -52,46 +51,122 @@ class ConnectionLost(RpcError):
     pass
 
 
+class RpcTimeoutError(RpcError, asyncio.TimeoutError):
+    """A call exceeded its deadline (the default rpc_call_timeout_s or
+    an explicit _timeout) with the retry budget exhausted. Subclasses
+    asyncio.TimeoutError so existing wait_for-style handlers keep
+    working; the typed name is what drills and operators see instead of
+    an unbounded hang."""
+
+
+class NodeUnreachableError(ConnectionLost):
+    """The peer could not be reached (connect failed or the connection
+    died) after the retry budget. Subclasses ConnectionLost so every
+    redial/re-resolve handler keeps working."""
+
+
 # --------------------------------------------------------------------------
-# Fault injection (chaos) — parsed once per process from config.
+# Failure-bounding policy: which control-plane methods may be retried
+# transparently (idempotent per their handler's semantics — registration
+# dedupes, reads re-read, reports overwrite) and which long-poll methods
+# are exempt from the DEFAULT call deadline (their callers bound them
+# explicitly or legitimately park: an owner fetch waits for the producing
+# task, however long it runs).
 # --------------------------------------------------------------------------
-class _Chaos:
-    def __init__(self, spec: str):
-        self.rules: Dict[str, list] = {}
-        for part in filter(None, (spec or "").split(",")):
-            method, params = part.split("=")
-            mx, req_p, res_p = params.split(":")
-            self.rules[method] = [int(mx), float(req_p), float(res_p)]
+IDEMPOTENT_METHODS = frozenset({
+    "ping", "heartbeat", "register_node", "list_nodes", "cluster_status",
+    "get_actor", "list_actors", "register_actor", "actor_ready",
+    "reattach_actor",
+    # NOT actor_died: its restart branch bumps num_restarts and spawns a
+    # scheduler pass per delivery — a retried-but-executed report would
+    # double-restart the actor
+    "kv_get", "kv_put", "kv_del", "kv_keys", "kv_exists",
+    "get_node_info", "get_metrics", "report_metrics", "report_backlog",
+    "list_jobs", "register_job", "mark_job_finished",
+    "list_placement_groups", "get_placement_group",
+    "list_task_events", "list_tasks", "get_task",
+    "om_meta", "om_endpoint", "chan_endpoint", "view_update",
+    "pick_node", "subscribe",
+})
 
-    def should_drop_request(self, method: str) -> bool:
-        rule = self.rules.get(method) or self.rules.get("*")
-        if not rule or rule[0] == 0:
-            return False
-        if random.random() < rule[1]:
-            rule[0] -= 1
-            return True
-        return False
+# long-poll methods whose wait is the PRODUCT, not a failure: no default
+# deadline (explicit _timeout still applies)
+UNBOUNDED_METHODS = frozenset({"fetch_object", "c_get", "c_wait"})
 
 
-_chaos: Optional[_Chaos] = None
+def _call_deadline(method: str, timeout: Optional[float]) -> Optional[float]:
+    if timeout is not None:
+        return timeout
+    if method in UNBOUNDED_METHODS:
+        return None
+    from .config import get_config
+
+    cfg_timeout = get_config().rpc_call_timeout_s
+    return cfg_timeout if cfg_timeout > 0 else None
 
 
-def _get_chaos() -> _Chaos:
+def _retry_budget(method: str) -> int:
+    if method not in IDEMPOTENT_METHODS:
+        return 0
+    from .config import get_config
+
+    return max(0, get_config().rpc_retry_max)
+
+
+def _backoff_delay(attempt: int) -> float:
+    """Exponential backoff with jitter (ref: the reference's
+    exponential_backoff.h), bounded by rpc_retry_max_s."""
+    from .config import get_config
+    from .procutil import jitter
+
+    cfg = get_config()
+    return jitter(min(cfg.rpc_retry_max_s,
+                      cfg.rpc_retry_base_s * (2 ** attempt)))
+
+
+# --------------------------------------------------------------------------
+# Fault injection — the deterministic fault plane (faults.py) subsumes
+# the legacy probabilistic chaos hook; `_chaos = None` still forces a
+# re-parse of config-sourced rules (test surface).
+# --------------------------------------------------------------------------
+_chaos: Optional[faults.FaultPlane] = None
+
+
+def _get_chaos() -> faults.FaultPlane:
     global _chaos
     if _chaos is None:
-        from .config import get_config
-
-        _chaos = _Chaos(get_config().testing_rpc_failure)
+        _chaos = faults.reload_from_config()
     return _chaos
 
 
 def chaos_should_drop(method: str) -> bool:
-    """Consult the chaos rules for `method` outside the dispatch layer.
+    """Consult the fault rules for `method` outside the dispatch layer.
     Batched endpoints (submit_task_batch) use this to apply the
     PER-LOGICAL-REQUEST rules of the method they aggregate, so
     fault-tolerance tests keyed on e.g. "submit_task" keep exercising
     real drops on the coalesced fast path."""
     return _get_chaos().should_drop_request(method)
+
+
+async def _apply_dispatch_fault(method: str,
+                                one_way: bool = False) -> bool:
+    """Run the fault plane's dispatch-side verdict for one inbound
+    request. Returns True when the frame must be DROPPED (simulated
+    network loss — the caller sees a hang into its deadline); a delay
+    rule sleeps here; an error rule raises FaultInjectedError into the
+    normal handler-error path so the caller gets a typed failure."""
+    action = _get_chaos().on_dispatch(method)
+    if action is None:
+        return False
+    kind, arg = action
+    if kind == "drop":
+        return True
+    if kind == "delay":
+        await asyncio.sleep(arg)
+        return False
+    if one_way:
+        return True  # error on a one-way frame: nothing to answer
+    raise faults.FaultInjectedError(arg)
 
 
 # --------------------------------------------------------------------------
@@ -382,10 +457,11 @@ class RpcServer:
                 pass
 
     async def _dispatch(self, conn: ServerConn, msg_id, method: str, kwargs):
-        if _get_chaos().should_drop_request(method):
-            return  # simulated network drop; caller sees a hang/timeout
         handler = self.handlers.get(method)
         try:
+            if await _apply_dispatch_fault(method,
+                                           one_way=msg_id is None):
+                return  # simulated network drop; caller hangs → deadline
             if handler is None:
                 raise RpcError(f"no handler for {method!r}")
             if _wants_conn(handler):
@@ -487,6 +563,12 @@ class RpcClient:
         # callers (controller storage) detect and replay lost sends
         # instead of silently diverging
         self.on_notify_error = None
+        # optional zero-arg hook spawned on the io loop after a RE-dial
+        # (not the first connect): session-state owners re-seed what the
+        # dead connection carried (pubsub subscriptions survive a
+        # controller restart this way)
+        self.on_reconnect = None
+        self._ever_connected = False
         self._idle_event: Optional[asyncio.Event] = None
         # one-way frames awaiting the coalesced flush (notify_async)
         self._wbuf: List[bytes] = []
@@ -503,9 +585,14 @@ class RpcClient:
     async def _call_local(self, server: "RpcServer", method: str,
                           kwargs: dict, _timeout: Optional[float],
                           one_way: bool = False):
-        """Direct in-process dispatch (no socket, no pickling). Chaos
+        """Direct in-process dispatch (no socket, no pickling). Fault
         injection still applies so FT tests behave identically."""
-        if _get_chaos().should_drop_request(method):
+        try:
+            dropped = await _apply_dispatch_fault(method, one_way=one_way)
+        except faults.FaultInjectedError as e:
+            raise RemoteHandlerError("FaultInjectedError", repr(e),
+                                     "") from None
+        if dropped:
             if one_way:
                 return None
             if _timeout is not None:
@@ -565,6 +652,15 @@ class RpcClient:
             self._wlock = asyncio.Lock()
             spawn_logged(self._read_loop(self._reader),
                          name="rpc.read_loop")
+            reconnected = self._ever_connected
+            self._ever_connected = True
+            if reconnected and self.on_reconnect is not None:
+                try:
+                    res = self.on_reconnect()
+                    if asyncio.iscoroutine(res):
+                        spawn_logged(res, name="rpc.on_reconnect")
+                except Exception:
+                    traceback.print_exc()
 
     async def _read_loop(self, reader):
         try:
@@ -600,11 +696,55 @@ class RpcClient:
                     fut.set_exception(err)
             self._pending.clear()
 
-    async def call_async(self, method: str, _timeout: Optional[float] = None, **kwargs):
+    async def call_async(self, method: str, _timeout: Optional[float] = None,
+                         _retry: Optional[int] = None, **kwargs):
+        """One request/response. The failure-bounding policy lives here:
+        every call gets a deadline (the caller's _timeout, else the
+        rpc_call_timeout_s default — long-poll methods exempt), and
+        idempotent control-plane methods retry under exponential backoff
+        with jitter inside a bounded budget (`_retry` overrides it —
+        periodic callers whose NEXT tick is the retry pass 0 so one
+        blackholed call costs one tick, not budget × deadline).
+        Exhaustion surfaces as the TYPED RpcTimeoutError /
+        NodeUnreachableError instead of an unbounded hang or a bare
+        transport error."""
         _send_counts[method] += 1
+        timeout = _call_deadline(method, _timeout)
+        retries = _retry_budget(method) if _retry is None else max(0, _retry)
+        attempt = 0
+        while True:
+            try:
+                return await self._call_attempt(method, timeout, kwargs)
+            except RpcTimeoutError:
+                raise
+            except asyncio.TimeoutError as e:
+                if attempt >= retries or self._closed:
+                    raise RpcTimeoutError(
+                        f"rpc {method!r} to {self.address} timed out "
+                        f"after {timeout}s "
+                        f"({attempt + 1} attempt(s))") from e
+            except NodeUnreachableError:
+                raise
+            except ConnectionLost as e:
+                if attempt >= retries or self._closed:
+                    raise NodeUnreachableError(
+                        f"rpc {method!r}: {self.address} unreachable "
+                        f"({attempt + 1} attempt(s)): {e}") from e
+            attempt += 1
+            await asyncio.sleep(_backoff_delay(attempt - 1))
+
+    async def _call_attempt(self, method: str, timeout: Optional[float],
+                            kwargs: dict):
+        if faults.check_send(method, self.address):
+            # one-way partition: the frame never leaves this process —
+            # the caller waits into its deadline, exactly like a
+            # blackholed link (drills verify the typed timeout here)
+            if timeout is not None:
+                await asyncio.wait_for(_hang_forever(), timeout)
+            await _hang_forever()
         server = self._local_server()
         if server is not None:
-            return await self._call_local(server, method, kwargs, _timeout)
+            return await self._call_local(server, method, kwargs, timeout)
         await self._ensure_connected()
         msg_id = next(self._ids)
         fut = asyncio.get_event_loop().create_future()
@@ -623,12 +763,20 @@ class RpcClient:
                 raise ConnectionLost(f"connection to {self.address} lost")
             self._writer.write(_frame(payload))
             await self._writer.drain()
-        if _timeout is not None:
-            return await asyncio.wait_for(fut, _timeout)
+        if timeout is not None:
+            try:
+                return await asyncio.wait_for(fut, timeout)
+            except asyncio.TimeoutError:
+                # the reply may still arrive later: drop the slot now or
+                # every timed-out call leaks one pending future forever
+                self._pending.pop(msg_id, None)
+                raise
         return await fut
 
     async def notify_async(self, method: str, **kwargs):
         _send_counts[method] += 1
+        if faults.check_send(method, self.address):
+            return  # one-way partition: a fire-and-forget frame is lost
         server = self._local_server()
         if server is not None:
             await self._call_local(server, method, kwargs, None, one_way=True)

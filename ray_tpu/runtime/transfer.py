@@ -40,6 +40,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import exceptions
+from . import faults
 from .config import get_config
 from .ids import ObjectID
 
@@ -85,6 +86,14 @@ async def _discard_exact(loop, sock: socket.socket, n: int):
         finally:
             view.release()
         left -= min(left, len(scratch))
+
+
+def _redial_backoff(base: float = 30.0) -> float:
+    """Jittered stream-redial backoff: many pullers downgraded by one
+    dead endpoint must not re-probe it in lockstep."""
+    from .procutil import jitter
+
+    return jitter(base)
 
 
 class _RangeGone(Exception):
@@ -433,8 +442,8 @@ class PullManager:
                 "om_endpoint", _timeout=10)
         except Exception:
             # old peer / momentary unreachability: RPC path now, re-probe
-            # after the backoff instead of downgrading forever
-            self._bulk_retry_at[addr] = time.monotonic() + 30.0
+            # after the (jittered) backoff instead of downgrading forever
+            self._bulk_retry_at[addr] = time.monotonic() + _redial_backoff()
             return None
         self._endpoints[addr] = ep
         return ep
@@ -444,7 +453,7 @@ class PullManager:
         bounded backoff, then re-probes — one transient hiccup must not
         pin a long-lived process to the slow path forever."""
         self._endpoints.pop(addr, None)
-        self._bulk_retry_at[addr] = time.monotonic() + 30.0
+        self._bulk_retry_at[addr] = time.monotonic() + _redial_backoff()
 
     async def pull(self, oid: ObjectID, size: int,
                    sources: List[Tuple[str, str]], writer) -> dict:
@@ -453,6 +462,7 @@ class PullManager:
         [(host, rpc_addr), ...]. Caller seals/aborts the writer. Raises
         ObjectLostError when every source fails. Returns per-pull info:
         {bytes, seconds, gb_s, per_source: {addr: bytes}}."""
+        faults.syncpoint("transfer.pull")
         cfg = get_config()
         chunk = max(64 << 10, int(cfg.bulk_chunk_size))
         srcs = [_Source(h, a, cfg.pull_conns_per_link) for h, a in sources]
@@ -789,17 +799,29 @@ class ChannelServer:
     # ----------------------------------------------------------- RPC path
     async def push(self, name: str, seq: int, flag: int, payload: bytes,
                    item_size: int, num_slots: int,
-                   timeout: float = 60.0) -> int:
+                   timeout: Optional[float] = None) -> int:
         """chan_push handler body: deposit one frame, dedupe by seq,
-        park (bounded) while the ring is full. Returns the delivered
-        sequence — the writer's ack."""
-        from .channel import FLAG_SENTINEL
+        park while the ring is full — BOUNDED by chan_push_timeout_s,
+        answering the typed ChannelBackpressure error past the deadline
+        so the writer retries with backoff instead of the wait pinning
+        this consumer's RPC dispatch task for as long as the ring stays
+        unread (PR-8 NOTE). Returns the delivered sequence — the
+        writer's ack."""
+        from .channel import FLAG_SENTINEL, ChannelBackpressure
 
+        faults.syncpoint("channel.push")
+        if timeout is None:
+            timeout = get_config().chan_push_timeout_s
         ent = self._entry(name, item_size, num_slots)
         async with ent["lock"]:
             if seq > ent["delivered"]:
-                wc = await asyncio.wait_for(self._claim_slot(ent["ring"]),
-                                            timeout)
+                try:
+                    wc = await asyncio.wait_for(
+                        self._claim_slot(ent["ring"]), timeout)
+                except asyncio.TimeoutError:
+                    raise ChannelBackpressure(
+                        f"channel {name}: remote ring full for "
+                        f"{timeout}s (reader not draining)") from None
                 if wc is not None:
                     view = ent["ring"].stage_frame(wc, flag, len(payload))
                     try:

@@ -153,6 +153,9 @@ class ServeControllerActor:
         self._loop_started = True
         while self._running:
             try:
+                from ..runtime import faults
+
+                faults.syncpoint("serve.reconcile")
                 await self._reconcile_once()
             except Exception:  # keep the loop alive (ref: controller.py:373)
                 import traceback

@@ -1,0 +1,723 @@
+"""Deterministic fault plane + cluster-wide failure drills.
+
+Unit tier drives the rule grammar, the dispatch/send injection points,
+the unified deadline/backoff policy, and kill_at syncpoints with bare
+RpcServer/RpcClient pairs — no cluster, fully deterministic. The drill
+tier marches the planes PRs 2-8 built through scripted disasters —
+controller kill+restart under live actor traffic, a one-way
+nodelet→controller partition that heals, node death mid compiled-DAG
+step and mid ring-allreduce, source death mid cross-host pull, and a
+30%-drop spill storm — asserting convergence (or a typed error) within
+a deadline and zero lost tasks (ref: the chaos discipline of
+rpc_chaos.cc + Basiri et al., "Chaos Engineering", IEEE Software 2016).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+from ray_tpu.runtime import faults
+from ray_tpu.runtime import rpc as rpc_mod
+from ray_tpu.runtime.config import get_config
+from ray_tpu.runtime.rpc import (
+    EventLoopThread,
+    NodeUnreachableError,
+    RemoteHandlerError,
+    RpcClient,
+    RpcServer,
+    RpcTimeoutError,
+)
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Every test leaves the process-global fault plane empty."""
+    yield
+    faults.get_plane().clear()
+
+
+@pytest.fixture
+def cfg_guard():
+    """Snapshot/restore the config fields drills tune."""
+    cfg = get_config()
+    saved = {k: getattr(cfg, k)
+             for k in ("rpc_call_timeout_s", "rpc_retry_max",
+                       "rpc_retry_base_s", "rpc_connect_timeout_s",
+                       "node_death_timeout_s", "chan_push_timeout_s")}
+    yield cfg
+    for k, v in saved.items():
+        setattr(cfg, k, v)
+
+
+def _socket_pair(tmp_path, handlers, name="srv"):
+    """RpcServer + RpcClient over a REAL unix socket (the in-process
+    shortcut is popped so reconnect/timeout paths are exercised)."""
+    addr = f"unix:{tmp_path}/{name}.sock"
+    server = RpcServer(addr, handlers)
+    elt = EventLoopThread.get()
+    elt.run(server.start())
+    rpc_mod._local_servers.pop(addr, None)
+    return server, RpcClient(addr)
+
+
+# ------------------------------------------------------------- rule grammar
+def test_rule_grammar_parses_every_kind():
+    rules = faults.parse_rules(
+        "drop(submit_task,nth=3); lag:delay(heartbeat,ms=250)@n1;"
+        "error(om_read,msg=boom,times=2); cut:partition(n1->controller);"
+        "kill_at(nodelet.dispatch,action=raise)")
+    kinds = [r.kind for r in rules]
+    assert kinds == ["drop", "delay", "error", "partition", "kill_at"]
+    assert rules[0].nth == 3
+    assert rules[1].name == "lag" and rules[1].ms == 250 \
+        and rules[1].node == "n1"
+    assert rules[2].times == 2 and rules[2].msg == "boom"
+    assert rules[3].src == "n1" and rules[3].dst == "controller"
+    assert rules[4].times == 1  # kill_at fires once by default
+    for bad in ("drop", "nope(x)", "partition(a)", "delay(hb)",
+                "kill_at(p,action=what)"):
+        with pytest.raises(faults.FaultSpecError):
+            faults.parse_rules(bad)
+    # legacy probabilistic chaos grammar still parses
+    (legacy,) = faults.parse_legacy("submit_task=2:1.0:0.0")
+    assert legacy.kind == "drop" and legacy.times == 2
+
+
+def test_default_config_bounds_every_control_rpc():
+    """The acceptance invariant: with default config no control-plane
+    RPC can hang forever — the default deadline is real, and long-poll
+    exemptions are the explicit named set."""
+    from ray_tpu.runtime.config import RuntimeConfig
+
+    assert RuntimeConfig().rpc_call_timeout_s > 0
+    assert RuntimeConfig().rpc_retry_max >= 1
+    assert "fetch_object" in rpc_mod.UNBOUNDED_METHODS
+    assert "heartbeat" in rpc_mod.IDEMPOTENT_METHODS
+    assert "submit_task" not in rpc_mod.IDEMPOTENT_METHODS
+
+
+# -------------------------------------------------------- dispatch faults
+def test_drop_nth_call_is_deterministic(tmp_path):
+    server, client = _socket_pair(tmp_path, {"probe_a": lambda: "ok"})
+    plane = faults.get_plane()
+    plane.add_rules("d1:drop(probe_a,nth=2)")
+    elt = EventLoopThread.get()
+    try:
+        assert client.call("probe_a", _timeout=5) == "ok"
+        t0 = time.monotonic()
+        with pytest.raises(RpcTimeoutError):
+            client.call("probe_a", _timeout=0.4)
+        assert time.monotonic() - t0 < 5.0  # typed error, bounded
+        assert client.call("probe_a", _timeout=5) == "ok"
+        (snap,) = [r for r in plane.snapshot() if r["name"] == "d1"]
+        assert snap["fired"] == 1 and snap["seen"] == 3
+    finally:
+        client.close()
+        elt.run(server.stop())
+
+
+def test_delay_and_error_rules(tmp_path):
+    server, client = _socket_pair(tmp_path, {"probe_b": lambda: "ok"})
+    plane = faults.get_plane()
+    # first matching rule to fire wins a call: the delay consumes call
+    # 1 (and its budget); the error rule then sees call 2 as its first
+    plane.add_rules("delay(probe_b,ms=300,times=1);"
+                    "e1:error(probe_b,msg=injected-boom,nth=1)")
+    elt = EventLoopThread.get()
+    try:
+        t0 = time.monotonic()
+        assert client.call("probe_b", _timeout=5) == "ok"
+        assert time.monotonic() - t0 >= 0.28  # delayed, then served
+        with pytest.raises(RemoteHandlerError) as ei:
+            client.call("probe_b", _timeout=5)
+        assert "FaultInjectedError" in str(ei.value)
+        assert "injected-boom" in str(ei.value)
+        assert client.call("probe_b", _timeout=5) == "ok"
+    finally:
+        client.close()
+        elt.run(server.stop())
+
+
+def test_idempotent_retry_rides_through_one_drop(tmp_path, cfg_guard):
+    """A dropped frame of an IDEMPOTENT method is retried under backoff
+    transparently; a non-idempotent method surfaces the typed timeout
+    on the first loss instead of risking double execution."""
+    calls = {"ping": 0, "probe_c": 0}
+
+    def ping():
+        calls["ping"] += 1
+        return "pong"
+
+    def probe_c():
+        calls["probe_c"] += 1
+        return "ok"
+
+    server, client = _socket_pair(tmp_path,
+                                  {"ping": ping, "probe_c": probe_c})
+    cfg_guard.rpc_retry_base_s = 0.05
+    plane = faults.get_plane()
+    plane.add_rules("drop(ping,nth=1); drop(probe_c,nth=1)")
+    elt = EventLoopThread.get()
+    try:
+        assert client.call("ping", _timeout=0.5) == "pong"  # retried
+        assert calls["ping"] == 1  # the dropped attempt never dispatched
+        with pytest.raises(RpcTimeoutError):
+            client.call("probe_c", _timeout=0.5)
+        assert calls["probe_c"] == 0
+        assert client.call("probe_c", _timeout=5) == "ok"  # link healthy
+    finally:
+        client.close()
+        elt.run(server.stop())
+
+
+def test_unreachable_peer_is_typed_not_hung(tmp_path, cfg_guard):
+    """Nothing listening: the connect budget surfaces as the typed
+    NodeUnreachableError (a ConnectionLost subclass, so every redial
+    handler keeps working)."""
+    cfg_guard.rpc_connect_timeout_s = 0.3
+    cfg_guard.rpc_retry_max = 0
+    client = RpcClient(f"unix:{tmp_path}/nobody.sock")
+    try:
+        with pytest.raises(NodeUnreachableError):
+            client.call("ping", _timeout=5)
+    finally:
+        client.close()
+
+
+# ------------------------------------------------------ partition (send)
+def test_partition_blackholes_one_direction_and_heals(tmp_path,
+                                                      cfg_guard):
+    """The blackhole drill: a one-way partition makes a control call
+    converge on the TYPED RpcTimeoutError within the default deadline —
+    never an unbounded hang — and clearing the rule heals the link."""
+    server, client = _socket_pair(tmp_path, {"probe_d": lambda: "ok"},
+                                  name="part")
+    faults.add_identity("chaos-proc-a")
+    cfg_guard.rpc_call_timeout_s = 0.5  # the DEFAULT deadline under test
+    cfg_guard.rpc_retry_max = 1
+    cfg_guard.rpc_retry_base_s = 0.05
+    plane = faults.get_plane()
+    plane.add_rules(f"cut:partition(chaos-proc-a->{tmp_path})")
+    elt = EventLoopThread.get()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(RpcTimeoutError):
+            client.call("probe_d")  # NO explicit timeout: default policy
+        assert time.monotonic() - t0 < 6.0
+        # one-way notifies are silently lost (that is what a dead link
+        # looks like from the sender), and counted
+        client.notify("probe_d")
+        (snap,) = [r for r in plane.snapshot() if r["name"] == "cut"]
+        assert snap["fired"] >= 2  # the call attempt + the notify
+        plane.clear("cut")
+        assert client.call("probe_d", _timeout=5) == "ok"  # healed
+    finally:
+        client.close()
+        elt.run(server.stop())
+
+
+def test_reconnect_hook_fires_on_redial(tmp_path):
+    """on_reconnect is the driver's reattach trigger: it must fire on a
+    RE-dial (controller restart) and not on the first connect."""
+    fired = []
+    server, client = _socket_pair(tmp_path, {"ping": lambda: "one"},
+                                  name="rc")
+    client.on_reconnect = lambda: fired.append(1)
+    elt = EventLoopThread.get()
+    try:
+        assert client.call("ping", _timeout=5) == "one"
+        assert fired == []  # first connect is not a REconnect
+        elt.run(server.stop())
+        time.sleep(0.3)  # let the EOF land so the redial path runs
+        server2 = RpcServer(client.address, {"ping": lambda: "two"})
+        elt.run(server2.start())
+        rpc_mod._local_servers.pop(client.address, None)
+        # ping is idempotent: even if the first attempt rode the dying
+        # socket, the retry redials and fires the hook
+        assert client.call("ping", _timeout=3) == "two"
+        assert fired == [1]
+    finally:
+        client.close()
+        elt.run(server2.stop())
+
+
+def test_driver_wires_resubscribe_on_reconnect(shared_cluster):
+    from ray_tpu.runtime.core import get_core
+
+    core = get_core()
+    assert core.controller.on_reconnect == core._resubscribe_all
+
+
+# ----------------------------------------------------------- kill_at
+def test_kill_at_syncpoint_fires_exactly_once():
+    plane = faults.get_plane()
+    plane.add_rules("k1:kill_at(test.point,action=raise)")
+    with pytest.raises(faults.FaultInjectedError):
+        faults.syncpoint("test.point")
+    faults.syncpoint("test.point")  # budget spent: fires exactly once
+    faults.syncpoint("other.point")
+    (snap,) = [r for r in plane.snapshot() if r["name"] == "k1"]
+    assert snap["fired"] == 1 and snap["times_left"] == 0
+    plane.clear("k1")
+    faults.syncpoint("test.point")  # cleared: no-op
+
+
+def test_kill_at_exit_kills_a_real_process(tmp_path):
+    """action=exit (the default) terminates the process with the
+    documented exit code — the process-death half of the drill kit,
+    configured purely through RTPU_FAULTS."""
+    env = dict(os.environ, RTPU_FAULTS="kill_at(boot.probe)")
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "from ray_tpu.runtime import faults\n"
+         "faults.syncpoint('boot.probe')\n"
+         "print('survived')"],
+        capture_output=True, text=True, timeout=60, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == faults.KILL_EXIT_CODE
+    assert "survived" not in r.stdout
+
+
+# ------------------------------------------------- runtime-mutable rules
+@pytest.fixture
+def cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    session = ray_tpu.init(num_cpus=2)
+
+    def add(num_cpus=2, **kw):
+        return session.add_node(num_cpus=num_cpus, **kw)
+
+    yield session, add
+    ray_tpu.shutdown()
+
+
+def _node_addr(session, node_id):
+    nodes = session.core.controller.call("list_nodes")
+    return nodes[node_id]["address"]
+
+
+def test_fault_inject_rpc_mutates_rules_without_restart(cluster):
+    """The admin RPC flips faults mid-run: a rule lands on a REMOTE
+    nodelet process, shows up (with counters) in get_node_info, takes
+    effect, and clears — no process restart anywhere."""
+    session, add = cluster
+    node_b = add(num_cpus=1)
+    reply = session.core.controller.call(
+        "fault_inject", spec="lag:delay(get_node_info,ms=800)",
+        node_id=node_b)
+    assert any(r["name"] == "lag" for r in reply[node_b])
+    client = session.core.client_for(_node_addr(session, node_b))
+    t0 = time.monotonic()
+    info = client.call("get_node_info", _timeout=10)
+    assert time.monotonic() - t0 >= 0.75  # the delay rule fired
+    (snap,) = [r for r in info["faults"] if r["name"] == "lag"]
+    assert snap["fired"] >= 1
+    # clear without restart: the next call is fast and the table empty
+    reply = session.core.controller.call("fault_inject", clear="lag",
+                                         node_id=node_b)
+    assert not [r for r in reply[node_b] if r["name"] == "lag"]
+    t0 = time.monotonic()
+    info = client.call("get_node_info", _timeout=10)
+    assert time.monotonic() - t0 < 0.6
+    assert not [r for r in info["faults"] if r["name"] == "lag"]
+
+
+# ----------------------------------------------------------------- drills
+def test_drill_controller_restart_under_live_traffic(cluster):
+    """Controller kill+restart under live actor traffic: nodelets must
+    re-register (the restarted controller's tables start EMPTY), live
+    actors reattach so new resolves work, the gossip view re-seeds, and
+    in-flight traffic never errors — the cluster re-forms by itself."""
+    import threading
+
+    from ray_tpu.runtime.controller import Controller
+
+    session, add = cluster
+    node_b = add(num_cpus=2)
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id=node_b)).remote()
+    assert ray_tpu.get(c.bump.remote(), timeout=60) == 1
+
+    errors, counts, stop = [], [], threading.Event()
+
+    def traffic():
+        while not stop.is_set():
+            try:
+                counts.append(ray_tpu.get(c.bump.remote(), timeout=30))
+            except Exception as e:  # noqa: BLE001 — the assertion below
+                errors.append(e)
+                return
+            time.sleep(0.02)
+
+    th = threading.Thread(target=traffic, daemon=True)
+    th.start()
+    time.sleep(0.3)
+
+    # ---- kill: the in-proc controller's server stops answering, its
+    # sweeps die with it; a brand-new controller (EMPTY tables — no
+    # persist dir) takes over the same address, like a failed-over head
+    elt = EventLoopThread.get()
+    old = session.controller_inproc
+    t_kill = time.monotonic()
+    elt.loop.call_soon_threadsafe(old._health_task.cancel)
+    elt.run(old._server.stop())
+    new = Controller(session.session_name, session.controller_addr)
+    elt.run(new.start())
+    session.controller_inproc = new
+
+    # ---- recovery: both nodelets re-register + reattach on their own
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        nodes = session.core.controller.call("list_nodes", _timeout=10)
+        if len(nodes) == 2 and all(n["alive"] for n in nodes.values()):
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError(f"nodes never re-registered: {nodes}")
+    recovery_ms = (time.monotonic() - t_kill) * 1000.0
+    faults.record_recovery("controller_restart", recovery_ms)
+
+    # the live actor reattached into the NEW controller's table
+    info = session.core.controller.call("get_actor",
+                                        actor_id=c._actor_id,
+                                        _timeout=10)
+    assert info is not None and info["state"] == "ALIVE", info
+    assert info["address"], info
+
+    # gossip view re-seeded (register reply seeds; beats keep it fresh)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if node_b in session.nodelet_inproc.cluster_view:
+            break
+        time.sleep(0.1)
+    assert node_b in session.nodelet_inproc.cluster_view
+
+    # new work schedules through the restarted controller
+    @ray_tpu.remote
+    def probe():
+        return "alive"
+
+    assert ray_tpu.get(probe.remote(), timeout=60) == "alive"
+
+    n_before_stop = len(counts)
+    time.sleep(0.5)
+    stop.set()
+    th.join(timeout=30)
+    assert not errors, f"traffic errored across the restart: {errors!r}"
+    assert len(counts) > n_before_stop, "traffic stalled after restart"
+    assert counts == sorted(counts)  # the SAME incarnation served it all
+    assert recovery_ms < 30000
+
+
+def test_drill_partition_heals_and_node_returns(cluster, cfg_guard):
+    """One-way nodelet→controller partition: the controller declares the
+    node dead on heartbeat silence; the nodelet's beat loop must keep
+    TICKING through the blackhole (short deadline per beat — before the
+    unified deadlines one hung beat wedged the loop forever), so when
+    the partition heals the node revives and runs work again, with the
+    outage exported as rtpu_recovery_ms{scenario=node_heal}."""
+    session, add = cluster
+    cfg_guard.node_death_timeout_s = 2.0
+    node_b = add(num_cpus=1)
+
+    # blackhole node_b -> controller (injected THROUGH the controller:
+    # the reverse direction still works — that is what one-way means)
+    reply = session.core.controller.call(
+        "fault_inject", spec=f"cut:partition({node_b}->controller)",
+        node_id=node_b)
+    assert any(r["name"] == "cut" for r in reply[node_b])
+
+    deadline = time.monotonic() + 30
+    t_cut = time.monotonic()
+    while time.monotonic() < deadline:
+        nodes = session.core.controller.call("list_nodes", _timeout=10)
+        if not nodes[node_b]["alive"]:
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("partitioned node was never declared dead")
+
+    # heal: the controller->node direction delivers the clear
+    session.core.controller.call("fault_inject", clear="cut",
+                                 node_id=node_b)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        nodes = session.core.controller.call("list_nodes", _timeout=10)
+        if nodes[node_b]["alive"]:
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("healed node never revived")
+    heal_ms = (time.monotonic() - t_cut) * 1000.0
+    assert heal_ms < 60000
+
+    # the runtime recorded the outage on its own heal path
+    from ray_tpu.util import metrics as metrics_mod
+
+    snap = metrics_mod.snapshot()
+    assert any(k.startswith("rtpu_recovery_ms") and "node_heal" in k
+               for k in snap), snap
+
+    # and the revived node takes work again
+    @ray_tpu.remote
+    def where():
+        from ray_tpu.runtime.core import get_core
+
+        return get_core().node_id
+
+    refs = [where.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=node_b)).remote()]
+    assert ray_tpu.get(refs, timeout=60) == [node_b]
+
+
+@pytest.fixture
+def two_host(tmp_path):
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    session = ray_tpu.init(num_cpus=2)
+    pool = str(tmp_path / "hostB_shm")
+    os.makedirs(pool, exist_ok=True)
+    node_b = session.add_node(
+        num_cpus=2,
+        env={"RTPU_HOST_ID": "chaos-host-b", "RTPU_SHM_ROOT": pool})
+    yield session, node_b, pool
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Stage:
+    def pid(self):
+        return os.getpid()
+
+    def echo(self, x):
+        return x
+
+    def scale(self, x):
+        return x * 2.0
+
+
+def _on(node_id):
+    return NodeAffinitySchedulingStrategy(node_id=node_id)
+
+
+def test_drill_node_death_mid_dag_step(two_host, cfg_guard):
+    """Kill the remote stage's worker process mid compiled-DAG steady
+    state: the in-flight step must surface a typed, DEADLINE-bounded
+    error at the driver (never a hang), teardown must stay bounded, and
+    the cluster must keep scheduling ordinary work afterwards."""
+    from ray_tpu.dag import InputNode
+
+    session, node_b, _ = two_host
+    # fail fast against the dead peer (connect + retry budgets)
+    cfg_guard.rpc_connect_timeout_s = 2.0
+    cfg_guard.rpc_retry_max = 1
+    a = Stage.options(scheduling_strategy=_on(session.node_id)).remote()
+    b = Stage.options(scheduling_strategy=_on(node_b)).remote()
+    b_pid = ray_tpu.get(b.pid.remote(), timeout=60)
+
+    with InputNode() as inp:
+        cdag = b.scale.bind(a.echo.bind(inp)).experimental_compile()
+    try:
+        arr = np.arange(1 << 14, dtype=np.float64)
+        np.testing.assert_array_equal(cdag.execute(arr).get(timeout=60),
+                                      arr * 2.0)
+        os.kill(b_pid, signal.SIGKILL)  # node B's stage dies mid-run
+        t0 = time.monotonic()
+        with pytest.raises((TimeoutError, exceptions.RtpuError,
+                            rpc_mod.RpcError)):
+            cdag.execute(arr).get(timeout=10)
+        assert time.monotonic() - t0 < 30  # typed error, bounded
+    finally:
+        t0 = time.monotonic()
+        cdag.teardown()
+        assert time.monotonic() - t0 < 60  # teardown bounded too
+
+    @ray_tpu.remote
+    def alive():
+        return 1
+
+    assert ray_tpu.get(alive.remote(), timeout=60) == 1
+
+
+def test_drill_ring_allreduce_rank_death(two_host, cfg_guard):
+    """Kill one rank's worker mid ring-allreduce: the surviving rank and
+    the driver converge on a typed error within the deadline instead of
+    the parked ring deadlocking the loop."""
+    from ray_tpu.dag import InputNode, MultiOutputNode, allreduce
+
+    session, node_b, _ = two_host
+    cfg_guard.rpc_connect_timeout_s = 2.0
+    cfg_guard.rpc_retry_max = 1
+    a = Stage.options(scheduling_strategy=_on(session.node_id)).remote()
+    b = Stage.options(scheduling_strategy=_on(node_b)).remote()
+    b_pid = ray_tpu.get(b.pid.remote(), timeout=60)
+
+    with InputNode() as inp:
+        ra, rb = allreduce.bind([a.echo.bind(inp), b.scale.bind(inp)],
+                                op="sum", topology="ring")
+        rdag = MultiOutputNode([ra, rb]).experimental_compile()
+    try:
+        x = np.ones(4096, dtype=np.float32)
+        va, vb = rdag.execute(x).get(timeout=60)
+        np.testing.assert_array_equal(va, x * 3.0)
+        os.kill(b_pid, signal.SIGKILL)  # rank 1 dies
+        t0 = time.monotonic()
+        with pytest.raises((TimeoutError, exceptions.RtpuError,
+                            rpc_mod.RpcError)):
+            rdag.execute(x).get(timeout=10)
+        assert time.monotonic() - t0 < 30
+    finally:
+        rdag.teardown()
+
+
+def test_drill_source_death_mid_pull_converges(two_host, cfg_guard):
+    """Prefill/source-node death mid cross-host pull (the KV-handoff
+    failure mode): the puller's replicas all die, the typed loss
+    triggers lineage reconstruction, and get() CONVERGES on the
+    recovered value within the deadline — zero lost objects."""
+    session, node_b, _ = two_host
+    # fail fast against the dead host: connect budget + retry budget
+    cfg_guard.rpc_connect_timeout_s = 2.0
+    cfg_guard.rpc_retry_max = 1
+
+    @ray_tpu.remote(max_retries=2)
+    def produce():
+        return np.full(6 << 20, 7, dtype=np.uint8)  # 6 MiB -> shm pool
+
+    ref = produce.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id=node_b, soft=True)).remote()
+    ready, _ = ray_tpu.wait([ref], timeout=90, fetch_local=False)
+    assert ready, "producer never finished"
+
+    # SIGKILL node B's nodelet: the only host holding the bytes is gone
+    proc = session._extra_nodelet_procs[-1]
+    proc.kill()
+    proc.wait(timeout=10)
+
+    t0 = time.monotonic()
+    value = ray_tpu.get(ref, timeout=120)
+    assert time.monotonic() - t0 < 90
+    assert value.shape == (6 << 20,) and int(value[0]) == 7
+
+
+def test_drill_spill_storm_30pct_drop(cluster, cfg_guard):
+    """30%-drop storm on the spill link: every frame the peer drops
+    times out at the sender and re-enters placement — all tasks
+    complete, none lost, and the drop counters prove the storm really
+    happened."""
+    session, add = cluster
+    cfg_guard.rpc_call_timeout_s = 3.0  # bounds each dropped hop
+    node_b = add(num_cpus=2)
+    # one DETERMINISTIC drop of the first spill frame of EACH kind (a
+    # burst may coalesce into submit_task_batch, so both need an nth=1
+    # rule or the "loss really happened" assert would ride on p=0.3)
+    reply = session.core.controller.call(
+        "fault_inject",
+        spec="stormd:drop(submit_task,nth=1);"
+             "stormb:drop(submit_task_batch,nth=1);"
+             "storm1:drop(submit_task,p=0.3,times=40);"
+             "storm2:drop(submit_task_batch,p=0.3,times=40)",
+        node_id=node_b)
+    assert any(r["name"] == "storm1" for r in reply[node_b])
+    # spills need node B in the head's gossiped view first
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and \
+            node_b not in session.nodelet_inproc.cluster_view:
+        time.sleep(0.05)
+    assert node_b in session.nodelet_inproc.cluster_view
+
+    @ray_tpu.remote
+    def work(i):
+        time.sleep(0.6)  # saturate the head so the burst must spill
+        return i * i
+
+    t0 = time.monotonic()
+    refs = [work.remote(i) for i in range(24)]
+    got = ray_tpu.get(refs, timeout=150)
+    assert got == [i * i for i in range(24)]  # zero lost tasks
+    assert time.monotonic() - t0 < 150
+    info = session.core.client_for(
+        _node_addr(session, node_b)).call("get_node_info", _timeout=10)
+    fired = sum(r["fired"] for r in info["faults"]
+                if r["name"].startswith("storm"))
+    seen = sum(r["seen"] for r in info["faults"]
+               if r["name"] in ("stormd", "stormb"))
+    assert seen >= 1, info["faults"]  # spill frames reached node B
+    assert fired >= 1, info["faults"]  # the storm actually dropped frames
+    session.core.controller.call("fault_inject", clear="*",
+                                 node_id=node_b)
+
+
+# --------------------------------------------- chan_push backpressure
+def test_chan_push_backpressure_is_typed_and_retried(tmp_path,
+                                                     monkeypatch,
+                                                     cfg_guard):
+    """PR-8 NOTE regression: a deliberately unread FULL ring must bound
+    the server-side chan_push wait (typed ChannelBackpressure within
+    chan_push_timeout_s, not an indefinite park of the consumer's RPC
+    dispatch), and the writer must ride the typed error with backoff —
+    draining the ring lets the parked write land; an undrained ring
+    surfaces the shm-ring TimeoutError at the writer's own deadline."""
+    from ray_tpu.runtime.channel import Channel, RemoteChannel
+    from ray_tpu.runtime.transfer import chan_handlers
+
+    monkeypatch.setenv("RTPU_SHM_ROOT", str(tmp_path))
+    cfg_guard.chan_push_timeout_s = 0.3
+    elt = EventLoopThread.get()
+    state: dict = {}
+    handlers = chan_handlers("chaosbp", "chaos-host", state, lambda: "")
+    server = RpcServer("tcp:127.0.0.1:0", handlers)
+    elt.run(server.start())
+    rpc_mod._local_servers.pop(server.address, None)
+    # endpoint=None: every frame takes the chan_push RPC fallback
+    w = RemoteChannel("chaosbp", "bp", None, server.address,
+                      item_size=1 << 12, num_slots=2)
+    r = Channel("chaosbp", "bp", item_size=1 << 12, num_slots=2)
+    try:
+        w.write(0, timeout=5)
+        w.write(1, timeout=5)  # ring full from here on
+        # unread full ring: the writer sees the typed backpressure,
+        # retries with backoff, and gives up at ITS deadline — bounded
+        # at both ends, with the server answering well inside it
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            w.write(2, timeout=1.0)
+        assert 0.9 < time.monotonic() - t0 < 10.0
+        # the timed-out frame stays queued (at-least-once replay, deduped
+        # by seq server-side); once the reader drains, the next flush
+        # lands it and everything arrives exactly once, in order
+        assert r.read(timeout=5) == 0
+        assert r.read(timeout=5) == 1
+        w.write(3, timeout=10.0)  # replays the parked 2, then sends 3
+        assert r.read(timeout=5) == 2
+        assert r.read(timeout=5) == 3
+        assert w.stats["rpc_frames"] >= 4
+    finally:
+        w.close()
+        r.unlink()
+        srv = state.get("server")
+        if srv is not None:
+            elt.run(srv.stop())
+        elt.run(server.stop())

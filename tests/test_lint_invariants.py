@@ -124,12 +124,13 @@ def test_cli_exit_codes(tmp_path):
 
 
 # ------------------------------------------------------------ tier-1 gate
-# Scanned paths. PR 7 gated runtime+serve only; the dag package joined
-# when the compiled-graph data plane went cross-host (its loop/teardown
-# code is exactly the concurrency-invariant surface the rules encode).
-# The rest of the package (client/tune/...) is still advisory-only: run
+# Scanned paths. PR 7 gated runtime+serve; PR 8 added dag; the client
+# link (client.py/client_proxy.py — its advisory RTPU006 findings are
+# now logged or reason-pragma'd) and the data package joined with the
+# fault-plane PR. Still advisory-only: tune/rllib/autoscaler — run
 # `python -m tools.rtpulint ray_tpu/` for the full list before widening.
-GATED_PATHS = ("runtime", "serve", "dag")
+GATED_PATHS = ("runtime", "serve", "dag", "data",
+               "client.py", "client_proxy.py")
 
 
 def test_runtime_and_serve_are_clean():
