@@ -295,27 +295,44 @@ def bench_pd_handoff() -> dict:
         f"pd_handoff produced no JSON: {out.stderr[-300:]}")
 
 
+def _run_bench_json(script: str, timeout: int, args: tuple = ()) -> dict:
+    """Run a benchmarks/<script> in a subprocess and return the last
+    JSON line it printed — the shared shape of every script-backed
+    bench tier."""
+    import os
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = subprocess.run(
+        [sys.executable, os.path.join(here, "benchmarks", script),
+         *args],
+        capture_output=True, text=True, timeout=timeout, cwd=here)
+    for line in reversed(out.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(
+        f"{script} produced no JSON: {out.stderr[-300:]}")
+
+
 def bench_dag() -> dict:
     """Compiled-graph cross-host data plane on the simulated two-host
     setup (benchmarks/dag_pipeline.py): steady-state per-step latency
     (`dag_step_us`, zero-RPC asserted), stage-handoff GB/s compiled vs
     the actor-RPC DAG path (`dag_handoff_gb_s` / `dag_handoff_gb_s_rpc`),
     and the cross-host ring allreduce with exactness check."""
-    import os
-    import subprocess
+    return _run_bench_json("dag_pipeline.py", 600,
+                           ("--size-mb", "4", "--steps", "16"))
 
-    here = os.path.dirname(os.path.abspath(__file__))
-    out = subprocess.run(
-        [sys.executable, os.path.join(here, "benchmarks",
-                                      "dag_pipeline.py"),
-         "--size-mb", "4", "--steps", "16"],
-        capture_output=True, text=True, timeout=600, cwd=here)
-    for line in reversed(out.stdout.strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            return json.loads(line)
-    raise RuntimeError(
-        f"dag_pipeline produced no JSON: {out.stderr[-300:]}")
+
+def bench_data_streaming() -> dict:
+    """Streaming data plane A/B (benchmarks/data_streaming.py):
+    time-to-first-batch streamed vs materialized (`data_ttfb_ms`,
+    >=5x bar), sustained `data_rows_per_s`, peak store fill
+    (`data_peak_store_frac` — queue-depth-bounded vs whole-dataset),
+    and two-consumer streaming_split throughput with exactly-once
+    coverage asserted in-bench."""
+    return _run_bench_json("data_streaming.py", 300)
 
 
 def bench_chaos_drill() -> dict:
@@ -324,20 +341,7 @@ def bench_chaos_drill() -> dict:
     under a live actor, then node death with placement failover) emits
     recovery_controller_ms / recovery_node_death_ms / chaos_drills_green
     so every round carries recovery time next to throughput."""
-    import os
-    import subprocess
-
-    here = os.path.dirname(os.path.abspath(__file__))
-    out = subprocess.run(
-        [sys.executable, os.path.join(here, "benchmarks",
-                                      "chaos_drill.py")],
-        capture_output=True, text=True, timeout=300, cwd=here)
-    for line in reversed(out.stdout.strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            return json.loads(line)
-    raise RuntimeError(
-        f"chaos_drill produced no JSON: {out.stderr[-300:]}")
+    return _run_bench_json("chaos_drill.py", 300)
 
 
 def bench_train(on_tpu: bool) -> dict:
@@ -489,6 +493,20 @@ def main():
         except Exception as e:  # noqa: BLE001
             result["detail"]["dag_pipeline"] = {"error": repr(e)[:200]}
 
+    # 7b. streaming data plane: time-to-first-batch streamed vs
+    # materialized, sustained rows/s, bounded peak store fill, and
+    # two-consumer streaming_split throughput (data_* keys), same guard
+    if time.perf_counter() - start < 475:
+        try:
+            stream = bench_data_streaming()
+            result["detail"]["data_streaming"] = stream
+            for key in ("data_rows_per_s", "data_ttfb_ms",
+                        "data_ttfb_speedup", "data_peak_store_frac"):
+                if key in stream:
+                    result["detail"][key] = stream[key]
+        except Exception as e:  # noqa: BLE001
+            result["detail"]["data_streaming"] = {"error": repr(e)[:200]}
+
     # 8. failure drill: controller restart + node death recovery times
     # (chaos_drill keys), same time guard — robustness alongside speed
     if time.perf_counter() - start < 480:
@@ -517,6 +535,8 @@ def main():
              _os.path.join(_repo, "ray_tpu", "serve"),
              _os.path.join(_repo, "ray_tpu", "dag"),
              _os.path.join(_repo, "ray_tpu", "data"),
+             _os.path.join(_repo, "ray_tpu", "train"),
+             _os.path.join(_repo, "ray_tpu", "tune"),
              _os.path.join(_repo, "ray_tpu", "client.py"),
              _os.path.join(_repo, "ray_tpu", "client_proxy.py")])
         _bad = sum(1 for f in _findings if not f.suppressed)

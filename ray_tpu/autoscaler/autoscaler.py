@@ -46,6 +46,11 @@ class Autoscaler:
         self._last_launch: Dict[str, float] = {}
         self._counts: Dict[str, int] = {t: 0 for t in self.node_types}
         self._node_type: Dict[str, str] = {}  # node_id -> type
+        self._launch_time: Dict[str, float] = {}  # instance -> launch ts
+        # how long a launched instance counts as in-flight supply while
+        # its hosts haven't joined; past this it stops gating launches
+        # (a create wedged in the cloud must not block scale-up forever)
+        self.boot_grace_s = 180.0
         self._idle_since: Dict[str, float] = {}
         self._draining: set = set()  # instances we already terminated
         self._stop = threading.Event()
@@ -96,7 +101,10 @@ class Autoscaler:
         # excludes PG-targeted demand the same way)
         demands += [p["resources"] for p in status.get("pending_actors", [])
                     if not p.get("placement_group_id")]
-        unmet = [(d, 1) for d in self._dedupe(demands)]
+        # rows are (demand, count, check_fit): check_fit=False opts the
+        # row out of the generic free-capacity suppression (slice gangs
+        # have same-slice affinity a per-node fit check can't see)
+        unmet = [(d, 1, True) for d in self._dedupe(demands)]
         # pending gangs are strategy-aware multi-node demand:
         # - STRICT_PACK needs ONE node fitting the bundle SUM;
         # - spread/pack gangs need one node PER bundle (multiplicity
@@ -112,21 +120,92 @@ class Autoscaler:
                 for b in bundles:
                     for k, v in b.items():
                         total[k] = total.get(k, 0.0) + v
-                unmet.append((total, 1))
+                unmet.append((total, 1, True))
             elif strategy == "SLICE_PACK":
-                for d in self._dedupe(bundles):
-                    unmet.append((d, 1))
+                # slice gangs need ALL bundles on ONE slice: suppress
+                # the launch only when an existing slice can pack the
+                # whole set — a random host fitting one bundle is not
+                # supply for this demand. ONE row per gang (the slice
+                # is the create unit; the provider fans out its hosts):
+                # per-deduped-bundle rows made a heterogeneous gang
+                # launch one slice PER distinct bundle shape.
+                if self._slice_fits(status, bundles):
+                    continue
+                gang_max: Dict[str, float] = {}
+                for b in bundles:
+                    for k, v in b.items():
+                        gang_max[k] = max(gang_max.get(k, 0.0), v)
+                unmet.append((gang_max, 1, False))
             else:
                 for d in self._dedupe(bundles):
-                    unmet.append((d, sum(1 for b in bundles if b == d)))
+                    unmet.append((d, sum(1 for b in bundles if b == d),
+                                  True))
+        # in-flight supply: instances we launched whose hosts have not
+        # joined the cluster yet still answer this demand (ref:
+        # resource_demand_scheduler counts pending nodes as supply).
+        # Without this, any boot slower than launch_cooldown_s
+        # double-launches for the same pending gang — the gang-launch
+        # test failed exactly so: two slices for one SLICE_PACK PG when
+        # the first slice's nodelets booted slowly. Joined-THEN-DIED
+        # nodes are not booting (dead nodes count as joined here), and
+        # a boot wedged past boot_grace_s stops gating — either way a
+        # node-death drill can still scale replacements.
         now = time.time()
-        for demand, count in unmet:
+        joined_hosts: Dict[str, int] = {}
+        for node_id, info in status.get("nodes", {}).items():
+            iid = node_id
+            if hasattr(self.provider, "instance_for"):
+                iid = self.provider.instance_for(
+                    node_id, info.get("labels", {}) or {}) or node_id
+            # dead nodes count as joined: a joined-then-died node is a
+            # replacement problem, not a boot in flight
+            joined_hosts[iid] = joined_hosts.get(iid, 0) + 1
+        booting: Dict[str, int] = {}
+        for iid, type_name in self._node_type.items():
+            expected = 1
+            if hasattr(self.provider, "expected_hosts"):
+                expected = self.provider.expected_hosts(iid)
+            if joined_hosts.get(iid, 0) >= expected:
+                continue  # fully joined (a HALF-joined slice is still
+                #           in flight: it cannot host its gang yet)
+            if iid in self._draining:
+                continue
+            # instances first seen via provider reconcile (not _launch)
+            # start their grace clock at first sight
+            if now - self._launch_time.setdefault(iid, now) \
+                    > self.boot_grace_s:
+                continue
+            booting[type_name] = booting.get(type_name, 0) + 1
+
+        # each booting instance answers ONE demand row (quantitative,
+        # like the reference's pending-node supply subtraction) — a
+        # boolean veto would serialize independent same-type gangs
+        # behind one slow boot
+        booting_left = dict(booting)
+        for demand, count, check_fit in unmet:
             if not any(v > 0 for v in demand.values()):
                 continue  # zero-resource requests fit anywhere already
+            if check_fit and self._fits_free_capacity(status, demand,
+                                                      count):
+                # supply already exists (e.g. a just-joined slice the
+                # scheduler hasn't placed the gang onto yet): launching
+                # again would double-scale for one demand
+                continue
             cfg = self._pick_type(demand)
-            if (cfg is None
-                    or now - self._last_launch.get(cfg.name, 0.0)
-                    < self.launch_cooldown_s):
+            if cfg is None:
+                continue
+            # in-flight boots answer demand UNITS, not whole rows: a
+            # 3-node gang with 1 instance booting still launches the
+            # other 2 now instead of waiting out the boot and then
+            # over-launching 3
+            absorbed = min(booting_left.get(cfg.name, 0), count)
+            if absorbed:
+                booting_left[cfg.name] -= absorbed
+                count -= absorbed
+            if count <= 0:
+                continue
+            if now - self._last_launch.get(cfg.name, 0.0) \
+                    < self.launch_cooldown_s:
                 continue
             for _ in range(count):
                 if self._counts[cfg.name] >= cfg.max_workers:
@@ -144,6 +223,8 @@ class Autoscaler:
                     1 for t in live.values() if t == type_name)
             self._node_type = {iid: t for iid, t in live.items()}
             self._draining &= set(live)  # terminated ones fell out
+            self._launch_time = {k: v for k, v in
+                                 self._launch_time.items() if k in live}
 
         # 4. idle autoscaled instances above min -> terminate after a
         # timeout. Cluster nodes group by owning provider instance (a
@@ -192,12 +273,73 @@ class Autoscaler:
 
     # ---------------------------------------------------------- helpers
 
+    @staticmethod
+    def _slice_fits(status: Dict, bundles: List[Dict[str, float]]) -> bool:
+        """True when one existing slice can host the gang EXACTLY the
+        way the scheduler places SLICE_PACK (scheduling.py): one bundle
+        per host, hosts filtered by the element-wise max demand, and
+        placement decided by the same topology.contiguous_hosts the
+        scheduler uses — launch suppression must never diverge from
+        what placement will actually do (greedy bundle packing here
+        claimed unplaceable gangs as placeable and suppressed the slice
+        launch forever)."""
+        from ..runtime.topology import slice_from_nodes
+
+        req_max: Dict[str, float] = {}
+        for b in bundles:
+            for k, v in b.items():
+                req_max[k] = max(req_max.get(k, 0.0), v)
+
+        class _Node:  # minimal shim over a cluster_status node snapshot
+            __slots__ = ("node_id", "labels", "total_resources")
+
+            def __init__(self, nid, info):
+                self.node_id = nid
+                self.labels = info.get("labels") or {}
+                self.total_resources = info.get("resources") or {}
+
+        feasible = []
+        for nid, info in status.get("nodes", {}).items():
+            if not info.get("alive", True):
+                continue
+            if not (info.get("labels") or {}).get("rtpu.slice"):
+                continue
+            avail = info.get("available_resources") or {}
+            if all(avail.get(k, 0.0) >= v
+                   for k, v in req_max.items() if v > 0):
+                feasible.append(_Node(nid, info))
+        for tslice in slice_from_nodes(feasible).values():
+            if tslice.contiguous_hosts(len(bundles)) is not None:
+                return True
+        return False
+
+    @staticmethod
+    def _fits_free_capacity(status: Dict, demand: Dict[str, float],
+                            count: int) -> bool:
+        """True when `count` alive nodes each have the free resources
+        for one unit of `demand` — the demand is placeable on what the
+        cluster ALREADY has, so it is not launch-worthy (ref:
+        resource_demand_scheduler bin-packs demand against current +
+        pending supply before requesting nodes)."""
+        fitting = 0
+        for info in status.get("nodes", {}).values():
+            if not info.get("alive", True):
+                continue
+            avail = info.get("available_resources") or {}
+            if all(avail.get(k, 0.0) >= v
+                   for k, v in demand.items() if v > 0):
+                fitting += 1
+                if fitting >= count:
+                    return True
+        return False
+
     def _launch(self, cfg: NodeTypeConfig) -> None:
         node_id = self.provider.create_node(cfg.name, cfg.resources,
                                             cfg.labels)
         self._counts[cfg.name] += 1
         self._last_launch[cfg.name] = time.time()
         self._node_type[node_id] = cfg.name
+        self._launch_time[node_id] = time.time()
         logger.info("autoscaler launched %s node %s", cfg.name, node_id[:8])
 
     def _pick_type(self, demand: Dict[str, float]

@@ -269,6 +269,17 @@ class GCPTPUNodeProvider(NodeProvider):
         return [i.instance_id for i in self.instances.by_status(
             REQUESTED, LAUNCHING, RUNNING, DRAINING)]
 
+    def expected_hosts(self, instance_id: str) -> int:
+        """How many cluster nodes this instance contributes once fully
+        up — the autoscaler counts the instance as in-flight supply
+        until ALL of them have joined (a half-joined slice can look
+        alive while it still cannot host its gang)."""
+        inst = self.instances.get(instance_id)
+        if inst is None:
+            return 1
+        spec = self.node_types.get(inst.node_type)
+        return max(1, spec.hosts if spec else 1)
+
     def _will_retry(self, inst: Instance) -> bool:
         if inst.cloud_id is not None:
             return True  # the delete is always reissued (never leak)

@@ -15,6 +15,9 @@ from .block import Block, BlockAccessor  # noqa: F401
 from .dataset import DataIterator, Dataset, GroupedData  # noqa: F401
 from .plan import InputData, LogicalPlan, Read
 from .executor import StreamingExecutor
+from .streaming import (  # noqa: F401
+    SplitCoordinator, StreamingTopology, StreamShardProvider,
+    StreamSplitDataIterator, stream_refs)
 
 
 def _from_read_tasks(tasks) -> Dataset:
@@ -180,7 +183,9 @@ def from_huggingface(hf_dataset) -> Dataset:
 
 __all__ = [
     "Block", "BlockAccessor", "DataIterator", "Dataset", "GroupedData",
-    "StreamingExecutor", "range", "range_tensor", "from_items", "from_numpy",
+    "StreamingExecutor", "StreamingTopology", "SplitCoordinator",
+    "StreamShardProvider", "StreamSplitDataIterator", "stream_refs",
+    "range", "range_tensor", "from_items", "from_numpy",
     "from_arrow", "from_pandas", "from_torch", "from_huggingface",
     "read_parquet", "read_csv", "read_json",
     "read_binary_files", "read_images",

@@ -31,6 +31,9 @@ class Dataset:
         self._plan = plan
         self._executor = executor or StreamingExecutor()
         self._cached_refs: Optional[List[Any]] = None
+        # stats of this dataset's most recent STREAMED iteration (None
+        # until one runs; cached/materialized iterations don't stream)
+        self._last_stream_stats: Optional[Dict[str, Any]] = None
 
     # ------------------------------------------------------------ transforms
     def _append(self, op) -> "Dataset":
@@ -241,10 +244,36 @@ class Dataset:
         return "\n".join(lines)
 
     # ----------------------------------------------------------- consumption
+    def _stream_block_refs(self) -> Iterator[Any]:
+        """Final block refs, streamed: already-materialized datasets
+        yield their cached refs; otherwise the plan runs on the pull-
+        based operator pipeline (data/streaming.py) so the first block
+        is available after ONE task's latency and peak store usage is
+        bounded by the per-operator queue depths. Iteration does NOT
+        cache refs (caching would pin the whole dataset and defeat the
+        bounded footprint); count()/materialize() still do."""
+        if self._cached_refs is not None:
+            yield from list(self._cached_refs)
+            return
+        from ..runtime.config import get_config
+
+        if not getattr(get_config(), "data_stream_enabled", True):
+            yield from self._execute()
+            return
+        from .streaming import stream_refs
+
+        stats: Dict[str, Any] = {}
+        try:
+            yield from stream_refs(compile_plan(self._plan),
+                                   executor=self._executor,
+                                   stats_out=stats)
+        finally:
+            self._last_stream_stats = stats or None
+
     def _iter_blocks(self) -> Iterator[Block]:
         import ray_tpu
 
-        for ref in self._execute():
+        for ref in self._stream_block_refs():
             yield ray_tpu.get(ref, timeout=600)
 
     def iter_rows(self) -> Iterator[Any]:
@@ -255,43 +284,22 @@ class Dataset:
                      batch_format: Optional[str] = None,
                      drop_last: bool = False) -> Iterator[Any]:
         """Re-chunk blocks into fixed-size batches (ref: DataIterator
-        iter_batches)."""
-        pending: List[Block] = []
-        pending_rows = 0
-        for block in self._iter_blocks():
-            acc = BlockAccessor(block)
-            if acc.num_rows() == 0:
-                continue
-            pending.append(block)
-            pending_rows += acc.num_rows()
-            while pending_rows >= batch_size:
-                merged = BlockAccessor.merge(pending)
-                macc = BlockAccessor(merged)
-                batch = macc.slice(0, batch_size)
-                rest = macc.slice(batch_size, macc.num_rows())
-                yield BlockAccessor(batch).to_batch(batch_format)
-                pending = [rest]
-                pending_rows = BlockAccessor(rest).num_rows()
-        if pending_rows > 0 and not drop_last:
-            merged = BlockAccessor.merge(pending)
-            if BlockAccessor(merged).num_rows():
-                yield BlockAccessor(merged).to_batch(batch_format)
+        iter_batches). Blocks arrive streamed (`_iter_blocks`), so the
+        first batch yields while upstream tasks still run."""
+        return batches_from_blocks(self._iter_blocks(),
+                                   batch_size=batch_size,
+                                   batch_format=batch_format,
+                                   drop_last=drop_last)
 
     def iter_jax_batches(self, *, batch_size: int = 256,
                          drop_last: bool = True,
                          sharding=None) -> Iterator[Dict[str, Any]]:
         """TPU ingest: numpy batches device_put onto `sharding` if given
         (the reference's iter_torch_batches analogue, TPU-first)."""
-        import jax
-
-        for batch in self.iter_batches(batch_size=batch_size,
-                                       batch_format="numpy",
-                                       drop_last=drop_last):
-            if sharding is not None:
-                yield {k: jax.device_put(v, sharding)
-                       for k, v in batch.items()}
-            else:
-                yield {k: jax.numpy.asarray(v) for k, v in batch.items()}
+        return jax_batches(self.iter_batches(batch_size=batch_size,
+                                             batch_format="numpy",
+                                             drop_last=drop_last),
+                           sharding=sharding)
 
     def iter_torch_batches(self, *, batch_size: int = 256,
                            drop_last: bool = False,
@@ -300,22 +308,10 @@ class Dataset:
         """Torch-tensor batches (ref: data/iterator.py
         iter_torch_batches) — interop for torch-side consumers; TPU
         training uses iter_jax_batches."""
-        import torch
-
-        for batch in self.iter_batches(batch_size=batch_size,
-                                       batch_format="numpy",
-                                       drop_last=drop_last):
-            out = {}
-            for k, v in batch.items():
-                t = torch.as_tensor(np.ascontiguousarray(v))
-                if dtypes is not None:
-                    want = dtypes.get(k) if isinstance(dtypes, dict)                         else dtypes
-                    if want is not None:
-                        t = t.to(want)
-                if device:
-                    t = t.to(device)
-                out[k] = t
-            yield out
+        return torch_batches(self.iter_batches(batch_size=batch_size,
+                                               batch_format="numpy",
+                                               drop_last=drop_last),
+                             dtypes=dtypes, device=device)
 
     def take(self, n: int = 20) -> List[Any]:
         out = []
@@ -559,10 +555,22 @@ class Dataset:
         return self.split_at_indices(indices)
 
     def streaming_split(self, n: int, *, equal: bool = False,
-                        locality_hints=None) -> List["DataIterator"]:
-        """n iterators over disjoint shards (ref: dataset.py
-        streaming_split for train ingest)."""
-        return [DataIterator(ds) for ds in self.split(n, equal=equal)]
+                        locality_hints=None):
+        """n iterators over disjoint shards served by one split-
+        coordinator actor (ref: dataset.py streaming_split for train
+        ingest). The plan executes ONCE, streamed; consumers pull
+        concurrently with per-epoch barriers and exactly-once delivery,
+        and a consumer that dies mid-epoch has its blocks redistributed
+        to the survivors (see data/streaming.py SplitCoordinator).
+        Consumers MUST pull concurrently: a peer silent past
+        `split_consumer_timeout_s` (including one that never starts) is
+        evicted, so draining the iterators sequentially hands the first
+        consumer the whole dataset after that timeout. Keep at least
+        one returned iterator referenced on the driver: they share the
+        coordinator's owning handle."""
+        from .streaming import split_iterators
+
+        return split_iterators(self, n, equal=equal)
 
     def iterator(self) -> "DataIterator":
         return DataIterator(self)
@@ -603,6 +611,70 @@ class Dataset:
 
     def __repr__(self):
         return f"Dataset(plan={self._plan.describe()})"
+
+
+def batches_from_blocks(blocks: Iterable[Block], *, batch_size: int = 256,
+                        batch_format: Optional[str] = None,
+                        drop_last: bool = False) -> Iterator[Any]:
+    """Re-chunk a (possibly streaming) block iterator into fixed-size
+    batches — shared by Dataset.iter_batches and the streaming_split
+    consumer iterators."""
+    pending: List[Block] = []
+    pending_rows = 0
+    for block in blocks:
+        acc = BlockAccessor(block)
+        if acc.num_rows() == 0:
+            continue
+        pending.append(block)
+        pending_rows += acc.num_rows()
+        while pending_rows >= batch_size:
+            merged = BlockAccessor.merge(pending)
+            macc = BlockAccessor(merged)
+            batch = macc.slice(0, batch_size)
+            rest = macc.slice(batch_size, macc.num_rows())
+            yield BlockAccessor(batch).to_batch(batch_format)
+            pending = [rest]
+            pending_rows = BlockAccessor(rest).num_rows()
+    if pending_rows > 0 and not drop_last:
+        merged = BlockAccessor.merge(pending)
+        if BlockAccessor(merged).num_rows():
+            yield BlockAccessor(merged).to_batch(batch_format)
+
+
+def jax_batches(batches: Iterable[Dict[str, Any]],
+                *, sharding=None) -> Iterator[Dict[str, Any]]:
+    """numpy batches -> jax arrays (device_put onto `sharding` if
+    given) — shared by Dataset and the streaming_split iterators."""
+    import jax
+
+    for batch in batches:
+        if sharding is not None:
+            yield {k: jax.device_put(v, sharding)
+                   for k, v in batch.items()}
+        else:
+            yield {k: jax.numpy.asarray(v) for k, v in batch.items()}
+
+
+def torch_batches(batches: Iterable[Dict[str, Any]], *,
+                  dtypes=None,
+                  device: Optional[str] = None) -> Iterator[Dict[str, Any]]:
+    """numpy batches -> torch tensors (per-column `dtypes` dict or one
+    dtype for all) — shared by Dataset and the streaming_split
+    iterators."""
+    import torch
+
+    for batch in batches:
+        out = {}
+        for k, v in batch.items():
+            t = torch.as_tensor(np.ascontiguousarray(v))
+            if dtypes is not None:
+                want = dtypes.get(k) if isinstance(dtypes, dict) else dtypes
+                if want is not None:
+                    t = t.to(want)
+            if device:
+                t = t.to(device)
+            out[k] = t
+        yield out
 
 
 def _count_block(block: Block) -> int:

@@ -1,0 +1,1081 @@
+"""Streaming data plane: pull-based physical operator pipeline.
+
+ref: python/ray/data/_internal/execution/streaming_executor_state.py —
+the reference compiles the logical plan into a topology of physical
+operators, each owning a bounded output queue of block refs, and a
+scheduling loop advances whichever operator has input AND downstream
+credit. This module reproduces that contract on the ray_tpu task
+runtime:
+
+- every physical operator (`_SourceOp`, `_MapOp`, `_LimitOp`) owns a
+  bounded output queue (``data_stream_queue_depth`` blocks) and may only
+  launch new tasks while it has credit — so ``iter_batches`` yields
+  batch 1 while upstream map tasks for block 200 are still running, and
+  peak object-store footprint is proportional to the queue depths, not
+  the dataset size;
+- barrier stages (all-to-all, join, zip, union) compile to a
+  `_BarrierOp` that collects its whole input and delegates to the
+  legacy ``StreamingExecutor`` machinery — a shuffle is a genuine
+  barrier, but the map prefix streams INTO it and the suffix streams
+  OUT of it;
+- the pump is pull-driven: the consumer's ``next()`` is what advances
+  the topology, so an idle consumer launches nothing and a slow one
+  backpressures the whole pipeline down to the source;
+- map tasks ride the normal ``.remote()`` path, so the PR-6 owner-side
+  ``arg_locs`` threading applies unchanged: a map task chases the node
+  holding its input block's bytes (tasks-to-the-bytes).
+
+On top of the pipeline, :class:`SplitCoordinator` (an actor) backs
+``Dataset.streaming_split(n, equal=)``: the plan executes ONCE as a
+stream inside the coordinator and disjoint block shards are served to n
+concurrent consumers with per-epoch barriers, exactly-once delivery per
+epoch, and redistribution of a dead consumer's blocks to the survivors
+(elastic Train ingest — a worker killed by a PR-10 chaos rule mid-epoch
+must not lose its shard).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from .block import Block, BlockAccessor
+
+# stats of the most recent completed stream in this process (bench and
+# test introspection; the per-dataset copy lives on Dataset._last_stream_stats)
+LAST_STATS: Optional[dict] = None
+
+
+def _cfg():
+    from ..runtime.config import get_config
+
+    return get_config()
+
+
+def _queue_depth() -> int:
+    try:
+        return max(1, int(getattr(_cfg(), "data_stream_queue_depth", 4)))
+    except Exception:  # rtpulint: ignore[RTPU006] — config not initialized in bare unit tests; the default depth is always safe
+        return 4
+
+
+_REMOTES: Dict[Any, Any] = {}
+
+
+def _remote(fn):
+    """Cache RemoteFunction wrappers so repeat launches reuse the PR-3
+    spec-template fast path instead of rebuilding it per block."""
+    import ray_tpu
+
+    r = _REMOTES.get(fn)
+    if r is None:
+        r = _REMOTES[fn] = ray_tpu.remote(fn)
+    return r
+
+
+def _split_block_even(block: Block, n: int):
+    """Partition one block's rows into n even, order-preserving slices
+    (the ``equal=True`` unit of streaming_split: every consumer gets
+    1/n of EVERY block, so shard sizes differ by at most one row per
+    block)."""
+    acc = BlockAccessor(block)
+    rows = acc.num_rows()
+    out = tuple(acc.slice((i * rows) // n, ((i + 1) * rows) // n)
+                for i in range(n))
+    return out if n > 1 else out[0]
+
+
+# ------------------------------------------------------------ physical ops
+class _PhysOp:
+    """One physical operator: bounded output queue + in-order emission.
+
+    ``depth`` bounds inbox + in-flight + buffered output, so the
+    operator's store footprint is depth-proportional; ``outq`` holds
+    completed refs in input order (completion order is nondeterministic,
+    emission order is not — the streamed block sequence must match the
+    materialized path's)."""
+
+    barrier = False
+
+    def __init__(self, name: str, depth: int):
+        self.name = name
+        self.depth = max(1, depth)
+        self.inbox: collections.deque = collections.deque()
+        self.running: Dict[Any, int] = {}     # task ref -> output seq
+        self.done_buf: Dict[int, Any] = {}    # seq -> completed ref
+        self.outq: collections.deque = collections.deque()
+        self._in_seq = 0
+        self._emit_seq = 0
+        self.upstream_done = False
+        self.closed = False  # a satisfied downstream limit cut us off
+        self.launched = 0
+
+    def occupancy(self) -> int:
+        return len(self.running) + len(self.done_buf) + len(self.outq)
+
+    def has_credit(self) -> bool:
+        return len(self.inbox) + self.occupancy() < self.depth
+
+    def accept(self, ref: Any) -> None:
+        if not self.closed:
+            self.inbox.append(ref)
+
+    def exhausted(self) -> bool:
+        if self.closed:
+            return True
+        return (self.upstream_done and not self.inbox and not self.running
+                and not self.done_buf and not self.outq)
+
+    def launch(self) -> bool:
+        raise NotImplementedError
+
+    def on_ready(self, task_ref: Any) -> None:
+        seq = self.running.pop(task_ref)
+        self.done_buf[seq] = task_ref
+        self._drain()
+
+    def _emit(self, ref: Any) -> None:
+        self.done_buf[self._in_seq] = ref
+        self._in_seq += 1
+        self._drain()
+
+    def _track(self, task_ref: Any) -> None:
+        self.running[task_ref] = self._in_seq
+        self._in_seq += 1
+        self.launched += 1
+
+    def _drain(self) -> None:
+        while self._emit_seq in self.done_buf:
+            self.outq.append(self.done_buf.pop(self._emit_seq))
+            self._emit_seq += 1
+
+
+class _SourceOp(_PhysOp):
+    """Read tasks / pre-materialized input blocks, launched under credit
+    — the source only reads as fast as downstream drains."""
+
+    def __init__(self, stage, depth: int):
+        super().__init__("Source", depth)
+        self.upstream_done = True
+        self._reads = collections.deque(stage.read_tasks or [])
+        self._blocks = collections.deque(
+            stage.blocks if stage.blocks is not None else [])
+
+    def launch(self) -> bool:
+        import ray_tpu
+
+        from .executor import _read_task
+
+        progressed = False
+        while self._blocks and self.occupancy() < self.depth:
+            b = self._blocks.popleft()
+            self._emit(b if isinstance(b, ray_tpu.ObjectRef)
+                       else ray_tpu.put(b))
+            progressed = True
+        while self._reads and self.occupancy() < self.depth:
+            task = self._reads.popleft()
+            self._track(_remote(_read_task).remote(task))
+            progressed = True
+        return progressed
+
+    def exhausted(self) -> bool:
+        return (super().exhausted()
+                and not self._reads and not self._blocks)
+
+
+class _MapOp(_PhysOp):
+    """A fused chain of per-block transforms: one task per input block,
+    launched the moment input + credit exist."""
+
+    def __init__(self, stage, depth: int):
+        super().__init__(stage.name or "Map", depth)
+        self._fns = stage.fns
+
+    def launch(self) -> bool:
+        from .executor import _apply_chain
+
+        progressed = False
+        while self.inbox and self.occupancy() < self.depth:
+            in_ref = self.inbox.popleft()
+            self._track(_remote(_apply_chain).remote(self._fns, in_ref))
+            progressed = True
+        return progressed
+
+
+class _LimitOp(_PhysOp):
+    """Streaming row-count cutoff. Row counts come from tiny remote
+    `_count_block` tasks — the block's BYTES never move to the pump
+    process (a limit over large tensor blocks must not pull payloads
+    into the driver/coordinator just to read num_rows). Decisions are
+    strictly serial in input order because `taken` accumulates in
+    stream order; upstream still runs ahead into the bounded inbox, and
+    satisfaction closes every upstream operator."""
+
+    def __init__(self, stage, depth: int):
+        super().__init__("Limit", depth)
+        self._n = stage.n
+        self._taken = 0
+        self._pending_block = None  # block ref awaiting its count
+        self._mode: Optional[str] = None  # "count" | "slice"
+
+    @property
+    def satisfied(self) -> bool:
+        return self._taken >= self._n
+
+    def launch(self) -> bool:
+        from .dataset import _count_block
+
+        if self.satisfied:
+            self.inbox.clear()
+            if not self.running:
+                self.upstream_done = True
+            return False
+        if self.running or not self.inbox:
+            return False  # serial: one count/slice decision at a time
+        ref = self.inbox.popleft()
+        self._pending_block = ref
+        self._mode = "count"
+        self._track(_remote(_count_block).remote(ref))
+        return True
+
+    def on_ready(self, task_ref: Any) -> None:
+        import ray_tpu
+
+        from .dataset import _slice_block
+
+        seq = self.running.pop(task_ref)
+        if self._mode == "count":
+            rows = int(ray_tpu.get(task_ref, timeout=60))  # tiny, ready
+            block_ref = self._pending_block
+            self._pending_block = None
+            if self._taken + rows <= self._n:
+                self._taken += rows
+                self.done_buf[seq] = block_ref
+                self._drain()
+                self._mode = None
+            else:
+                # launch() never counts past satisfaction, so the
+                # remainder is always >= 1 rows of this block
+                remaining = self._n - self._taken
+                self._taken = self._n
+                self._mode = "slice"
+                slice_ref = _remote(_slice_block).remote(
+                    block_ref, 0, remaining)
+                self.running[slice_ref] = seq  # same output slot
+                self.launched += 1
+        else:  # the boundary slice landed
+            self.done_buf[seq] = task_ref
+            self._drain()
+            self._mode = None
+        if self.satisfied and not self.running:
+            self.inbox.clear()
+            self.upstream_done = True
+
+
+class _BarrierOp(_PhysOp):
+    """All-to-all / join / zip / union: collects the full upstream
+    output (a barrier inherently materializes its input set) and runs
+    the legacy executor stage, then streams the result refs out."""
+
+    barrier = True
+
+    def __init__(self, stage, executor, depth: int):
+        super().__init__(type(stage).__name__.replace("Stage", ""), depth)
+        self._stage = stage
+        self._executor = executor
+        self._collected: List[Any] = []
+        self._ran = False
+
+    def has_credit(self) -> bool:
+        return not self.closed  # unbounded inbox: the barrier is the buffer
+
+    def launch(self) -> bool:
+        progressed = False
+        while self.inbox:
+            self._collected.append(self.inbox.popleft())
+            progressed = True
+        if self.upstream_done and not self._ran:
+            self._ran = True
+            self.outq.extend(self._run(self._collected))
+            self._collected = []
+            progressed = True
+        return progressed
+
+    def exhausted(self) -> bool:
+        return self.closed or (self.upstream_done and self._ran
+                               and not self.outq)
+
+    def _run(self, refs: List[Any]) -> List[Any]:
+        from .executor import _compile
+        from .plan import AllToAllStage, JoinStage, UnionStage, ZipStage
+
+        ex = self._executor
+        st = self._stage
+        if isinstance(st, AllToAllStage):
+            return ex._run_all_to_all(st, refs)
+        if isinstance(st, JoinStage):
+            return ex._run_join(st, refs)
+        if isinstance(st, ZipStage):
+            return ex._run_zip(st, refs)
+        if isinstance(st, UnionStage):
+            out = list(refs)
+            for other in st.others:
+                out += ex.execute(_compile(other))
+            return out
+        raise TypeError(f"unknown barrier stage {st}")
+
+
+# --------------------------------------------------------------- topology
+class StreamingTopology:
+    """Compiled stages -> physical operator pipeline + pull-based pump.
+
+    ``advance()`` is the scheduling loop body (ref:
+    streaming_executor_state.py select_operator_to_run): move completed
+    refs downstream where credit exists, launch every operator with
+    input + credit, then wait on in-flight tasks until the SINK has
+    output. It is only ever called from the consumer's pull, so the
+    consumer's pace bounds the pipeline's store footprint."""
+
+    def __init__(self, stages: List[Any], executor=None,
+                 queue_depth: Optional[int] = None):
+        from .executor import StreamingExecutor
+        from .plan import LimitStage, MapStage, SourceStage
+
+        self.executor = executor or StreamingExecutor()
+        depth = queue_depth or _queue_depth()
+        ops: List[_PhysOp] = []
+        for st in stages:
+            if isinstance(st, SourceStage):
+                ops.append(_SourceOp(st, depth))
+            elif isinstance(st, MapStage):
+                ops.append(_MapOp(st, depth))
+            elif isinstance(st, LimitStage):
+                ops.append(_LimitOp(st, depth))
+            else:
+                ops.append(_BarrierOp(st, self.executor, depth))
+        if not ops or not isinstance(ops[0], _SourceOp):
+            raise ValueError("plan must start with a source stage")
+        self.ops = ops
+        self.queue_depth = depth
+        self.stats = {"peak_in_flight_blocks": 0, "peak_store_frac": 0.0,
+                      "blocks_out": 0, "tasks_launched": 0,
+                      "tasks_completed": 0, "advances": 0}
+
+    # ------------------------------------------------------------- pump
+    def done(self) -> bool:
+        return self.ops[-1].exhausted()
+
+    def _propagate(self) -> None:
+        for i in range(len(self.ops) - 1):
+            up, down = self.ops[i], self.ops[i + 1]
+            while up.outq and down.has_credit() and not down.closed:
+                down.accept(up.outq.popleft())
+            if down.closed:
+                up.outq.clear()
+            if up.exhausted():
+                down.upstream_done = True
+
+    def _close_upstream_of(self, idx: int) -> None:
+        for op in self.ops[:idx]:
+            op.closed = True
+
+    def _note_pressure(self) -> None:
+        from .executor import _store_used_fraction
+
+        in_flight = sum(op.occupancy() + len(op.inbox)
+                        for op in self.ops if not op.barrier)
+        if in_flight > self.stats["peak_in_flight_blocks"]:
+            self.stats["peak_in_flight_blocks"] = in_flight
+        frac = _store_used_fraction()
+        if frac > self.stats["peak_store_frac"]:
+            self.stats["peak_store_frac"] = frac
+        self.stats["tasks_launched"] = sum(op.launched for op in self.ops)
+
+    def advance(self, wait_s: float = 30.0) -> List[Any]:
+        """Pump until the sink has output (or `wait_s` of task-waiting
+        is spent); returns the newly-ready sink refs in stream order."""
+        import ray_tpu
+
+        sink = self.ops[-1]
+        deadline = time.monotonic() + max(wait_s, 0.0)
+        self.stats["advances"] += 1
+        while True:
+            progressed = False
+            self._propagate()
+            for i, op in enumerate(self.ops):
+                if op.closed:
+                    continue
+                if op.launch():
+                    progressed = True
+                if getattr(op, "satisfied", False):
+                    self._close_upstream_of(i)
+            self._propagate()
+            self._note_pressure()
+            if sink.outq:
+                out = list(sink.outq)
+                sink.outq.clear()
+                self.stats["blocks_out"] += len(out)
+                return out
+            if self.done():
+                return []
+            waitable = [r for op in self.ops if not op.closed
+                        for r in op.running]
+            remain = deadline - time.monotonic()
+            if not waitable:
+                if progressed:
+                    continue
+                raise RuntimeError(
+                    "streaming pump stalled: no runnable work and no "
+                    f"in-flight tasks (ops={[op.name for op in self.ops]})")
+            if remain <= 0:
+                return []
+            ready, _ = ray_tpu.wait(waitable, num_returns=1,
+                                    timeout=min(remain, 5.0),
+                                    fetch_local=False)
+            if ready:
+                # task completion IS progress: the deadline bounds a
+                # genuine stall, not total pipeline wall time (a long
+                # map prefix feeding a barrier may take many times
+                # wait_s before the sink emits anything)
+                deadline = time.monotonic() + max(wait_s, 0.0)
+                self.stats["tasks_completed"] += len(ready)
+            owner = {r: op for op in self.ops for r in op.running}
+            for r in ready:
+                owner[r].on_ready(r)
+
+    def close(self) -> None:
+        """Drop every buffered/in-flight ref so refcounting can release
+        the blocks (an abandoned iterator must not pin the pipeline)."""
+        for op in self.ops:
+            op.inbox.clear()
+            op.running.clear()
+            op.done_buf.clear()
+            op.outq.clear()
+            op.closed = True
+
+
+def stream_refs(stages: List[Any], executor=None,
+                queue_depth: Optional[int] = None,
+                stats_out: Optional[dict] = None) -> Iterator[Any]:
+    """Generator over the pipeline's final block refs, in order, pumping
+    lazily on each pull — time-to-first-block is one task's latency, not
+    the whole plan's."""
+    global LAST_STATS
+
+    topo = StreamingTopology(stages, executor=executor,
+                             queue_depth=queue_depth)
+    wait_s = float(getattr(_cfg(), "data_stream_wait_s", 300.0))
+    try:
+        while not topo.done():
+            got = topo.advance(wait_s=wait_s)
+            if not got and not topo.done():
+                raise TimeoutError(
+                    f"streaming pump made no progress for {wait_s}s "
+                    f"(ops={[op.name for op in topo.ops]})")
+            for ref in got:
+                yield ref
+    finally:
+        if stats_out is not None:
+            stats_out.update(topo.stats)
+        LAST_STATS = dict(topo.stats)
+        topo.close()
+
+
+# sentinel: the stream is alive but produced nothing within this slice
+# of pumping — callers answer {'wait'} so consumer polls keep flowing
+_PENDING = object()
+
+
+# ------------------------------------------------------- split coordinator
+class SplitCoordinator:
+    """Actor: one streamed plan execution, n disjoint consumers.
+
+    ref: python/ray/data/_internal/execution/streaming_executor -> the
+    reference's SplitCoordinator behind streaming_split. Contract:
+
+    - the dataset's plan executes ONCE (streamed, bounded queues); block
+      refs are cached as they arrive so later epochs replay without
+      re-executing;
+    - per-epoch barrier: an epoch begins only when every live consumer
+      has asked for it (``begin_epoch``), so Train workers step epochs
+      in lockstep;
+    - ``equal=False``: consumers pull whole blocks off one shared queue
+      (dynamic load balancing, disjoint by construction);
+      ``equal=True``: every block is split into one even slice per live
+      consumer (shards differ by at most one row per block);
+    - exactly-once per epoch: each block (or slice) is delivered to
+      exactly one live consumer. A consumer that stops pulling for
+      ``split_consumer_timeout_s`` while the epoch cannot otherwise
+      complete is declared dead and EVERY block delivered to it this
+      epoch is redistributed to the survivors — a worker killed by a
+      PR-10 chaos rule mid-epoch loses its progress, not its shard.
+    """
+
+    def __init__(self, ds, world: int = 0, equal: bool = False,
+                 consumer_timeout_s: Optional[float] = None):
+        self._ds = ds
+        self._world = int(world)
+        self._equal = bool(equal)
+        self._timeout = float(
+            consumer_timeout_s
+            or getattr(_cfg(), "split_consumer_timeout_s", 15.0))
+        self._members: set = set()
+        self._dead: set = set()
+        self._last_seen: Dict[int, float] = {}
+        # epoch machinery
+        self._epoch = -1
+        self._serving = False
+        self._wanted: set = set()
+        self._joined: set = set()
+        self._finished: set = set()
+        self._revive: set = set()  # evicted ranks asking to rejoin
+        self._barrier_t0: Optional[float] = None
+        # one plan execution, cached for replay. A dataset that already
+        # materialized (count()/materialize() populated _cached_refs)
+        # seeds the cache directly: re-executing the plan would both
+        # waste the work AND, for unseeded nondeterministic stages,
+        # serve different rows than the caller already observed.
+        self._cache: List[Any] = []
+        self._cache_done = False
+        cached = getattr(ds, "_cached_refs", None)
+        if cached is not None:
+            self._cache = list(cached)
+            self._cache_done = True
+        self._topo: Optional[StreamingTopology] = None
+        self._stalled_s = 0.0
+        self._cursor = 0
+        # serving queues
+        self._shared: collections.deque = collections.deque()
+        self._pending: Dict[int, collections.deque] = {}
+        self._respill: collections.deque = collections.deque()
+        self._delivered: Dict[int, List[Any]] = {}
+        # ranks currently parked at the drained tail (epoch-completion
+        # rendezvous: eof commits only when EVERY live unfinished rank
+        # is here at once — otherwise a consumer that dies with
+        # delivered blocks could strand them after survivors left)
+        self._tail_seen: set = set()
+
+    # ---------------------------------------------------------- membership
+    def register(self, rank: int, world: int):
+        """A consumer joins. A re-registration of a live/dead rank or a
+        changed world size means a new worker-group attempt (elastic
+        restart): membership and epoch state reset; the block cache
+        survives, so the new generation replays without re-executing."""
+        rank, world = int(rank), int(world)
+        if (world != self._world and self._world != 0) \
+                or rank in self._members:
+            self._members = set()
+            self._dead = set()
+            self._revive = set()
+            self._epoch = -1
+            self._serving = False
+            self._wanted = set()
+            self._joined = set()
+            self._finished = set()
+            self._barrier_t0 = None
+            # stale pre-reset timestamps would instantly evict the new
+            # generation's slower registrants at the first barrier
+            self._last_seen = {}
+            self._reset_epoch_state()
+        self._world = world
+        if rank in self._dead:
+            # evicted before it ever registered this generation (slow
+            # spawn / long compile past the barrier timeout): a LATE
+            # ARRIVAL, not a restart — it rejoins at the next epoch
+            # boundary; resetting the generation here would evict the
+            # healthy survivors mid-epoch
+            self._revive.add(rank)
+        else:
+            self._members.add(rank)
+        self._last_seen[rank] = time.monotonic()
+        return {"world": self._world, "epoch": self._epoch}
+
+    def _live(self) -> set:
+        return self._members - self._dead
+
+    def _reset_epoch_state(self) -> None:
+        self._shared.clear()
+        self._respill.clear()
+        self._pending = {}
+        self._delivered = {}
+        self._tail_seen = set()
+        self._cursor = 0
+
+    # --------------------------------------------------------------- epochs
+    def begin_epoch(self, rank: int):
+        """Per-epoch barrier: returns {'epoch': e} once every live
+        consumer has requested it, {'wait': True} meanwhile. A consumer
+        silent past the timeout while the barrier waits is evicted so
+        survivors are never wedged on a corpse. An evicted-but-ALIVE
+        consumer (early epoch exit, transient stall) is re-admitted
+        here at the next epoch boundary — eviction is an epoch-level
+        verdict, not a death sentence for a live training worker."""
+        rank = int(rank)
+        now = time.monotonic()
+        self._last_seen[rank] = now
+        if rank not in self._members and rank not in self._dead:
+            return {"evicted": True}  # never registered
+        if self._serving and rank in self._joined \
+                and rank not in self._finished \
+                and rank not in self._dead:
+            return {"epoch": self._epoch}  # duplicate call mid-epoch
+        if rank in self._dead:
+            self._revive.add(rank)  # rejoin takes effect at the boundary
+        self._wanted.add(rank)
+        if self._barrier_t0 is None:
+            self._barrier_t0 = now
+        # the barrier expects EVERY rank of the split (0..world-1) that
+        # isn't dead (plus revival requesters), not just whoever
+        # registered first — a fast consumer must not open the epoch
+        # alone and drain it before its peers even arrive. A rank that
+        # never shows (or goes silent) within the timeout is declared
+        # dead so survivors are never wedged on a corpse.
+        def expected():
+            return ((set(range(max(self._world, 1))) - self._dead)
+                    | self._revive)
+
+        for r in list(expected() - self._wanted):
+            if now - self._last_seen.get(r, self._barrier_t0) \
+                    > self._timeout:
+                self._evict(r)
+        if expected() - self._wanted:
+            return {"wait": True}
+        if self._serving and (self._live() - self._finished):
+            return {"wait": True}  # current epoch still mid-flight
+        # boundary: apply revivals, then open the next epoch
+        self._members |= self._revive
+        self._dead -= self._revive
+        self._revive = set()
+        self._epoch += 1
+        self._serving = True
+        self._joined = set(self._wanted)
+        self._wanted = set()
+        self._finished = set()
+        self._barrier_t0 = None
+        self._reset_epoch_state()
+        return {"epoch": self._epoch}
+
+    # ---------------------------------------------------------------- pull
+    def next_block(self, rank: int, epoch: int):
+        """Next block (ref) for this consumer, or {'wait'} / {'eof'}.
+        The pull is what advances the stream: no consumer demand, no
+        task launches."""
+        rank = int(rank)
+        now = time.monotonic()
+        self._last_seen[rank] = now
+        if rank in self._dead or rank not in self._members:
+            return {"evicted": True}
+        if not self._serving or int(epoch) != self._epoch:
+            return {"wait": True}
+        if rank in self._finished:
+            return {"eof": True}
+        self._refill(rank)
+        ref = self._pick(rank)
+        if ref is None:
+            # starved while the epoch has work elsewhere: a silent peer
+            # may be what blocks us (equal mode: its backlog exhausts
+            # the refill cap while the source is NOT yet drained — the
+            # drained-tail branch below would never run). Evict it and
+            # retry the pick so its requeued blocks flow immediately.
+            # Shared mode with an undrained source is just a slow
+            # pipeline — no peer is blocking, so nobody is evicted.
+            if (self._equal or self._supply_drained()) \
+                    and self._evict_stalled(now):
+                self._refill(rank)
+                ref = self._pick(rank)
+        if ref is not None:
+            self._tail_seen.discard(rank)
+            self._delivered.setdefault(rank, []).append(ref)
+            return {"ref": ref}
+        if self._supply_drained() and self._all_served():
+            # this consumer is at the drained tail. The epoch completes
+            # only when every live unfinished consumer is parked here
+            # TOGETHER — a peer still mid-epoch may yet die and have its
+            # delivered blocks requeued, and those must land on a
+            # consumer that hasn't left the epoch.
+            self._tail_seen.add(rank)
+            if not (self._live() - self._finished - self._tail_seen):
+                self._finished |= self._tail_seen
+                self._tail_seen = set()
+                return {"eof": True}
+            # still waiting on a mid-epoch peer: a silent one is dead —
+            # evict it so its blocks requeue (which resumes the tail)
+            self._evict_stalled(now)
+        return {"wait": True}
+
+    def epoch_done(self, rank: int, epoch: int):
+        """A consumer is done with this epoch WITHOUT draining its
+        shard (early exit: steps_per_epoch cutoff, a `break` out of
+        iter_batches). Its delivered blocks stay consumed — it chose to
+        stop — and the tail rendezvous stops waiting for it, so its
+        peers can complete the epoch without evicting a live worker."""
+        rank = int(rank)
+        self._last_seen[rank] = time.monotonic()
+        if self._serving and int(epoch) == self._epoch \
+                and rank in self._members and rank not in self._dead:
+            self._finished.add(rank)
+            self._tail_seen.discard(rank)
+            # equal mode: its UNDELIVERED backlog goes to the active
+            # ranks (delivered blocks stay consumed) — left in place it
+            # would exhaust the refill cap and wedge the epoch, and the
+            # rows would never reach anyone
+            backlog = self._pending.pop(rank, None)
+            if backlog:
+                self._respill.extend(backlog)
+        return True
+
+    def mark_dead(self, rank: int):
+        """Explicit death notice (Train failure path / drills): requeue
+        everything the consumer held this epoch."""
+        rank = int(rank)
+        if rank in self._members and rank not in self._dead:
+            self._evict(rank)
+        return {"dead": sorted(self._dead)}
+
+    def describe(self):
+        return {
+            "epoch": self._epoch,
+            "world": self._world,
+            "members": sorted(self._members),
+            "dead": sorted(self._dead),
+            "finished": sorted(self._finished),
+            "cache_blocks": len(self._cache),
+            "cache_done": self._cache_done,
+            "delivered": {r: len(v) for r, v in self._delivered.items()},
+            "equal": self._equal,
+        }
+
+    # ------------------------------------------------------------ internals
+    def _pull_source(self):
+        """Next raw block for this epoch: replay the cache, then extend
+        it from the live stream. Returns a ref, ``None`` (plan
+        exhausted), or ``_PENDING`` (stream alive, nothing ready within
+        ~1s of pumping). The pump is advanced in SHORT slices — this
+        actor serves every consumer serially, so one long blocking wait
+        here would starve peers' polls past their RPC deadlines AND
+        freeze their `last_seen` into spurious evictions."""
+        if self._cursor < len(self._cache):
+            ref = self._cache[self._cursor]
+            self._cursor += 1
+            return ref
+        if self._cache_done:
+            return None
+        if self._topo is None:
+            from .plan import compile_plan
+
+            self._topo = StreamingTopology(compile_plan(self._ds._plan),
+                                           executor=self._ds._executor)
+        if self._topo.done():
+            self._cache_done = True
+            self._topo.close()
+            self._topo = None
+            return None
+        done_before = self._topo.stats["tasks_completed"]
+        got = self._topo.advance(wait_s=1.0)
+        if not got:
+            if self._topo.done():
+                self._cache_done = True
+                self._topo.close()
+                self._topo = None
+                return None
+            if self._topo.stats["tasks_completed"] > done_before:
+                self._stalled_s = 0.0  # upstream progressed; no sink
+                #                        output yet is not a stall
+            else:
+                self._stalled_s += 1.0
+            budget = float(getattr(_cfg(), "data_stream_wait_s", 300.0))
+            if self._stalled_s > budget:
+                raise TimeoutError(
+                    f"streaming_split pump made no progress for "
+                    f"{budget}s")
+            return _PENDING
+        self._stalled_s = 0.0
+        self._cache.extend(got)
+        ref = self._cache[self._cursor]
+        self._cursor += 1
+        return ref
+
+    def _refill(self, rank: Optional[int] = None) -> None:
+        """Pull from the source into the serving queues, bounded by a
+        small multiple of the consumer count (the coordinator's own
+        backpressure: its queues must not re-materialize the dataset).
+        In equal mode the bound is PER QUEUE — one consumer's backlog
+        (e.g. a dead peer's) must not exhaust a global budget and
+        starve the others; the starved puller's eviction path handles
+        the backlog's owner."""
+        cap = max(2, 2 * max(self._world, 1))
+        while True:
+            if self._equal:
+                if any(len(q) >= cap for q in self._pending.values()):
+                    return
+                if rank is not None and (self._respill
+                                         or self._pending.get(rank)):
+                    return  # caller already has supply
+            elif self._queued() >= cap:
+                return
+            ref = self._pull_source()
+            if ref is None or ref is _PENDING:
+                return
+            if self._equal:
+                self._enqueue_parts(ref)
+            else:
+                self._shared.append(ref)
+
+    def _queued(self) -> int:
+        n = len(self._respill) + len(self._shared)
+        for q in self._pending.values():
+            n += len(q)
+        return n
+
+    def _enqueue_parts(self, ref) -> None:
+        # split among ACTIVE ranks only: a rank that already finished
+        # its epoch (early exit) must not accumulate slices it will
+        # never pull
+        active = sorted(self._live() - self._finished)
+        if not active:
+            self._respill.append(ref)
+            return
+        n = len(active)
+        if n == 1:
+            self._pending.setdefault(active[0],
+                                     collections.deque()).append(ref)
+            return
+        res = _remote(_split_block_even).options(
+            num_returns=n).remote(ref, n)
+        parts = res if isinstance(res, list) else [res]
+        for r, part in zip(active, parts):
+            self._pending.setdefault(r, collections.deque()).append(part)
+
+    def _pick(self, rank: int):
+        if self._respill:
+            return self._respill.popleft()
+        if self._equal:
+            q = self._pending.get(rank)
+            return q.popleft() if q else None
+        return self._shared.popleft() if self._shared else None
+
+    def _supply_drained(self) -> bool:
+        return self._cache_done and self._cursor >= len(self._cache)
+
+    def _all_served(self) -> bool:
+        if self._respill or self._shared:
+            return False
+        return not any(self._pending.get(r)
+                       for r in self._live() - self._finished)
+
+    def _evict_stalled(self, now: float) -> bool:
+        evicted = False
+        for r in sorted(self._live()):
+            if r in self._finished:
+                continue  # done with this epoch; silence is legitimate
+            if now - self._last_seen.get(r, now) > self._timeout:
+                self._evict(r)
+                evicted = True
+        return evicted
+
+    def _evict(self, rank: int) -> None:
+        self._dead.add(rank)
+        # exactly-once across SURVIVORS: everything this consumer was
+        # handed this epoch goes back on the queue for the living
+        self._respill.extend(self._delivered.pop(rank, []))
+        q = self._pending.pop(rank, None)
+        if q:
+            self._respill.extend(q)
+        self._wanted.discard(rank)
+        self._finished.discard(rank)
+        # requeued work (or a shrunken live set) re-opens the tail
+        # rendezvous: parked survivors resume pulling
+        self._tail_seen = set()
+
+
+# --------------------------------------------------------- consumer handle
+class StreamSplitDataIterator:
+    """Per-consumer iterator over a :class:`SplitCoordinator` shard.
+
+    Each ``iter_batches()`` / ``iter_rows()`` call consumes ONE epoch:
+    it enters the epoch barrier, then pulls blocks until the coordinator
+    answers eof. Registration happens lazily in the consuming process,
+    so the handle pickles into Train workers."""
+
+    def __init__(self, coordinator, rank: int, world: int):
+        self._coord = coordinator
+        self._rank = int(rank)
+        self._world = int(world)
+        self._registered_pid: Optional[int] = None
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def coordinator(self):
+        return self._coord
+
+    def _ensure_registered(self) -> None:
+        import os
+
+        import ray_tpu
+
+        if self._registered_pid != os.getpid():
+            ray_tpu.get(self._coord.register.remote(self._rank, self._world),
+                        timeout=60)
+            self._registered_pid = os.getpid()
+
+    def iter_block_refs(self, *, poll_s: float = 0.02) -> Iterator[Any]:
+        import ray_tpu
+
+        from ..runtime import faults
+
+        self._ensure_registered()
+        while True:
+            d = ray_tpu.get(self._coord.begin_epoch.remote(self._rank),
+                            timeout=120)
+            if d.get("evicted"):
+                raise RuntimeError(
+                    f"consumer {self._rank} was evicted from the "
+                    f"streaming split (stalled past "
+                    f"split_consumer_timeout_s)")
+            if "epoch" in d:
+                epoch = d["epoch"]
+                break
+            time.sleep(poll_s)
+        drained = False
+        try:
+            while True:
+                # chaos syncpoint: kill_at(data.split_pull) drills
+                # consumer death mid-epoch (redistribution is the
+                # invariant under test)
+                faults.syncpoint("data.split_pull")
+                d = ray_tpu.get(
+                    self._coord.next_block.remote(self._rank, epoch),
+                    timeout=120)
+                if d.get("eof"):
+                    drained = True
+                    return
+                if d.get("evicted"):
+                    drained = True  # nothing left to release
+                    raise RuntimeError(
+                        f"consumer {self._rank} was evicted mid-epoch "
+                        f"from the streaming split")
+                ref = d.get("ref")
+                if ref is None:
+                    time.sleep(poll_s)
+                    continue
+                yield ref
+        finally:
+            if not drained:
+                # early exit (a `break` out of iter_batches): tell the
+                # coordinator this rank is done with the epoch so peers
+                # complete without evicting a live worker
+                try:
+                    self._coord.epoch_done.remote(self._rank, epoch)
+                except Exception:  # rtpulint: ignore[RTPU006] — best-effort close signal; the timeout eviction path remains the backstop
+                    pass
+
+    def _iter_blocks(self) -> Iterator[Block]:
+        import ray_tpu
+
+        for ref in self.iter_block_refs():
+            yield ray_tpu.get(ref, timeout=600)
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: Optional[str] = None,
+                     drop_last: bool = False) -> Iterator[Any]:
+        from .dataset import batches_from_blocks
+
+        return batches_from_blocks(self._iter_blocks(),
+                                   batch_size=batch_size,
+                                   batch_format=batch_format,
+                                   drop_last=drop_last)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self._iter_blocks():
+            yield from BlockAccessor(block).iter_rows()
+
+    def iter_jax_batches(self, *, batch_size: int = 256,
+                         drop_last: bool = True,
+                         sharding=None) -> Iterator[Dict[str, Any]]:
+        from .dataset import jax_batches
+
+        return jax_batches(self.iter_batches(batch_size=batch_size,
+                                             batch_format="numpy",
+                                             drop_last=drop_last),
+                           sharding=sharding)
+
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False,
+                           device: Optional[str] = None,
+                           dtypes=None) -> Iterator[Any]:
+        from .dataset import torch_batches
+
+        return torch_batches(self.iter_batches(batch_size=batch_size,
+                                               batch_format="numpy",
+                                               drop_last=drop_last),
+                             dtypes=dtypes, device=device)
+
+    def stats(self) -> dict:
+        import ray_tpu
+
+        return ray_tpu.get(self._coord.describe.remote(), timeout=60)
+
+    # DataIterator compatibility surface: a coordinator-served shard
+    # has no static size (blocks are balanced dynamically and
+    # redistributed on death) and no standalone materialization —
+    # raise a typed, explanatory error instead of an AttributeError
+    def count(self) -> int:
+        raise NotImplementedError(
+            "a streaming_split shard has no static row count (blocks "
+            "are assigned dynamically per epoch); count the source "
+            "Dataset, or tally rows while iterating")
+
+    def materialize(self):
+        raise NotImplementedError(
+            "a streaming_split shard cannot be materialized standalone "
+            "(one epoch's shard only exists while all consumers pull); "
+            "materialize the source Dataset instead")
+
+
+def split_iterators(ds, n: int, *, equal: bool = False,
+                    consumer_timeout_s: Optional[float] = None
+                    ) -> List[StreamSplitDataIterator]:
+    """Create the coordinator actor + n consumer iterators. The
+    returned iterators share ONE owning handle: keep at least one of
+    them referenced on the driver for the coordinator's lifetime (they
+    pickle into workers as non-owning borrows)."""
+    import ray_tpu
+
+    if n < 1:
+        raise ValueError(f"streaming_split needs n >= 1, got {n}")
+    coord = ray_tpu.remote(SplitCoordinator).remote(
+        ds, n, equal, consumer_timeout_s)
+    return [StreamSplitDataIterator(coord, rank, n) for rank in range(n)]
+
+
+class StreamShardProvider:
+    """Driver-side shard factory for elastic Train ingest.
+
+    Created once per dataset in ``JaxTrainer.fit`` (the DRIVER owns the
+    coordinator, so it survives worker deaths and elastic restarts);
+    pickled into every Train worker, where ``iterator_for(rank, world)``
+    yields that worker's shard. A restarted attempt re-registers its
+    ranks, which the coordinator treats as a new generation — the block
+    cache survives, the epoch state resets."""
+
+    def __init__(self, ds, *, equal: bool = False):
+        import ray_tpu
+
+        self._equal = bool(equal)
+        self._handle = ray_tpu.remote(SplitCoordinator).remote(
+            ds, 0, self._equal, None)
+
+    def iterator_for(self, rank: int, world: int) -> StreamSplitDataIterator:
+        return StreamSplitDataIterator(self._handle, rank, world)
+
+    def shutdown(self) -> None:
+        import ray_tpu
+
+        try:
+            ray_tpu.kill(self._handle)
+        except Exception:  # rtpulint: ignore[RTPU006] — teardown is best-effort; the owning handle's release kills the actor anyway
+            pass

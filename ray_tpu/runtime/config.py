@@ -140,6 +140,26 @@ class RuntimeConfig:
     # dispatch task indefinitely (PR-8 NOTE).
     chan_push_timeout_s: float = 5.0
 
+    # --- streaming data plane (data/streaming.py) ---
+    # master switch: False restores full-materialization iteration
+    # (every stage drains into a block list before iter_batches yields)
+    data_stream_enabled: bool = True
+    # Per-operator bounded output queue, in blocks. Peak store footprint
+    # of a streamed map pipeline is proportional to ops x 2 x depth; a
+    # slow consumer parks the source once the queues fill.
+    data_stream_queue_depth: int = 4
+    # Ceiling on how long one pull may wait for the pipeline to produce
+    # a block before the stream surfaces a TimeoutError.
+    data_stream_wait_s: float = 300.0
+    # streaming_split: a consumer silent this long, while its epoch
+    # cannot otherwise complete, is declared dead and every block it was
+    # handed this epoch is redistributed to the surviving consumers.
+    # Silence is measured between PULLS, so it must comfortably exceed
+    # the slowest per-batch training step — a healthy-but-slow consumer
+    # evicted here crashes with a typed error and its rows re-train on
+    # a survivor. Raise it for long-step jobs.
+    split_consumer_timeout_s: float = 60.0
+
     # --- memory monitor (ref: src/ray/common/memory_monitor.h:52 —
     # cgroup/rss watcher; kill policy raylet/worker_killing_policy.cc) ---
     memory_usage_threshold: float = 0.95

@@ -1012,7 +1012,16 @@ class Controller:
                     and node.client._local_server() is not None:
                 # in-process nodelet (single-host head): one plane per
                 # process — applying through the client would double
-                # every rule we just added locally
+                # every rule we just added locally. Its WORKERS are
+                # separate processes though: fan the mutation out to
+                # them via the forward-only endpoint.
+                try:
+                    await node.client.call_async(
+                        "fault_forward", spec=spec, clear=clear,
+                        _timeout=10)
+                except Exception as e:  # noqa: BLE001 — partial fan-out is reported, not fatal
+                    log.debug("fault_forward to in-proc nodelet "
+                              "failed: %r", e)
                 out[node.node_id] = out["controller"]
                 continue
             try:

@@ -66,6 +66,7 @@ SYNCPOINTS = (
     "channel.push",
     "serve.reconcile",
     "controller.health_sweep",
+    "data.split_pull",
 )
 
 
@@ -141,6 +142,43 @@ class FaultRule:
         self.source = source  # "config" rules are replaced on reload
         self.seen = 0  # matching calls observed
         self.fired = 0  # injections actually performed
+
+    def to_spec(self) -> Optional[str]:
+        """Re-serialize into the rule grammar (for forwarding a live
+        plane's injected rules to a worker that registered after the
+        mutation). Returns None for rules that cannot round-trip: a
+        fired-out budget, or an error message containing grammar
+        metacharacters. `times` carries the REMAINING budget and match
+        counters reset in the receiver (an nth= rule starts counting
+        from its arrival there)."""
+        if self.times == 0:
+            return None
+        args: List[str] = []
+        if self.kind == "partition":
+            args.append(f"{self.src}->{self.dst}")
+        elif self.kind == "kill_at":
+            args.append(self.syncpoint)
+            if self.action != "exit":
+                args.append(f"action={self.action}")
+        else:
+            args.append(self.method)
+        if self.kind == "delay":
+            args.append(f"ms={self.ms:g}")
+        if self.kind == "error" and self.msg:
+            if any(c in self.msg for c in ";,()=@"):
+                return None
+            args.append(f"msg={self.msg}")
+        if self.nth is not None:
+            args.append(f"nth={self.nth}")
+        if self.prob < 1.0:
+            args.append(f"p={self.prob:g}")
+        default_times = 1 if self.kind == "kill_at" else -1
+        if self.times != default_times:
+            args.append(f"times={self.times}")
+        spec = f"{self.name}:{self.kind}({','.join(args)})"
+        if self.node:
+            spec += f"@{self.node}"
+        return spec
 
     def to_dict(self) -> dict:
         d = {"name": self.name, "kind": self.kind,
@@ -358,6 +396,15 @@ class FaultPlane:
 
     def snapshot(self) -> List[dict]:
         return [r.to_dict() for r in list(self.rules.values())]
+
+    def injected_spec(self) -> str:
+        """The RUNTIME-injected rules (source != config) re-serialized
+        into the grammar — what a newly registered worker must receive
+        to match this plane (config/env rules reach it via its own
+        RTPU_FAULTS at boot)."""
+        specs = [r.to_spec() for r in list(self.rules.values())
+                 if r.source != "config"]
+        return ";".join(s for s in specs if s)
 
     # ----------------------------------------------------------- hooks
     def _fire(self, rule: FaultRule) -> bool:

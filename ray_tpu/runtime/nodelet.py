@@ -393,14 +393,45 @@ class Nodelet:
             "view_update": self.view_update,
             "get_node_info": self.get_node_info,
             "fault_inject": self.fault_inject,
+            "fault_forward": self.fault_forward,
             "shutdown": self._on_shutdown,
             "ping": lambda: "pong",
         }
 
     async def fault_inject(self, spec: str = None, clear=None):
         """Runtime-mutable fault plane for THIS node's process (the
-        controller's fault_inject admin RPC routes here per node)."""
-        return faults.apply_spec(spec, clear)
+        controller's fault_inject admin RPC routes here per node), fanned
+        out to every LIVE registered worker — a rule scoped ``@<worker
+        id>`` reaches a running worker without a respawn (spawn-time
+        RTPU_FAULTS stays the path for workers born later). Per-worker
+        failures are logged, not fatal: a worker racing its own death
+        must not fail the admin RPC. Returns this node process's rule
+        snapshot (the shape the drills assert on)."""
+        snapshot = faults.apply_spec(spec, clear)
+        await self.fault_forward(spec=spec, clear=clear)
+        return snapshot
+
+    async def fault_forward(self, spec: str = None, clear=None):
+        """Fan a fault_inject mutation out to this node's LIVE workers
+        WITHOUT touching the nodelet's own plane — the controller calls
+        this directly for an in-process head nodelet, where re-applying
+        the spec would double every unnamed rule in the shared plane."""
+        forwards = [self._forward_fault_inject(ws, spec, clear)
+                    for ws in list(self.workers.values())
+                    if ws.client is not None]  # mid-spawn workers get the plane's injected rules at worker_register instead
+        if forwards:
+            # awaited (not fire-and-forget) so a drill that injects then
+            # immediately drives a worker cannot race the propagation
+            await asyncio.gather(*forwards)
+        return len(forwards)
+
+    async def _forward_fault_inject(self, ws: WorkerState, spec, clear):
+        try:
+            await ws.client.call_async("fault_inject", spec=spec,
+                                       clear=clear, _timeout=5)
+        except Exception as e:  # noqa: BLE001 — partial fan-out is logged, not fatal
+            log.debug("fault_inject forward to worker %s failed: %r",
+                      ws.worker_id[:8], e)
 
     # ------------------------------------------------------------ lifecycle
     async def start(self):
@@ -1048,6 +1079,14 @@ class Nodelet:
         ws.conn = _conn
         ws.client = RpcClient(address)
         ws.idle_since = time.monotonic()
+        # close the mid-spawn window: a fault_inject that ran while this
+        # worker was booting could not reach it (no client yet, and
+        # runtime mutations never touch the RTPU_FAULTS env the spawn
+        # inherited) — push the plane's injected rules now
+        injected = faults.get_plane().injected_spec()
+        if injected:
+            spawn_logged(self._forward_fault_inject(ws, injected, None),
+                         name="nodelet.fault_forward_register")
         self._idle_pool(ws.env_key).append(worker_id)
         self._dispatch()
         return {"session_name": self.session_name}

@@ -123,8 +123,18 @@ class Executor:
             "actor_call": self.h_actor_call,
             "kill_self": self.h_kill_self,
             "drain_exit": self.h_drain_exit,
+            "fault_inject": self.h_fault_inject,
             "shutdown": self.h_kill_self,
         }
+
+    async def h_fault_inject(self, spec: str = None, clear=None):
+        """Runtime-mutable fault plane for THIS worker process. The
+        nodelet forwards fault_inject here so live workers pick up rules
+        without a respawn (previously rules only arrived via the
+        RTPU_FAULTS env at spawn time)."""
+        from . import faults
+
+        return faults.apply_spec(spec, clear)
 
     # ------------------------------------------------------------ plain tasks
     def _is_duplicate_dispatch(self, spec: dict) -> bool:

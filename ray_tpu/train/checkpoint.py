@@ -10,6 +10,7 @@ torch.save, with a msgpack/pickle fallback for plain trees.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import pickle
 import shutil
@@ -87,8 +88,12 @@ def save_pytree(tree: Any, path: str) -> None:
         try:
             with open(os.path.join(path, "treedef.pkl"), "wb") as f:
                 pickle.dump(treedef, f)
-        except Exception:
-            pass  # structure only recoverable via `target=` then
+        except Exception as e:  # noqa: BLE001
+            # structure only recoverable via `target=` then — worth a
+            # diagnostic: the checkpoint silently loses self-describing
+            # restore otherwise
+            logging.getLogger(__name__).debug(
+                "treedef.pkl save failed (%r); load will need target=", e)
         return
     except Exception as e:
         # a partial orbax dir must not shadow the pickle fallback on load

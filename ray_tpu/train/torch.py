@@ -112,7 +112,7 @@ def _with_torch_process_group(train_fn: Callable, fit_id: str) -> Callable:
                 if rank == 0:
                     try:  # clear the address so restarts re-rendezvous
                         core.controller.call("kv_del", ns=ns, key=key)
-                    except Exception:
+                    except Exception:  # rtpulint: ignore[RTPU006] — teardown cleanup; a stale KV entry is overwritten by the next rendezvous anyway
                         pass
 
     return wrapped

@@ -62,14 +62,25 @@ class JaxTrainer:
                   else policy_cls(sc))
 
         train_fn = self.train_loop_per_worker
+        providers: Dict[str, Any] = {}
         if self.datasets:
-            # materialize ONCE on the driver: workers then split the same
-            # block refs, so nondeterministic plans (shuffles) can't give
-            # workers overlapping/disjoint-missing shards
-            materialized = {
-                name: (ds.materialize() if hasattr(ds, "materialize") else ds)
-                for name, ds in self.datasets.items()}
-            train_fn = _wrap_with_datasets(train_fn, materialized)
+            # streaming ingest: each ray_tpu Dataset gets ONE driver-
+            # owned split-coordinator actor — the plan executes once as
+            # a stream and workers pull disjoint shards with per-epoch
+            # barriers, so nondeterministic plans (shuffles) can't give
+            # workers overlapping shards AND the coordinator survives
+            # worker deaths/elastic restarts (the driver owns it).
+            # Non-Dataset objects keep the legacy materialize path.
+            prepared = {}
+            for name, ds in self.datasets.items():
+                provider = _maybe_stream_provider(ds)
+                if provider is not None:
+                    prepared[name] = provider
+                    providers[name] = provider
+                else:
+                    prepared[name] = (ds.materialize()
+                                      if hasattr(ds, "materialize") else ds)
+            train_fn = _wrap_with_datasets(train_fn, prepared)
 
         controller = TrainController(
             train_fn=train_fn,
@@ -79,7 +90,28 @@ class JaxTrainer:
             scaling_policy=policy,
             resume_from_checkpoint=self.resume_from_checkpoint,
         )
-        return controller.run()
+        try:
+            return controller.run()
+        finally:
+            for provider in providers.values():
+                provider.shutdown()
+
+
+def _maybe_stream_provider(ds):
+    """A ray_tpu Dataset (with streaming enabled) gets a driver-owned
+    StreamShardProvider; anything else returns None and takes the
+    legacy path."""
+    try:
+        from ..data.dataset import Dataset
+        from ..data.streaming import StreamShardProvider
+        from ..runtime.config import get_config
+    except Exception:  # rtpulint: ignore[RTPU006] — data package optional; trainer must work without it
+        return None
+    if not isinstance(ds, Dataset):
+        return None
+    if not getattr(get_config(), "data_stream_enabled", True):
+        return None
+    return StreamShardProvider(ds)
 
 
 def _wrap_with_datasets(train_fn: Callable,
@@ -87,7 +119,10 @@ def _wrap_with_datasets(train_fn: Callable,
     """Give each worker its split of every dataset via
     train.get_dataset_shard (ref: DataParallelTrainer dataset splitting).
     Split counts come from the ACTUAL world size at run time, so elastic
-    restarts at a smaller size still cover the whole dataset."""
+    restarts at a smaller size still cover the whole dataset. Streaming
+    providers (ray_tpu Datasets) hand each rank an iterator over its
+    coordinator-served shard; re-registration after an elastic restart
+    resets the coordinator's epoch state (a new generation)."""
 
     def wrapped(config):
         from . import session as _session
@@ -98,10 +133,18 @@ def _wrap_with_datasets(train_fn: Callable,
         rank, num_workers = ctx.get_world_rank(), ctx.get_world_size()
         shards = {}
         for name, ds in datasets.items():
-            if hasattr(ds, "streaming_split"):
-                shards[name] = ds.streaming_split(num_workers)[rank]
+            if hasattr(ds, "iterator_for"):  # StreamShardProvider
+                shards[name] = ds.iterator_for(rank, num_workers)
             elif hasattr(ds, "split"):
+                # materialized Datasets shard by block here. split MUST
+                # come before the streaming_split probe: streaming_split
+                # is coordinator-backed, and calling it in EVERY worker
+                # would give each worker a private coordinator serving
+                # it the FULL dataset (overlapping shards) — the
+                # provider branch above is the one-coordinator path.
                 shards[name] = ds.split(num_workers)[rank]
+            elif hasattr(ds, "streaming_split"):
+                shards[name] = ds.streaming_split(num_workers)[rank]
             else:
                 shards[name] = ds
         _session.get_session().dataset_shards = shards

@@ -295,7 +295,7 @@ class TuneController:
                                  else "stop_criteria")
             try:
                 trial.actor.stop.remote()
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — graceful-stop escalation: _stop_actor force-kills right after
                 pass
             self._stop_actor(trial)
             trial.status = TERMINATED
@@ -356,7 +356,7 @@ class TuneController:
         if trial.actor is not None:
             try:
                 ray_tpu.kill(trial.actor)
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — kill of an already-dead trial actor is the expected teardown race
                 pass
             trial.actor = None
 

@@ -49,13 +49,18 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "lint: rtpulint static-analysis tier (analyzer "
         "self-tests + the zero-unsuppressed-findings gate over "
-        "ray_tpu/{runtime,serve,dag,data} and the client link)")
+        "ray_tpu/{runtime,serve,dag,data,train,tune} and the client "
+        "link)")
     config.addinivalue_line(
         "markers", "dag: compiled-graph data plane (cross-host "
         "channels, ring collectives, teardown) tests")
     config.addinivalue_line(
         "markers", "chaos: deterministic fault plane (runtime/faults.py) "
         "unit tests + the cluster-wide failure-drill suite")
+    config.addinivalue_line(
+        "markers", "stream: streaming data plane (pull-based operator "
+        "pipeline, streaming_split coordinator, elastic Train ingest) "
+        "tests")
 
 
 @pytest.fixture

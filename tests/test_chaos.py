@@ -333,6 +333,67 @@ def test_fault_inject_rpc_mutates_rules_without_restart(cluster):
     assert not [r for r in info["faults"] if r["name"] == "lag"]
 
 
+def test_fault_inject_reaches_live_workers(cluster):
+    """fault_inject propagates to LIVE worker processes (the PR-10
+    future-work gap): a rule injected at runtime lands in a running
+    worker's plane, fires there, and clears — no respawn, no
+    RTPU_FAULTS env."""
+    session, _ = cluster
+
+    @ray_tpu.remote
+    class Probe:
+        def wid(self):
+            from ray_tpu.runtime.core import get_core
+
+            return get_core().worker_id.hex()
+
+        def rules(self):
+            return [r["name"] for r in faults.get_plane().snapshot()]
+
+        def hit(self):
+            faults.syncpoint("data.split_pull")
+            return "alive"
+
+    probe = Probe.remote()
+    wid = ray_tpu.get(probe.wid.remote(), timeout=30)
+    try:
+        # propagation: the named rule shows up in the worker's plane
+        session.core.controller.call(
+            "fault_inject", spec=f"w_probe:drop(never_called)@{wid}",
+            node_id="*")
+        assert "w_probe" in ray_tpu.get(probe.rules.remote(), timeout=30)
+        # behavior: a runtime-injected kill_at fires inside the worker
+        session.core.controller.call(
+            "fault_inject",
+            spec=f"w_kill:kill_at(data.split_pull,action=raise)@{wid}",
+            node_id="*")
+        with pytest.raises(Exception, match="FaultInjected"):
+            ray_tpu.get(probe.hit.remote(), timeout=30)
+        # clear propagates too
+        session.core.controller.call("fault_inject", clear="*",
+                                     node_id="*")
+        assert ray_tpu.get(probe.rules.remote(), timeout=30) == []
+        assert ray_tpu.get(probe.hit.remote(), timeout=30) == "alive"
+        # a worker spawned AFTER the mutation gets the injected rules
+        # at registration (runtime mutations never touch the
+        # RTPU_FAULTS env the spawn inherits)
+        session.core.controller.call(
+            "fault_inject", spec="late_probe:drop(never_called)",
+            node_id="*")
+        late = Probe.options(max_concurrency=1).remote()
+        deadline = time.monotonic() + 30
+        rules = []
+        while time.monotonic() < deadline:
+            rules = ray_tpu.get(late.rules.remote(), timeout=30)
+            if "late_probe" in rules:
+                break
+            time.sleep(0.1)  # registration forward is async
+        assert "late_probe" in rules, rules
+    finally:
+        session.core.controller.call("fault_inject", clear="*",
+                                     node_id="*")
+
+
 # ----------------------------------------------------------------- drills
 def test_drill_controller_restart_under_live_traffic(cluster):
     """Controller kill+restart under live actor traffic: nodelets must

@@ -132,15 +132,29 @@ def test_iter_batches_and_jax():
 
 
 def test_split_and_streaming_split():
+    import threading
+
     ds = rd.range(60, parallelism=6)
     parts = ds.split(3)
     assert sum(p.count() for p in parts) == 60
+    # streaming_split consumers pull CONCURRENTLY from one coordinator
+    # (per-epoch barrier: a lone consumer would wait for its peer)
     its = ds.streaming_split(2)
-    ids = []
-    for it in its:
-        for b in it.iter_batches(batch_size=100, batch_format="numpy"):
-            ids.extend(b["id"].tolist())
-    assert sorted(ids) == list(range(60))
+    out = {0: [], 1: []}
+
+    def consume(rank):
+        for b in its[rank].iter_batches(batch_size=100,
+                                        batch_format="numpy"):
+            out[rank].extend(b["id"].tolist())
+
+    threads = [threading.Thread(target=consume, args=(r,), daemon=True)
+               for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not set(out[0]) & set(out[1])
+    assert sorted(out[0] + out[1]) == list(range(60))
 
 
 def test_read_write_parquet_csv_json(tmp_path):

@@ -125,11 +125,12 @@ def test_cli_exit_codes(tmp_path):
 
 # ------------------------------------------------------------ tier-1 gate
 # Scanned paths. PR 7 gated runtime+serve; PR 8 added dag; the client
-# link (client.py/client_proxy.py — its advisory RTPU006 findings are
-# now logged or reason-pragma'd) and the data package joined with the
-# fault-plane PR. Still advisory-only: tune/rllib/autoscaler — run
-# `python -m tools.rtpulint ray_tpu/` for the full list before widening.
-GATED_PATHS = ("runtime", "serve", "dag", "data",
+# link and the data package joined with the fault-plane PR; train+tune
+# joined with the streaming-data-plane PR (their advisory RTPU006
+# findings now logged or reason-pragma'd). Still advisory-only:
+# rllib/autoscaler/models/ops — run `python -m tools.rtpulint ray_tpu/`
+# for the full list before widening.
+GATED_PATHS = ("runtime", "serve", "dag", "data", "train", "tune",
                "client.py", "client_proxy.py")
 
 
