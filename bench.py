@@ -521,24 +521,16 @@ def main():
             result["detail"]["chaos_drill"] = {"error": repr(e)[:200]}
             result["detail"]["chaos_drills_green"] = False
 
-    # 9. static analysis: rtpulint over the runtime layers (cheap, ~2s).
-    # lint_clean records when the tree regresses on a concurrency
-    # invariant; unsuppressed_findings is the count behind it.
-    try:
-        import os as _os
+    # 9. static analysis: rtpulint per-file rules over the WHOLE package
+    # (cheap, ~2s). lint_clean records when the tree regresses on a
+    # concurrency invariant; unsuppressed_findings is the count behind it.
+    import os as _os
 
+    _repo = _os.path.dirname(_os.path.abspath(__file__))
+    try:
         from tools.rtpulint import run as _lint_run
 
-        _repo = _os.path.dirname(_os.path.abspath(__file__))
-        _findings, _ = _lint_run(
-            [_os.path.join(_repo, "ray_tpu", "runtime"),
-             _os.path.join(_repo, "ray_tpu", "serve"),
-             _os.path.join(_repo, "ray_tpu", "dag"),
-             _os.path.join(_repo, "ray_tpu", "data"),
-             _os.path.join(_repo, "ray_tpu", "train"),
-             _os.path.join(_repo, "ray_tpu", "tune"),
-             _os.path.join(_repo, "ray_tpu", "client.py"),
-             _os.path.join(_repo, "ray_tpu", "client_proxy.py")])
+        _findings, _ = _lint_run([_os.path.join(_repo, "ray_tpu")])
         _bad = sum(1 for f in _findings if not f.suppressed)
         result["detail"]["lint_clean"] = _bad == 0
         result["detail"]["lint_unsuppressed_findings"] = _bad
@@ -546,6 +538,24 @@ def main():
         result["detail"]["lint_clean"] = False
         result["detail"]["lint_unsuppressed_findings"] = -1
         result["detail"]["lint_error"] = repr(e)[:200]
+
+    # 10. protocol analysis: the rtpuproto whole-program pass
+    # (RTPU101-106) over the package with tests/benchmarks as evidence.
+    # proto_clean regresses when an RPC edge, failure classification,
+    # fault-rule string, config knob or metric name goes stale.
+    try:
+        from tools.rtpulint.proto import default_aux_paths as _aux
+        from tools.rtpulint.proto import run_proto as _proto_run
+
+        _pkg = _os.path.join(_repo, "ray_tpu")
+        _pfindings, _ = _proto_run([_pkg], aux_paths=_aux(_pkg))
+        _pbad = sum(1 for f in _pfindings if not f.suppressed)
+        result["detail"]["proto_clean"] = _pbad == 0
+        result["detail"]["proto_unsuppressed_findings"] = _pbad
+    except Exception as e:  # noqa: BLE001
+        result["detail"]["proto_clean"] = False
+        result["detail"]["proto_unsuppressed_findings"] = -1
+        result["detail"]["proto_error"] = repr(e)[:200]
     print(json.dumps(result))
 
 

@@ -175,7 +175,7 @@ class NativePool:
                 MADV_POPULATE_WRITE = 23
                 libc.madvise(ctypes.c_void_p(addr),
                              ctypes.c_size_t(size), MADV_POPULATE_WRITE)
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — madvise prefault is a droppable optimization; the pool works unpopulated
                 pass
 
         threading.Thread(target=run, daemon=True,

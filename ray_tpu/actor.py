@@ -131,7 +131,7 @@ class ActorHandle:
                 if core is not None and not core._shutting_down:
                     # deferred until this owner's in-flight calls resolve
                     core.release_actor_handle(self._actor_id)
-            except BaseException:  # interpreter teardown: names may be gone
+            except BaseException:  # rtpulint: ignore[RTPU006] — __del__ at interpreter teardown: imported names may already be gone
                 pass
 
     def __getattr__(self, name: str) -> ActorMethod:
@@ -181,7 +181,9 @@ class ActorClass:
 
     def _export(self) -> str:
         core = get_core()
-        token = getattr(core, "core_token", None) or id(core)
+        # core_token (pid, counter) is set in CoreWorker.__init__;
+        # the old id(core) fallback was address-derived (RTPU005)
+        token = core.core_token
         key = self._cls_key_cache.get(token)
         if key is None:
             blob = serialization.dumps_inline(self._cls)

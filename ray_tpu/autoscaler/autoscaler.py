@@ -218,7 +218,7 @@ class Autoscaler:
         # must release its max_workers budget
         if hasattr(self.provider, "instance_types"):
             live = self.provider.instance_types()
-            for type_name in self._counts:
+            for type_name in list(self._counts):
                 self._counts[type_name] = sum(
                     1 for t in live.values() if t == type_name)
             self._node_type = {iid: t for iid, t in live.items()}

@@ -22,12 +22,15 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import threading
 import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 from .node_provider import NodeProvider
+
+log = logging.getLogger("ray_tpu")
 
 # lifecycle states (ref: instance_manager.proto Instance.Status)
 REQUESTED = "REQUESTED"    # recorded; no cloud call yet
@@ -108,8 +111,8 @@ class InstanceManager:
         for fn in self._subscribers:
             try:
                 fn(inst, old)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — one bad subscriber must not block the rest
+                log.debug("instance-update subscriber failed: %r", e)
         return inst
 
     def get(self, instance_id: str) -> Optional[Instance]:
@@ -330,8 +333,8 @@ class GCPTPUNodeProvider(NodeProvider):
         while not self._stop.is_set():
             try:
                 self.reconcile_once()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — loop must survive, but a permanently failing reconcile was invisible
+                log.debug("reconcile pass failed: %r", e)
             self._stop.wait(self.poll_interval_s)
 
     def _startup_script(self, spec: TPUNodeTypeSpec) -> str:
@@ -432,8 +435,9 @@ class FakeSliceProvider(GCPTPUNodeProvider):
                 try:
                     get_core().controller.call("drain_node",
                                                node_id=node_id)
-                except Exception:
-                    pass
+                except Exception as e:  # noqa: BLE001 — instance is already terminated; drain is advisory cleanup
+                    log.debug("drain_node for terminated instance %s "
+                              "failed: %r", inst.instance_id, e)
 
 
 class _FakeTPUAPI:

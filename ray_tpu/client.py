@@ -16,6 +16,7 @@ pickled across the link).
 from __future__ import annotations
 
 import collections
+import os
 import threading
 import uuid
 from typing import Any, Dict, List, Optional, Sequence
@@ -56,6 +57,10 @@ class ClientCore:
         self.client_id = uuid.uuid4().hex
         self.namespace = namespace
         self.job_id = JobID.from_random()
+        # same contract as CoreWorker.core_token: a process-stable
+        # export-cache key (never the old address-derived id(core) —
+        # rtpulint RTPU005)
+        self.core_token = (os.getpid(), self.client_id)
         self._client = RpcClient(address)
         self._client.call("ping", _timeout=30)
         self.controller = _ControllerProxy(self)

@@ -10,6 +10,7 @@ submitting cluster.
 
 from __future__ import annotations
 
+import logging
 import os
 import time
 import uuid
@@ -52,6 +53,7 @@ class JobSupervisorActor:
             self.end_time = time.time()
             return self.status
         self.status = RUNNING
+        # rtpulint: ignore[RTPU001] — one local open per job launch; the subprocess needs the real fd before it spawns
         with open(self.log_path, "ab") as log:
             self._proc = await asyncio.create_subprocess_shell(
                 self.entrypoint, stdout=log, stderr=log, env=self._env,
@@ -72,15 +74,17 @@ class JobSupervisorActor:
 
             get_core().controller.call("mark_job_finished",
                                        job_id=self.submission_id, _timeout=5)
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — job ran; a lost finish mark is diagnostic, not fatal
+            logging.getLogger("ray_tpu").debug(
+                "mark_job_finished for %s undeliverable: %r",
+                self.submission_id, e)
 
     def _kill(self):
         try:
             import signal
 
             os.killpg(os.getpgid(self._proc.pid), signal.SIGTERM)
-        except Exception:
+        except Exception:  # rtpulint: ignore[RTPU006] — the process group may already be gone; stop() is idempotent
             pass
 
     def stop(self) -> bool:

@@ -128,7 +128,7 @@ def current_mesh() -> Optional[Mesh]:
             m = jax.interpreters.pxla.thread_resources.env.physical_mesh
         if m.axis_names:
             return m
-    except Exception:
+    except Exception:  # rtpulint: ignore[RTPU006] — jax version-compat probe; absence of an ambient mesh is the None return
         pass
     return None
 

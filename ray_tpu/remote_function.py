@@ -55,7 +55,9 @@ class RemoteFunction:
 
     def _export(self) -> str:
         core = get_core()
-        token = getattr(core, "core_token", None) or id(core)
+        # core_token (pid, counter) is set in CoreWorker.__init__;
+        # the old id(core) fallback was address-derived (RTPU005)
+        token = core.core_token
         key = self._fn_key_cache.get(token)
         if key is None:
             blob = serialization.dumps_inline(self._fn)

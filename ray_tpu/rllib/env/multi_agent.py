@@ -173,7 +173,8 @@ class MultiAgentEnvRunner:
                     if agent not in self._episodes:
                         self._episodes[agent] = Episode()
         # cut in-flight fragments (bootstrapped) into the batch
-        for agent, episode in self._episodes.items():
+        # (list(): the body replaces entries in self._episodes mid-walk)
+        for agent, episode in list(self._episodes.items()):
             if len(episode) > 0:
                 episode.truncated = True
                 episode.cut = True

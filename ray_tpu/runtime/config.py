@@ -92,19 +92,22 @@ class RuntimeConfig:
 
     # --- workers / scheduling ---
     worker_idle_timeout_s: float = 60.0
+    # Deadline for one worker-spawn request against the fork factory
+    # (covers the factory's warm import of jax on a cold tier).
     worker_start_timeout_s: float = 60.0
     prestart_workers: int = 0
-    max_tasks_in_flight_per_worker: int = 1
-    # Lease/dispatch pipelining cap, modeled on
-    # ClusterSizeBasedLeaseRequestRateLimiter (ref: core_worker.h:1962).
-    max_pending_lease_requests: int = 10
 
     # --- objects ---
     # Results smaller than this are returned inline to the owner's in-process
     # memory store instead of the shared-memory store (the reference inlines
     # small returns the same way; ref: core_worker.cc ExecuteTask return path).
     max_direct_call_object_size: int = 100 * 1024
-    object_store_memory: int = 0  # 0 = auto (fraction of shm)
+    # Shared-memory pool capacity in bytes; 0 = auto-size to
+    # object_store_fraction of the shm filesystem. The RTPU_POOL_SIZE
+    # env var (the pre-knob spelling) still overrides both. Default is
+    # the historical fixed pool so fraction-of-capacity bench metrics
+    # stay comparable across boxes.
+    object_store_memory: int = 256 << 20
     object_store_fraction: float = 0.3
     object_spill_dir: str = ""  # "" = <session>/spill
 
@@ -174,8 +177,13 @@ class RuntimeConfig:
 
     # --- observability ---
     enable_timeline: bool = True
-    event_buffer_size: int = 10000
-    metrics_report_interval_s: float = 5.0
+    # Capacity of the controller's task-event and trace-span ring
+    # buffers (default matches the previously hard-coded deques).
+    event_buffer_size: int = 100000
+    # Minimum interval between metric-snapshot flushes. Flushes
+    # piggyback on task completions (no timer wakes — the r5
+    # many_actors cliff), so this is a floor, not a cadence.
+    metrics_report_interval_s: float = 30.0
     # Event-loop stall watchdog: >0 arms asyncio debug mode on the
     # process's io loop with slow_callback_duration set to this many
     # milliseconds — callbacks that hold the loop longer are logged by

@@ -26,6 +26,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from . import faults, scheduling
+from .config import get_config
 from .procutil import log, spawn_logged
 from .ids import ActorID, NodeID, PlacementGroupID
 from .rpc import RpcClient, RpcServer, ServerConn
@@ -142,8 +143,14 @@ class Controller:
         self.placement_groups: Dict[str, Dict[str, Any]] = {}
         self.jobs: Dict[str, Dict[str, Any]] = {}
         self.unschedulable: collections.deque = collections.deque(maxlen=1000)
-        self.trace_spans: collections.deque = collections.deque(maxlen=100000)
-        self.task_events: collections.deque = collections.deque(maxlen=100000)
+        # observability ring buffers, sized by the event_buffer_size
+        # knob (rtpuproto RTPU105: the knob existed, these were
+        # hard-coded — RTPU_event_buffer_size silently did nothing)
+        event_cap = max(1, get_config().event_buffer_size)
+        self.trace_spans: collections.deque = collections.deque(
+            maxlen=event_cap)
+        self.task_events: collections.deque = collections.deque(
+            maxlen=event_cap)
         # per-task aggregation over the event stream (ref:
         # gcs_task_manager.cc — attempt counts, terminal state, error,
         # bounded by task count with LRU drop)
@@ -252,8 +259,6 @@ class Controller:
             "kv_put": self.kv_put,
             "kv_get": self.kv_get,
             "kv_del": self.kv_del,
-            "kv_keys": self.kv_keys,
-            "kv_exists": self.kv_exists,
             # actors
             "register_actor": self.register_actor,
             "actor_ready": self.actor_ready,
@@ -263,7 +268,6 @@ class Controller:
             "kill_actor": self.kill_actor,
             # scheduling
             "pick_node": self.pick_node,
-            "report_backlog": self.report_backlog,
             # placement groups
             "create_placement_group": self.create_placement_group,
             "remove_placement_group": self.remove_placement_group,
@@ -495,12 +499,6 @@ class Controller:
         if existed:
             self._journal_kv("del", ns, key)
         return existed
-
-    async def kv_keys(self, ns: str, prefix: str = ""):
-        return [k for k in self.kv[ns] if k.startswith(prefix)]
-
-    async def kv_exists(self, ns: str, key: str):
-        return key in self.kv[ns]
 
     # ------------------------------------------------------------------ actors
     async def register_actor(self, actor_id: str, spec: Dict[str, Any]):
@@ -738,12 +736,6 @@ class Controller:
                 {"resources": dict(resources), "ts": time.time()})
             return None
         return {"node_id": node.node_id, "address": node.address}
-
-    async def report_backlog(self, node_id: str, backlog: int):
-        node = self.nodes.get(node_id)
-        if node is not None:
-            node.last_heartbeat = time.monotonic()
-        return True
 
     # ------------------------------------------------------------------ placement groups
     async def create_placement_group(self, pg_id: str, bundles: List[Dict[str, float]],

@@ -364,12 +364,18 @@ class CoreWorker:
             for line in entry.get("lines", []):
                 print(f"{prefix} {line}", file=sys_mod.stderr)
 
-    def maybe_flush_metrics(self, min_interval_s: float = 30.0) -> None:
+    def maybe_flush_metrics(self, min_interval_s: Optional[float] = None
+                            ) -> None:
         """Piggyback metric reporting on work the process is ALREADY
         awake for (task completion): workers get fresh series while
         active and zero timer wakes while idle — periodic wakes across
         hundreds of forked workers were the r5 many_actors cliff. Cheap
-        on the hot path: one clock read unless the interval elapsed."""
+        on the hot path: one clock read unless the interval elapsed.
+        The floor comes from the metrics_report_interval_s knob
+        (rtpuproto RTPU105: the knob existed, this was hard-coded 30.0
+        — RTPU_metrics_report_interval_s silently did nothing)."""
+        if min_interval_s is None:
+            min_interval_s = get_config().metrics_report_interval_s
         now = time.monotonic()
         if now - getattr(self, "_metrics_flushed_at", 0.0) < min_interval_s:
             return
@@ -695,7 +701,21 @@ class CoreWorker:
                 self.store.unpin(oid)
             except Exception:  # rtpulint: ignore[RTPU006] — unpin of an entry the store already evicted/forgot is a no-op
                 pass
+        # mirror of the object_sealed notice: without it the nodelet's
+        # object_bytes gauge only ever grows (rtpuproto RTPU101 found
+        # the handler registered with no caller — the accounting leak)
+        size = None
+        try:
+            size = self.store.size_of(oid)
+        except Exception:  # rtpulint: ignore[RTPU006] — size probe on an already-evicted entry; the delete below is still correct
+            pass
         self.store.delete(oid)
+        if size and self.nodelet is not None:
+            try:
+                self.nodelet.notify_nowait("object_deleted",
+                                           oid=oid.binary(), size=size)
+            except Exception:  # rtpulint: ignore[RTPU006] — __del__/shutdown path: the loop or client may already be closed; accounting is advisory
+                pass
 
     # ------------------------------------------------------------ events
     def _event(self, oid: ObjectID) -> asyncio.Event:
@@ -795,8 +815,19 @@ class CoreWorker:
                 <= get_config().max_direct_call_object_size):
             self.memory_store[oid] = value
         else:
-            self.store.put_serialized(oid, sv)
+            size = self.store.put_serialized(oid, sv)
             self.memory_store[oid] = _IN_SHM
+            # advisory host accounting, symmetric with the worker-return
+            # and pull-replica seal notices; _delete_object sends the
+            # matching object_deleted when the bytes leave the pool
+            # (rtpuproto RTPU101: that handler existed with no caller,
+            # so the object_bytes gauge only ever grew)
+            if self.nodelet is not None:
+                try:
+                    self.nodelet.notify_nowait("object_sealed",
+                                               oid=oid.binary(), size=size)
+                except Exception:  # rtpulint: ignore[RTPU006] — seal notice is advisory accounting; the put itself succeeded
+                    pass
         return ObjectRef(oid, owner_addr=self.address)
 
     def _resolve_threadsafe(self, oid, value):

@@ -953,7 +953,9 @@ class Nodelet:
                 raise OSError("factory sockets unreachable")
             time.sleep(0.05)
         try:  # phase 2: exactly-once request
-            sock.settimeout(60.0)  # covers the factory's warm import
+            # covers the factory's warm import (rtpuproto RTPU105: the
+            # worker_start_timeout_s knob existed, this was a bare 60.0)
+            sock.settimeout(get_config().worker_start_timeout_s)
             sock.sendall((json.dumps(
                 {"worker_id": worker_id, "runtime_env": runtime_env,
                  "warm": warm}) + "\n").encode())
@@ -2060,6 +2062,10 @@ class Nodelet:
             "available": self.available,
             "workers": len(self.workers),
             "queued": len(self.queue),
+            # sealed-minus-deleted advisory accounting (the
+            # object_deleted half only started flowing when rtpuproto
+            # RTPU101 flagged its handler as caller-less)
+            "object_bytes": self.object_bytes,
             # scheduling-plane observability: spill-path counters + the
             # hop histogram (benchmarks/scale.py derives spill_hops_p99)
             "sched": dict(self.sched_counters),

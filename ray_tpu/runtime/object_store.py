@@ -627,6 +627,32 @@ class _PoolIngest:
             pass
 
 
+def pool_capacity(session_name: str) -> int:
+    """Shared-memory pool size: the RTPU_POOL_SIZE env var (the
+    pre-knob spelling) wins, then RuntimeConfig.object_store_memory,
+    then — with object_store_memory=0 — object_store_fraction of the
+    shm filesystem holding the session dir: the auto path the knob
+    always documented but (until rtpuproto flagged both knobs as dead,
+    RTPU105) nothing implemented."""
+    env = os.environ.get("RTPU_POOL_SIZE")
+    if env:
+        return int(env)
+    from .config import get_config
+
+    cfg = get_config()
+    if cfg.object_store_memory > 0:
+        return int(cfg.object_store_memory)
+    shm_dir = _shm_dir(session_name)
+    try:
+        st = os.statvfs(os.path.dirname(shm_dir) or shm_dir)
+        total = st.f_frsize * st.f_blocks
+    except OSError:
+        total = 0
+    if total <= 0:
+        return 256 << 20  # unknown filesystem: the historical default
+    return max(64 << 20, int(total * cfg.object_store_fraction))
+
+
 def make_store_client(session_name: str):
     """Native pool when the toolchain/lib is available (default),
     pure-Python file-per-object store otherwise or with RTPU_NATIVE=0."""
@@ -634,7 +660,7 @@ def make_store_client(session_name: str):
         try:
             from .._native import NativePool
 
-            capacity = int(os.environ.get("RTPU_POOL_SIZE", 256 << 20))
+            capacity = pool_capacity(session_name)
             os.makedirs(_shm_dir(session_name), exist_ok=True)
             pool = NativePool(os.path.join(_shm_dir(session_name), "pool"),
                               capacity=capacity)

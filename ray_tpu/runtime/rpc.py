@@ -80,18 +80,65 @@ IDEMPOTENT_METHODS = frozenset({
     # NOT actor_died: its restart branch bumps num_restarts and spawns a
     # scheduler pass per delivery — a retried-but-executed report would
     # double-restart the actor
-    "kv_get", "kv_put", "kv_del", "kv_keys", "kv_exists",
-    "get_node_info", "get_metrics", "report_metrics", "report_backlog",
+    "kv_get", "kv_put", "kv_del",
+    "get_node_info", "get_metrics", "report_metrics",
     "list_jobs", "register_job", "mark_job_finished",
     "list_placement_groups", "get_placement_group",
-    "list_task_events", "list_tasks", "get_task",
-    "om_meta", "om_endpoint", "chan_endpoint", "view_update",
+    "list_task_events", "list_tasks", "get_task", "list_trace_spans",
+    "om_meta", "om_endpoint", "om_read", "chan_endpoint", "view_update",
     "pick_node", "subscribe",
+    # storage reads (controller persistence tier): re-reading re-reads
+    "st_load_meta", "st_load_kv",
+    # client-proxy liveness touch: a duplicated beat is a no-op
+    "c_heartbeat",
 })
 
 # long-poll methods whose wait is the PRODUCT, not a failure: no default
 # deadline (explicit _timeout still applies)
 UNBOUNDED_METHODS = frozenset({"fetch_object", "c_get", "c_wait"})
+
+# Methods whose handlers have at-most-once side effects: NEVER retried
+# transparently — a retried-but-executed frame double-runs user code,
+# double-frees accounting, or double-fires a state machine. This set
+# exists so the choice is EXPLICIT: rtpuproto's RTPU103 gate fails the
+# build when an RPC method is in none of the three classes, which is
+# how the PR-10 `actor_died` double-restart class of bug gets decided
+# at review time instead of in production. Grouped by server.
+NON_IDEMPOTENT_METHODS = frozenset({
+    # controller: state machines and fan-out (a duplicate actor_died
+    # report double-restarts; a duplicate publish double-delivers)
+    "actor_died", "kill_actor", "drain_node",
+    "create_placement_group", "remove_placement_group",
+    "publish", "add_task_events", "add_trace_spans", "fault_inject",
+    # nodelet: task/actor lifecycle and resource accounting
+    "submit_task", "submit_task_batch", "lease_worker_for_actor",
+    "worker_register", "task_finished", "task_done", "actor_exited",
+    "reserve_bundle", "return_bundle", "cancel_task",
+    "object_sealed", "object_deleted", "fault_forward",
+    # worker executor: user code runs here (dispatch dedupe windows
+    # guard double-DELIVERY, not transport-level double-send)
+    "execute_task", "create_actor", "actor_call", "kill_self",
+    "drain_exit", "shutdown",
+    # owner-side pushes: results/streams are seq-stamped, not retried
+    "task_result", "task_spilled", "task_stream_item", "replica_ready",
+    "borrow_inc", "borrow_dec", "pubsub",
+    # compiled-graph channel writes: seq-replayed by the WRITER's
+    # exactly-once protocol, never by the transport
+    "chan_push",
+    # controller persistence writes (append/compact ordering matters)
+    "st_save_meta", "st_append_kv", "st_compact_kv",
+    # client proxy: submissions and refcounts mirror the owner API
+    "c_export", "c_submit", "c_create_actor", "c_actor_call",
+    "c_release_actor", "c_put", "c_cancel", "c_free", "c_kill_actor",
+    "c_decref", "c_controller", "c_disconnect",
+})
+
+# the three classes partition the RPC surface: a method in two would
+# make retry semantics ambiguous, and rtpuproto (RTPU103) additionally
+# requires every registered method to appear in exactly one
+assert not (IDEMPOTENT_METHODS & NON_IDEMPOTENT_METHODS)
+assert not (IDEMPOTENT_METHODS & UNBOUNDED_METHODS)
+assert not (UNBOUNDED_METHODS & NON_IDEMPOTENT_METHODS)
 
 
 def _call_deadline(method: str, timeout: Optional[float]) -> Optional[float]:

@@ -226,7 +226,7 @@ def _wait_node(address, node_id, timeout):
             try:
                 if node_id in client.call("list_nodes", _timeout=5):
                     return
-            except Exception:
+            except Exception:  # rtpulint: ignore[RTPU006] — registration poll: failure IS the retry condition until the deadline
                 pass
             time.sleep(0.2)
     finally:

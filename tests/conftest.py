@@ -47,10 +47,11 @@ def pytest_configure(config):
         "markers", "sched: decentralized scheduling plane (gossiped "
         "views, p2p spill, locality) tests")
     config.addinivalue_line(
-        "markers", "lint: rtpulint static-analysis tier (analyzer "
-        "self-tests + the zero-unsuppressed-findings gate over "
-        "ray_tpu/{runtime,serve,dag,data,train,tune} and the client "
-        "link)")
+        "markers", "lint: rtpulint/rtpuproto static-analysis tier "
+        "(per-rule fixture self-tests + the zero-unsuppressed-findings "
+        "gates: per-file RTPU001-007 over the whole package, "
+        "whole-program protocol RTPU101-106 over package+tests+"
+        "benchmarks)")
     config.addinivalue_line(
         "markers", "dag: compiled-graph data plane (cross-host "
         "channels, ring collectives, teardown) tests")

@@ -1,0 +1,34 @@
+"""RTPU101 fixture: RPC call sites vs registered handlers, both ways.
+
+Analyzed with the whole-program proto pass over THIS file alone (it is
+its own mini protocol definition); lines that must flag carry trailing
+EXPECT markers, everything else must stay clean. Never imported.
+"""
+
+
+class Server:
+    def _handlers(self):
+        return {
+            "good_method": self.good_method,
+            "dead_method": self.dead_method,  # EXPECT[RTPU101]
+            # rtpulint: ignore[RTPU101] — kept for a rollout window: old clients still dial it
+            "dead_but_excused": self.dead_method,
+            "mentioned_method": self.good_method,
+            "wrapped_method": self.good_method,
+        }
+
+    async def good_method(self, a=None):
+        return a
+
+    async def dead_method(self):
+        return None
+
+
+def caller(client, worker):
+    client.call("good_method", a=1)
+    client.call_async("mispelled_method")  # EXPECT[RTPU101]
+    # a method name routed through a variable is still a live caller
+    meth = "mentioned_method"
+    client.notify(meth)
+    # wrapper form: the *notify*-named helper carries the method string
+    worker._notify_worker(worker, "wrapped_method")

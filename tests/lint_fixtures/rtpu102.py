@@ -1,0 +1,29 @@
+"""RTPU102 fixture: call-site kwargs vs handler signatures.
+
+Analyzed with the proto pass over THIS file alone. Lines that must flag
+carry trailing EXPECT markers. Never imported.
+"""
+
+
+class Server:
+    def _handlers(self):
+        return {
+            "do_thing": self.do_thing,
+            "starry": self.starry,
+        }
+
+    async def do_thing(self, a, b=1, _conn=None):
+        return a + b
+
+    async def starry(self, **kw):
+        return kw
+
+
+def caller(client):
+    client.call("do_thing", a=1, b=2, _timeout=5)  # transport kwarg ok
+    client.call("do_thing", a=1, wrong_kwarg=2)  # EXPECT[RTPU102]
+    # rtpulint: ignore[RTPU102] — exercising the server's TypeError answer on purpose
+    client.call("do_thing", a=1, deliberately_bad=3)
+    client.call("starry", anything=1, goes=2)  # **kw accepts all
+    extras = {"a": 1}
+    client.call("do_thing", **extras)  # open kwarg set: not checkable

@@ -1,0 +1,46 @@
+"""RTPU103 fixture: the three-way failure-class partition of the RPC
+surface (IDEMPOTENT / UNBOUNDED / NON_IDEMPOTENT).
+
+Analyzed with the proto pass over THIS file alone. Lines that must flag
+carry trailing EXPECT markers. Never imported.
+"""
+
+IDEMPOTENT_METHODS = frozenset({
+    "ping",
+    "ghost_method",  # EXPECT[RTPU103]
+    "both_ways",
+})
+
+UNBOUNDED_METHODS = frozenset({
+    "long_poll",
+})
+
+NON_IDEMPOTENT_METHODS = frozenset({  # EXPECT[RTPU103]
+    "mutate",
+    "both_ways",
+})
+
+
+class Server:
+    def _handlers(self):
+        return {
+            "ping": self.ping,
+            "long_poll": self.ping,
+            "mutate": self.ping,
+            "both_ways": self.ping,
+            "unclassified_method": self.ping,  # EXPECT[RTPU103]
+            # rtpulint: ignore[RTPU103] — classification deferred: semantics decided in the follow-up that adds its retry story
+            "excused_unclassified": self.ping,
+        }
+
+    async def ping(self):
+        return "pong"
+
+
+def caller(client):
+    client.call("ping")
+    client.call("long_poll")
+    client.call("mutate")
+    client.call("both_ways")
+    client.call("unclassified_method")
+    client.call("excused_unclassified")

@@ -1,0 +1,38 @@
+"""RTPU104 fixture: fault-plane grammar references vs reality —
+SYNCPOINTS vs planted syncpoints, and fault-rule strings vs the
+methods/syncpoints that exist.
+
+Analyzed with the proto pass over THIS file alone. Lines that must flag
+carry trailing EXPECT markers. Never imported.
+"""
+
+SYNCPOINTS = (
+    "planted.point",
+    "unplanted.point",  # EXPECT[RTPU104]
+)
+
+
+class Server:
+    def _handlers(self):
+        return {"real_method": self.real_method}
+
+    async def real_method(self):
+        syncpoint("planted.point")
+        syncpoint("undocumented.point")  # EXPECT[RTPU104]
+        return True
+
+
+def caller(client):
+    client.call("real_method")
+
+
+FAULT_SPECS = [
+    "drop(real_method,nth=2); delay(real_method,ms=50)",
+    "drop(ghost_method)",  # EXPECT[RTPU104]
+    "kill_at(planted.point,action=raise)",
+    "kill_at(ghost.point)",  # EXPECT[RTPU104]
+    # rtpulint: ignore[RTPU104] — deliberately inert rule: the harness asserts it never fires
+    "probe:drop(intentionally_absent)",
+    "drop(*)",  # wildcard matches any method
+    "nope(not_a_rule)",  # unknown kind: not a fault spec, never parsed
+]

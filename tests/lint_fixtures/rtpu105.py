@@ -1,0 +1,43 @@
+"""RTPU105 fixture: get_config() reads vs RuntimeConfig fields, and
+dead knobs nothing reads.
+
+Analyzed with the proto pass over THIS file alone (defining get_config
+here marks the file as the runtime-config surface, exactly like
+runtime/config.py). Lines that must flag carry trailing EXPECT markers.
+Never imported.
+"""
+
+
+class RuntimeConfig:
+    live_knob: float = 1.0
+    closure_knob: int = 2
+    tolerant_knob: bool = True
+    dead_knob: int = 3  # EXPECT[RTPU105]
+    # rtpulint: ignore[RTPU105] — reserved: the follow-up wiring lands with its subsystem
+    excused_dead_knob: int = 4
+
+
+def get_config():
+    return RuntimeConfig()
+
+
+def _cfg():
+    return get_config()
+
+
+def reader(sink):
+    cfg = get_config()
+    sink(cfg.live_knob)
+    sink(cfg.missing_knob)  # EXPECT[RTPU105]
+    # rtpulint: ignore[RTPU105] — probing a foreign build's knob on purpose
+    sink(cfg.deliberately_missing)
+    # 3-arg getattr is the tolerant compat read: counts as a read of
+    # tolerant_knob, never flags
+    sink(getattr(cfg, "tolerant_knob", False))
+    sink(getattr(_cfg(), "soft_missing", None))
+
+    def closure():
+        # nested frames inherit the enclosing provenance
+        return cfg.closure_knob
+
+    return closure

@@ -1,0 +1,19 @@
+"""RTPU106 fixture: rtpu_* metric-name hygiene — counter suffix and
+one (type, label-set) per name.
+
+Analyzed with the proto pass over THIS file alone. Lines that must flag
+carry trailing EXPECT markers. Never imported.
+"""
+
+
+def declare(Counter, Gauge, Histogram):
+    a = Counter("rtpu_good_total", "fine", ("rule",))
+    b = Counter("rtpu_bad_count", "counter must end _total")  # EXPECT[RTPU106]
+    # rtpulint: ignore[RTPU106] — legacy dashboard key: renaming breaks saved queries, migration tracked
+    c = Counter("rtpu_grandfathered_count", "suppressed")
+    d = Gauge("rtpu_thing_total", "gauge must not end _total")  # EXPECT[RTPU106]
+    e = Counter("rtpu_dup_total", "first declaration", ("x",))
+    f = Counter("rtpu_dup_total", "conflicting labels", ("y",))  # EXPECT[RTPU106]
+    g = Counter("rtpu_dup_total", "same labels is fine", ("x",))
+    h = Histogram("rtpu_latency_seconds", "fine")
+    return a, b, c, d, e, f, g, h

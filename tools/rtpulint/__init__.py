@@ -1,2 +1,3 @@
 from .analyzer import (RULES, Finding, analyze_file, analyze_source,  # noqa: F401
                        iter_python_files, render_human, render_json, run)
+from .proto import default_aux_paths, run_proto  # noqa: F401

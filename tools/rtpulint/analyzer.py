@@ -46,6 +46,23 @@ RULES: Dict[str, Tuple[str, str]] = {
     "RTPU006": ("warning", "blanket `except: pass` without a log or "
                            "counter hides real failures"),
     "RTPU007": ("error", "container mutated while iterating it"),
+    # whole-program protocol rules (tools/rtpulint/proto.py): these need
+    # the cross-module model, so the per-file pass never emits them, but
+    # they live in the one registry so pragmas, --select and JSON output
+    # treat both passes identically
+    "RTPU101": ("error", "RPC call site names a method no server "
+                         "registers, or a registered handler nothing "
+                         "calls"),
+    "RTPU102": ("error", "RPC call site passes kwargs the handler "
+                         "signature cannot accept"),
+    "RTPU103": ("error", "RPC method in no deliberate failure class "
+                         "(IDEMPOTENT / UNBOUNDED / NON_IDEMPOTENT)"),
+    "RTPU104": ("error", "fault rule or kill_at syncpoint references a "
+                         "method/syncpoint that does not exist"),
+    "RTPU105": ("error", "unknown get_config() attribute read, or a "
+                         "dead RuntimeConfig knob no code reads"),
+    "RTPU106": ("warning", "rtpu_* metric-name violation (counter "
+                           "suffix, conflicting type/label sets)"),
 }
 
 # pragma grammar: "# rtpulint: ignore[RTPU001,RTPU003] — reason text"
@@ -637,9 +654,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.rtpulint",
         description="AST concurrency-invariant analyzer for the ray_tpu "
-                    "runtime (rules RTPU001-RTPU007)")
+                    "runtime (per-file rules RTPU001-RTPU007; "
+                    "--proto adds the whole-program protocol pass "
+                    "RTPU101-RTPU106)")
     parser.add_argument("paths", nargs="+",
                         help="files or directories to analyze")
+    parser.add_argument("--proto", action="store_true",
+                        help="run the cross-module protocol pass "
+                             "(RTPU101-106) over the package instead of "
+                             "the per-file rules; tests/ and benchmarks/ "
+                             "siblings are scanned as auxiliary evidence")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable output")
     parser.add_argument("--select", default="",
@@ -651,7 +675,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     select = {r.strip().upper() for r in args.select.split(",")
               if r.strip()} or None
     try:
-        findings, n_files = run(args.paths, select=select)
+        if args.proto:
+            from .proto import default_aux_paths, run_proto
+
+            aux: List[str] = []
+            for p in args.paths:
+                aux.extend(default_aux_paths(p))
+            findings, n_files = run_proto(args.paths, aux_paths=aux)
+            if select:
+                findings = [f for f in findings
+                            if f.rule in select or f.rule == "RTPU000"]
+        else:
+            findings, n_files = run(args.paths, select=select)
     except FileNotFoundError as e:
         print(f"rtpulint: error: {e}", file=sys.stderr)
         return 2
