@@ -344,6 +344,17 @@ def bench_chaos_drill() -> dict:
     return _run_bench_json("chaos_drill.py", 300)
 
 
+def bench_overload_drill() -> dict:
+    """Serve admission plane under overload (benchmarks/
+    overload_drill.py): open-loop arrival at 1x-10x of measured
+    capacity against a slow deployment — goodput held at 10x
+    (serve_goodput_rps vs serve_capacity_rps), typed-429 shedding
+    (serve_shed_rate, serve_reject_p99_ms < 1s), bounded p99 of
+    admitted traffic (serve_admitted_p99_ms), zero untyped timeouts,
+    and a chaos wave with delay(execute_task) injected mid-overload."""
+    return _run_bench_json("overload_drill.py", 300)
+
+
 def bench_train(on_tpu: bool) -> dict:
     import jax
     import jax.numpy as jnp
@@ -520,6 +531,22 @@ def main():
         except Exception as e:  # noqa: BLE001
             result["detail"]["chaos_drill"] = {"error": repr(e)[:200]}
             result["detail"]["chaos_drills_green"] = False
+
+    # 8b. overload drill: the Serve admission plane at 1x-10x offered
+    # load (serve_goodput_rps / serve_shed_rate / serve_admitted_p99_ms
+    # keys), same time guard — graceful degradation alongside recovery
+    if time.perf_counter() - start < 480:
+        try:
+            overload = bench_overload_drill()
+            result["detail"]["overload_drill"] = overload
+            for key in ("serve_capacity_rps", "serve_goodput_rps",
+                        "serve_shed_rate", "serve_admitted_p99_ms",
+                        "serve_untyped_timeouts", "overload_green"):
+                if key in overload:
+                    result["detail"][key] = overload[key]
+        except Exception as e:  # noqa: BLE001
+            result["detail"]["overload_drill"] = {"error": repr(e)[:200]}
+            result["detail"]["overload_green"] = False
 
     # 9. static analysis: rtpulint per-file rules over the WHOLE package
     # (cheap, ~2s). lint_clean records when the tree regresses on a

@@ -82,6 +82,47 @@ class TaskCancelledError(RtpuError):
     pass
 
 
+class ServiceOverloadedError(RtpuError):
+    """A Serve request was rejected AT ADMISSION: the deployment's bounded
+    queue is full, the estimated queue wait exceeds the request's remaining
+    deadline, or the deployment is browning out. Mapped by the ingress
+    proxies to HTTP 429 / gRPC RESOURCE_EXHAUSTED with a Retry-After hint —
+    overload degrades into fast typed rejections, never a timeout storm.
+
+    Subclasses RtpuError so worker error propagation ships it typed
+    (``_send_error`` forwards RtpuError subclasses unwrapped)."""
+
+    def __init__(self, message: str = "service overloaded",
+                 reason: str = "queue_full",
+                 retry_after_s: "float | None" = None):
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (ServiceOverloadedError,
+                (self.args[0] if self.args else "service overloaded",
+                 self.reason, self.retry_after_s))
+
+
+class RequestExpiredError(RtpuError, TimeoutError):
+    """A Serve request's propagated deadline expired before (or while) it
+    could be executed; every hop sheds such requests immediately instead of
+    doing dead work. Subclasses TimeoutError so deadline-aware callers keep
+    working, but the typed name is what proxies map (504 + error-type
+    header) and what drills count — distinct from an untyped timeout."""
+
+    def __init__(self, message: str = "request deadline expired",
+                 where: str = ""):
+        self.where = where
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (RequestExpiredError,
+                (self.args[0] if self.args else "request deadline expired",
+                 self.where))
+
+
 class PlacementGroupSchedulingError(RtpuError):
     pass
 

@@ -163,6 +163,22 @@ class RuntimeConfig:
     # a survivor. Raise it for long-step jobs.
     split_consumer_timeout_s: float = 60.0
 
+    # --- Serve admission plane (serve/admission.py, handle.py) ---
+    # Default end-to-end deadline stamped on a Serve request at its FIRST
+    # hop (proxy or driver-side handle) when the caller gives none; the
+    # absolute deadline then propagates handle -> router -> replica ->
+    # engine queue, and any hop that observes it expired sheds the
+    # request with a typed RequestExpiredError instead of executing dead
+    # work. 0 disables default deadlines (explicit timeout_s still
+    # propagates).
+    serve_request_timeout_s: float = 60.0
+    # Smoothing factor (0..1] for the admission plane's EWMAs: the
+    # per-router service-time estimate that turns queue depth into a
+    # queue-WAIT estimate, and the controller's per-deployment shed-rate
+    # that routers consult for brownout. Higher = reacts faster,
+    # forgets faster; the effective horizon is ~1/alpha observations.
+    serve_ewma_alpha: float = 0.2
+
     # --- memory monitor (ref: src/ray/common/memory_monitor.h:52 —
     # cgroup/rss watcher; kill policy raylet/worker_killing_policy.cc) ---
     memory_usage_threshold: float = 0.95

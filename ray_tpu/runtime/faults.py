@@ -32,11 +32,15 @@ the controller's ``fault_inject`` admin RPC). Rules are ';'-separated::
   identity alias such as "controller"/"nodelet", or an address
   substring). Requests hang into their deadline; one-way notifies drop
   silently — precisely what a dead link looks like from the sender.
-- ``kill_at(syncpoint)`` fires at named points planted in the runtime:
-  ``nodelet.dispatch``, ``transfer.pull``, ``channel.push``,
-  ``serve.reconcile``, ``controller.health_sweep``. ``action=exit``
-  (default) terminates the process with exit code 43; ``action=raise``
-  raises :class:`FaultInjectedError` in place (for in-process tests).
+- ``kill_at(syncpoint)`` fires at named points planted in the runtime
+  (the ``SYNCPOINTS`` inventory below: ``nodelet.dispatch``,
+  ``transfer.pull``, ``channel.push``, ``serve.reconcile``,
+  ``serve.admission`` — the Serve router's admission decision, so
+  overload drills can kill/delay exactly between admission and
+  execution — ``controller.health_sweep``, ``data.split_pull``).
+  ``action=exit`` (default) terminates the process with exit code 43;
+  ``action=raise`` raises :class:`FaultInjectedError` in place (for
+  in-process tests).
 
 Every injection increments ``rtpu_faults_injected_total{rule=<name>}``;
 ``FaultPlane.snapshot()`` (surfaced on ``get_node_info`` and in the
@@ -65,6 +69,7 @@ SYNCPOINTS = (
     "transfer.pull",
     "channel.push",
     "serve.reconcile",
+    "serve.admission",
     "controller.health_sweep",
     "data.split_pull",
 )

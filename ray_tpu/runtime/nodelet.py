@@ -338,6 +338,11 @@ class Nodelet:
                                "pick_node_rpcs": 0, "spill_bounces": 0,
                                "spills_received": 0}
         self.spill_hops_hist: Dict[int, int] = {}
+        # last-reported rtpu_serve_* snapshot per worker (keyed by the
+        # flush's node_id/worker tag): workers host the Serve replicas
+        # and proxies, so their admission counters must fold into THIS
+        # node's get_node_info for the autoscaler to see rejects
+        self._worker_serve_metrics: Dict[str, Dict[str, float]] = {}
         self._factory_proc = None
         self._factory_path = os.path.join(
             session_dir, "sock", f"factory-{node_id[:8]}.sock")
@@ -1994,6 +1999,17 @@ class Nodelet:
         """Worker metric snapshots ride the nodelet connection too (same
         rationale as actor_ready; losses are fine — the worker's flush
         loop resends on the next tick)."""
+        serve_family = {
+            k: v for k, v in (metrics or {}).items()
+            if k.startswith("rtpu_serve_")
+            and k.split("{", 1)[0].endswith("_total")}
+        if serve_family:
+            # retained for get_node_info aggregation: replica/proxy
+            # sheds happen in worker processes, not this one. COUNTERS
+            # only — cumulative, so a dead worker's last snapshot stays
+            # valid forever; a retained gauge (queue wait) would pin the
+            # historical worst value past the worker's death.
+            self._worker_serve_metrics[node_id] = serve_family
         try:
             return await self.controller.call_async(
                 "report_metrics", node_id=node_id, metrics=metrics)
@@ -2075,7 +2091,31 @@ class Nodelet:
             # active fault rules + per-rule seen/fired counters, so
             # drills can assert an injection actually happened
             "faults": faults.get_plane().snapshot(),
+            # Serve admission-plane counters: this process's registry
+            # (single-host sessions run driver + routers here) PLUS the
+            # last snapshot each worker flushed (replicas/proxies live
+            # there) — the autoscaler reads rejects, not just queue
+            # depth. Staleness is bounded by metrics_report_interval_s.
+            "serve": self._serve_metrics(),
         }
+
+    def _serve_metrics(self) -> Dict[str, float]:
+        out = dict(_serve_metrics_snapshot())
+        for snap in self._worker_serve_metrics.values():
+            for key, value in snap.items():
+                out[key] = out.get(key, 0.0) + value  # counters sum
+        return out
+
+
+def _serve_metrics_snapshot() -> Dict[str, float]:
+    """rtpu_serve_* admission counters from this process's registry
+    (empty when no Serve traffic has touched this process)."""
+    try:
+        from ..util import metrics
+
+        return metrics.snapshot("rtpu_serve_")
+    except Exception:  # rtpulint: ignore[RTPU006] — node info is advisory telemetry; a metrics hiccup must not fail the RPC
+        return {}
 
 
 def _leq(req: Dict[str, float], avail: Dict[str, float]) -> bool:

@@ -23,7 +23,9 @@ from .config import (AutoscalingConfig, DeploymentConfig,  # noqa: F401
 from .deployment import Application, Deployment, deployment  # noqa: F401
 from .handle import DeploymentHandle, DeploymentResponse  # noqa: F401
 from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
-from .replica import Request  # noqa: F401
+from .replica import Request, get_request_deadline  # noqa: F401
+from ..exceptions import (RequestExpiredError,  # noqa: F401
+                          ServiceOverloadedError)
 
 __all__ = [
     "deployment", "Deployment", "Application", "run", "start", "status",
@@ -31,5 +33,6 @@ __all__ = [
     "get_proxy_url", "get_grpc_address", "DeploymentHandle",
     "DeploymentResponse", "multiplexed", "get_multiplexed_model_id",
     "AutoscalingConfig", "DeploymentConfig", "HTTPOptions", "gRPCOptions",
-    "Request",
+    "Request", "get_request_deadline", "RequestExpiredError",
+    "ServiceOverloadedError",
 ]

@@ -1,0 +1,251 @@
+"""Serve admission plane: deadlines, load shedding, and error mapping.
+
+The shared vocabulary of the overload-tolerant traffic plane (ref:
+python/ray/serve/_private — proxy request timeouts, replica queue-length
+caps, and the backpressure error surfaced as HTTP 503/429 there; here the
+discipline is end-to-end and typed, extending PR 10's
+RpcTimeoutError/NodeUnreachableError contract to the Serve stack):
+
+- every request carries an ABSOLUTE deadline from its first hop
+  (``serve_request_timeout_s`` default, ``timeout_s`` header / handle
+  option override) through handle._Router -> ReplicaActor -> the LLM
+  engine queue; a hop that observes the deadline expired sheds with the
+  typed :class:`~ray_tpu.exceptions.RequestExpiredError` instead of
+  executing dead work;
+- admission is BOUNDED: per-router and per-replica ``max_queued_requests``
+  caps plus a queue-wait estimate (EWMA of recent service times) shed at
+  admission with :class:`~ray_tpu.exceptions.ServiceOverloadedError` — a
+  fast typed rejection the proxies map to 429/RESOURCE_EXHAUSTED with a
+  Retry-After hint, never a timeout;
+- sheds/admits flow into ``rtpu_serve_*`` metrics here and piggyback on
+  the routing-table poll so the controller keeps a per-deployment
+  shed-rate EWMA (brownout state) that routers consult before hammering
+  a saturated deployment, and the autoscaler scales on rejects.
+
+This module is deliberately tiny and dependency-light: the proxies, the
+handle router, the replica, and the LLM engine all import it.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from ..exceptions import (ActorDiedError, ActorError, ObjectLostError,
+                          RequestExpiredError, ServiceOverloadedError,
+                          TaskError, WorkerCrashedError)
+
+# shed reasons (the rtpu_serve_shed_total label values)
+SHED_QUEUE_FULL = "queue_full"        # bounded queue at capacity
+SHED_DEADLINE = "deadline"            # est. wait exceeds remaining deadline
+SHED_BROWNOUT = "brownout"            # deployment-wide shed-rate EWMA high
+SHED_EXPIRED = "expired"              # deadline already expired at this hop
+SHED_REPLICA_QUEUE = "replica_queue_full"  # per-replica overcommit net
+SHED_ENGINE_EXPIRED = "engine_expired"     # pruned from the WAITING queue
+
+# a deployment whose shed-rate EWMA crosses this is browning out: routers
+# stop queueing new arrivals behind an already-saturated deployment
+BROWNOUT_SHED_RATE = 0.5
+
+_metrics = None
+
+
+def get_metrics() -> Dict[str, Any]:
+    """Lazy per-process admission metrics (util.metrics registers on
+    construction; instances re-registering a name share one series)."""
+    global _metrics
+    if _metrics is None:
+        from ..util.metrics import Counter, Gauge
+
+        _metrics = {
+            "shed": Counter(
+                "rtpu_serve_shed_total",
+                "serve requests shed by the admission plane", ("reason",)),
+            "admitted": Counter(
+                "rtpu_serve_admitted_total",
+                "serve requests admitted past router admission"),
+            "queue_wait": Gauge(
+                "rtpu_serve_queue_wait_s",
+                "most recent router queue wait of an admitted request"),
+        }
+    return _metrics
+
+
+def count_shed(reason: str) -> None:
+    get_metrics()["shed"].inc(tags={"reason": reason})
+    # Sheds are the overload signal the autoscaler reacts to — the
+    # default 30s metrics floor (tuned for steady-state telemetry)
+    # would land them uselessly late, so a shedding process flushes its
+    # registry within ~1s (still piggyback-cheap: one clock read when
+    # the floor has not elapsed).
+    try:
+        from ..runtime.core import get_core
+
+        core = get_core(required=False)
+        if core is not None:
+            core.maybe_flush_metrics(min_interval_s=1.0)
+    except Exception:  # rtpulint: ignore[RTPU006] — metric delivery is advisory; shedding must never fail on it
+        pass
+
+
+def default_deadline(now: Optional[float] = None) -> Optional[float]:
+    """Absolute default deadline for a request entering the plane now
+    (None when default deadlines are disabled)."""
+    from ..runtime.config import get_config
+
+    timeout_s = get_config().serve_request_timeout_s
+    if timeout_s <= 0:
+        return None
+    return (time.time() if now is None else now) + timeout_s
+
+
+def remaining(deadline: Optional[float],
+              now: Optional[float] = None) -> Optional[float]:
+    if deadline is None:
+        return None
+    return deadline - (time.time() if now is None else now)
+
+
+def expired(deadline: Optional[float],
+            now: Optional[float] = None) -> bool:
+    return deadline is not None and (
+        (time.time() if now is None else now) >= deadline)
+
+
+class ServiceTimeEWMA:
+    """Exponentially weighted service-time estimate (seconds). alpha from
+    the serve_ewma_alpha knob; ~1/alpha-call horizon. None until the
+    first observation — estimators must not invent a wait from nothing."""
+
+    def __init__(self, alpha: Optional[float] = None):
+        if alpha is None:
+            from ..runtime.config import get_config
+
+            alpha = get_config().serve_ewma_alpha
+        self.alpha = min(1.0, max(1e-3, float(alpha)))
+        self.value: Optional[float] = None
+
+    def update(self, sample_s: float) -> float:
+        sample_s = max(0.0, float(sample_s))
+        if self.value is None:
+            self.value = sample_s
+        else:
+            self.value += self.alpha * (sample_s - self.value)
+        return self.value
+
+    def estimate_wait(self, queue_position: int, capacity: int) -> float:
+        """Expected wait for a request entering the queue at
+        ``queue_position`` (1-based) when ``capacity`` requests run
+        concurrently: full service waves ahead of it times the smoothed
+        service time. 0.0 while there is no estimate yet."""
+        if self.value is None or queue_position <= 0:
+            return 0.0
+        waves = math.ceil(queue_position / max(1, capacity))
+        return waves * self.value
+
+
+# ------------------------------------------------------------ error mapping
+# classification symbols shared by the HTTP and gRPC proxies so the two
+# protocols cannot silently diverge (satellite: every typed runtime error
+# maps to a proper status, never a generic 500 with a pickled traceback)
+KIND_OVERLOADED = "overloaded"
+KIND_EXPIRED = "expired"
+KIND_TIMEOUT = "timeout"
+KIND_UNREACHABLE = "unreachable"
+KIND_INTERNAL = "internal"
+
+HTTP_STATUS = {
+    KIND_OVERLOADED: 429,
+    KIND_EXPIRED: 504,
+    KIND_TIMEOUT: 504,
+    KIND_UNREACHABLE: 503,
+    KIND_INTERNAL: 500,
+}
+
+_UNREACHABLE_NAMES = {"NodeUnreachableError", "ConnectionLost",
+                      "ActorDiedError", "ActorUnavailableError",
+                      "WorkerCrashedError", "ObjectLostError"}
+_TIMEOUT_NAMES = {"RpcTimeoutError", "GetTimeoutError", "TimeoutError",
+                  "CancelledError"}
+
+
+def error_kind(exc: BaseException) -> str:
+    """Map an exception (possibly a TaskError wrapping the real cause by
+    name) to a proxy status symbol."""
+    from ..runtime.rpc import ConnectionLost, RpcTimeoutError
+
+    if isinstance(exc, ServiceOverloadedError):
+        return KIND_OVERLOADED
+    if isinstance(exc, RequestExpiredError):
+        return KIND_EXPIRED
+    if isinstance(exc, (ActorDiedError, ActorError, WorkerCrashedError,
+                        ObjectLostError, ConnectionLost)):
+        return KIND_UNREACHABLE
+    import asyncio
+    import concurrent.futures
+
+    if isinstance(exc, (RpcTimeoutError, TimeoutError,
+                        asyncio.TimeoutError,
+                        concurrent.futures.TimeoutError)):
+        # pre-3.11 the three TimeoutErrors are distinct classes; list
+        # them all — a deadline that fired anywhere must never surface
+        # as a generic 500
+        return KIND_TIMEOUT
+    if isinstance(exc, TaskError):
+        name = exc.cause_cls_name
+        if name == "ServiceOverloadedError":
+            return KIND_OVERLOADED
+        if name == "RequestExpiredError":
+            return KIND_EXPIRED
+        if name in _UNREACHABLE_NAMES:
+            return KIND_UNREACHABLE
+        if name in _TIMEOUT_NAMES:
+            return KIND_TIMEOUT
+    return KIND_INTERNAL
+
+
+def error_type_name(exc: BaseException) -> str:
+    """The typed name surfaced in the X-Error-Type header / trailing
+    metadata: the wrapped cause for TaskError, the class otherwise."""
+    if isinstance(exc, TaskError):
+        return exc.cause_cls_name
+    return type(exc).__name__
+
+
+def retry_after_s(exc: BaseException) -> int:
+    """Retry-After hint (whole seconds, >= 1) for overload rejections."""
+    hint = getattr(exc, "retry_after_s", None)
+    if not hint or hint <= 0:
+        return 1
+    return max(1, int(math.ceil(hint)))
+
+
+def http_error_response(exc: BaseException) -> Tuple[int, Dict[str, str], str]:
+    """(status, headers, body) for the HTTP proxy. Typed errors keep a
+    one-line body — the remote traceback stays in logs, not on the wire."""
+    kind = error_kind(exc)
+    status = HTTP_STATUS[kind]
+    headers = {"X-Error-Type": error_type_name(exc)}
+    if kind == KIND_OVERLOADED:
+        headers["Retry-After"] = str(retry_after_s(exc))
+    if kind == KIND_INTERNAL:
+        body = f"{type(exc).__name__}: {exc}"
+    else:
+        first_line = str(exc).splitlines()[0] if str(exc) else kind
+        body = f"{error_type_name(exc)}: {first_line}"
+    return status, headers, body
+
+
+def grpc_status_for(exc: BaseException):
+    """The gRPC StatusCode mirroring HTTP_STATUS (429 ->
+    RESOURCE_EXHAUSTED, 503 -> UNAVAILABLE, 504 -> DEADLINE_EXCEEDED)."""
+    import grpc
+
+    return {
+        KIND_OVERLOADED: grpc.StatusCode.RESOURCE_EXHAUSTED,
+        KIND_EXPIRED: grpc.StatusCode.DEADLINE_EXCEEDED,
+        KIND_TIMEOUT: grpc.StatusCode.DEADLINE_EXCEEDED,
+        KIND_UNREACHABLE: grpc.StatusCode.UNAVAILABLE,
+        KIND_INTERNAL: grpc.StatusCode.INTERNAL,
+    }[error_kind(exc)]

@@ -54,6 +54,15 @@ class AutoscalingConfig:
 class DeploymentConfig:
     num_replicas: int = 1
     max_ongoing_requests: int = DEFAULT_MAX_ONGOING_REQUESTS
+    # Admission cap: how many requests may WAIT for a free replica slot
+    # (beyond the max_ongoing in-flight ones) before new arrivals are
+    # shed with a typed ServiceOverloadedError. Enforced independently
+    # per handle-router (each router process bounds its own queue) and
+    # per replica (ongoing beyond max_ongoing + this cap sheds — the
+    # safety net when several routers overcommit one replica). 0
+    # disables queueing entirely (admit-or-shed); negative disables the
+    # cap (pre-admission-plane unbounded behavior).
+    max_queued_requests: int = 100
     user_config: Optional[Any] = None
     autoscaling_config: Optional[AutoscalingConfig] = None
     health_check_period_s: float = 10.0

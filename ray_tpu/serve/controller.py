@@ -61,6 +61,17 @@ class _DeploymentState:
         # autoscaling smoothing state
         self._scale_up_since: Optional[float] = None
         self._scale_down_since: Optional[float] = None
+        # overload (brownout) state: EWMA of the shed FRACTION reported
+        # by routers with their routing-table polls (+ replica-side shed
+        # deltas folded in by _autoscale). Published back on the routing
+        # table so every router sees cluster-wide saturation, and fed to
+        # the autoscaler so it scales on rejects, not just queue depth.
+        self.shed_rate_ewma = 0.0
+        self._last_stats_at = 0.0
+        # sheds accumulated since the autoscaler last consumed them
+        self._shed_window = 0
+        # cumulative per-replica shed counters already consumed
+        self._replica_sheds_seen: Dict[str, int] = {}
         # prefix-cache registry polling state: None = unknown (probe),
         # False = replicas expose no KV frontier (stop probing)
         self._kv_enabled: Optional[bool] = None
@@ -171,6 +182,7 @@ class ServeControllerActor:
     async def _reconcile_once(self) -> None:
         for app_name, states in list(self._apps.items()):
             for state in list(states.values()):
+                self._decay_overload(state)
                 await self._autoscale(state)
                 await self._health_check(state)
                 await self._kv_poll(state)
@@ -423,6 +435,34 @@ class ServeControllerActor:
             "page_size": next(iter(page_sizes)) if page_sizes else None,
         }
 
+    def _note_router_stats(self, state: _DeploymentState,
+                           stats: dict) -> None:
+        """Fold one router's shed/admit deltas (piggybacked on its
+        routing-table poll) into the deployment's overload state."""
+        sheds = int(stats.get("shed", 0)) + int(stats.get("expired", 0))
+        admits = int(stats.get("admitted", 0))
+        if sheds + admits <= 0:
+            return
+        from ..runtime.config import get_config
+
+        alpha = get_config().serve_ewma_alpha
+        rate = sheds / (sheds + admits)
+        state.shed_rate_ewma += alpha * (rate - state.shed_rate_ewma)
+        state._shed_window += sheds
+        state._last_stats_at = time.time()
+
+    def _decay_overload(self, state: _DeploymentState) -> None:
+        """Brownout must clear itself: with no shed reports for a few
+        seconds (traffic stopped, or admission is succeeding again) the
+        published shed rate decays toward zero each reconcile tick
+        instead of pinning routers in brownout forever."""
+        if state.shed_rate_ewma <= 0.0:
+            return
+        if time.time() - state._last_stats_at > 5.0:
+            state.shed_rate_ewma *= 0.95
+            if state.shed_rate_ewma < 0.01:
+                state.shed_rate_ewma = 0.0
+
     async def _autoscale(self, state: _DeploymentState) -> None:
         cfg = state.config.autoscaling_config
         if cfg is None or not state.replicas:
@@ -438,10 +478,32 @@ class ServeControllerActor:
         for rep in state.replicas.values():
             fut = futs.get(rep.replica_id)
             if fut is not None and fut.done() and fut.exception() is None:
-                rep.ongoing = fut.result()["ongoing"]
+                metrics = fut.result()
+                rep.ongoing = metrics["ongoing"]
+                # replica-side sheds (multi-router overcommit net) join
+                # the shed window as their delta since the last poll
+                sheds = int(metrics.get("shed_total", 0) or 0)
+                seen = state._replica_sheds_seen.get(rep.replica_id, 0)
+                if sheds > seen:
+                    state._shed_window += sheds - seen
+                state._replica_sheds_seen[rep.replica_id] = sheds
             elif fut is not None and not fut.done():
                 fut.cancel()
             total += rep.ongoing
+        for rid in list(state._replica_sheds_seen):
+            if rid not in state.replicas:
+                del state._replica_sheds_seen[rid]
+        # Scale on REJECTS, not just queue depth: a shed request never
+        # shows up in `ongoing`, so a saturated deployment shedding 90%
+        # of its traffic would otherwise look exactly at target. Inflate
+        # observed demand by the shed fraction (bounded 20x), and let a
+        # non-empty shed window force at least target-exceeding demand.
+        if state.shed_rate_ewma > 0.0:
+            total = total / max(0.05, 1.0 - min(0.95, state.shed_rate_ewma))
+        if state._shed_window > 0:
+            total = max(total, len(state.replicas)
+                        * cfg.target_ongoing_requests + 1)
+            state._shed_window = 0
         desired = cfg.desired_replicas(total, len(state.replicas))
         now = time.time()
         if desired > state.target_replicas:
@@ -465,10 +527,16 @@ class ServeControllerActor:
     # ------------------------------------------------------------ queries
 
     def get_routing_table(self, app_name: str, deployment_name: str,
-                          for_request: bool = False) -> Optional[dict]:
+                          for_request: bool = False,
+                          router_stats: Optional[dict] = None,
+                          ) -> Optional[dict]:
         state = self._apps.get(app_name, {}).get(deployment_name)
         if state is None:
             return None
+        if router_stats:
+            # shed/admit deltas ride the poll the router makes anyway;
+            # they feed the brownout EWMA published right back below
+            self._note_router_stats(state, router_stats)
         if for_request and state.target_replicas == 0:
             # Scale-from-zero: a router asked on behalf of a live request
             # (ref: autoscaling wakes on handle queue metrics).
@@ -477,6 +545,9 @@ class ServeControllerActor:
         return {
             "version": state.version,
             "max_ongoing_requests": state.config.max_ongoing_requests,
+            "max_queued_requests": getattr(
+                state.config, "max_queued_requests", -1),
+            "shed_rate": round(state.shed_rate_ewma, 4),
             "replicas": [rep.handle.actor_id
                          for rep in state.replicas.values()
                          if rep.healthy and rep.ready],
@@ -504,6 +575,8 @@ class ServeControllerActor:
                                else "UPDATING"),
                     "replicas": n_ready,
                     "target_replicas": state.target_replicas,
+                    # overload observability: the published brownout EWMA
+                    "shed_rate": round(state.shed_rate_ewma, 4),
                 }
             app_ok = all(d["status"] == "HEALTHY"
                          for d in deployments.values())

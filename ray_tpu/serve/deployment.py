@@ -56,6 +56,7 @@ class Deployment:
     def options(self, *, name: Optional[str] = None,
                 num_replicas: Optional[int] = None,
                 max_ongoing_requests: Optional[int] = None,
+                max_queued_requests: Optional[int] = None,
                 user_config: Optional[Any] = None,
                 autoscaling_config: Optional[Any] = None,
                 health_check_period_s: Optional[float] = None,
@@ -69,6 +70,8 @@ class Deployment:
             cfg.num_replicas = num_replicas
         if max_ongoing_requests is not None:
             cfg.max_ongoing_requests = max_ongoing_requests
+        if max_queued_requests is not None:
+            cfg.max_queued_requests = max_queued_requests
         if user_config is not None:
             cfg.user_config = user_config
         if autoscaling_config is not None:
@@ -103,6 +106,7 @@ def _coerce_autoscaling(value) -> AutoscalingConfig:
 def deployment(func_or_class=None, *, name: Optional[str] = None,
                num_replicas: Optional[int] = None,
                max_ongoing_requests: Optional[int] = None,
+               max_queued_requests: Optional[int] = None,
                user_config: Optional[Any] = None,
                autoscaling_config: Optional[Any] = None,
                health_check_period_s: Optional[float] = None,
@@ -119,6 +123,8 @@ def deployment(func_or_class=None, *, name: Optional[str] = None,
             cfg.num_replicas = num_replicas
         if max_ongoing_requests is not None:
             cfg.max_ongoing_requests = max_ongoing_requests
+        if max_queued_requests is not None:
+            cfg.max_queued_requests = max_queued_requests
         if user_config is not None:
             cfg.user_config = user_config
         if autoscaling_config is not None:

@@ -120,20 +120,47 @@ class GrpcProxyActor(RouteTableMixin):
         req = Request(method="GRPC", path=method, query_params={},
                       headers=metadata, body=body)
 
+        from . import admission
         from .handle import DeploymentHandle
+        from .proxy import request_timeout_s
 
+        # deadline at the first hop: explicit timeout_s metadata wins,
+        # then the client's own gRPC deadline (time_remaining), then the
+        # serve_request_timeout_s default — mirroring the HTTP proxy
+        timeout_s = request_timeout_s(metadata.get)
+        client_remaining = None
+        try:
+            client_remaining = context.time_remaining()
+        except Exception:  # rtpulint: ignore[RTPU006] — non-aio test contexts may not implement it; the header/default path still bounds the request
+            pass
+        if client_remaining is not None and (
+                timeout_s is None or client_remaining < timeout_s):
+            timeout_s = max(0.001, client_remaining)
         handle = DeploymentHandle(route["app"], route["ingress"])
+        if timeout_s is not None:
+            handle = handle.options(timeout_s=timeout_s)
         model_id = metadata.get("multiplexed_model_id")
         if model_id:
             handle = handle.options(multiplexed_model_id=model_id)
         loop = asyncio.get_running_loop()
+        result_budget = timeout_s + 5 if timeout_s is not None else 120
 
         def call():
-            return handle.remote(req).result(timeout_s=120)
+            return handle.remote(req).result(timeout_s=result_budget)
 
         try:
             result = await loop.run_in_executor(self._call_pool, call)
-        except Exception as e:  # surface user errors as INTERNAL
-            await context.abort(grpc.StatusCode.INTERNAL,
-                                f"{type(e).__name__}: {e}")
+        except Exception as e:
+            # the typed mapping mirrors the HTTP proxy's status table:
+            # 429 -> RESOURCE_EXHAUSTED (+retry-after-s), 503 ->
+            # UNAVAILABLE, 504 -> DEADLINE_EXCEEDED, else INTERNAL;
+            # the error type name rides the trailing metadata
+            trailers = [("error-type", admission.error_type_name(e))]
+            if admission.error_kind(e) == admission.KIND_OVERLOADED:
+                trailers.append(
+                    ("retry-after-s", str(admission.retry_after_s(e))))
+            context.set_trailing_metadata(trailers)
+            await context.abort(
+                admission.grpc_status_for(e),
+                f"{admission.error_type_name(e)}: {e}")
         return _encode_reply(result)

@@ -27,9 +27,14 @@ from typing import Any, Dict, Optional
 from .config import CONTROLLER_NAME
 
 # Shared pool driving request submission; sized generously since entries
-# block only while every replica of the target deployment is saturated.
+# block only while every replica of the target deployment is saturated
+# (parked-on-IO, not running). It must stay comfortably ABOVE the
+# default DeploymentConfig.max_queued_requests (100): every queued
+# picker parks a worker here, and once the pool is exhausted further
+# remote() calls wait in the executor's own unbounded queue where no
+# admission or deadline logic runs yet — the cap would be unreachable.
 _SUBMIT_POOL = concurrent.futures.ThreadPoolExecutor(
-    max_workers=64, thread_name_prefix="serve-submit")
+    max_workers=256, thread_name_prefix="serve-submit")
 
 # prefix-affinity gives way to load balance beyond this in-flight skew
 _PREFIX_IMBALANCE = 4
@@ -68,8 +73,8 @@ class DeploymentResponse:
         self._ref_fut.set_result(ref)
 
         def _done(fut):
-            on_done()
             err = fut.exception()
+            on_done(err)
             if err is not None:
                 self._result_fut.set_exception(err)
             else:
@@ -118,6 +123,31 @@ class _Router:
         self.inflight: Dict[str, int] = {}  # actor_id -> count
         self.cond = threading.Condition()
         self._last_refresh = 0.0
+        # ---- admission plane (bounded queue + deadline shedding) ----
+        from . import admission as _admission
+
+        # pickers currently parked waiting for a replica slot; bounded
+        # by max_queued (per handle-router cap from the routing table)
+        self.queued = 0
+        # FIFO fairness for the bounded queue: pickers drain in arrival
+        # order (Condition.notify wakes an ARBITRARY waiter — without
+        # this, an unlucky queued request can be barged past repeatedly
+        # until its deadline, exactly the tail the admission plane
+        # exists to bound)
+        import collections
+
+        self._fifo: "collections.deque" = collections.deque()
+        self.max_queued = -1  # <0 = uncapped until the table says
+        # deployment-wide shed-rate EWMA published by the controller on
+        # the routing table (brownout state fed by every router's stats)
+        self.shed_rate = 0.0
+        # EWMA of observed service times -> queue-WAIT estimate
+        self.ewma = _admission.ServiceTimeEWMA()
+        # shed/admit deltas piggybacked to the controller on the next
+        # routing-table poll (zero extra RPCs)
+        self.stats_shed = 0
+        self.stats_admitted = 0
+        self.stats_expired = 0
         # cluster prefix-cache registry view (controller-polled frontiers
         # of each replica's PageAllocator): actor_id -> frozenset of
         # chain hashes. Refreshed lazily, only for prefix-hash requests.
@@ -142,13 +172,40 @@ class _Router:
             now = time.time()
             if self.replicas and now - self._last_refresh < 0.5:
                 return
-            table = ray_tpu.get(self._controller().get_routing_table.remote(
-                self.app, self.deployment, True))
+            # flush shed/admit deltas to the controller with the poll we
+            # are making anyway: they feed the deployment's shed-rate
+            # EWMA (brownout state) and reject-aware autoscaling
+            with self.cond:
+                stats = None
+                if self.stats_shed or self.stats_admitted \
+                        or self.stats_expired:
+                    stats = {"shed": self.stats_shed,
+                             "admitted": self.stats_admitted,
+                             "expired": self.stats_expired}
+                    self.stats_shed = 0
+                    self.stats_admitted = 0
+                    self.stats_expired = 0
+            try:
+                table = ray_tpu.get(
+                    self._controller().get_routing_table.remote(
+                        self.app, self.deployment, True, stats))
+            except BaseException:
+                if stats:
+                    # the deltas must survive a failed poll (most likely
+                    # DURING overload, exactly when the signal matters):
+                    # restore them for the next attempt
+                    with self.cond:
+                        self.stats_shed += stats["shed"]
+                        self.stats_admitted += stats["admitted"]
+                        self.stats_expired += stats["expired"]
+                raise
             with self.cond:
                 self._last_refresh = time.time()
                 if table is not None:
                     self.version = table["version"]
                     self.max_ongoing = table["max_ongoing_requests"]
+                    self.max_queued = table.get("max_queued_requests", -1)
+                    self.shed_rate = table.get("shed_rate", 0.0)
                     from ..actor import ActorHandle
 
                     self.replicas = [ActorHandle(aid)
@@ -223,101 +280,224 @@ class _Router:
             return True
         return False
 
-    def _wait_saturated(self, deadline: float) -> None:
-        """Under self.cond: block briefly for a completion, force a
-        routing-table re-pull, and enforce the pick deadline — the one
-        saturation behavior every routing policy shares."""
-        self.cond.wait(timeout=0.2)
-        self._last_refresh = 0.0
-        if time.time() > deadline:
-            raise TimeoutError("all replicas saturated for 120s")
-
-    def pick(self, routing_key: Optional[str] = None,
-             prefix_hashes: Optional[list] = None) -> "Any":
-        """Power-of-two-choices over in-flight counts
-        (ref: pow_2_router.py:27). With prefix_hashes (the prompt's
-        page-chain hashes), prefer the replica whose PUBLISHED prefix
-        cache matches the longest prefix (cluster registry; ref:
-        request_router/prefix_aware/prefix_aware_router.py — here matched
-        against real frontiers, not locality heuristics), falling back to
-        least-outstanding-requests. With only a routing_key, prefer the
-        rendezvous-hash choice for that key. Both affinities yield to
-        load balance when the preferred replica is saturated."""
-        deadline = time.time() + 120.0
-        kv_counted = False  # outcome metric: once per pick(), not per spin
-        while True:
-            self.refresh()
-            if prefix_hashes:
-                self.refresh_kv()
-            with self.cond:
-                candidates = self.replicas
-                if not candidates:
-                    # A concurrent refresh may have published an empty
-                    # (all-unhealthy) table after ours; wait and re-poll.
-                    self.cond.wait(timeout=0.2)
-                    self._last_refresh = 0.0
-                    continue
-                if prefix_hashes:
-                    best = self._pick_by_prefix(candidates, prefix_hashes)
-                    if best is not None and self._claim(best):
-                        if not kv_counted:
-                            _get_kv_metrics().inc(
-                                tags={"outcome": "prefix"})
-                        return best
-                    if not kv_counted:
-                        kv_counted = True
-                        _get_kv_metrics().inc(tags={"outcome": "fallback"})
-                    if routing_key is None:
-                        # no registry match and no string key (the PD
-                        # router's prefill leg): least-outstanding over
-                        # ALL replicas (not a 2-sample) — a cold replica
-                        # should take the new prefix and start caching it
-                        best = min(candidates,
-                                   key=lambda h: self.inflight.get(
-                                       h.actor_id, 0))
-                        if self._claim(best):
-                            return best
-                        self._wait_saturated(deadline)
-                        continue
-                    # registry miss WITH a routing_key (the ingress
-                    # path): fall through to the rendezvous affinity so
-                    # repeated prefixes stay sticky even while the
-                    # registry is empty/stale — the pre-registry policy
-                if routing_key is not None:
-                    # rendezvous hashing: stable under replica changes AND
-                    # across processes (hashlib, not salted builtin hash)
-                    import hashlib
-
-                    def _score(h):
-                        return hashlib.md5(
-                            f"{routing_key}|{h.actor_id}".encode()).digest()
-
-                    preferred = max(candidates, key=_score)
-                    pref_load = self.inflight.get(preferred.actor_id, 0)
-                    min_load = min(self.inflight.get(h.actor_id, 0)
-                                   for h in candidates)
-                    # prefix affinity only while the preferred replica is
-                    # not badly imbalanced vs the least-loaded one (the
-                    # reference's prefix router falls back on load, not
-                    # only at the hard cap) and under its cap
-                    if (pref_load - min_load <= _PREFIX_IMBALANCE
-                            and self._claim(preferred)):
-                        return preferred
-                    # imbalanced/saturated: fall through to pow-2
-                if len(candidates) > 2:
-                    candidates = random.sample(candidates, 2)
+    def _try_claim_policy(self, candidates, routing_key, prefix_hashes,
+                          kv_counted, exhaustive: bool = False
+                          ) -> Optional[Any]:
+        """Under self.cond: ONE claim attempt per the routing policy
+        (prefix registry -> rendezvous key -> pow-2 over in-flight
+        counts; ref: pow_2_router.py:27). Returns the claimed replica or
+        None when every eligible choice is saturated. ``exhaustive``
+        (the queue-drain path) replaces the pow-2 sample with
+        least-loaded over ALL replicas: the FIFO head must find the one
+        freed slot, or it idles the slot AND blocks the queue behind
+        it."""
+        if prefix_hashes:
+            best = self._pick_by_prefix(candidates, prefix_hashes)
+            if best is not None and self._claim(best):
+                if not kv_counted[0]:
+                    _get_kv_metrics().inc(tags={"outcome": "prefix"})
+                return best
+            if not kv_counted[0]:
+                kv_counted[0] = True
+                _get_kv_metrics().inc(tags={"outcome": "fallback"})
+            if routing_key is None:
+                # no registry match and no string key (the PD
+                # router's prefill leg): least-outstanding over
+                # ALL replicas (not a 2-sample) — a cold replica
+                # should take the new prefix and start caching it
                 best = min(candidates,
-                           key=lambda h: self.inflight.get(h.actor_id, 0))
+                           key=lambda h: self.inflight.get(
+                               h.actor_id, 0))
                 if self._claim(best):
                     return best
-                # All replicas saturated: wait for a completion, retry.
-                self._wait_saturated(deadline)
+                return None
+            # registry miss WITH a routing_key (the ingress
+            # path): fall through to the rendezvous affinity so
+            # repeated prefixes stay sticky even while the
+            # registry is empty/stale — the pre-registry policy
+        if routing_key is not None:
+            # rendezvous hashing: stable under replica changes AND
+            # across processes (hashlib, not salted builtin hash)
+            import hashlib
 
-    def release(self, actor_id: str):
+            def _score(h):
+                return hashlib.md5(
+                    f"{routing_key}|{h.actor_id}".encode()).digest()
+
+            preferred = max(candidates, key=_score)
+            pref_load = self.inflight.get(preferred.actor_id, 0)
+            min_load = min(self.inflight.get(h.actor_id, 0)
+                           for h in candidates)
+            # prefix affinity only while the preferred replica is
+            # not badly imbalanced vs the least-loaded one (the
+            # reference's prefix router falls back on load, not
+            # only at the hard cap) and under its cap
+            if (pref_load - min_load <= _PREFIX_IMBALANCE
+                    and self._claim(preferred)):
+                return preferred
+            # imbalanced/saturated: fall through to pow-2
+        if len(candidates) > 2 and not exhaustive:
+            candidates = random.sample(candidates, 2)
+        best = min(candidates,
+                   key=lambda h: self.inflight.get(h.actor_id, 0))
+        if self._claim(best):
+            return best
+        return None
+
+    def _capacity(self) -> int:
+        """Concurrent-execution capacity this router can see (slots
+        across the live replica set); floor 1 so estimates stay finite."""
+        per = self.max_ongoing if self.max_ongoing > 0 else 1
+        return max(1, len(self.replicas) * per)
+
+    def _shed(self, reason: str, retry_after: Optional[float] = None):
+        """Under self.cond: count + raise the typed admission rejection."""
+        from ..exceptions import ServiceOverloadedError
+        from . import admission
+
+        self.stats_shed += 1
+        admission.count_shed(reason)
+        if retry_after is None:
+            # best drain hint we have: one service wave
+            retry_after = self.ewma.value
+        raise ServiceOverloadedError(
+            f"{self.app}#{self.deployment} overloaded ({reason}): "
+            f"{self.queued} queued, {len(self.replicas)} replicas x "
+            f"{self.max_ongoing} ongoing",
+            reason=reason, retry_after_s=retry_after)
+
+    def _expire(self, where: str, queued: bool):
+        """Under self.cond: count + raise the typed deadline expiry.
+        Only expiries of QUEUED requests feed the controller's brownout/
+        autoscale stats — a request that arrived already expired (a
+        client with a spent budget) says nothing about this deployment's
+        load, and counting it would let tight-deadline clients brown out
+        an idle deployment."""
+        from ..exceptions import RequestExpiredError
+        from . import admission
+
+        if queued:
+            self.stats_expired += 1
+        admission.count_shed(admission.SHED_EXPIRED)
+        raise RequestExpiredError(
+            f"request deadline expired {where} for "
+            f"{self.app}#{self.deployment}", where=where)
+
+    def _admission_check(self, deadline: Optional[float]) -> None:
+        """Under self.cond, about to park this picker in the queue:
+        reject NOW (typed, fast) when the bounded queue is full, when
+        the queue-wait estimate cannot meet the remaining deadline, or
+        when the deployment is browning out — never let a doomed
+        request ripen into a timeout."""
+        from . import admission
+
+        ahead = self.queued
+        cap = self.max_queued
+        capacity = self._capacity()
+        est = self.ewma.estimate_wait(ahead + 1, capacity)
+        if cap >= 0 and ahead >= cap:
+            self._shed(admission.SHED_QUEUE_FULL, retry_after=est or None)
+        rem = admission.remaining(deadline)
+        if rem is not None and est > rem:
+            self._shed(admission.SHED_DEADLINE, retry_after=est)
+        if (self.shed_rate >= admission.BROWNOUT_SHED_RATE
+                and ahead >= capacity):
+            # the controller says this deployment is shedding hard
+            # cluster-wide; with a full wave already queued locally,
+            # queueing more is just hammering a saturated deployment
+            self._shed(admission.SHED_BROWNOUT, retry_after=est or None)
+
+    def pick(self, routing_key: Optional[str] = None,
+             prefix_hashes: Optional[list] = None,
+             deadline: Optional[float] = None) -> "Any":
+        """Admission-controlled routing. The policy (prefix registry ->
+        rendezvous -> pow-2, see _try_claim_policy) claims a slot when
+        one is free; otherwise the request must pass admission
+        (_admission_check) before parking in the bounded queue, and a
+        parked request whose ABSOLUTE deadline expires is shed typed
+        instead of timing out. ``deadline`` is wall-clock seconds
+        (time.time() domain), propagated from the request's first hop."""
+        from ..runtime import faults
+        from . import admission
+
+        faults.syncpoint("serve.admission")
+        t0 = time.time()
+        hard_deadline = t0 + 120.0
+        kv_counted = [False]  # outcome metric: once per pick, not per spin
+        queued = False
+        ticket = None
+        try:
+            while True:
+                self.refresh()
+                if prefix_hashes:
+                    self.refresh_kv()
+                with self.cond:
+                    if admission.expired(deadline):
+                        self._expire("while queued" if queued
+                                     else "before admission", queued)
+                    candidates = self.replicas
+                    if candidates:
+                        # FIFO fairness: a fresh arrival may claim only
+                        # when nobody is queued ahead; queued pickers
+                        # claim strictly in arrival order
+                        at_head = (self._fifo[0] is ticket if queued
+                                   else not self._fifo)
+                        best = self._try_claim_policy(
+                            candidates, routing_key, prefix_hashes,
+                            kv_counted,
+                            exhaustive=queued) if at_head else None
+                        if best is not None:
+                            if queued:
+                                self._fifo.popleft()
+                                self.queued -= 1
+                                queued = False
+                                self.cond.notify_all()
+                            self.stats_admitted += 1
+                            m = admission.get_metrics()
+                            m["admitted"].inc()
+                            m["queue_wait"].set(time.time() - t0)
+                            return best
+                        # every replica saturated: admission-check, then
+                        # park in the bounded queue
+                        if not queued:
+                            self._admission_check(deadline)
+                            ticket = object()
+                            self._fifo.append(ticket)
+                            self.queued += 1
+                            queued = True
+                    # (empty table: wait for a reconcile to publish
+                    # replicas — admission caps only meter slot waits)
+                    wait_s = 0.2
+                    rem = admission.remaining(deadline)
+                    if rem is not None:
+                        # wake right at expiry, not a poll tick later —
+                        # expiries must answer promptly, like sheds
+                        wait_s = max(0.01, min(wait_s, rem + 0.01))
+                    self.cond.wait(timeout=wait_s)
+                    self._last_refresh = 0.0
+                    if time.time() > hard_deadline:
+                        raise TimeoutError(
+                            "all replicas saturated for 120s")
+        finally:
+            if queued:
+                with self.cond:
+                    try:
+                        self._fifo.remove(ticket)
+                    except ValueError:
+                        pass
+                    self.queued -= 1
+                    self.cond.notify_all()
+
+    def release(self, actor_id: str,
+                service_s: Optional[float] = None):
         with self.cond:
             if actor_id in self.inflight:
                 self.inflight[actor_id] = max(0, self.inflight[actor_id] - 1)
-            self.cond.notify()
+            if service_s is not None:
+                self.ewma.update(service_s)
+            # notify_all: the FIFO head must wake (notify() could pick
+            # any waiter, stalling the freed slot behind a non-head)
+            self.cond.notify_all()
 
 
 class DeploymentHandle:
@@ -336,6 +516,10 @@ class DeploymentHandle:
         # per-request page-chain hashes for cache-aware routing
         # (ephemeral: set via options(prefix_hashes=...), not serialized)
         self._prefix_hashes: Optional[list] = None
+        # per-request deadline budget: options(timeout_s=...) pins it;
+        # otherwise remote() inherits the surrounding request's deadline
+        # (replica context) or stamps serve_request_timeout_s
+        self._timeout_s: Optional[float] = None
 
     _UNSET = object()
 
@@ -343,6 +527,7 @@ class DeploymentHandle:
                 routing_key: Any = _UNSET,
                 prefix_hashes: Optional[list] = None,
                 multiplexed_model_id: Optional[str] = None,
+                timeout_s: Optional[float] = None,
                 **_ignored) -> "DeploymentHandle":
         handle = DeploymentHandle(
             self.app_name, self.deployment_name,
@@ -353,6 +538,8 @@ class DeploymentHandle:
         handle._prefix_hashes = (list(prefix_hashes)
                                  if prefix_hashes is not None
                                  else self._prefix_hashes)
+        handle._timeout_s = (timeout_s if timeout_s is not None
+                             else self._timeout_s)
         if multiplexed_model_id is not None:
             # the model id routes (affinity: reuse the replica that has the
             # model loaded, ref: serve multiplexed routing) AND travels
@@ -364,8 +551,29 @@ class DeploymentHandle:
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
-        return DeploymentHandle(self.app_name, self.deployment_name, name,
-                                self._routing_key, self._model_id)
+        handle = DeploymentHandle(self.app_name, self.deployment_name, name,
+                                  self._routing_key, self._model_id)
+        handle._timeout_s = self._timeout_s
+        return handle
+
+    def _request_deadline(self) -> Optional[float]:
+        """Absolute deadline for a request submitted NOW. Must run on the
+        CALLING thread (submission-pool threads never see the caller's
+        contextvars): explicit timeout_s option > the surrounding
+        request's propagated deadline (when called inside a replica
+        handling a request) > the serve_request_timeout_s default."""
+        if self._timeout_s is not None:
+            if self._timeout_s <= 0:
+                return None
+            return time.time() + self._timeout_s
+        from .replica import get_request_deadline
+
+        inherited = get_request_deadline()
+        if inherited is not None:
+            return inherited
+        from . import admission
+
+        return admission.default_deadline()
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         app, deployment = self.app_name, self.deployment_name
@@ -373,6 +581,7 @@ class DeploymentHandle:
         routing_key = self._routing_key
         prefix_hashes = self._prefix_hashes
         model_id = self._model_id
+        deadline = self._request_deadline()
         if model_id is not None:
             kwargs = {**kwargs, "_multiplexed_model_id": model_id}
 
@@ -384,16 +593,28 @@ class DeploymentHandle:
                 k: (v._to_object_ref() if isinstance(v, DeploymentResponse)
                     else v) for k, v in kwargs.items()}
             router = _Router.get(app, deployment)
-            replica = router.pick(routing_key, prefix_hashes)
+            replica = router.pick(routing_key, prefix_hashes,
+                                  deadline=deadline)
+            claimed_at = time.time()
             try:
                 ref = replica.handle_request.remote(method_name, resolved,
-                                                    resolved_kw)
+                                                    resolved_kw, deadline)
             except BaseException:
                 # pick() incremented the in-flight slot; give it back or the
                 # replica looks saturated forever.
                 router.release(replica.actor_id)
                 raise
-            return ref, lambda: router.release(replica.actor_id)
+            # a SUCCESSFUL completion feeds the router's service-time
+            # EWMA (the queue-wait estimator behind deadline-aware
+            # admission); failures — above all replica-side sheds, which
+            # answer in ~1ms — must not drag the estimate toward zero
+            # and disarm the very estimator that prevents them
+            def done(err=None):
+                router.release(
+                    replica.actor_id,
+                    None if err is not None else time.time() - claimed_at)
+
+            return ref, done
 
         return DeploymentResponse(submit)
 

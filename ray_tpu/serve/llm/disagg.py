@@ -73,7 +73,12 @@ class PrefillServer(EngineDriverMixin):
         sampling.prefill_only = True
         queue: asyncio.Queue = asyncio.Queue()
         self._waiters[request_id] = queue
-        self.engine.add_request(request_id, prompt_ids, sampling)
+        from ..replica import get_request_deadline
+
+        # the Serve-propagated deadline reaches the prefill queue too:
+        # an expired entry is pruned instead of burning prefill compute
+        self.engine.add_request(request_id, prompt_ids, sampling,
+                                deadline=get_request_deadline())
         first: List[int] = []
         reason = None
         try:
@@ -82,6 +87,14 @@ class PrefillServer(EngineDriverMixin):
                 reason = delta.finish_reason
         finally:
             self._waiters.pop(request_id, None)
+        if reason == "expired":
+            # pruned from the WAITING queue: the propagated deadline
+            # passed before prefill admission — typed, never dead work
+            from ...exceptions import RequestExpiredError
+
+            raise RequestExpiredError(
+                f"request {request_id} expired in the prefill queue",
+                where="prefill queue")
         if reason != "prefill_done":
             # the first token already terminated the request (EOS/stop/
             # length) — nothing to hand off

@@ -86,6 +86,11 @@ class Request:
     arrival_t: float = dataclasses.field(default_factory=time.monotonic)
     dispatched_t: Optional[float] = None  # first prefill dispatch (TTFT
                                           # queue/prefill split)
+    # absolute expiry in the time.monotonic() domain (converted from the
+    # wall-clock deadline at add_request); an expired WAITING entry is
+    # PRUNED at batch admission instead of burning prefill compute on a
+    # request whose client already gave up
+    deadline_mono: Optional[float] = None
     slot: int = -1               # decode slot while RUNNING
     planned_out: int = 0         # tokens dispatched (>= len(output_ids))
     decode_ready: bool = False   # prefill harvested; slot may decode
@@ -269,6 +274,9 @@ class LLMEngine:
         self.waiting: List[Request] = []
         self.running: List[Request] = []
         self.requests: Dict[str, Request] = {}
+        # WAITING entries pruned for an expired deadline (stats() key;
+        # the Serve layer surfaces them as typed RequestExpiredError)
+        self._expired_total = 0
         self._jit_cache: Dict[tuple, Any] = {}
         self._pending_deltas: List[OutputDelta] = []
         # the single compiled prefill row count (and max rows per prefill
@@ -291,7 +299,12 @@ class LLMEngine:
     # ----------------------------------------------------------- intake
 
     def add_request(self, request_id: str, prompt_ids: List[int],
-                    sampling: Optional[SamplingParams] = None) -> None:
+                    sampling: Optional[SamplingParams] = None,
+                    deadline: Optional[float] = None) -> None:
+        """``deadline`` is the request's ABSOLUTE wall-clock expiry
+        (time.time() domain, as propagated by the Serve admission
+        plane); it is converted to the engine's monotonic domain here so
+        queue-time pruning is immune to wall-clock steps."""
         sampling = sampling or SamplingParams()
         if len(prompt_ids) + 1 > self.config.max_model_len:
             raise ValueError(
@@ -302,6 +315,8 @@ class LLMEngine:
                 f"top_k={sampling.top_k} exceeds the on-device sampler "
                 f"bound of {_MAX_TOP_K}")
         req = Request(request_id, list(prompt_ids), sampling)
+        if deadline is not None:
+            req.deadline_mono = time.monotonic() + (deadline - time.time())
         with self._intake_lock:
             self._intake.append(req)
 
@@ -341,6 +356,7 @@ class LLMEngine:
         deltas: List[OutputDelta] = list(self._pending_deltas)
         self._pending_deltas.clear()
         self._drain_intake(deltas)
+        self._prune_expired_waiting(deltas)
         self._try_admit_injection(deltas)
         self._dispatch_prefills()
         depth = max(1, int(self.config.pipeline_depth))
@@ -371,6 +387,36 @@ class LLMEngine:
             if req and req.state != FINISHED:
                 self._finish(req, "aborted")
                 deltas.append(OutputDelta(rid, [], True, "aborted"))
+
+    def _prune_expired_waiting(self, deltas: List[OutputDelta]) -> None:
+        """Shed expired WAITING entries at batch admission: a request
+        whose propagated deadline passed while it sat in the queue must
+        never reach prefill — its client already gave up, and the pages
+        plus compute belong to requests that can still meet their SLO.
+        Touches only queue bookkeeping (WAITING entries hold no pages or
+        slots), so it is unit-testable without a built model."""
+        if not self.waiting:
+            return
+        now = time.monotonic()
+        kept: List[Request] = []
+        for req in self.waiting:
+            if req.deadline_mono is not None and now >= req.deadline_mono:
+                req.state = FINISHED
+                req.finish_reason = "expired"
+                self.requests.pop(req.request_id, None)
+                self._expired_total += 1
+                deltas.append(OutputDelta(req.request_id, [], True,
+                                          "expired"))
+                try:  # serve metrics are advisory; the engine runs
+                    # standalone (batch workers, tests) without them
+                    from .. import admission
+
+                    admission.count_shed(admission.SHED_ENGINE_EXPIRED)
+                except Exception:  # rtpulint: ignore[RTPU006] — metric registration may fail outside a serve process; pruning must not
+                    pass
+            else:
+                kept.append(req)
+        self.waiting[:] = kept
 
     def _admit_one(self, burst_prefixes: set = None) -> Optional[Request]:
         """Admit the head of the waiting queue (slot + pages permitting)
@@ -1145,6 +1191,7 @@ class LLMEngine:
             "running": len(self.running),
             "waiting": len(self.waiting),
             "inflight": len(self._inflight),
+            "expired_total": self._expired_total,
             "free_pages": self.allocator.num_free(),
             **self.allocator.stats,
         }

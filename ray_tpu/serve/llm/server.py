@@ -165,8 +165,17 @@ class LLMServer(EngineDriverMixin):
     async def generate(self, prompt: str = None, *,
                        prompt_ids: Optional[List[int]] = None,
                        max_tokens: int = 64, temperature: float = 0.0,
-                       top_k: int = 0, seed: Optional[int] = None) -> Dict[str, Any]:
-        """Generate to completion; returns text + token ids + usage."""
+                       top_k: int = 0, seed: Optional[int] = None,
+                       deadline: Optional[float] = None) -> Dict[str, Any]:
+        """Generate to completion; returns text + token ids + usage.
+        ``deadline`` (absolute, time.time() domain) defaults to the
+        Serve request deadline propagated into this replica; the engine
+        prunes the request from its WAITING queue if it expires before
+        admission (surfaced as a typed RequestExpiredError)."""
+        if deadline is None:
+            from ..replica import get_request_deadline
+
+            deadline = get_request_deadline()
         if prompt_ids is None:
             prompt_ids = self.tokenizer.encode(prompt)
         request_id = f"req-{next(self._ids)}"
@@ -176,7 +185,8 @@ class LLMServer(EngineDriverMixin):
                                   temperature=temperature, top_k=top_k,
                                   seed=seed)
         t0 = time.time()
-        self.engine.add_request(request_id, prompt_ids, sampling)
+        self.engine.add_request(request_id, prompt_ids, sampling,
+                                deadline=deadline)
         await self._ensure_driver()
         out_ids: List[int] = []
         finish_reason = None
@@ -192,6 +202,15 @@ class LLMServer(EngineDriverMixin):
                     break
         finally:
             self._waiters.pop(request_id, None)
+        if finish_reason == "expired":
+            # the engine pruned this request from its WAITING queue: the
+            # propagated deadline passed before a batch slot opened —
+            # surface the typed expiry, never a silent empty completion
+            from ...exceptions import RequestExpiredError
+
+            raise RequestExpiredError(
+                f"request {request_id} expired in the engine queue",
+                where="engine queue")
         return {
             "request_id": request_id,
             "text": self.tokenizer.decode(out_ids),

@@ -17,6 +17,26 @@ from typing import Dict, Optional
 from .config import CONTROLLER_NAME
 from .replica import Request
 
+# request-deadline header aliases accepted by both ingress proxies
+TIMEOUT_HEADERS = ("X-Request-Timeout-S", "timeout_s")
+
+
+def request_timeout_s(get_header) -> Optional[float]:
+    """Per-request timeout budget: the first parseable timeout header
+    wins, else the serve_request_timeout_s default (None = no deadline).
+    ``get_header`` maps a header name to its value or None."""
+    for name in TIMEOUT_HEADERS:
+        value = get_header(name) or get_header(name.lower())
+        if value:
+            try:
+                return max(0.001, float(value))
+            except (TypeError, ValueError):
+                continue  # unparseable header: try the next alias
+    from ..runtime.config import get_config
+
+    timeout_s = get_config().serve_request_timeout_s
+    return timeout_s if timeout_s > 0 else None
+
 
 class RouteTableMixin:
     """Shared controller route-cache for the ingress proxies (HTTP here,
@@ -42,13 +62,26 @@ class RouteTableMixin:
 
 
 class ProxyActor(RouteTableMixin):
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_concurrency: int = 256):
+        from concurrent.futures import ThreadPoolExecutor
+
         self._host = host
         self._port = port
         self._actual_port: Optional[int] = None
         self._routes: Dict[str, str] = {}
         self._routes_fetched_at = 0.0
         self._started = asyncio.Event()
+        # dedicated pool for blocking handle calls (same rationale as
+        # grpc_proxy._call_pool): the loop's DEFAULT executor has only
+        # min(32, cpus+4) threads, so under overload parked calls would
+        # head-of-line block both new requests — defeating the
+        # fast-typed-429 contract exactly when it matters — and
+        # _refresh_routes, which shares the default pool. Threads here
+        # are parked-on-IO, so a high cap is cheap.
+        self._call_pool = ThreadPoolExecutor(
+            max_workers=max_concurrency,
+            thread_name_prefix="http-proxy-call")
 
     async def run(self) -> None:
         from aiohttp import web
@@ -88,18 +121,30 @@ class ProxyActor(RouteTableMixin):
                       query_params=dict(request.query),
                       headers=dict(request.headers), body=body)
 
+        from . import admission
         from .handle import DeploymentHandle
 
+        # stamp the request's end-to-end deadline at the FIRST hop: the
+        # handle propagates it router -> replica -> engine, and every
+        # hop sheds typed instead of executing expired work
+        timeout_s = request_timeout_s(request.headers.get)
         handle = DeploymentHandle(route["app"], route["ingress"])
+        if timeout_s is not None:
+            handle = handle.options(timeout_s=timeout_s)
         loop = asyncio.get_running_loop()
+        result_budget = timeout_s + 5 if timeout_s is not None else 120
 
         def call():
-            return handle.remote(req).result(timeout_s=120)
+            return handle.remote(req).result(timeout_s=result_budget)
 
         try:
-            result = await loop.run_in_executor(None, call)
-        except Exception as e:  # surface user errors as 500s
-            return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+            result = await loop.run_in_executor(self._call_pool, call)
+        except Exception as e:
+            # typed runtime errors map to real status codes (429
+            # overloaded w/ Retry-After, 503 unreachable, 504 deadline);
+            # only genuinely unknown failures remain 500s
+            status, headers, body = admission.http_error_response(e)
+            return web.Response(status=status, text=body, headers=headers)
         if isinstance(result, web.Response):
             return result
         if isinstance(result, bytes):

@@ -9,9 +9,23 @@ requests arrive as plain `Request` objects from the proxy.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import inspect
 import time
 from typing import Any, Dict, Optional
+
+# The ABSOLUTE deadline (time.time() domain) of the request currently
+# being handled, set for the duration of handle_request so user code —
+# and any downstream DeploymentHandle.remote() it makes — inherits it
+# (deadline PROPAGATION: one budget end-to-end, not per-hop resets).
+_request_deadline: contextvars.ContextVar = contextvars.ContextVar(
+    "rtpu_serve_request_deadline", default=None)
+
+
+def get_request_deadline() -> Optional[float]:
+    """Absolute wall-clock deadline of the request being handled (None
+    outside a request, or when default deadlines are disabled)."""
+    return _request_deadline.get()
 
 
 class Request:
@@ -57,20 +71,65 @@ class ReplicaActor:
         self._ongoing = 0
         self._total = 0
         self._started_at = time.time()
+        # admission-plane accounting (polled by the controller via
+        # get_metrics; the autoscaler scales on rejects, not only depth)
+        self._admitted_total = 0
+        self._shed_total = 0
+        self._expired_total = 0
+        from .admission import ServiceTimeEWMA
+
+        self._service_ewma = ServiceTimeEWMA()
         if (spec.config.user_config is not None
                 and hasattr(self._user_callable, "reconfigure")):
             self._user_callable.reconfigure(spec.config.user_config)
 
+    def _admit(self, deadline: Optional[float]) -> None:
+        """Replica-side admission: a request whose deadline already
+        expired is dead work — shed it; and ongoing beyond
+        max_ongoing + max_queued_requests means several routers
+        overcommitted this replica past its bounded queue — shed typed
+        instead of letting the pile ripen into a timeout storm. Health
+        checks, metrics polls, and frontier polls are separate actor
+        methods: saturation never sheds them (saturation != death)."""
+        from ..exceptions import RequestExpiredError, ServiceOverloadedError
+        from . import admission
+
+        if admission.expired(deadline):
+            self._expired_total += 1
+            admission.count_shed(admission.SHED_EXPIRED)
+            raise RequestExpiredError(
+                f"deadline expired on arrival at replica "
+                f"{self._replica_id} of {self._app}#{self._deployment}",
+                where=f"replica {self._replica_id}")
+        cfg = self._config
+        cap = getattr(cfg, "max_queued_requests", -1)
+        max_ongoing = getattr(cfg, "max_ongoing_requests", 0)
+        if cap >= 0 and max_ongoing > 0 \
+                and self._ongoing >= max_ongoing + cap:
+            self._shed_total += 1
+            admission.count_shed(admission.SHED_REPLICA_QUEUE)
+            raise ServiceOverloadedError(
+                f"replica {self._replica_id} of "
+                f"{self._app}#{self._deployment} at capacity "
+                f"({self._ongoing} ongoing >= {max_ongoing}+{cap})",
+                reason=admission.SHED_REPLICA_QUEUE,
+                retry_after_s=self._service_ewma.value)
+
     async def handle_request(self, method_name: str, args: tuple,
-                             kwargs: dict) -> Any:
+                             kwargs: dict,
+                             deadline: Optional[float] = None) -> Any:
+        self._admit(deadline)
+        self._admitted_total += 1
         self._ongoing += 1
         self._total += 1
+        started = time.time()
         model_id = kwargs.pop("_multiplexed_model_id", None)
         token = None
         if model_id is not None:
             from .multiplex import _set_model_id
 
             token = _set_model_id(model_id)
+        deadline_token = _request_deadline.set(deadline)
         try:
             if method_name in ("__call__", ""):
                 target = self._user_callable
@@ -84,6 +143,8 @@ class ReplicaActor:
             return out
         finally:
             self._ongoing -= 1
+            self._service_ewma.update(time.time() - started)
+            _request_deadline.reset(deadline_token)
             if token is not None:
                 from .multiplex import _current_model_id
 
@@ -96,6 +157,10 @@ class ReplicaActor:
 
     def get_metrics(self) -> Dict[str, Any]:
         return {"ongoing": self._ongoing, "total": self._total,
+                "admitted_total": self._admitted_total,
+                "shed_total": self._shed_total,
+                "expired_total": self._expired_total,
+                "service_ewma_s": self._service_ewma.value,
                 "uptime_s": time.time() - self._started_at}
 
     async def kv_frontier(self, known_rev: Any = None

@@ -156,11 +156,14 @@ class Histogram(Metric):
         return out
 
 
-def snapshot() -> Dict[str, float]:
-    """Flat snapshot {name{tags}: value} for the controller channel."""
+def snapshot(prefix: str = "") -> Dict[str, float]:
+    """Flat snapshot {name{tags}: value} for the controller channel.
+    ``prefix`` restricts to one metric family (e.g. "rtpu_serve_" for
+    the admission-plane counters surfaced on get_node_info)."""
     out: Dict[str, float] = {}
     with _registry_lock:
-        metrics = list(_registry.values())
+        metrics = [m for name, m in _registry.items()
+                   if name.startswith(prefix)]
     for metric in metrics:
         for name, tags, value in metric._samples():
             tag_str = ",".join(f"{k}={v}" for k, v in sorted(tags.items()))

@@ -62,6 +62,11 @@ def pytest_configure(config):
         "markers", "stream: streaming data plane (pull-based operator "
         "pipeline, streaming_split coordinator, elastic Train ingest) "
         "tests")
+    config.addinivalue_line(
+        "markers", "overload: Serve admission plane (deadline "
+        "propagation, bounded-queue load shedding to typed "
+        "429s/ServiceOverloadedError, engine expiry pruning) tests + "
+        "the 10x-overload drill in benchmarks/overload_drill.py")
 
 
 @pytest.fixture

@@ -1,0 +1,426 @@
+"""Serve admission-plane tests: deadline propagation, bounded-queue load
+shedding to typed errors, engine-level expiry pruning, proxy status
+mapping, and health-probe exemption under overload.
+
+The contract under test (PR 13; blueprint: SURVEY §2.3/§3.5 proxy
+backpressure + PR 10's typed-error discipline): overload degrades into
+FAST typed rejections (ServiceOverloadedError -> 429, RequestExpiredError
+-> 504) while admitted traffic completes exactly once — never a timeout
+storm, never dead work for clients that already gave up.
+"""
+
+import asyncio
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.exceptions import (RequestExpiredError, ServiceOverloadedError,
+                                TaskError)
+from ray_tpu.serve import admission
+
+pytestmark = pytest.mark.overload
+
+
+@pytest.fixture
+def serve_cluster(shared_cluster):
+    yield shared_cluster
+    serve.shutdown()
+
+
+def _suite_overloaded() -> bool:
+    """PR 11 deflake discipline: timing assertions (shed answered < 1s)
+    record as a reasoned skip, not an F, when co-tenant suite load has
+    measurably starved the 2-vCPU box."""
+    try:
+        load1 = os.getloadavg()[0]
+    except OSError:
+        return False
+    return load1 > 1.5 * (os.cpu_count() or 1)
+
+
+# ------------------------------------------------------------- unit tiers
+
+
+def test_error_mapping_unit():
+    """Every typed runtime error maps to a proper proxy status — never a
+    generic 500 with a pickled traceback (satellite #1)."""
+    from ray_tpu.exceptions import ActorDiedError, GetTimeoutError
+    from ray_tpu.runtime.rpc import NodeUnreachableError, RpcTimeoutError
+
+    cases = [
+        (ServiceOverloadedError(reason="queue_full", retry_after_s=2.3), 429),
+        (RequestExpiredError(where="router"), 504),
+        (RpcTimeoutError("deadline"), 504),
+        (GetTimeoutError("get timed out"), 504),
+        (TimeoutError("bare"), 504),
+        (NodeUnreachableError("peer gone"), 503),
+        (ActorDiedError("abc123", "replica died"), 503),
+        (ValueError("user bug"), 500),
+    ]
+    for exc, want in cases:
+        status, headers, _body = admission.http_error_response(exc)
+        assert status == want, f"{type(exc).__name__} -> {status} != {want}"
+        assert headers["X-Error-Type"] == type(exc).__name__
+    # overload rejections carry a Retry-After hint (whole seconds, >= 1)
+    status, headers, _ = admission.http_error_response(
+        ServiceOverloadedError(retry_after_s=2.3))
+    assert headers["Retry-After"] == "3"
+    status, headers, _ = admission.http_error_response(
+        ServiceOverloadedError(retry_after_s=None))
+    assert headers["Retry-After"] == "1"
+    # TaskError wrapping (user code re-raised a typed error by value):
+    # classified by the wrapped cause's name, surfaced in the header
+    wrapped = TaskError("ServiceOverloadedError", "overloaded", "tb")
+    status, headers, _ = admission.http_error_response(wrapped)
+    assert status == 429 and headers["X-Error-Type"] == \
+        "ServiceOverloadedError"
+    assert admission.http_error_response(
+        TaskError("RpcTimeoutError", "t", "tb"))[0] == 504
+    assert admission.http_error_response(
+        TaskError("NodeUnreachableError", "n", "tb"))[0] == 503
+    assert admission.http_error_response(
+        TaskError("ValueError", "v", "tb"))[0] == 500
+    # the gRPC mapping mirrors the HTTP table
+    import grpc
+
+    assert admission.grpc_status_for(ServiceOverloadedError()) == \
+        grpc.StatusCode.RESOURCE_EXHAUSTED
+    assert admission.grpc_status_for(RequestExpiredError()) == \
+        grpc.StatusCode.DEADLINE_EXCEEDED
+    assert admission.grpc_status_for(NodeUnreachableError()) == \
+        grpc.StatusCode.UNAVAILABLE
+    assert admission.grpc_status_for(ValueError()) == \
+        grpc.StatusCode.INTERNAL
+    # typed errors survive a pickle round trip (worker error propagation)
+    import pickle
+
+    back = pickle.loads(pickle.dumps(
+        ServiceOverloadedError("m", reason="deadline", retry_after_s=4.0)))
+    assert isinstance(back, ServiceOverloadedError)
+    assert back.reason == "deadline" and back.retry_after_s == 4.0
+    back = pickle.loads(pickle.dumps(RequestExpiredError("m", where="w")))
+    assert isinstance(back, RequestExpiredError) and back.where == "w"
+    assert isinstance(back, TimeoutError)  # deadline-aware callers work
+
+
+def test_service_time_ewma_unit():
+    ewma = admission.ServiceTimeEWMA(alpha=0.5)
+    assert ewma.value is None
+    assert ewma.estimate_wait(5, 2) == 0.0  # no estimate -> no invented wait
+    ewma.update(1.0)
+    assert ewma.value == 1.0
+    ewma.update(3.0)
+    assert abs(ewma.value - 2.0) < 1e-9
+    # 5 queued across 2 slots = 3 service waves of ~2s
+    assert abs(ewma.estimate_wait(5, 2) - 6.0) < 1e-9
+    assert ewma.estimate_wait(0, 2) == 0.0
+
+
+def test_engine_prunes_expired_waiting():
+    """Acceptance: a request whose deadline expires while queued is never
+    executed — the engine sheds it from WAITING at batch admission. The
+    prune touches only queue bookkeeping, so it is exercised without a
+    built model."""
+    from ray_tpu.serve.llm.engine import (FINISHED, LLMEngine,
+                                          Request, SamplingParams)
+
+    eng = LLMEngine.__new__(LLMEngine)
+    eng._expired_total = 0
+    expired = Request("dead", [1, 2, 3], SamplingParams())
+    expired.deadline_mono = time.monotonic() - 0.5
+    alive = Request("alive", [1, 2, 3], SamplingParams())
+    alive.deadline_mono = time.monotonic() + 60.0
+    no_deadline = Request("nodl", [1, 2, 3], SamplingParams())
+    eng.waiting = [expired, alive, no_deadline]
+    eng.requests = {r.request_id: r for r in eng.waiting}
+
+    deltas = []
+    eng._prune_expired_waiting(deltas)
+
+    assert [r.request_id for r in eng.waiting] == ["alive", "nodl"]
+    assert expired.state == FINISHED
+    assert expired.finish_reason == "expired"
+    assert "dead" not in eng.requests
+    assert eng._expired_total == 1
+    assert len(deltas) == 1 and deltas[0].request_id == "dead"
+    assert deltas[0].finished and deltas[0].finish_reason == "expired"
+    # idempotent: nothing left to prune
+    eng._prune_expired_waiting(deltas)
+    assert len(deltas) == 1 and len(eng.waiting) == 2
+
+
+def test_engine_add_request_deadline_conversion():
+    """add_request converts the wall-clock deadline into the engine's
+    monotonic domain (queue pruning immune to wall-clock steps)."""
+    from ray_tpu.serve.llm.engine import LLMEngine
+    import threading
+
+    eng = LLMEngine.__new__(LLMEngine)
+
+    class _Cfg:
+        max_model_len = 512
+
+    eng.config = _Cfg()
+    eng._intake = []
+    eng._intake_lock = threading.Lock()
+    eng.add_request("r1", [1, 2, 3], deadline=time.time() + 5.0)
+    eng.add_request("r2", [1, 2, 3])
+    (r1, r2) = eng._intake
+    assert r1.deadline_mono is not None
+    assert 4.0 < r1.deadline_mono - time.monotonic() < 5.5
+    assert r2.deadline_mono is None
+
+
+# --------------------------------------------------- cluster-tier drills
+
+
+def test_router_backpressure_typed_and_fast(serve_cluster):
+    """Fill a router past max_queued_requests: (a) the overflow request
+    sheds with a typed ServiceOverloadedError in < 1s — not a 60s
+    timeout; (b) queued-but-unexpired requests complete exactly once
+    after the burst drains; (c) the shed request is never executed."""
+
+    @serve.deployment(max_ongoing_requests=2, max_queued_requests=3)
+    class Slow:
+        def __init__(self):
+            self.executed = []
+
+        async def __call__(self, x):
+            await asyncio.sleep(0.8)
+            self.executed.append(x)
+            return x
+
+        def executed_ids(self):
+            return list(self.executed)
+
+    handle = serve.run(Slow.bind(), name="bp")
+    try:
+        # 2 executing + 3 parked in the router's bounded queue
+        burst = [handle.options(timeout_s=30).remote(i) for i in range(5)]
+        time.sleep(0.4)  # let the burst claim/park
+        t0 = time.time()
+        with pytest.raises(ServiceOverloadedError) as ei:
+            handle.options(timeout_s=30).remote(99).result(timeout_s=10)
+        elapsed = time.time() - t0
+        assert ei.value.reason == admission.SHED_QUEUE_FULL
+        if elapsed >= 1.0:
+            if _suite_overloaded():
+                pytest.skip(
+                    f"shed took {elapsed:.2f}s under suite load (loadavg "
+                    f"{os.getloadavg()[0]:.1f}); known environmental")
+            raise AssertionError(
+                f"typed shed took {elapsed:.2f}s — admission must reject "
+                f"fast, not ripen into a timeout")
+        # the queued-but-unexpired burst completes exactly once each
+        results = sorted(r.result(timeout_s=30) for r in burst)
+        assert results == list(range(5))
+        executed = sorted(
+            handle.executed_ids.remote().result(timeout_s=15))
+        assert executed.count(99) == 0, "shed request must never execute"
+        assert [x for x in executed if x != 99] == list(range(5)), (
+            f"admitted requests must run exactly once: {executed}")
+    finally:
+        serve.delete("bp")
+
+
+def test_queued_request_expiry_is_typed_and_never_executes(serve_cluster):
+    """A request whose deadline expires while parked in the router queue
+    sheds with RequestExpiredError (typed, prompt) and never reaches the
+    replica."""
+
+    @serve.deployment(max_ongoing_requests=1, max_queued_requests=10)
+    class Busy:
+        def __init__(self):
+            self.executed = []
+
+        async def __call__(self, x, sleep_s=0.0):
+            self.executed.append(x)
+            await asyncio.sleep(sleep_s)
+            return x
+
+        def executed_ids(self):
+            return list(self.executed)
+
+    handle = serve.run(Busy.bind(), name="expire")
+    try:
+        blocker = handle.options(timeout_s=30).remote(0, sleep_s=1.6)
+        time.sleep(0.3)  # blocker holds the only slot
+        doomed = [handle.options(timeout_s=0.4).remote(100 + i)
+                  for i in range(3)]
+        for d in doomed:
+            t0 = time.time()
+            with pytest.raises(RequestExpiredError):
+                d.result(timeout_s=10)
+            assert time.time() - t0 < 5.0
+        assert blocker.result(timeout_s=30) == 0
+        executed = handle.executed_ids.remote().result(timeout_s=15)
+        assert not any(x in executed for x in (100, 101, 102)), (
+            f"expired requests must never execute: {executed}")
+    finally:
+        serve.delete("expire")
+
+
+def test_deadline_propagates_downstream(serve_cluster):
+    """One deadline budget end-to-end: a downstream handle call made
+    inside a replica inherits the SAME absolute deadline the ingress
+    stamped (no per-hop resets)."""
+
+    @serve.deployment
+    class Inner:
+        def __call__(self):
+            return serve.get_request_deadline()
+
+    @serve.deployment
+    class Outer:
+        def __init__(self, inner):
+            self.inner = inner
+
+        async def __call__(self):
+            mine = serve.get_request_deadline()
+            inner_deadline = await self.inner.remote()
+            return {"outer": mine, "inner": inner_deadline}
+
+    handle = serve.run(Outer.bind(Inner.bind()), name="prop")
+    try:
+        out = handle.options(timeout_s=7).remote().result(timeout_s=30)
+        assert out["outer"] is not None and out["inner"] is not None
+        assert abs(out["outer"] - out["inner"]) < 1e-6, (
+            "downstream hop must inherit the ingress deadline, not "
+            "stamp a fresh one")
+        assert 0 < out["outer"] - time.time() < 7.5
+        # no explicit timeout: the serve_request_timeout_s default
+        out = handle.remote().result(timeout_s=30)
+        from ray_tpu.runtime.config import get_config
+
+        assert out["outer"] - time.time() <= \
+            get_config().serve_request_timeout_s + 0.5
+    finally:
+        serve.delete("prop")
+
+
+def test_health_probes_exempt_while_shedding(serve_cluster):
+    """Acceptance: health probes succeed while the deployment is
+    actively shedding — saturation is not death (PR 4's direct-probe
+    rule), so a browned-out deployment must not get its replicas
+    killed. Also: the controller publishes a non-zero shed rate."""
+
+    @serve.deployment(max_ongoing_requests=1, max_queued_requests=0,
+                      health_check_period_s=0.3)
+    class Saturated:
+        async def __call__(self, x=None):
+            await asyncio.sleep(2.0)
+            return "ok"
+
+    handle = serve.run(Saturated.bind(), name="sat")
+    try:
+        blocker = handle.options(timeout_s=30).remote()
+        time.sleep(0.3)
+        # actively shed for a while (queue cap 0: admit-or-shed)
+        sheds = 0
+        deadline = time.time() + 2.0
+        while time.time() < deadline:
+            try:
+                handle.options(timeout_s=30).remote().result(timeout_s=10)
+            except ServiceOverloadedError:
+                sheds += 1
+            time.sleep(0.05)
+        assert sheds > 0, "expected the saturated deployment to shed"
+        # direct health probe (the controller's path) answers despite
+        # the saturation, and the replica was never replaced
+        controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+        table = ray_tpu.get(controller.get_routing_table.remote(
+            "sat", "Saturated", False))
+        assert len(table["replicas"]) == 1
+        from ray_tpu.actor import ActorHandle
+
+        probe = ActorHandle(table["replicas"][0]).check_health.remote()
+        assert ray_tpu.get(probe, timeout=10) is True
+        st = serve.status()["applications"]["sat"]
+        assert st["deployments"]["Saturated"]["replicas"] == 1
+        # the brownout EWMA (fed by this router's piggybacked stats)
+        # reaches the published table
+        shed_rate = 0.0
+        deadline = time.time() + 6.0
+        while time.time() < deadline:
+            st = serve.status()["applications"]["sat"]
+            shed_rate = st["deployments"]["Saturated"]["shed_rate"]
+            if shed_rate > 0:
+                break
+            try:  # keep one router poll cycle flowing
+                handle.options(timeout_s=30).remote().result(timeout_s=10)
+            except ServiceOverloadedError:
+                pass
+            time.sleep(0.3)
+        assert shed_rate > 0, "router sheds never reached the controller"
+        assert blocker.result(timeout_s=30) == "ok"
+    finally:
+        serve.delete("sat")
+
+
+def test_http_proxy_maps_overload_to_429(serve_cluster):
+    """e2e proxy mapping: an overloaded deployment answers HTTP 429 with
+    Retry-After + X-Error-Type — never a 500 — and recovers to 200 once
+    the saturation drains. Exercises the replica-side admission cap (the
+    proxy's router is a different process from the driver's, so the
+    replica's ongoing-beyond-cap net is what sheds here)."""
+
+    @serve.deployment(max_ongoing_requests=1, max_queued_requests=0)
+    class SlowEcho:
+        async def __call__(self, request):
+            await asyncio.sleep(1.5)
+            return {"ok": True}
+
+    handle = serve.run(SlowEcho.bind(), name="ovl", route_prefix="/ovl",
+                       _start_http=True)
+    try:
+        url = serve.get_proxy_url()
+        blocker = handle.options(timeout_s=30).remote(None)
+        time.sleep(0.3)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{url}/ovl", timeout=10)
+        assert ei.value.code == 429
+        assert ei.value.headers["X-Error-Type"] == "ServiceOverloadedError"
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        assert blocker.result(timeout_s=30) == {"ok": True}
+        # drained: the same route serves again
+        with urllib.request.urlopen(f"{url}/ovl", timeout=30) as resp:
+            assert resp.status == 200
+            assert json.loads(resp.read()) == {"ok": True}
+    finally:
+        serve.delete("ovl")
+
+
+def test_kill_at_admission_syncpoint(serve_cluster):
+    """The serve.admission syncpoint is plantable: a kill_at rule fires
+    exactly at the router's admission decision (chaos drills can target
+    the admission plane per PR 10's grammar)."""
+    from ray_tpu.runtime import faults
+    from ray_tpu.runtime.faults import FaultInjectedError
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Echo.bind(), name="killat")
+    try:
+        assert handle.remote(1).result(timeout_s=30) == 1
+        plane = faults.get_plane()
+        plane.add_rules("adm:kill_at(serve.admission,action=raise)")
+        try:
+            with pytest.raises(FaultInjectedError):
+                handle.remote(2).result(timeout_s=10)
+            fired = {r["name"]: r for r in plane.snapshot()}
+            assert fired["adm"]["fired"] == 1
+        finally:
+            plane.clear("adm")
+        # plane cleared: traffic flows again
+        assert handle.remote(3).result(timeout_s=30) == 3
+    finally:
+        serve.delete("killat")
