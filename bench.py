@@ -355,6 +355,21 @@ def bench_overload_drill() -> dict:
     return _run_bench_json("overload_drill.py", 300)
 
 
+def bench_engine_sched() -> dict:
+    """Continuous-batching scheduler A/B (benchmarks/engine_sched.py):
+    chunked-prefill interleave TTFT under mixed short/512-token arrivals
+    (ttft_ms_p99_longmix on vs off, >=2x bar), bounded inter-token
+    latency (itl_ms_p99), continuous-batching decode throughput
+    (decode_tok_s_cb), and prompt-lookup speculative decoding on an
+    in-bench-trained repetitive model (spec_tok_s vs
+    decode_tok_s_spec_base, >=1.3x bar, greedy bit-parity asserted as
+    spec_exact). Forces the CPU backend internally — the scheduler
+    effects under test are compute-ordering effects. Full-length waves
+    (not --quick): the p99 keys are max-of-collisions and need the
+    larger sample to sit stably above their bars."""
+    return _run_bench_json("engine_sched.py", 420)
+
+
 def bench_train(on_tpu: bool) -> dict:
     import jax
     import jax.numpy as jnp
@@ -547,6 +562,21 @@ def main():
         except Exception as e:  # noqa: BLE001
             result["detail"]["overload_drill"] = {"error": repr(e)[:200]}
             result["detail"]["overload_green"] = False
+
+    # 8c. engine scheduler A/B: chunked-prefill interleave + speculative
+    # decoding (engine_sched keys), same time guard — the inference
+    # engine's raw-speed trend line next to decode_tok_s / pd_ttft_ms
+    if time.perf_counter() - start < 480:
+        try:
+            sched = bench_engine_sched()
+            result["detail"]["engine_sched"] = sched
+            for key in ("decode_tok_s_cb", "itl_ms_p99",
+                        "ttft_ms_p99_longmix", "ttft_longmix_speedup",
+                        "spec_accept_rate", "spec_tok_s", "spec_exact"):
+                if key in sched:
+                    result["detail"][key] = sched[key]
+        except Exception as e:  # noqa: BLE001
+            result["detail"]["engine_sched"] = {"error": repr(e)[:200]}
 
     # 9. static analysis: rtpulint per-file rules over the WHOLE package
     # (cheap, ~2s). lint_clean records when the tree regresses on a

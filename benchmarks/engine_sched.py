@@ -1,0 +1,327 @@
+"""Continuous-batching scheduler v2 A/B: token-budget chunked-prefill
+interleave + prompt-lookup speculative decoding.
+
+Two self-contained experiments on the tiny CPU model (forced onto the
+CPU backend — the scheduler effects under test are compute-ordering
+effects, identical in kind on real chips where one whole-prompt prefill
+dispatch also monopolizes the device for its full compute):
+
+1. LONG-MIX TTFT/ITL: open-loop arrivals of short prompts with a
+   512-token (max-bucket) prompt landing periodically. With the legacy
+   prefill-priority scheduler every running request's next token waits
+   behind the whole 512-token dispatch; with `prefill_chunk_tokens` the
+   long prompt advances one chunk per step between decode dispatches.
+   Keys: ttft_ms_p99_longmix (chunked) vs ttft_ms_p99_longmix_off,
+   ttft_longmix_speedup, itl_ms_p99, decode_tok_s_cb.
+
+2. SPECULATIVE DECODE: the tiny model is briefly TRAINED in-process on
+   a cyclic token stream (~5 s of adam on 64-hidden — so its greedy
+   output is genuinely repetitive, the regime prompt-lookup targets;
+   nothing is faked) and the same trained params drive a spec-off and a
+   spec-on engine over the same requests. Keys: spec_tok_s vs
+   decode_tok_s_spec_base, spec_speedup, spec_accept_rate, spec_exact
+   (greedy bit-parity asserted).
+
+Run:  python benchmarks/engine_sched.py [--quick]
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # scheduler A/B is backend-agnostic
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # runnable from anywhere
+
+
+def _p(values, q):
+    values = sorted(values)
+    if not values:
+        return None
+    return values[min(len(values) - 1, int(len(values) * q))]
+
+
+# ----------------------------------------------------------- long mix
+
+LONGMIX_CFG = dict(model="tiny", page_size=16, num_pages=256,
+                   max_model_len=768, max_batch=8,
+                   prefill_buckets=(32, 64, 128, 256, 512),
+                   dtype="float32", prefill_wave_size=4,
+                   decode_steps_per_dispatch=2,
+                   # prefill-heavy shape (the realistic regime: prompt
+                   # compute >> per-token decode; bare "tiny" prefills
+                   # 512 tokens in ~one decode step, hiding the
+                   # head-of-line effect under test)
+                   model_overrides={"vocab_size": 512,
+                                    "hidden_size": 256,
+                                    "intermediate_size": 512,
+                                    "num_layers": 4, "num_heads": 8,
+                                    "num_kv_heads": 4})
+
+
+def run_longmix(chunk_tokens: int, duration_s: float,
+                long_every_s: float, short_rate: float) -> dict:
+    """Open-loop mixed arrivals against one engine:
+
+    - two persistent FOREGROUND decoders run the whole wave (their
+      inter-token gaps are the ITL series — the direct victims of a
+      whole-prompt prefill monopolizing the device);
+    - short prompts arrive at `short_rate`/s, plus three PROBE shorts
+      pinned shortly after each long arrival (deterministic collisions:
+      a sparse random wave can miss the prefill window entirely and
+      report a meaningless p99);
+    - a 512-token prompt lands every `long_every_s`.
+
+    TTFT counts from the SCHEDULED arrival (open loop: the client sent
+    it then), so a short that sat out a blocking whole-prompt prefill
+    dispatch pays that wait in full. Returns short-TTFT and
+    foreground-ITL percentiles plus total decode throughput."""
+    import numpy as np
+
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine, SamplingParams
+
+    engine = LLMEngine(EngineConfig(**LONGMIX_CFG,
+                                    prefill_chunk_tokens=chunk_tokens))
+    # warm every bucket traffic can hit — shorts (32), chunk waves (the
+    # chunk bucket, incl. mixed admission+chunk rows), whole longs (512);
+    # an unwarmed bucket compiling mid-wave is a multi-second spike that
+    # would swamp the scheduling effect under test
+    engine.warmup(prompt_buckets=(32, 64, 128, 512))
+    rng = np.random.default_rng(0)
+    long_prompt = list(rng.integers(0, 400, 505))
+
+    arrivals = []  # (t_rel, rid, prompt, max_tokens)
+    t, i = 0.0, 0
+    gaps = np.random.default_rng(1).exponential(1.0 / short_rate, 4096)
+    while t < duration_s:
+        arrivals.append((t, f"s{i}", list(rng.integers(0, 400, 24)), 8))
+        t += float(gaps[i])
+        i += 1
+    nlong = 0
+    t = long_every_s * 0.5
+    while t < duration_s:
+        arrivals.append((t, f"L{nlong}", long_prompt, 4))
+        for j, off in enumerate((0.05, 0.2, 0.35)):
+            arrivals.append((t + off, f"s_probe{nlong}_{j}",
+                             list(rng.integers(0, 400, 24)), 8))
+        t += long_every_s
+        nlong += 1
+    arrivals.sort(key=lambda a: a[0])
+
+    for k in range(2):
+        engine.add_request(f"fg{k}", list(rng.integers(0, 400, 24)),
+                           SamplingParams(max_tokens=100000))
+    for _ in range(10):  # foreground decoders into steady state
+        engine.step()
+
+    submit, first_tok, fg_at = {}, {}, {"fg0": [], "fg1": []}
+    n_tokens = 0
+    finished = 0
+    pending = list(arrivals)
+    t0 = time.perf_counter()
+    deadline = t0 + duration_s + 120.0
+    while time.perf_counter() < deadline:
+        now_rel = time.perf_counter() - t0
+        while pending and pending[0][0] <= now_rel:
+            t_arr, rid, prompt, mt = pending.pop(0)
+            submit[rid] = t0 + t_arr
+            engine.add_request(rid, prompt,
+                               SamplingParams(max_tokens=mt))
+        for d in engine.step():
+            now = time.perf_counter()
+            if d.new_token_ids:
+                n_tokens += len(d.new_token_ids)
+                first_tok.setdefault(d.request_id, now)
+                if d.request_id in fg_at:
+                    fg_at[d.request_id].append(now)
+            if d.finished and d.request_id in submit:
+                finished += 1
+        if finished >= len(arrivals) and not pending:
+            break
+    span = time.perf_counter() - t0
+    for k in range(2):
+        engine.abort(f"fg{k}")
+    while engine.has_work():
+        engine.step()
+    ttfts = [(first_tok[r] - submit[r]) * 1e3 for r in submit
+             if r in first_tok and r.startswith("s")]
+    itls = []
+    for times in fg_at.values():
+        itls.extend((b - a) * 1e3 for a, b in zip(times, times[1:]))
+    return {
+        "chunk": chunk_tokens,
+        "n_short": len([r for r in submit if r.startswith("s")]),
+        "n_long": nlong,
+        "finished": finished,
+        "ttft_ms_p50": round(_p(ttfts, 0.50), 1),
+        "ttft_ms_p99": round(_p(ttfts, 0.99), 1),
+        "itl_ms_p50": round(_p(itls, 0.50), 2) if itls else None,
+        "itl_ms_p99": round(_p(itls, 0.99), 2) if itls else None,
+        "tok_s": round(n_tokens / span, 1),
+    }
+
+
+# ------------------------------------------------------------- spec
+
+SPEC_CFG = dict(model="tiny", page_size=16, num_pages=256,
+                max_model_len=512, max_batch=4,
+                prefill_buckets=(16, 32, 64, 128), dtype="float32",
+                model_overrides={"vocab_size": 512},
+                decode_steps_per_dispatch=4)
+
+_CYCLE_PERIOD = 7
+
+
+def train_cyclic_params(steps: int = 60):
+    """Train the tiny model on a period-7 token cycle so greedy decode
+    genuinely repeats — the workload class speculation exists for. ~5 s
+    on one CPU core."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import flax.linen as nn
+
+    from ray_tpu.models.llama import LlamaModel, get_config
+
+    cfg = get_config("tiny", scan_layers=True, remat=False,
+                     dtype=jnp.float32, param_dtype=jnp.float32,
+                     max_seq_len=SPEC_CFG["max_model_len"],
+                     vocab_size=512)
+    model = LlamaModel(cfg)
+    params = nn.meta.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"])
+
+    def batch(rng, bs=8, s=64):
+        starts = rng.integers(0, _CYCLE_PERIOD, bs)
+        rows = [[10 + (int(st) + i) % _CYCLE_PERIOD for i in range(s + 1)]
+                for st in starts]
+        a = np.asarray(rows, np.int32)
+        return a[:, :-1], a[:, 1:]
+
+    def loss_fn(p, x, y):
+        logits = model.apply({"params": p}, x)
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        return -jnp.mean(jnp.take_along_axis(lp, y[..., None], -1))
+
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(p, o, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+        upd, o = tx.update(g, o, p)
+        return optax.apply_updates(p, upd), o, loss
+
+    rng = np.random.default_rng(0)
+    loss = None
+    for _ in range(steps):
+        x, y = batch(rng)
+        params, opt, loss = step(params, opt, jnp.asarray(x),
+                                 jnp.asarray(y))
+    return params, float(loss)
+
+
+def run_spec(params, lookahead: int, max_tokens: int) -> dict:
+    """Drive 4 cyclic-prompt requests to completion; returns tok/s +
+    collected outputs + spec stats."""
+    import numpy as np
+
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine, SamplingParams
+
+    engine = LLMEngine(EngineConfig(**SPEC_CFG,
+                                    spec_lookahead=lookahead),
+                       params=params)
+    engine.warmup(prompt_buckets=(32,))
+    prompts = {}
+    for i in range(SPEC_CFG["max_batch"]):
+        prompts[f"r{i}"] = [10 + (j + i) % _CYCLE_PERIOD
+                            for j in range(21 + i)]
+    for rid, p in prompts.items():
+        engine.add_request(rid, p, SamplingParams(max_tokens=max_tokens))
+    out = {rid: [] for rid in prompts}
+    done = set()
+    n_tokens = 0
+    t0 = time.perf_counter()
+    while len(done) < len(prompts):
+        for d in engine.step():
+            out[d.request_id].extend(d.new_token_ids)
+            n_tokens += len(d.new_token_ids)
+            if d.finished:
+                done.add(d.request_id)
+    span = time.perf_counter() - t0
+    st = engine.stats()
+    return {
+        "tok_s": round(n_tokens / span, 1),
+        "out": out,
+        "drafted": st["spec_drafted_total"],
+        "accepted": st["spec_accepted_total"],
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="shorter waves (CI smoke)")
+    # 32 (2 pages) measures best on this box: chunk dispatches stay far
+    # cheaper than a decode step, so a colliding short's admission wave
+    # costs it ~100 ms instead of an 800 ms whole-prompt block (~3x p99)
+    parser.add_argument("--chunk", type=int, default=32)
+    parser.add_argument("--lookahead", type=int, default=15)
+    args = parser.parse_args()
+
+    duration = 6.0 if args.quick else 12.0
+    out = {"metric": "engine_sched"}
+
+    # 1. long-mix TTFT: chunked interleave ON vs OFF
+    on = run_longmix(args.chunk, duration, long_every_s=2.0,
+                     short_rate=1.0)
+    off = run_longmix(0, duration, long_every_s=2.0, short_rate=1.0)
+    out["longmix_on"] = on
+    out["longmix_off"] = off
+    out["ttft_ms_p99_longmix"] = on["ttft_ms_p99"]
+    out["ttft_ms_p99_longmix_off"] = off["ttft_ms_p99"]
+    out["ttft_longmix_speedup"] = round(
+        off["ttft_ms_p99"] / on["ttft_ms_p99"], 2) \
+        if on["ttft_ms_p99"] else None
+    out["itl_ms_p99"] = on["itl_ms_p99"]
+    out["decode_tok_s_cb"] = on["tok_s"]
+
+    # 2. speculative decode on a genuinely repetitive (trained) model
+    params, loss = train_cyclic_params(40 if args.quick else 60)
+    max_tokens = 48 if args.quick else 96
+    # alternate the arms and take each arm's median tok/s: single runs
+    # on a loaded 2-vCPU box swing 2x run-to-run; parity must hold on
+    # EVERY repeat
+    bases, specs = [], []
+    exact = True
+    for _ in range(2 if args.quick else 3):
+        base = run_spec(params, 0, max_tokens)
+        spec = run_spec(params, args.lookahead, max_tokens)
+        exact = exact and spec["out"] == base["out"]
+        bases.append(base)
+        specs.append(spec)
+    base = sorted(bases, key=lambda r: r["tok_s"])[len(bases) // 2]
+    spec = sorted(specs, key=lambda r: r["tok_s"])[len(specs) // 2]
+    out["spec_train_loss"] = round(loss, 4)
+    out["decode_tok_s_spec_base"] = base["tok_s"]
+    out["spec_tok_s"] = spec["tok_s"]
+    out["spec_speedup"] = round(spec["tok_s"] / base["tok_s"], 2) \
+        if base["tok_s"] else None
+    out["spec_accept_rate"] = round(
+        spec["accepted"] / spec["drafted"], 3) if spec["drafted"] else 0.0
+    out["spec_exact"] = exact
+
+    print(json.dumps(out))
+    if not exact:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -2001,7 +2001,7 @@ class Nodelet:
         loop resends on the next tick)."""
         serve_family = {
             k: v for k, v in (metrics or {}).items()
-            if k.startswith("rtpu_serve_")
+            if (k.startswith("rtpu_serve_") or k.startswith("rtpu_llm_"))
             and k.split("{", 1)[0].endswith("_total")}
         if serve_family:
             # retained for get_node_info aggregation: replica/proxy
@@ -2108,12 +2108,15 @@ class Nodelet:
 
 
 def _serve_metrics_snapshot() -> Dict[str, float]:
-    """rtpu_serve_* admission counters from this process's registry
-    (empty when no Serve traffic has touched this process)."""
+    """rtpu_serve_* admission + rtpu_llm_* engine-scheduler counters
+    from this process's registry (empty when no Serve traffic has
+    touched this process)."""
     try:
         from ..util import metrics
 
-        return metrics.snapshot("rtpu_serve_")
+        out = metrics.snapshot("rtpu_serve_")
+        out.update(metrics.snapshot("rtpu_llm_"))
+        return out
     except Exception:  # rtpulint: ignore[RTPU006] — node info is advisory telemetry; a metrics hiccup must not fail the RPC
         return {}
 

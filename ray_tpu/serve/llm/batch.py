@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -67,6 +68,15 @@ class ProcessorConfig:
     apply_chat_template: bool = True
     sampling: SamplingParams = dataclasses.field(
         default_factory=SamplingParams)
+    # per-request generation budget in seconds (None = unbounded): each
+    # row's deadline is stamped when its batch enters the engine stage,
+    # so offline batches participate in the engine's expiry pruning
+    # (WAITING entries are shed before prefill, RUNNING slots at step
+    # start) exactly like serve traffic. A row may instead carry its own
+    # absolute wall-clock "deadline" column, which wins over this knob.
+    # Expired rows come back with finish_reason == "expired" and
+    # whatever tokens they produced before the deadline.
+    deadline_s: Optional[float] = None
 
 
 def render_chat_template(messages: List[dict]) -> str:
@@ -120,15 +130,24 @@ class Processor:
         # request id from an earlier batch would cross-wire their tokens
         # (rtpulint RTPU005 — the PR 4 chain-hash bug class)
         batch_tag = next(_BATCH_SEQ)
+        # deadline threading (absolute wall clock, the engine converts
+        # to its monotonic domain): per-row "deadline" column wins, the
+        # ProcessorConfig.deadline_s budget stamps the rest
+        default_deadline = (time.time() + self.config.deadline_s
+                            if self.config.deadline_s else None)
         for i, row in enumerate(rows):
             rid = f"batch-{batch_tag}-{i}"
             row = dict(row)
             by_id[rid] = row
             max_new = int(row.get("max_tokens", sampling.max_tokens))
             params = dataclasses.replace(sampling, max_tokens=max_new)
+            deadline = row.get("deadline", default_deadline)
             engine.add_request(rid, list(map(int,
                                              row["prompt_token_ids"])),
-                               params)
+                               params,
+                               deadline=(float(deadline)
+                                         if deadline is not None
+                                         else None))
         collected: Dict[str, List[int]] = {rid: [] for rid in by_id}
         finish: Dict[str, str] = {}
         while engine.has_work():
@@ -139,6 +158,10 @@ class Processor:
                     if delta.finished:
                         finish[delta.request_id] = delta.finish_reason
         tok = get_tokenizer(self.config.tokenizer)
+        # per-batch expiry count rides the rows (the engine stage runs in
+        # a map_batches worker — driver-side Processor state never sees
+        # it; a shared column does)
+        n_expired = sum(1 for r in finish.values() if r == "expired")
         out = []
         for rid, row in by_id.items():
             ids = collected[rid]
@@ -147,6 +170,7 @@ class Processor:
             row["finish_reason"] = finish.get(rid, "stop")
             row["num_input_tokens"] = len(row["prompt_token_ids"])
             row["num_generated_tokens"] = len(ids)
+            row["num_expired_in_batch"] = n_expired
             out.append(row)
         return out
 

@@ -82,6 +82,30 @@ class PageAllocator:
         payloads (batched publication)."""
         return {"rev": self._rev, "hashes": list(self._hash_to_page)}
 
+    def cached_prefix_pages(self, tokens: Sequence[int]) -> int:
+        """Read-only probe: how many leading FULL pages of ``tokens`` are
+        already in the prefix cache. No ref bumps — admission lookahead
+        uses this to spot cheap (prefix-sharing) requests behind a
+        page-hungry queue head without committing pages to them."""
+        prev_hash: Optional[int] = None
+        n = 0
+        limit = (len(tokens) - 1) // self.page_size
+        for i in range(limit):
+            chunk = tokens[i * self.page_size:(i + 1) * self.page_size]
+            h = self.chain_hash(prev_hash, chunk)
+            if h not in self._hash_to_page:
+                break
+            prev_hash = h
+            n += 1
+        return n
+
+    def reclaimable_pages(self, pages: Sequence[int]) -> int:
+        """How many of ``pages`` would actually return capacity to the
+        pool if released now (sole reference): a prefix page shared with
+        another live sequence frees nothing, so preemption picks its
+        victim by this count, not by page-list length."""
+        return sum(1 for p in pages if self._refcount.get(p, 0) == 1)
+
     def note_prefix_lookup(self, n_tokens: int, n_hit: int) -> None:
         """Account one admitted request's prefix-cache outcome (token
         granularity — feeds the rtpu_kv_prefix_hit_rate gauge)."""

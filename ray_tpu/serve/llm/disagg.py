@@ -88,12 +88,13 @@ class PrefillServer(EngineDriverMixin):
         finally:
             self._waiters.pop(request_id, None)
         if reason == "expired":
-            # pruned from the WAITING queue: the propagated deadline
-            # passed before prefill admission — typed, never dead work
+            # pruned by the engine: the propagated deadline passed
+            # before admission OR mid-prefill (RUNNING slots are pruned
+            # at step start too) — typed, never dead work
             from ...exceptions import RequestExpiredError
 
             raise RequestExpiredError(
-                f"request {request_id} expired in the prefill queue",
+                f"request {request_id} expired in the prefill tier",
                 where="prefill queue")
         if reason != "prefill_done":
             # the first token already terminated the request (EOS/stop/

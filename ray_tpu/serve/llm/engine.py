@@ -41,6 +41,29 @@ count per length bucket): the waves pipeline on-device, so a burst's
 total prefill compute is unchanged but the first wave's tokens surface
 after only its own share of it — chunked prefill, adapted to a link
 where adding a dispatch is free and adding a sync costs an RTT.
+
+Scheduler v2 (token-budget continuous batching), on top of the above:
+- `prefill_chunk_tokens > 0` switches step() from prefill-priority to a
+  per-step TOKEN budget: every step dispatches the running slots' fused
+  decode FIRST, then at most one prefill dispatch of at most that many
+  prompt tokens — a long prompt advances one fixed-size chunk per step
+  (each chunk rides the existing length-bucket jit cache; chunk k>0
+  attends to the pages chunks 0..k-1 wrote through the same ctx-merge
+  path prefix-cache hits use), so a 512-token arrival bounds a running
+  request's inter-token gap by one chunk instead of one whole prompt.
+- admission is page-budget- and prefix-aware: when the queue head does
+  not fit the page headroom, requests further back whose prompt prefix
+  is already cached may co-admit ahead of it (their cached pages make
+  them nearly free), and preemption picks its victim by reclaimable
+  page count (pages shared with other live requests free nothing).
+- `spec_lookahead > 0` adds prompt-lookup speculative decoding: a
+  greedy slot with no in-flight work drafts up to that many tokens from
+  its own prompt+output n-grams, one prefill-shaped dispatch verifies
+  the whole draft (argmax at every position), and the harvest accepts
+  the longest prefix whose draft tokens match the model's own argmax —
+  bit-exact vs plain greedy decode by construction. Draft page writes
+  past the accepted prefix sit beyond the request's total and are
+  rewritten by the next dispatch before they ever become visible.
 """
 
 from __future__ import annotations
@@ -94,6 +117,14 @@ class Request:
     slot: int = -1               # decode slot while RUNNING
     planned_out: int = 0         # tokens dispatched (>= len(output_ids))
     decode_ready: bool = False   # prefill harvested; slot may decode
+    # prompt tokens whose KV is dispatched into pages (cache-restored +
+    # prefilled chunks); < len(prompt_ids) while a chunked prefill is in
+    # progress
+    n_prefilled: int = 0
+    # a speculative verify dispatch is in flight for this slot: the
+    # device carry is not updated by verify, so no other decode dispatch
+    # may touch the slot until the harvest resolves acceptance
+    spec_inflight: bool = False
 
     @property
     def total_len(self) -> int:
@@ -150,6 +181,21 @@ class EngineConfig:
     # first wave's tokens surface after only its own share — chunked
     # prefill, adapted to an RTT-dominated link. None => max_batch // 2.
     prefill_wave_size: Optional[int] = None
+    # token-budget scheduling: >0 caps each step's prefill work at this
+    # many prompt tokens (rounded up to a page multiple, clamped to the
+    # largest bucket) and interleaves it AFTER the running slots' fused
+    # decode — a long prompt prefills in fixed-size chunks across steps
+    # instead of stalling every running request for one whole prompt.
+    # Trades ~1 dispatch of pipeline depth for bounded inter-token gaps.
+    # 0 = legacy prefill-priority scheduling (whole prompts first).
+    prefill_chunk_tokens: int = 0
+    # prompt-lookup speculative decoding: >0 drafts up to this many
+    # tokens per idle greedy slot from the request's own prompt+output
+    # n-grams (no draft model) and verifies the draft in ONE
+    # prefill-shaped dispatch; the longest argmax-matching prefix is
+    # accepted, so one dispatch can emit many tokens on repetitive
+    # output. Greedy-only and bit-exact by construction. 0 = off.
+    spec_lookahead: int = 0
 
 
 _MAX_TOP_K = 64
@@ -275,8 +321,18 @@ class LLMEngine:
         self.running: List[Request] = []
         self.requests: Dict[str, Request] = {}
         # WAITING entries pruned for an expired deadline (stats() key;
-        # the Serve layer surfaces them as typed RequestExpiredError)
+        # the Serve layer surfaces them as typed RequestExpiredError).
+        # RUNNING slots whose deadline passes mid-decode count here too.
         self._expired_total = 0
+        # scheduler counters (stats() keys, exported as rtpu_llm_* by
+        # the serve layer): page-pressure preemptions and speculative
+        # draft/accept volumes
+        self._preempted_total = 0
+        self._spec_drafted_total = 0
+        self._spec_accepted_total = 0
+        # (head request_id, times passed) — bounds prefix-aware
+        # skip-ahead unfairness against one page-blocked queue head
+        self._head_overtaken: tuple = (None, 0)
         self._jit_cache: Dict[tuple, Any] = {}
         self._pending_deltas: List[OutputDelta] = []
         # the single compiled prefill row count (and max rows per prefill
@@ -349,21 +405,43 @@ class LLMEngine:
     # ------------------------------------------------------------- step
 
     def step(self) -> List[OutputDelta]:
-        """One scheduler iteration: admit + dispatch up to the pipeline
-        window, then harvest the oldest in-flight dispatch (blocking only
-        when its transfer has not landed yet). Prefill-priority, like
-        vLLM's default."""
+        """One scheduler iteration. Two scheduling modes share the same
+        dispatch/harvest machinery:
+
+        - legacy (prefill_chunk_tokens == 0): admit + prefill whole
+          prompts first (prefill-priority, like vLLM's default), fill
+          the pipeline with fused decode chunks, harvest the oldest
+          in-flight dispatch (blocking only when its transfer has not
+          landed yet).
+        - token budget (prefill_chunk_tokens > 0): decode FIRST — the
+          running slots' next tokens never queue behind a new prompt —
+          then at most one prefill dispatch of at most the budgeted
+          prompt tokens (long prompts advance one chunk per step), then
+          harvest enough dispatches to keep the backlog under the
+          pipeline depth, so a running slot's inter-token gap is one
+          decode chunk + one prefill chunk instead of one whole prompt.
+        """
         deltas: List[OutputDelta] = list(self._pending_deltas)
         self._pending_deltas.clear()
         self._drain_intake(deltas)
+        self._prune_expired_running(deltas)
         self._prune_expired_waiting(deltas)
         self._try_admit_injection(deltas)
-        self._dispatch_prefills()
+        chunked = self.config.prefill_chunk_tokens > 0
         depth = max(1, int(self.config.pipeline_depth))
+        if not chunked:
+            self._dispatch_prefills()
         while (len(self._inflight) < depth
-               and self._dispatch_decode_chunk()):
+               and (self._dispatch_spec()
+                    or self._dispatch_decode_chunk())):
             pass
-        if self._inflight:
+        if chunked:
+            self._dispatch_prefill_chunks()
+            if self._inflight:
+                self._harvest(self._inflight.pop(0), deltas)
+            while len(self._inflight) >= depth:
+                self._harvest(self._inflight.pop(0), deltas)
+        elif self._inflight:
             self._harvest(self._inflight.pop(0), deltas)
         return deltas
 
@@ -388,6 +466,38 @@ class LLMEngine:
                 self._finish(req, "aborted")
                 deltas.append(OutputDelta(rid, [], True, "aborted"))
 
+    @staticmethod
+    def _count_engine_expired() -> None:
+        try:  # serve metrics are advisory; the engine runs standalone
+            # (batch workers, tests) without them
+            from .. import admission
+
+            admission.count_shed(admission.SHED_ENGINE_EXPIRED)
+        except Exception:  # rtpulint: ignore[RTPU006] — metric registration may fail outside a serve process; pruning must not
+            pass
+
+    def _prune_expired_running(self, deltas: List[OutputDelta]) -> None:
+        """Shed RUNNING requests whose propagated deadline has passed: a
+        slot still decoding for a client that already gave up is pure
+        dead work AND pins pages + a batch slot other requests need.
+        Free both at step start and emit the typed "expired" delta (the
+        Serve layer maps it to RequestExpiredError). Dispatches already
+        in flight for the slot are discarded at harvest — the same
+        mechanism abort uses — and their page writes land beyond any
+        live request's visible range."""
+        if not self.running:
+            return
+        now = time.monotonic()
+        expired = [r for r in self.running
+                   if r.deadline_mono is not None
+                   and now >= r.deadline_mono]
+        for req in expired:
+            self._finish(req, "expired")
+            self._expired_total += 1
+            deltas.append(OutputDelta(req.request_id, [], True,
+                                      "expired"))
+            self._count_engine_expired()
+
     def _prune_expired_waiting(self, deltas: List[OutputDelta]) -> None:
         """Shed expired WAITING entries at batch admission: a request
         whose propagated deadline passed while it sat in the queue must
@@ -407,60 +517,106 @@ class LLMEngine:
                 self._expired_total += 1
                 deltas.append(OutputDelta(req.request_id, [], True,
                                           "expired"))
-                try:  # serve metrics are advisory; the engine runs
-                    # standalone (batch workers, tests) without them
-                    from .. import admission
-
-                    admission.count_shed(admission.SHED_ENGINE_EXPIRED)
-                except Exception:  # rtpulint: ignore[RTPU006] — metric registration may fail outside a serve process; pruning must not
-                    pass
+                self._count_engine_expired()
             else:
                 kept.append(req)
         self.waiting[:] = kept
 
+    # bounded admission lookahead: how far past the head of the waiting
+    # queue prefix-aware admission may scan when the head does not fit
+    # the page budget (only cached-prefix requests may skip ahead)
+    _ADMIT_LOOKAHEAD = 32
+    # bounded unfairness: how many requests may pass ONE blocked head
+    # before skip-ahead pauses (sustained prefix-sharing traffic would
+    # otherwise absorb every freed page and starve the head forever)
+    _HEAD_OVERTAKE_CAP = 32
+
     def _admit_one(self, burst_prefixes: set = None) -> Optional[Request]:
-        """Admit the head of the waiting queue (slot + pages permitting)
-        WITHOUT prefilling; returns the request or None. A request whose
-        leading page matches one already admitted THIS step is deferred:
-        next step its prefix pages are computed and cached, so it shares
-        them instead of prefilling the same content in parallel."""
+        """Admit one waiting request (slot + page budget permitting)
+        WITHOUT prefilling; returns the request or None.
+
+        FIFO first: the head of the queue is always tried. When the head
+        does NOT fit the current page headroom, requests further back
+        whose prompt prefix is already in the page cache may admit ahead
+        of it (prefix-aware co-admission): their cached pages make them
+        nearly free, and joining the wave that computed their prefix
+        beats queueing behind a page-hungry stranger. At most
+        _HEAD_OVERTAKE_CAP requests may pass one blocked head — past
+        that, skip-ahead pauses until the head admits, so freed pages
+        accumulate for it instead of being absorbed by an endless stream
+        of cheap prefix-sharers. The lookahead is part of scheduler v2:
+        with prefill_chunk_tokens == 0 admission is strict FIFO (head
+        only), preserving the legacy scheduler's order exactly.
+
+        A request whose leading page matches one already admitted THIS
+        step is deferred: next step its prefix pages are computed and
+        cached, so it shares them instead of prefilling the same content
+        in parallel (in v2 mode a twin whose prefix is ALREADY cached
+        co-admits instead of deferring)."""
         if not self.waiting or not self._free_slots:
             return None
-        req = self.waiting[0]
         page = self.config.page_size
-        if burst_prefixes is not None and len(req.prompt_ids) >= page:
-            first_hash = self.allocator.chain_hash(
-                None, req.prompt_ids[:page])
-            if first_hash in burst_prefixes:
-                return None  # wait one step; the prefix cache will hit
-            burst_prefixes.add(first_hash)
-        cached_pages, n_cached = self.allocator.match_prefix(req.prompt_ids)
-        need = (-(-(len(req.prompt_ids) + 1) // page)
-                - len(cached_pages))
-        if self.allocator.num_free() < need:
-            self.allocator.release(cached_pages)
-            self.allocator.stats["cache_hits"] -= len(cached_pages)
-            return None
-        self.waiting.pop(0)
-        self.allocator.note_prefix_lookup(len(req.prompt_ids), n_cached)
-        new_pages = self.allocator.allocate(need)
-        req.pages = cached_pages + new_pages
-        req.n_cached = n_cached
-        req.n_hashed = n_cached
-        req.last_page_hash = None
-        if cached_pages:
-            # Recompute the chain hash up to the cached boundary.
-            h = None
-            for i in range(len(cached_pages)):
-                h = self.allocator.chain_hash(
-                    h, req.prompt_ids[i * page:(i + 1) * page])
-            req.last_page_hash = h
-        req.state = RUNNING
-        req.slot = self._free_slots.pop(0)
-        req.planned_out = 0
-        self._slot_req[req.slot] = req
-        self.running.append(req)
-        return req
+        legacy = self.config.prefill_chunk_tokens <= 0
+        lookahead = 1 if legacy else self._ADMIT_LOOKAHEAD
+        head_id = self.waiting[0].request_id
+        if self._head_overtaken[0] != head_id:
+            self._head_overtaken = (head_id, 0)
+        for qi in range(min(len(self.waiting), lookahead)):
+            req = self.waiting[qi]
+            if qi > 0 and self._head_overtaken[1] >= \
+                    self._HEAD_OVERTAKE_CAP:
+                return None  # head has been passed enough; let it age in
+            first_hash = None
+            if burst_prefixes is not None and len(req.prompt_ids) >= page:
+                first_hash = self.allocator.chain_hash(
+                    None, req.prompt_ids[:page])
+                if first_hash in burst_prefixes:
+                    continue  # wait one step; the prefix cache will hit
+            cached_pages, n_cached = self.allocator.match_prefix(
+                req.prompt_ids)
+            if qi > 0 and not cached_pages:
+                continue  # only prefix-sharers may pass a blocked head
+            need = (-(-(len(req.prompt_ids) + 1) // page)
+                    - len(cached_pages))
+            if self.allocator.num_free() < need:
+                self.allocator.release(cached_pages)
+                self.allocator.stats["cache_hits"] -= len(cached_pages)
+                continue  # page budget: scan on for a cached-prefix fit
+            if first_hash is not None and (legacy or not cached_pages):
+                # this admission will COMPUTE the prefix: defer same-
+                # prefix twins one step so they share it from the cache.
+                # v2 mode skips the mark when the prefix is already
+                # cached (the twin co-admits); legacy mode always marks,
+                # matching the pre-v2 scheduler's behavior exactly.
+                burst_prefixes.add(first_hash)
+            if qi > 0:
+                self._head_overtaken = (head_id,
+                                        self._head_overtaken[1] + 1)
+            else:
+                self._head_overtaken = (None, 0)
+            self.waiting.pop(qi)
+            self.allocator.note_prefix_lookup(len(req.prompt_ids),
+                                              n_cached)
+            new_pages = self.allocator.allocate(need)
+            req.pages = cached_pages + new_pages
+            req.n_cached = n_cached
+            req.n_prefilled = n_cached
+            req.n_hashed = n_cached
+            req.last_page_hash = None
+            if cached_pages:
+                # Recompute the chain hash up to the cached boundary.
+                h = None
+                for i in range(len(cached_pages)):
+                    h = self.allocator.chain_hash(
+                        h, req.prompt_ids[i * page:(i + 1) * page])
+                req.last_page_hash = h
+            req.state = RUNNING
+            req.slot = self._free_slots.pop(0)
+            req.planned_out = 0
+            self._slot_req[req.slot] = req
+            self.running.append(req)
+            return req
+        return None
 
     # ---------------------------------------------------------- compute
 
@@ -525,6 +681,43 @@ class LLMEngine:
             self._jit_cache[key] = fn
             return fn
 
+        if kind == "verify":
+            # speculative verification: prefill-shaped (the draft is a
+            # short "prompt" continuing the sequence, attending to all
+            # earlier pages through the same ctx-merge path), but greedy
+            # tokens come back for EVERY position — the acceptance walk
+            # needs argmax-after-each-draft-token, and comparing argmax
+            # against the draft is what makes acceptance bit-exact
+            mp = self.max_pages_per_seq
+
+            def run_verify(params, kv_pages, block_tables, total_lens,
+                           input_ids, positions):
+                pc = PagedCache(
+                    kv_pages=kv_pages,
+                    block_tables=jnp.broadcast_to(
+                        block_tables, (L,) + block_tables.shape),
+                    total_lens=jnp.broadcast_to(
+                        total_lens, (L,) + total_lens.shape),
+                    ctx_pages=mp, ref_attention=ref_attn)
+                logits, new_pc = model.apply({"params": params},
+                                             input_ids,
+                                             positions=positions,
+                                             kv_caches=pc)
+                toks = jnp.argmax(logits.astype(jnp.float32), axis=-1)
+                return toks.astype(jnp.int32), new_pc.kv_pages
+
+            if self.sharding is not None:
+                repl = self._repl_sharding
+                fn = jax.jit(
+                    run_verify, donate_argnums=(1,),
+                    in_shardings=(self._param_shardings,
+                                  self._kv_sharding) + (repl,) * 4,
+                    out_shardings=(repl, self._kv_sharding))
+            else:
+                fn = jax.jit(run_verify, donate_argnums=(1,))
+            self._jit_cache[key] = fn
+            return fn
+
         # decode: fixed slot-set [S] batch, K fused steps, device-carry ids
         n_steps = shape_key[0]
 
@@ -585,10 +778,11 @@ class LLMEngine:
         return fn
 
     def _dispatch_prefills(self) -> None:
-        """Admit as many waiting requests as slots/pages allow and launch
-        one prefill dispatch per length-bucket (single dispatch per
-        bucket: with tunnel RTT >> prefill compute, per-prompt dispatch
-        made TTFT queue-linear for no win)."""
+        """Legacy (prefill-priority) mode: admit as many waiting requests
+        as slots/pages allow and launch one WHOLE-prompt prefill dispatch
+        per length-bucket (single dispatch per bucket: with tunnel RTT >>
+        prefill compute, per-prompt dispatch made TTFT queue-linear for
+        no win)."""
         admitted = []
         burst_prefixes: set = set()
         while len(self.running) < self.config.max_batch:
@@ -599,17 +793,101 @@ class LLMEngine:
         if not admitted:
             return
         wave = self._wave_rb
-        by_bucket: Dict[int, List[Request]] = {}
+        by_bucket: Dict[int, List[tuple]] = {}
         for req in admitted:
-            n_new = len(req.prompt_ids) - req.n_cached
+            n_new = len(req.prompt_ids) - req.n_prefilled
             sb = _bucket(n_new, self.config.prefill_buckets)
-            by_bucket.setdefault(sb, []).append(req)
+            by_bucket.setdefault(sb, []).append((req, n_new))
         for sb, group in by_bucket.items():
             for i in range(0, len(group), wave):
                 self._dispatch_prefill_batch(sb, group[i:i + wave])
 
+    def _chunk_tokens(self) -> int:
+        """prefill_chunk_tokens rounded UP to a page multiple (chunk
+        boundaries stay page-aligned so every completed chunk's full
+        pages enter the prefix cache) and clamped to the largest length
+        bucket (a chunk must fit one compiled prefill shape)."""
+        page = self.config.page_size
+        c = max(1, int(self.config.prefill_chunk_tokens))
+        return max(page, min(-(-c // page) * page,
+                             self.config.prefill_buckets[-1]))
+
+    def _dispatch_prefill_chunks(self) -> None:
+        """Token-budget mode: admit new requests and advance mid-prefill
+        requests, together bounded by the per-step budget — ONE dispatch
+        per step (rows share the chunk's length bucket), so the device
+        work a step adds ahead of the next decode harvest is bounded by
+        one prefill chunk.
+
+        NEW admissions take the budget FIRST: a short prompt arriving
+        while a long prompt is mid-prefill starts immediately inside
+        this step's budget instead of waiting out the long prompt's
+        remaining chunks — that ordering IS the head-of-line fix, and it
+        cannot starve the long prompt because admissions stop once the
+        batch is full while most steps see no arrivals at all. The
+        leftover budget is split evenly across continuing mid-prefill
+        requests (page-aligned shares) so concurrent long prompts
+        advance together instead of strictly FIFO."""
+        budget = self._chunk_tokens()
+        page = self.config.page_size
+
+        def grant(req: Request, tokens: int) -> int:
+            """Tokens this row may prefill now: a FINAL chunk takes its
+            exact remainder; a non-final chunk rounds DOWN to a page
+            multiple so every chunk boundary stays page-aligned (full
+            pages enter the prefix cache; the ctx-merge path only ever
+            sees the page-multiple starts prefix-cache hits produce)."""
+            remaining = len(req.prompt_ids) - req.n_prefilled
+            if remaining <= tokens:
+                return remaining
+            return tokens // page * page
+
+        rows: List[tuple] = []
+        used = 0
+        burst_prefixes: set = set()
+        while (used < budget and len(rows) < self._wave_rb
+               and len(self.running) < self.config.max_batch):
+            req = self._admit_one(burst_prefixes)
+            if req is None:
+                break
+            n_new = grant(req, budget - used)
+            if n_new > 0:
+                rows.append((req, n_new))
+                used += n_new
+            # n_new == 0: admitted with < 1 page of budget left — it
+            # holds its slot/pages and continues in the next step's wave
+        continuing = [r for r in self.running
+                      if r.state == RUNNING and not r.decode_ready
+                      and 0 < len(r.prompt_ids) - r.n_prefilled
+                      and all(r is not q for q, _ in rows)]
+        if continuing and used < budget:
+            # even, page-aligned shares; the division remainder goes to
+            # the FIRST continuing row so the full budget is dispatched
+            share = max(page,
+                        (budget - used) // len(continuing) // page * page)
+            extra = max(0, (budget - used) - share * len(continuing))
+            for idx, req in enumerate(continuing):
+                if used >= budget or len(rows) >= self._wave_rb:
+                    break
+                n_new = grant(req, min(share + (extra if idx == 0 else 0),
+                                       budget - used))
+                if n_new <= 0:
+                    continue
+                rows.append((req, n_new))
+                used += n_new
+        if not rows:
+            return
+        sb = _bucket(max(n for _, n in rows), self.config.prefill_buckets)
+        self._dispatch_prefill_batch(sb, rows)
+
     def _dispatch_prefill_batch(self, sb: int,
-                                group: List[Request]) -> None:
+                                group: List[tuple]) -> None:
+        """One prefill dispatch. ``group`` rows are (request, n_new):
+        each row prefills n_new prompt tokens starting at the request's
+        n_prefilled mark — the whole remaining prompt in legacy mode, one
+        chunk in token-budget mode. Rows whose start is > 0 attend to
+        their earlier pages through the same ctx-merge path prefix-cache
+        hits use; only rows whose FINAL chunk this is sample a token."""
         import jax.numpy as jnp
 
         # rows always pad to the wave size: ONE compiled row count per
@@ -622,21 +900,25 @@ class LLMEngine:
         bt = np.zeros((rb, self.max_pages_per_seq), np.int32)
         total = np.zeros((rb,), np.int32)
         gather = np.zeros((rb,), np.int32)
-        for i, req in enumerate(group):
-            n_new = len(req.prompt_ids) - req.n_cached
-            ids[i, :n_new] = req.prompt_ids[req.n_cached:]
-            positions[i] = req.n_cached + np.arange(sb, dtype=np.int32)
+        rows = []
+        for i, (req, n_new) in enumerate(group):
+            start = req.n_prefilled
+            ids[i, :n_new] = req.prompt_ids[start:start + n_new]
+            positions[i] = start + np.arange(sb, dtype=np.int32)
             bt[i, :len(req.pages)] = req.pages
-            total[i] = len(req.prompt_ids)
+            total[i] = start + n_new
             gather[i] = n_new - 1
+            final = start + n_new >= len(req.prompt_ids)
+            rows.append((req.request_id, req.slot, start + n_new, final))
         now = time.monotonic()
-        for req in group:
+        for req, _ in group:
             if req.dispatched_t is None:
                 req.dispatched_t = now
         cp = (self.max_pages_per_seq
-              if any(req.n_cached for req in group) else 0)
+              if any(req.n_prefilled for req, _ in group) else 0)
         fn = self._jit("prefill", (sb, rb, cp))
-        temp, topk, keys = self._sampling_arrays(group, rb)
+        temp, topk, keys = self._sampling_arrays(
+            [req for req, _ in group], rb)
         tokens, self.kv_pages = fn(
             self.params, self.kv_pages, jnp.asarray(bt),
             jnp.asarray(total), jnp.asarray(ids), jnp.asarray(positions),
@@ -645,12 +927,121 @@ class LLMEngine:
             tokens.copy_to_host_async()
         except Exception:  # noqa: BLE001  # rtpulint: ignore[RTPU006] — optional D2H prefetch: CPU backends lack it; harvest blocks on the array either way
             pass
-        for req in group:
-            req.planned_out = 1
+        for req, n_new in group:
+            req.n_prefilled += n_new
+            if req.n_prefilled >= len(req.prompt_ids):
+                req.planned_out = 1
         self._inflight.append({
-            "kind": "prefill", "toks": tokens,
-            "group": [(req.request_id, req.slot) for req in group],
+            "kind": "prefill", "toks": tokens, "group": rows,
         })
+
+    @staticmethod
+    def _prompt_lookup_draft(req: Request, max_len: int) -> List[int]:
+        """Prompt-lookup (n-gram) draft: find the most recent earlier
+        occurrence of the sequence's trailing n-gram in prompt+output and
+        propose the tokens that followed it. No draft model — the
+        request's own text is the only source, which is exactly the
+        regime speculation wins in (code, templated output, extraction,
+        repetition). Longer (more precise) n-grams are tried first."""
+        seq = req.prompt_ids + req.output_ids
+        for n in (3, 2):
+            if len(seq) < n + 1:
+                continue
+            tail = seq[-n:]
+            # backwards: the MOST RECENT occurrence predicts best
+            for i in range(len(seq) - n - 1, -1, -1):
+                if seq[i:i + n] == tail:
+                    return [int(t) for t in seq[i + n:i + n + max_len]]
+        return []
+
+    def _dispatch_spec(self) -> bool:
+        """Prompt-lookup speculative decode: ONE prefill-shaped dispatch
+        verifies each drafted continuation (inputs = pending token +
+        draft; argmax at every position comes back); the harvest accepts
+        the longest prefix whose draft tokens match the model's own
+        argmax, emitting up to spec_lookahead+1 tokens per dispatch.
+        Greedy-only (temperature == 0) and only for slots with no work
+        in flight (drafting needs the host-known tail of the sequence).
+        Returns False when no slot qualifies — the normal fused decode
+        then covers everything."""
+        import jax.numpy as jnp
+
+        cfg = self.config
+        L = int(cfg.spec_lookahead)
+        if L <= 0:
+            return False
+        L = min(L, cfg.prefill_buckets[-1] - 1)
+        page = cfg.page_size
+        rows: List[tuple] = []
+        for req in self.running:
+            if (req.slot < 0 or not req.decode_ready
+                    or req.spec_inflight
+                    or req.sampling.temperature > 0
+                    or req.sampling.prefill_only
+                    or req.planned_out != len(req.output_ids)
+                    or req.planned_out >= req.sampling.max_tokens):
+                continue
+            cap = _cap_total(req, cfg.max_model_len)
+            total = len(req.prompt_ids) + len(req.output_ids)
+            if total >= cap:
+                continue
+            draft = self._prompt_lookup_draft(req, min(L, cap - total))
+            if not draft:
+                continue
+            # page horizon for the draft writes (positions total-1 ..
+            # total-1+len(draft), all < cap by the clamp above); a
+            # shortfall skips speculation for this slot — the normal
+            # decode path owns preemption
+            last_pos = total - 1 + len(draft)
+            required = min(last_pos // page + 1, self.max_pages_per_seq)
+            if len(req.pages) < required:
+                try:
+                    req.pages.extend(self.allocator.allocate(
+                        required - len(req.pages)))
+                except OutOfPages:
+                    continue
+            rows.append((req, draft))
+            if len(rows) >= self._wave_rb:
+                break
+        if not rows:
+            return False
+        rb = self._wave_rb
+        sb = _bucket(L + 1, cfg.prefill_buckets)
+        ids = np.zeros((rb, sb), np.int32)
+        positions = np.zeros((rb, sb), np.int32)
+        bt = np.zeros((rb, self.max_pages_per_seq), np.int32)
+        total_arr = np.zeros((rb,), np.int32)
+        recs = []
+        for i, (req, draft) in enumerate(rows):
+            total = len(req.prompt_ids) + len(req.output_ids)
+            pending = (req.output_ids[-1] if req.output_ids
+                       else req.prompt_ids[-1])
+            n = len(draft)
+            ids[i, 0] = pending
+            ids[i, 1:1 + n] = draft
+            positions[i] = (total - 1) + np.arange(sb, dtype=np.int32)
+            bt[i, :len(req.pages)] = req.pages
+            # pos-mask: writes beyond the pending token + draft are
+            # dropped (padding columns), and the clamp above keeps every
+            # draft write under the request's cap
+            total_arr[i] = total + n
+            recs.append((req.request_id, req.slot, len(req.output_ids),
+                         list(draft)))
+            req.planned_out += n + 1  # optimistic; rolled back at harvest
+            req.spec_inflight = True
+            self._spec_drafted_total += n
+        fn = self._jit("verify", (sb, rb))
+        toks, self.kv_pages = fn(
+            self.params, self.kv_pages, jnp.asarray(bt),
+            jnp.asarray(total_arr), jnp.asarray(ids),
+            jnp.asarray(positions))
+        try:
+            toks.copy_to_host_async()
+        except Exception:  # noqa: BLE001  # rtpulint: ignore[RTPU006] — optional D2H prefetch: CPU backends lack it; harvest blocks on the array either way
+            pass
+        self._inflight.append({"kind": "spec", "toks": toks,
+                               "rows": recs})
+        return True
 
     def _dispatch_decode_chunk(self) -> bool:
         """Launch one fused K-step decode dispatch over the full slot set,
@@ -669,7 +1060,10 @@ class LLMEngine:
         # EOS/stop-token are the speculative waste we accept
         elig = []
         for req in self.running:
-            if req.slot < 0 or not req.decode_ready:
+            if (req.slot < 0 or not req.decode_ready
+                    or req.spec_inflight):
+                # spec_inflight: a verify dispatch owns the slot — the
+                # device carry is stale until its harvest resolves
                 continue
             cap = _cap_total(req, cfg.max_model_len)
             if (req.planned_out >= req.sampling.max_tokens
@@ -680,9 +1074,11 @@ class LLMEngine:
             return False
         # page horizon: every eligible slot needs pages covering its
         # planned writes through this chunk (clamped by its cap). Oldest
-        # first; on exhaustion with an empty pipeline, preempt the NEWEST
-        # running request (vLLM's recompute-style preemption) — with work
-        # in flight, back off and let the harvest free pages instead.
+        # first; on exhaustion with an empty pipeline, preempt the victim
+        # with the MOST reclaimable pages (sole-reference pages — prefix
+        # pages shared with other live requests free nothing), newest
+        # arrival breaking ties (vLLM's recompute-style preemption) —
+        # with work in flight, back off and let the harvest free pages.
         for req in sorted(elig, key=lambda r: r.arrival_t):
             cap = _cap_total(req, cfg.max_model_len)
             # last position this chunk writes: the pending token sits at
@@ -705,7 +1101,11 @@ class LLMEngine:
                         if req.planned_out == len(req.output_ids):
                             self._preempt(req)
                         break
-                    self._preempt(max(victims, key=lambda r: r.arrival_t))
+                    self._preempt(max(
+                        victims,
+                        key=lambda r: (
+                            self.allocator.reclaimable_pages(r.pages),
+                            r.arrival_t)))
         elig = [r for r in elig
                 if r in self.running and r.state == RUNNING]
         if not elig:
@@ -766,11 +1166,15 @@ class LLMEngine:
     def _harvest(self, rec: dict, deltas: List[OutputDelta]) -> None:
         toks_np = np.asarray(rec["toks"])
         if rec["kind"] == "prefill":
-            for i, (rid, slot) in enumerate(rec["group"]):
+            for i, (rid, slot, end, final) in enumerate(rec["group"]):
                 req = self.requests.get(rid)
                 if req is None or req.state != RUNNING or req.slot != slot:
                     continue  # aborted while in flight
-                self._register_full_pages(req)
+                self._register_full_pages(req, upto=end)
+                if not final:
+                    # intermediate chunk: pages are written; the sampled
+                    # token (mid-prompt continuation) is meaningless
+                    continue
                 token = int(toks_np[i])
                 # the decode chain reads this slot's first input from the
                 # host-side override (the prefill wrote pages, not the
@@ -778,6 +1182,41 @@ class LLMEngine:
                 self._slot_override[slot] = token
                 req.decode_ready = True
                 self._append_token(req, token, deltas)
+            return
+        if rec["kind"] == "spec":
+            # toks_np is [rb, sb]: g[j] = the model's argmax AFTER input
+            # column j. Accept g[0] (computed from the true pending
+            # token), then each g[j] while draft[j-1] == g[j-1] — the
+            # draft token fed at column j was the model's own choice, so
+            # everything before the first mismatch is exactly what plain
+            # greedy decode would have produced.
+            for i, (rid, slot, start, draft) in enumerate(rec["rows"]):
+                req = self.requests.get(rid)
+                if req is None:
+                    continue
+                req.spec_inflight = False
+                if (req.state != RUNNING or req.slot != slot
+                        or len(req.output_ids) != start):
+                    continue  # finished/aborted while in flight
+                g = toks_np[i]
+                emitted = [int(g[0])]
+                for j in range(1, len(draft) + 1):
+                    if int(draft[j - 1]) != emitted[-1]:
+                        break
+                    emitted.append(int(g[j]))
+                self._spec_accepted_total += len(emitted) - 1
+                for tok in emitted:
+                    if req.state != RUNNING:
+                        break  # EOS/stop/length inside the accepted run
+                    self._append_token(req, tok, deltas)
+                if req.state == RUNNING:
+                    # roll the optimistic plan back to reality and feed
+                    # the next dispatch the last ACCEPTED token (verify
+                    # never touches the device carry); rejected draft
+                    # writes sit beyond total and are rewritten before
+                    # any live request's attention can reach them
+                    req.planned_out = len(req.output_ids)
+                    self._slot_override[req.slot] = req.output_ids[-1]
             return
         # decode chunk: toks_np is [K, S]
         k_steps = rec["k"]
@@ -797,6 +1236,7 @@ class LLMEngine:
         folded into the prompt). Only called with an empty pipeline, so
         host bookkeeping is authoritative."""
         assert not self._inflight
+        self._preempted_total += 1
         self.running.remove(req)
         self._release_slot(req)
         self.allocator.release(req.pages)
@@ -805,9 +1245,11 @@ class LLMEngine:
         req.output_ids = []
         req.pages = []
         req.n_cached = 0
+        req.n_prefilled = 0
         req.n_hashed = 0
         req.planned_out = 0
         req.decode_ready = False
+        req.spec_inflight = False
         req.dispatched_t = None  # re-prefill measures its own queue wait
         req.state = WAITING
         self.waiting.insert(0, req)
@@ -892,11 +1334,16 @@ class LLMEngine:
         else:
             deltas.append(OutputDelta(req.request_id, [token], False))
 
-    def _register_full_pages(self, req: Request) -> None:
+    def _register_full_pages(self, req: Request,
+                             upto: Optional[int] = None) -> None:
         """Enter any newly-FULL prompt pages into the prefix cache (only
-        prompt tokens — generated text is rarely shared)."""
+        prompt tokens — generated text is rarely shared). ``upto`` bounds
+        registration to tokens whose KV has actually been written (a
+        chunked prefill registers chunk by chunk as dispatches land)."""
         page = self.config.page_size
         n_prompt_full = len(req.prompt_ids) // page
+        if upto is not None:
+            n_prompt_full = min(n_prompt_full, upto // page)
         while req.n_hashed // page < n_prompt_full:
             i = req.n_hashed // page
             tokens = req.prompt_ids[i * page:(i + 1) * page]
@@ -1031,6 +1478,7 @@ class LLMEngine:
         page = self.config.page_size
         req.n_hashed = (len(req.prompt_ids) // page) * page
         req.n_cached = 0
+        req.n_prefilled = len(req.prompt_ids)
         req.slot = self._free_slots.pop(0)
         req.planned_out = len(req.output_ids)
         req.decode_ready = True
@@ -1083,6 +1531,23 @@ class LLMEngine:
             n += 1
         if not include_decode:
             return n
+        if self.config.spec_lookahead > 0:
+            # the speculative verify dispatch (decode-phase work) has ONE
+            # shape: the bucket covering spec_lookahead+1 — padded rows
+            # and columns handle shorter drafts
+            sbv = _bucket(min(int(self.config.spec_lookahead),
+                              self.config.prefill_buckets[-1] - 1) + 1,
+                          self.config.prefill_buckets)
+            fn = self._jit("verify", (sbv, rb))
+            toks, self.kv_pages = fn(
+                self.params, self.kv_pages,
+                jnp.asarray(np.zeros((rb, self.max_pages_per_seq),
+                                     np.int32)),
+                jnp.asarray(np.zeros((rb,), np.int32)),
+                jnp.asarray(np.zeros((rb, sbv), np.int32)),
+                jnp.asarray(np.zeros((rb, sbv), np.int32)))
+            np.asarray(toks)
+            n += 1
         for mp in (self.max_pages_per_seq,):
             fn = self._jit("decode", (k_steps, mp))
             toks, self.slot_ids, self.kv_pages = fn(
@@ -1187,12 +1652,17 @@ class LLMEngine:
     # ------------------------------------------------------------ stats
 
     def stats(self) -> Dict[str, Any]:
+        free = self.allocator.num_free()
         out = {
             "running": len(self.running),
             "waiting": len(self.waiting),
             "inflight": len(self._inflight),
             "expired_total": self._expired_total,
-            "free_pages": self.allocator.num_free(),
+            "preempted_total": self._preempted_total,
+            "spec_drafted_total": self._spec_drafted_total,
+            "spec_accepted_total": self._spec_accepted_total,
+            "free_pages": free,
+            "pages_free": free,  # rtpu_llm_pages_free gauge key
             **self.allocator.stats,
         }
         if self.sharding is not None:

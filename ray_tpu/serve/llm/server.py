@@ -65,6 +65,40 @@ class LLMConfig:
     kv_handoff_cap: int = 256
 
 
+_LLM_METRICS = None
+
+
+def _get_llm_metrics():
+    """Engine-scheduler metric family (``rtpu_llm_*``), lazily
+    registered so importing the module costs nothing: queue gauges +
+    scheduler counters the continuous-batching bench and dashboards
+    read. Counters end ``_total``, gauges do not (RTPU106); the nodelet
+    ships worker-side counters with get_node_info's serve family."""
+    global _LLM_METRICS
+    if _LLM_METRICS is None:
+        from ...util.metrics import Counter, Gauge
+
+        _LLM_METRICS = {
+            "waiting": Gauge("rtpu_llm_waiting",
+                             "requests queued for engine admission"),
+            "running": Gauge("rtpu_llm_running",
+                             "requests holding a decode slot"),
+            "pages_free": Gauge(
+                "rtpu_llm_pages_free",
+                "free KV pages (incl. evictable cached pages)"),
+            "preempted": Counter(
+                "rtpu_llm_preempted_total",
+                "requests preempted for page pressure"),
+            "spec_drafted": Counter(
+                "rtpu_llm_spec_drafted_total",
+                "speculative draft tokens dispatched for verification"),
+            "spec_accepted": Counter(
+                "rtpu_llm_spec_accepted_total",
+                "speculative draft tokens accepted by verification"),
+        }
+    return _LLM_METRICS
+
+
 class EngineDriverMixin:
     """Single driver coroutine + per-request waiter queues over the
     non-thread-safe engine. Concurrent request coroutines never call
@@ -75,6 +109,10 @@ class EngineDriverMixin:
     def _init_driver(self):
         self._waiters: Dict[str, asyncio.Queue] = {}
         self._driver_task: Optional[asyncio.Task] = None
+        # last engine counter values already folded into the rtpu_llm_*
+        # counters (engine stats are cumulative; metrics take deltas)
+        self._llm_counts: Dict[str, int] = {}
+        self._llm_pub_t = 0.0
 
     async def _ensure_driver(self):
         if self._driver_task is None or self._driver_task.done():
@@ -90,6 +128,10 @@ class EngineDriverMixin:
                     queue = self._waiters.get(delta.request_id)
                     if queue is not None:
                         queue.put_nowait(delta)
+                now = time.monotonic()
+                if now - self._llm_pub_t > 2.0:
+                    self._llm_pub_t = now
+                    self._publish_llm_metrics(self.engine.stats())
                 if not deltas:
                     await asyncio.sleep(0.005)
             # Linger one tick before exiting: work enqueued between the
@@ -99,6 +141,7 @@ class EngineDriverMixin:
             # into that window unseen.
             await asyncio.sleep(0.005)
             if not self.engine.has_work():
+                self._publish_llm_metrics(self.engine.stats())
                 return
 
     async def _await_request(self, request_id: str,
@@ -112,8 +155,24 @@ class EngineDriverMixin:
             if delta.finished:
                 return
 
+    def _publish_llm_metrics(self, stats: Dict[str, Any]) -> None:
+        m = _get_llm_metrics()
+        m["waiting"].set(stats.get("waiting", 0))
+        m["running"].set(stats.get("running", 0))
+        m["pages_free"].set(stats.get("pages_free", 0))
+        for key, mk in (("preempted_total", "preempted"),
+                        ("spec_drafted_total", "spec_drafted"),
+                        ("spec_accepted_total", "spec_accepted")):
+            cur = int(stats.get(key, 0))
+            delta = cur - self._llm_counts.get(key, 0)
+            if delta > 0:
+                m[mk].inc(delta)
+            self._llm_counts[key] = cur
+
     def engine_stats(self) -> Dict[str, Any]:
-        return self.engine.stats()
+        stats = self.engine.stats()
+        self._publish_llm_metrics(stats)
+        return stats
 
     def kv_frontier(self,
                     known_rev: Optional[int] = None) -> Optional[Dict[str, Any]]:
@@ -203,14 +262,17 @@ class LLMServer(EngineDriverMixin):
         finally:
             self._waiters.pop(request_id, None)
         if finish_reason == "expired":
-            # the engine pruned this request from its WAITING queue: the
-            # propagated deadline passed before a batch slot opened —
-            # surface the typed expiry, never a silent empty completion
+            # the engine pruned this request: the propagated deadline
+            # passed while it sat in the WAITING queue or mid-decode
+            # (RUNNING slots are pruned at step start too — dead work
+            # must not pin pages) — surface the typed expiry, never a
+            # silent empty/partial completion
             from ...exceptions import RequestExpiredError
 
+            where = "engine decode" if out_ids else "engine queue"
             raise RequestExpiredError(
-                f"request {request_id} expired in the engine queue",
-                where="engine queue")
+                f"request {request_id} expired in the {where}",
+                where=where)
         return {
             "request_id": request_id,
             "text": self.tokenizer.decode(out_ids),

@@ -155,6 +155,53 @@ def test_engine_prunes_expired_waiting():
     assert len(deltas) == 1 and len(eng.waiting) == 2
 
 
+def test_engine_prunes_expired_running():
+    """Acceptance: a RUNNING slot whose deadline passes mid-decode is
+    pruned at step start — slot and pages freed, typed 'expired' delta,
+    counted in expired_total — instead of decoding dead work to
+    max_tokens. Bookkeeping-only, so exercised without a built model."""
+    from ray_tpu.serve.llm.cache import PageAllocator
+    from ray_tpu.serve.llm.engine import (FINISHED, LLMEngine, Request,
+                                          RUNNING, SamplingParams)
+
+    eng = LLMEngine.__new__(LLMEngine)
+    eng._expired_total = 0
+    eng.allocator = PageAllocator(num_pages=8, page_size=4)
+    eng.waiting = []
+    eng._free_slots = [1]
+    eng._slot_req = {}
+    eng._slot_override = {0: 7}
+
+    dead = Request("dead", [1, 2, 3], SamplingParams())
+    dead.state = RUNNING
+    dead.slot = 0
+    dead.pages = eng.allocator.allocate(2)
+    dead.deadline_mono = time.monotonic() - 0.5
+    alive = Request("alive", [1, 2, 3], SamplingParams())
+    alive.state = RUNNING
+    alive.slot = 2
+    alive.deadline_mono = time.monotonic() + 60.0
+    eng.running = [dead, alive]
+    eng._slot_req = {0: dead, 2: alive}
+    eng.requests = {r.request_id: r for r in eng.running}
+
+    deltas = []
+    eng._prune_expired_running(deltas)
+
+    assert [r.request_id for r in eng.running] == ["alive"]
+    assert dead.state == FINISHED and dead.finish_reason == "expired"
+    assert dead.slot == -1 and dead.pages == []
+    assert eng.allocator.num_free() == 7  # both pages returned
+    assert sorted(eng._free_slots) == [0, 1]
+    assert 0 not in eng._slot_override  # stale pending token dropped
+    assert eng._expired_total == 1
+    assert "dead" not in eng.requests
+    assert len(deltas) == 1 and deltas[0].finish_reason == "expired"
+    # idempotent
+    eng._prune_expired_running(deltas)
+    assert len(deltas) == 1 and len(eng.running) == 1
+
+
 def test_engine_add_request_deadline_conversion():
     """add_request converts the wall-clock deadline into the engine's
     monotonic domain (queue pruning immune to wall-clock steps)."""
