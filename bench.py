@@ -338,9 +338,12 @@ def bench_data_streaming() -> dict:
 def bench_chaos_drill() -> dict:
     """Robustness signal for the trajectory files: a time-guarded mini
     failure drill (benchmarks/chaos_drill.py — controller kill+restart
-    under a live actor, then node death with placement failover) emits
-    recovery_controller_ms / recovery_node_death_ms / chaos_drills_green
-    so every round carries recovery time next to throughput."""
+    under a live actor, node death with placement failover, then a
+    persist-dir restart replaying journal+snapshot with a torn tail)
+    emits recovery_controller_ms / recovery_node_death_ms /
+    recovery_controller_persist_ms / persist_drill_green /
+    chaos_drills_green so every round carries recovery time next to
+    throughput."""
     return _run_bench_json("chaos_drill.py", 300)
 
 
@@ -540,7 +543,9 @@ def main():
             drill = bench_chaos_drill()
             result["detail"]["chaos_drill"] = drill
             for key in ("recovery_controller_ms",
-                        "recovery_node_death_ms", "chaos_drills_green"):
+                        "recovery_node_death_ms",
+                        "recovery_controller_persist_ms",
+                        "persist_drill_green", "chaos_drills_green"):
                 if key in drill:
                     result["detail"][key] = drill[key]
         except Exception as e:  # noqa: BLE001

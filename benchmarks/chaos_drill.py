@@ -1,5 +1,5 @@
 """Mini failure drill for the bench round: controller restart + node
-death, timed.
+death + persist-dir restart, timed.
 
 Prints ONE JSON line:
   recovery_controller_ms — wall time from killing the in-proc controller
@@ -9,11 +9,18 @@ Prints ONE JSON line:
   recovery_node_death_ms — wall time from SIGKILLing a nodelet until the
       controller declares it dead AND a task soft-pinned to the dead
       node completes elsewhere (placement failover);
-  chaos_drills_green — both drills converged inside their deadlines.
+  recovery_controller_persist_ms — wall time from crash-stopping a
+      PERSISTING controller (no clean close, journal tail torn to
+      simulate the mid-append kill) until a replacement replays the
+      persist dir, the named actor reattaches WITHOUT re-creation, and
+      the acked KV reads back bit-exact (the torn record discarded);
+  persist_drill_green / chaos_drills_green — drills converged inside
+      their deadlines.
 
-The full scripted-disaster catalog lives in tests/test_chaos.py; this
-guarded pair gives every bench round a robustness trend line next to
-the throughput keys.
+The full scripted-disaster catalog lives in tests/test_chaos.py (the
+real kill -9 at the controller.persist syncpoint runs there, against a
+standalone controller process); this guarded set gives every bench
+round a robustness trend line next to the throughput keys.
 """
 
 import argparse
@@ -41,7 +48,7 @@ def main():
         NodeAffinitySchedulingStrategy,
     )
 
-    out = {"chaos_drills_green": False}
+    out = {"chaos_drills_green": False, "persist_drill_green": False}
     cfg = get_config()
     cfg.node_death_timeout_s = 3.0  # bound the death verdict
     session = ray_tpu.init(num_cpus=2)
@@ -109,6 +116,88 @@ def main():
         assert got == "alive"
         out["recovery_node_death_ms"] = round(
             (time.monotonic() - t0) * 1000.0, 1)
+
+        # ---- drill 3: persist-dir restart — replay + reattach from disk
+        import shutil
+        import tempfile
+
+        pdir = tempfile.mkdtemp(prefix="rtpu_persist_drill_")
+        try:
+            @ray_tpu.remote
+            class Keeper:
+                def pid(self):
+                    return os.getpid()
+
+            # swap in a PERSISTING controller on the same address
+            old = session.controller_inproc
+            elt.loop.call_soon_threadsafe(old._health_task.cancel)
+            elt.run(old._server.stop())
+            cp = Controller(session.session_name, session.controller_addr,
+                            persist_dir=pdir)
+            elt.run(cp.start())
+            session.controller_inproc = cp
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                nodes = session.core.controller.call("list_nodes",
+                                                     _timeout=10)
+                if any(n["alive"] for n in nodes.values()):
+                    break
+                time.sleep(0.1)
+            keeper = Keeper.options(name="persist_keeper").remote()
+            k_pid = ray_tpu.get(keeper.pid.remote(), timeout=30)
+            acked = {f"k{i}": b"v%d" % i for i in range(4)}
+            for key, value in acked.items():
+                session.core.controller.call("kv_put", ns="drill",
+                                             key=key, value=value)
+            session.core.controller.call("kv_put", ns="drill", key="tail",
+                                         value=b"torn-away")
+            # crash-stop: no backend close, no compaction — then TEAR
+            # the journal tail (the mid-append kill -9 artifact)
+            t0 = time.monotonic()
+            elt.loop.call_soon_threadsafe(cp._health_task.cancel)
+            elt.run(cp._server.stop())
+            jpath = os.path.join(pdir, "kv.journal")
+            with open(jpath, "r+b") as f:
+                f.truncate(os.path.getsize(jpath) - 3)
+            cr = Controller(session.session_name, session.controller_addr,
+                            persist_dir=pdir)
+            elt.run(cr.start())
+            session.controller_inproc = cr
+            deadline = time.monotonic() + 30
+            info = None
+            while time.monotonic() < deadline:
+                try:
+                    nodes = session.core.controller.call(
+                        "list_nodes", _timeout=5)
+                    info = session.core.controller.call(
+                        "get_actor", name="persist_keeper", namespace="",
+                        _timeout=5)
+                except Exception:  # noqa: BLE001 — replacement still booting
+                    time.sleep(0.1)
+                    continue
+                if any(n["alive"] for n in nodes.values()) \
+                        and info is not None and info["state"] == "ALIVE":
+                    break
+                time.sleep(0.1)
+            else:
+                raise TimeoutError(
+                    "persist-dir restart drill never converged")
+            # reattached, not re-created: same process, zero restarts
+            assert ray_tpu.get(keeper.pid.remote(), timeout=30) == k_pid
+            assert info["num_restarts"] == 0
+            for key, value in acked.items():
+                got = session.core.controller.call("kv_get", ns="drill",
+                                                   key=key)
+                assert got == value, (key, got)
+            # the torn (never-fully-written) record is discarded
+            assert session.core.controller.call(
+                "kv_get", ns="drill", key="tail") is None
+            out["recovery_controller_persist_ms"] = round(
+                (time.monotonic() - t0) * 1000.0, 1)
+            out["persist_drill_green"] = True
+        finally:
+            shutil.rmtree(pdir, ignore_errors=True)
+
         out["chaos_drills_green"] = True
     except Exception as e:  # noqa: BLE001 — the bench line reports it
         out["error"] = repr(e)[:200]

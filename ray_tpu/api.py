@@ -41,9 +41,12 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
         session = connect(address, namespace=namespace)
         _node.set_session(session)
         return session
+    # extra keywords flow through to Session (session_name,
+    # controller_address for a standalone controller process,
+    # persist_dir for a durable in-proc controller)
     session = _node.Session(address=address, num_cpus=num_cpus,
                             num_tpus=num_tpus, resources=resources,
-                            labels=labels, namespace=namespace)
+                            labels=labels, namespace=namespace, **kwargs)
     _node.set_session(session)
     return session
 

@@ -67,6 +67,16 @@ class RuntimeConfig:
     # latency); >0 trades per-call latency for larger coalesced bursts.
     submit_drain_interval_s: float = 0.0
 
+    # --- controller persistence (runtime/storage.py) ---
+    # fsync policy for the persist-dir journal/snapshots: "always"
+    # fsyncs every journal append and snapshot publish (power-loss
+    # durable per mutation), "batch" (default) fsyncs snapshots but
+    # batches journal fsyncs into the controller's health-sweep cadence,
+    # "off" leaves durability to OS writeback. A SIGKILL'd controller
+    # loses nothing under any policy (OS-buffered writes survive process
+    # death); the knob prices host/power failure.
+    persist_fsync: str = "batch"
+
     # --- health / liveness (ref: gcs_health_check_manager.cc cadence flags
     # ray_config_def.h:879-885) ---
     heartbeat_interval_s: float = 1.0

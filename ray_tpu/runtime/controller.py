@@ -90,6 +90,19 @@ class ActorInfo:
         self.worker_id: Optional[str] = None
         self.num_restarts = 0
         self.death_cause: Optional[str] = None
+        # replay↔reattach reconciliation state: a replayed RESTARTING
+        # actor first WAITS for its (possibly still live) worker to
+        # re-announce via reattach_actor before any restart verdict...
+        self.awaiting_reattach = False
+        # ...and once a replacement lease is in flight, a late reattach
+        # from the old incarnation is refused (the nodelet kills the
+        # ghost) — otherwise two ALIVE incarnations of one actor race
+        self.lease_inflight = False
+        # worker ids whose incarnation was ruled dead or superseded:
+        # their (re)delivered death reports must never trigger another
+        # restart — info.worker_id alone cannot carry this, since the
+        # restart verdict clears it until the replacement's actor_ready
+        self.superseded_workers: set = set()
 
     def snapshot(self):
         return {
@@ -189,8 +202,13 @@ class Controller:
             return
         state = {
             "jobs": dict(self.jobs),
+            # placement IS persisted: replay tries to re-reserve the
+            # SAME bundles on re-registered nodes first (idempotent
+            # nodelet-side), so actors already running inside a PG keep
+            # their reservations across a controller restart
             "placement_groups": {
-                pg_id: {k: v for k, v in pg.items() if k != "placement"}
+                pg_id: {k: v for k, v in pg.items()
+                        if not k.startswith("_replay")}
                 for pg_id, pg in self.placement_groups.items()},
             "named_actors": {
                 f"{ns}\x00{name}": actor_id
@@ -212,25 +230,55 @@ class Controller:
     def _replay_persisted(self) -> None:
         """Replay snapshot + journal into fresh tables (ref:
         gcs_init_data.cc — the restarted GCS reloads its tables before
-        serving), then compact the journal."""
+        serving), then compact the journal. Corruption never aborts the
+        boot: the backend quarantines checksum failures, and a legacy
+        (headerless) blob whose pickle fails is counted and skipped —
+        the controller comes up with whatever state IS readable."""
+        from .storage import count_corruption
+
         meta_blob = self._store_backend.load_meta()
+        state = {}
         if meta_blob:
-            state = pickle.loads(meta_blob)
-            self.jobs.update(state.get("jobs", {}))
-            for pg_id, pg in state.get("placement_groups", {}).items():
-                # bundles must be re-reserved on live nodes; mark pending
-                self.placement_groups[pg_id] = dict(
-                    pg, state="PENDING", placement=None)
-            for key, actor_id in state.get("named_actors", {}).items():
-                ns, _, name = key.partition("\x00")
-                self.named_actors[(ns, name)] = actor_id
-            for actor_id, spec in state.get("actor_specs", {}).items():
-                info = ActorInfo(actor_id, spec)
-                info.state = ACTOR_RESTARTING
-                self.actors[actor_id] = info
+            try:
+                state = pickle.loads(meta_blob)
+            except Exception:  # rtpulint: ignore[RTPU006] — a corrupt legacy meta blob must not crash the boot; counted + replay continues journal-only
+                count_corruption("meta")
+                log.warning("persisted meta snapshot unreadable; "
+                            "starting with empty meta tables")
+                state = {}
+        self.jobs.update(state.get("jobs", {}))
+        for pg_id, pg in state.get("placement_groups", {}).items():
+            # bundles must be re-reserved on live nodes; stash the old
+            # placement so _retry_pg can re-reserve the SAME bundles
+            # once those nodes re-register (or fall back to a fresh
+            # placement / PENDING after the re-registration grace)
+            replayed = dict(pg, state="PENDING")
+            replayed["_replayed_placement"] = replayed.pop(
+                "placement", None)
+            replayed["placement"] = None
+            self.placement_groups[pg_id] = replayed
+        for key, actor_id in state.get("named_actors", {}).items():
+            ns, _, name = key.partition("\x00")
+            self.named_actors[(ns, name)] = actor_id
+        for actor_id, spec in state.get("actor_specs", {}).items():
+            info = ActorInfo(actor_id, spec)
+            info.state = ACTOR_RESTARTING
+            # the worker may still be ALIVE and serving: wait for its
+            # nodelet's reattach before any restart verdict (start()
+            # spawns _reconcile_replayed) — scheduling immediately
+            # double-created every replayed actor whose process survived
+            info.awaiting_reattach = True
+            self.actors[actor_id] = info
         snap_blob, records, had_journal = self._store_backend.load_kv()
         if snap_blob:
-            for ns, kvs in pickle.loads(snap_blob).items():
+            try:
+                loaded = pickle.loads(snap_blob)
+            except Exception:  # rtpulint: ignore[RTPU006] — a corrupt legacy kv snapshot must not crash the boot; journal replay still runs
+                count_corruption("kv_snapshot")
+                log.warning("persisted kv snapshot unreadable; "
+                            "replaying journal only")
+                loaded = {}
+            for ns, kvs in loaded.items():
                 self.kv[ns].update(kvs)
         for record in records:
             try:
@@ -299,10 +347,16 @@ class Controller:
     async def start(self):
         await self._server.start()
         self._health_task = asyncio.ensure_future(self._health_loop())
-        # replayed named actors + pending PGs reschedule once nodes
-        # re-register
+        # replayed named actors reconcile against live-worker reattach
+        # (grace window, then the normal death/restart verdict); pending
+        # PGs re-reserve once nodes re-register
         for info in self.actors.values():
-            if info.state == ACTOR_RESTARTING:
+            if info.state != ACTOR_RESTARTING:
+                continue
+            if info.awaiting_reattach:
+                spawn_logged(self._reconcile_replayed(info),
+                             name="controller.reconcile_replayed")
+            else:
                 spawn_logged(self._schedule_actor(info),
                              name="controller.schedule_actor")
         for pg in self.placement_groups.values():
@@ -464,6 +518,14 @@ class Controller:
         while True:
             await asyncio.sleep(cfg.heartbeat_interval_s)
             faults.syncpoint("controller.health_sweep")
+            if self._store_backend is not None:
+                # persist_fsync=batch durability point (fsync is a
+                # blocking syscall: keep it off the control loop)
+                try:
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self._store_backend.flush)
+                except Exception as e:  # noqa: BLE001 — a failed fsync degrades durability, not liveness
+                    log.debug("persist flush failed: %r", e)
             now = time.monotonic()
             for node in self.nodes.values():
                 if node.alive and now - node.last_heartbeat > cfg.node_death_timeout_s:
@@ -526,6 +588,29 @@ class Controller:
                      name="controller.schedule_actor")
         return {"status": "registered", "actor_id": actor_id}
 
+    async def _reconcile_replayed(self, info: ActorInfo):
+        """Replay↔reattach reconciliation: a replayed RESTARTING actor's
+        worker may still be alive — its nodelet re-registers and
+        re-announces it via reattach_actor, and the actor converges to
+        ALIVE without a restart. Only when the node stays silent for
+        node_death_timeout_s does the actor get the normal
+        death/restart verdict (restart if the budget allows, DEAD
+        otherwise — exactly what a node-death sweep would have ruled)."""
+        cfg = get_config()
+        deadline = time.monotonic() + cfg.node_death_timeout_s
+        while time.monotonic() < deadline:
+            if not info.awaiting_reattach \
+                    or info.state != ACTOR_RESTARTING:
+                return  # reattached (ALIVE) or resolved meanwhile
+            await asyncio.sleep(0.1)
+        if info.awaiting_reattach and info.state == ACTOR_RESTARTING:
+            info.awaiting_reattach = False
+            await self.actor_died(
+                info.actor_id,
+                reason="node never re-registered within "
+                       f"{cfg.node_death_timeout_s}s after controller "
+                       "restart", worker_failed=True)
+
     async def _schedule_actor(self, info: ActorInfo):
         """GCS-based actor scheduling (ref: gcs_actor_scheduler.cc:65
         ScheduleByGcs): pick a node, lease a worker there directly."""
@@ -540,6 +625,12 @@ class Controller:
                 bundle_index=spec.get("bundle_index", -1),
             )
             if node is not None:
+                # from here a replacement worker may be booting: a late
+                # reattach from an older incarnation must be refused
+                # (reattach_actor checks this flag), or two ALIVE
+                # incarnations of one actor would race — the flag holds
+                # through the boot until actor_ready / actor_died
+                info.lease_inflight = True
                 try:
                     ok = await node.client.call_async(
                         "lease_worker_for_actor", spec=spec, actor_id=info.actor_id
@@ -549,6 +640,7 @@ class Controller:
                 if ok:
                     info.node_id = node.node_id
                     return
+                info.lease_inflight = False
             else:
                 self.unschedulable.append(
                     {"resources": dict(resources), "ts": time.time()})
@@ -564,6 +656,8 @@ class Controller:
         info.address = address
         info.worker_id = worker_id
         info.node_id = node_id
+        info.lease_inflight = False
+        info.awaiting_reattach = False
         self._wake_actor_waiters(actor_id)
         await self._publish(f"actor:{actor_id}", info.snapshot())
         if getattr(info, "drain_requested", False):
@@ -585,9 +679,27 @@ class Controller:
         'unknown actor' while the actor process is alive and serving.
         Idempotent — re-announcing a known actor just refreshes its
         address/placement (ref: the reference's GCS restart rebuilds the
-        actor table from raylet reconnection the same way)."""
+        actor table from raylet reconnection the same way).
+
+        Refused (returns False — the announcing nodelet must then kill
+        the ghost worker) when this incarnation has been SUPERSEDED:
+        the actor is DEAD, a different worker is already ALIVE under the
+        id, or a replacement lease is in flight after a restart verdict.
+        Accepting any of those would leave two live incarnations of one
+        actor (the replay↔reattach double-restart/ghost hazard)."""
         info = self.actors.get(actor_id)
-        if info is None:
+        if info is not None:
+            if info.state == ACTOR_DEAD:
+                return False
+            if (info.state == ACTOR_ALIVE and info.worker_id
+                    and worker_id and info.worker_id != worker_id):
+                self._mark_superseded(info, worker_id)
+                return False
+            if info.state in (ACTOR_PENDING, ACTOR_RESTARTING) \
+                    and info.lease_inflight:
+                self._mark_superseded(info, worker_id)
+                return False
+        else:
             info = ActorInfo(actor_id, spec or {})
             self.actors[actor_id] = info
             name = info.spec.get("name")
@@ -595,6 +707,7 @@ class Controller:
                 ns = info.spec.get("namespace", "")
                 self.named_actors[(ns, name)] = actor_id
                 self._persist()
+        info.awaiting_reattach = False
         info.state = ACTOR_ALIVE
         info.address = address
         info.worker_id = worker_id
@@ -605,21 +718,43 @@ class Controller:
         return True
 
     async def actor_died(self, actor_id: str, reason: str = "",
-                         worker_failed: bool = True):
+                         worker_failed: bool = True,
+                         worker_id: Optional[str] = None):
         info = self.actors.get(actor_id)
         if info is None or info.state == ACTOR_DEAD:
             return False
+        if worker_id is not None and (
+                worker_id in info.superseded_workers
+                or (info.worker_id is not None
+                    and worker_id != info.worker_id)):
+            # a SUPERSEDED incarnation died (a ghost worker killed after
+            # its reattach was refused, or a redelivered death report
+            # from before a restart): ignoring the stale report is what
+            # prevents a kill-the-ghost from double-restarting. The
+            # superseded set matters between a restart verdict (which
+            # clears info.worker_id) and the replacement's actor_ready —
+            # in that window worker_id comparison alone can't tell a
+            # ghost's death from the replacement's boot crash.
+            return False
+        if worker_id is not None:
+            # THIS incarnation is dead as of now: dedupe redeliveries
+            self._mark_superseded(info, worker_id)
         max_restarts = info.spec.get("max_restarts", 0)
         if worker_failed and (max_restarts == -1 or info.num_restarts < max_restarts):
             info.num_restarts += 1
             info.state = ACTOR_RESTARTING
             info.address = None
+            info.worker_id = None  # any incarnation may report the next death
+            info.lease_inflight = False
+            info.awaiting_reattach = False
             await self._publish(f"actor:{actor_id}", info.snapshot())
             spawn_logged(self._schedule_actor(info),
                          name="controller.schedule_actor")
         else:
             info.state = ACTOR_DEAD
             info.death_cause = reason
+            info.lease_inflight = False
+            info.awaiting_reattach = False
             name = info.spec.get("name")
             if name:
                 self.named_actors.pop((info.spec.get("namespace", ""), name), None)
@@ -627,6 +762,18 @@ class Controller:
             self._wake_actor_waiters(actor_id)
             await self._publish(f"actor:{actor_id}", info.snapshot())
         return True
+
+    @staticmethod
+    def _mark_superseded(info: ActorInfo, worker_id: Optional[str]) -> None:
+        """Record a worker id whose incarnation was ruled dead or
+        superseded (bounded: a crash-looping max_restarts=-1 actor must
+        not grow the set without end — old entries only dedupe stale
+        redeliveries, which stop arriving long before 64 restarts)."""
+        if not worker_id:
+            return
+        if len(info.superseded_workers) >= 64:
+            info.superseded_workers.pop()
+        info.superseded_workers.add(worker_id)
 
     def _wake_actor_waiters(self, actor_id: str) -> None:
         ev = getattr(self, "_actor_waiters", {}).pop(actor_id, None)
@@ -788,17 +935,85 @@ class Controller:
             reserved.append((idx, node_id))
         return True
 
+    async def _retry_pg_replayed(self, pg) -> Optional[bool]:
+        """One reconciliation attempt for a REPLAYED pending PG: prefer
+        re-reserving the SAME bundles on the original nodes once they
+        re-register (idempotent nodelet-side — actors already running
+        inside keep their reservations). Returns True when the PG was
+        re-created on its old placement, None to keep waiting (within
+        the re-registration grace), False to fall back to a fresh
+        placement (grace expired or the old shape no longer fits)."""
+        old = pg.get("_replayed_placement")
+        if not old:
+            return False
+        grace = pg.setdefault(
+            "_replay_grace_until",
+            time.monotonic() + get_config().node_death_timeout_s)
+        nodes = [self.nodes.get(nid) for nid in old]
+        if all(n is not None and n.alive for n in nodes):
+            # NO-rollback re-reserve (unlike _reserve_placement, whose
+            # partial-failure rollback would return_bundle a bundle a
+            # surviving nodelet HELD through the outage — yanking a
+            # reservation with live actors still inside it). A bundle
+            # re-confirmed here is this PG's own property either way;
+            # on partial failure we keep retrying the original
+            # placement until the grace expires, and only the
+            # grace-expiry fallback below releases everything.
+            ok_all = True
+            for idx, nid in enumerate(old):
+                node = self.nodes.get(nid)
+                try:
+                    ok = await node.client.call_async(
+                        "reserve_bundle", pg_id=pg["pg_id"],
+                        bundle_index=idx, resources=pg["bundles"][idx])
+                except Exception:  # noqa: BLE001 — a failed node retries until the grace expires
+                    ok = False
+                if not ok:
+                    ok_all = False
+                    break
+            if ok_all:
+                pg["state"] = "CREATED"
+                pg["placement"] = list(old)
+                pg.pop("_replayed_placement", None)
+                pg.pop("_replay_grace_until", None)
+                self._persist()
+                await self._publish(f"pg:{pg['pg_id']}", pg)
+                return True
+        if time.monotonic() < grace:
+            return None  # original nodes still re-registering / refilling
+        # grace expired — the old nodes are gone for good (or present
+        # but unable to re-fit the shape): the PG is moving, so release
+        # whatever the survivors still hold, then place fresh
+        for idx, nid in enumerate(old):
+            n = self.nodes.get(nid)
+            if n is not None and n.client is not None:
+                try:
+                    await n.client.call_async(
+                        "return_bundle", pg_id=pg["pg_id"],
+                        bundle_index=idx)
+                except Exception:  # rtpulint: ignore[RTPU006] — releasing a replayed bundle on a node that vanished again; its resources died with it
+                    pass
+        pg.pop("_replayed_placement", None)
+        return False
+
     async def _retry_pg(self, pg):
         delay = 0.1
         while pg["state"] == "PENDING" and pg["pg_id"] in self.placement_groups:
             await asyncio.sleep(min(delay, 2.0))
             delay *= 2
+            replayed = await self._retry_pg_replayed(pg)
+            if replayed:
+                return
+            if replayed is None:
+                delay = 0.1  # original nodes still re-registering: poll fast
+                continue
             placement = scheduling.place_bundles(
                 list(self.nodes.values()), pg["bundles"], pg["strategy"])
             if placement is not None:
                 if await self._reserve_placement(pg["pg_id"], pg["bundles"], placement):
                     pg["state"] = "CREATED"
                     pg["placement"] = placement
+                    self._persist()
                     await self._publish(f"pg:{pg['pg_id']}", pg)
 
     async def remove_placement_group(self, pg_id: str):
@@ -806,8 +1021,11 @@ class Controller:
         if pg is None:
             return False
         self._persist()
-        if pg.get("placement"):
-            for idx, node_id in enumerate(pg["placement"]):
+        # a replayed-but-not-yet-reconciled PG still holds its ORIGINAL
+        # bundles on re-registered nodelets: return those too
+        placement = pg.get("placement") or pg.get("_replayed_placement")
+        if placement:
+            for idx, node_id in enumerate(placement):
                 node = self.nodes.get(node_id)
                 if node is not None:
                     try:

@@ -37,7 +37,11 @@ the controller's ``fault_inject`` admin RPC). Rules are ';'-separated::
   ``transfer.pull``, ``channel.push``, ``serve.reconcile``,
   ``serve.admission`` — the Serve router's admission decision, so
   overload drills can kill/delay exactly between admission and
-  execution — ``controller.health_sweep``, ``data.split_pull``).
+  execution — ``controller.health_sweep``, ``controller.persist`` —
+  planted MID journal-append (frame header written, payload not) and
+  just before a snapshot rename in runtime/storage.py, so restart
+  drills die with a genuinely torn write on disk —
+  ``data.split_pull``).
   ``action=exit`` (default) terminates the process with exit code 43;
   ``action=raise`` raises :class:`FaultInjectedError` in place (for
   in-process tests).
@@ -71,6 +75,7 @@ SYNCPOINTS = (
     "serve.reconcile",
     "serve.admission",
     "controller.health_sweep",
+    "controller.persist",
     "data.split_pull",
 )
 

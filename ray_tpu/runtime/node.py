@@ -62,7 +62,9 @@ class Session:
 
     def __init__(self, *, address: Optional[str] = None, num_cpus=None,
                  num_tpus=None, resources=None, labels=None,
-                 namespace: str = "", session_name: Optional[str] = None):
+                 namespace: str = "", session_name: Optional[str] = None,
+                 controller_address: Optional[str] = None,
+                 persist_dir: Optional[str] = None):
         self.namespace = namespace
         self.session_name = session_name or f"{int(time.time())}_{uuid.uuid4().hex[:8]}"
         self.session_dir = f"/tmp/ray_tpu/{self.session_name}"
@@ -75,11 +77,26 @@ class Session:
 
         loop_thread = EventLoopThread.get()
         if address is None:
-            # head: in-process controller + nodelet
-            self.controller_addr = f"unix:{self.session_dir}/sock/controller.sock"
+            # head: in-process nodelet; the controller is in-process too
+            # unless controller_address points at a STANDALONE controller
+            # (``python -m ray_tpu.runtime.controller``) — the persist-dir
+            # restart drills kill -9 that process and restart it over the
+            # same --persist-dir while this session keeps running
+            self.controller_addr = (
+                controller_address
+                or f"unix:{self.session_dir}/sock/controller.sock")
             self.nodelet_addr = f"unix:{self.session_dir}/sock/nodelet-head.sock"
-            self.controller_inproc = Controller(self.session_name, self.controller_addr)
-            loop_thread.run(self.controller_inproc.start())
+            if controller_address is None:
+                self.controller_inproc = Controller(
+                    self.session_name, self.controller_addr,
+                    persist_dir=persist_dir)
+                loop_thread.run(self.controller_inproc.start())
+            else:
+                # external controller: confirm it answers before wiring
+                # the head nodelet to it
+                probe = RpcClient(self.controller_addr)
+                probe.call("ping", _timeout=30)
+                probe.close()
             self.nodelet_inproc = Nodelet(
                 session_name=self.session_name, session_dir=self.session_dir,
                 node_id=self.node_id, address=self.nodelet_addr,

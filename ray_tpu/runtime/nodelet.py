@@ -473,6 +473,12 @@ class Nodelet:
         for client in self._peer_clients.values():
             client.close()
         self._peer_clients.clear()
+        # the control uplink: with an in-proc controller this client is
+        # a local-server shortcut (no socket), but against a STANDALONE
+        # controller it owns a real connection + read loop that must not
+        # outlive the nodelet (caught by the RTPU_ORPHAN_CHECK pass on
+        # the external-controller session)
+        self.controller.close()
         bulk_srv = self._om_bulk.get("server")
         if bulk_srv is not None:
             try:
@@ -529,7 +535,7 @@ class Nodelet:
                 # from cls_key); args_inline/args_oid must SURVIVE — the
                 # controller keeps this spec, and a later restart of the
                 # reattached actor re-runs __init__ from it
-                await self.controller.call_async(
+                ok = await self.controller.call_async(
                     "reattach_actor", actor_id=ws.actor_id,
                     spec={k: v for k, v in (spec or {}).items()
                           if k != "cls_blob"},
@@ -538,6 +544,23 @@ class Nodelet:
             except Exception as e:
                 log.debug("reattach of actor %s undeliverable: %r",
                           ws.actor_id, e)
+                continue
+            if not ok:
+                # the controller refused: this incarnation was
+                # superseded while we were apart (actor DEAD, a
+                # replacement ALIVE elsewhere, or a replacement lease in
+                # flight after the replay verdict). Exactly ONE
+                # incarnation may survive — kill the ghost; its death
+                # report carries our worker_id, which the controller
+                # ignores as stale against the live incarnation.
+                log.debug("reattach of actor %s refused — killing "
+                          "superseded worker %s", ws.actor_id,
+                          ws.worker_id[:8])
+                try:
+                    await self._notify_worker(ws, "kill_self")
+                except Exception as e:  # noqa: BLE001 — ghost kill is best-effort; the reap loop finishes the job
+                    log.debug("ghost kill for %s undeliverable: %r",
+                              ws.actor_id, e)
 
     async def _heartbeat_loop(self):
         cfg = get_config()
@@ -1155,9 +1178,13 @@ class Nodelet:
             if ws.current_task and not ws.current_task.get("placeholder"):
                 self._release(ws.current_task)
             try:
+                # worker_id lets the controller drop STALE reports: a
+                # superseded incarnation's death (ghost killed after a
+                # refused reattach) must not restart the live one
                 await self.controller.call_async(
                     "actor_died", actor_id=ws.actor_id,
-                    reason=f"worker {ws.worker_id[:8]} died", worker_failed=True)
+                    reason=f"worker {ws.worker_id[:8]} died",
+                    worker_failed=True, worker_id=ws.worker_id)
             except Exception as e:
                 # an unreported actor death leaves clients waiting on a
                 # ghost until the controller's own liveness sweep
@@ -2034,6 +2061,19 @@ class Nodelet:
     # ------------------------------------------------------------ bundles
     async def reserve_bundle(self, pg_id: str, bundle_index: int,
                              resources: Dict[str, float]):
+        held = self.bundles.get((pg_id, bundle_index))
+        if held is not None:
+            if held["total"] == dict(resources):
+                # idempotent re-reserve: a controller replaying its
+                # persisted PG table (or retrying a lost reply)
+                # re-reserves a bundle this nodelet still holds —
+                # re-debiting would leak the resources, and the actors
+                # already running inside keep their allocations
+                return True
+            # same id, different shape: release the old pool first
+            _add(self.available, held["total"])
+            del self.bundles[(pg_id, bundle_index)]
+            self._resource_version += 1
         if not _leq(resources, self.available):
             return False
         _sub(self.available, resources)

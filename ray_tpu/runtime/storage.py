@@ -15,13 +15,76 @@ head machine's disk). Two backends:
 
 Select by address: ``persist_dir="/path"`` -> FileBackend;
 ``persist_dir="tcp:host:port"`` -> TCPBackend.
+
+Crash consistency (the persist-dir kill -9 contract):
+
+- every ``kv.journal`` record is FRAMED — ``RJ1\\n`` magic + payload
+  length + CRC32, then the pickled payload — and replay TRUNCATES the
+  torn tail in place at the first bad frame (a writer killed mid-append
+  leaves a half frame; before framing, a corrupt middle record silently
+  dropped the whole suffix AND left garbage that made every later
+  append unreadable);
+- ``meta.pkl``/``kv.pkl`` snapshots carry the same checksum header and
+  are published fsync-then-rename atomic: a reader sees either the old
+  snapshot or the complete new one, never a torn mix. A snapshot whose
+  checksum fails is QUARANTINED (renamed to ``*.corrupt``, counted in
+  ``rtpu_persist_corruptions_total``) and replay falls back to the
+  journal / empty table instead of dying in ``pickle.loads`` at boot;
+- the ``persist_fsync`` knob picks the durability/latency trade:
+  ``always`` fsyncs every append + snapshot + directory rename,
+  ``batch`` (default) fsyncs snapshots but batches journal fsyncs into
+  ``flush()`` (the controller calls it on its health-sweep cadence),
+  ``off`` leaves everything to the OS writeback. A SIGKILL'd process
+  never loses OS-buffered writes under any policy — the knob is about
+  host/power failure;
+- the ``controller.persist`` syncpoint is planted mid journal-append
+  (header written, payload not — exactly the torn frame replay must
+  truncate) and just before the snapshot rename, so ``kill_at``
+  drills die at the worst possible byte.
+
+Round-2 compatibility: a journal that does not open with the frame
+magic is parsed as the old raw-pickle stream (appends keep that format
+until the next replay compacts it away), and a headerless snapshot blob
+is accepted as-is.
 """
 
 from __future__ import annotations
 
 import os
 import pickle
+import struct
+import zlib
 from typing import Iterator, List, Optional, Tuple
+
+from . import faults
+from .config import get_config
+
+# journal frame: magic + (payload length, crc32(payload)), then payload
+_J_MAGIC = b"RJ1\n"
+# snapshot header: magic + (payload length, crc32(payload)), then payload
+_S_MAGIC = b"RS1\n"
+_HDR = struct.Struct("<II")
+
+_corruption_metric = None
+
+
+def count_corruption(kind: str) -> None:
+    """Count one detected persisted-state corruption (quarantined
+    snapshot, truncated journal tail, or an unreadable legacy blob) as
+    ``rtpu_persist_corruptions_total{kind=}``."""
+    global _corruption_metric
+    if _corruption_metric is None:
+        from ..util.metrics import Counter
+
+        _corruption_metric = Counter(
+            "rtpu_persist_corruptions_total",
+            "corrupt persisted snapshots/journal tails detected at replay",
+            ("kind",))
+    _corruption_metric.inc(tags={"kind": kind})
+
+
+def _fsync_policy() -> str:
+    return get_config().persist_fsync
 
 
 class StoreBackend:
@@ -51,6 +114,10 @@ class StoreBackend:
         """Replace the snapshot with `snapshot` and clear the journal."""
         raise NotImplementedError
 
+    def flush(self) -> None:
+        """Durability point for batched writes (``persist_fsync=batch``):
+        the controller calls this on its health-sweep cadence."""
+
     def close(self) -> None:
         pass
 
@@ -59,66 +126,247 @@ class FileBackend(StoreBackend):
     def __init__(self, directory: str):
         self.dir = directory
         os.makedirs(directory, exist_ok=True)
+        self._jf = None  # open append handle for kv.journal
+        self._jf_legacy = False  # append in the round-2 raw-pickle format
+        self._j_dirty = False  # appends not yet fsynced (batch policy)
 
     def _p(self, name: str) -> str:
         return os.path.join(self.dir, name)
 
-    def save_meta(self, blob: bytes) -> None:
-        tmp = self._p("meta.pkl.tmp")
-        with open(tmp, "wb") as f:
-            f.write(blob)
-        os.replace(tmp, self._p("meta.pkl"))
-
-    def load_meta(self) -> Optional[bytes]:
+    # ------------------------------------------------------- snapshots
+    def _fsync_dir(self) -> None:
         try:
-            with open(self._p("meta.pkl"), "rb") as f:
-                return f.read()
+            dfd = os.open(self.dir, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:  # rtpulint: ignore[RTPU006] — directory fsync is a durability upgrade, not a correctness gate (some filesystems refuse O_RDONLY dir fsync)
+            pass
+
+    def _write_snapshot(self, name: str, blob: bytes) -> None:
+        """Checksummed, fsync-then-rename atomic snapshot publish: a
+        crash leaves either the old file or the complete new one."""
+        policy = _fsync_policy()
+        tmp = self._p(name + ".tmp")
+        with open(tmp, "wb") as f:
+            f.write(_S_MAGIC + _HDR.pack(len(blob), zlib.crc32(blob)))
+            f.write(blob)
+            f.flush()
+            if policy != "off":
+                # data durable BEFORE the rename publishes it — rename
+                # first and a power cut can publish a hole
+                os.fsync(f.fileno())
+        # snapshot-write kill site: tmp complete, old snapshot intact
+        faults.syncpoint("controller.persist")
+        os.replace(tmp, self._p(name))
+        if policy == "always":
+            self._fsync_dir()
+
+    def _read_snapshot(self, name: str, kind: str) -> Optional[bytes]:
+        path = self._p(name)
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
         except FileNotFoundError:
             return None
+        if not data.startswith(_S_MAGIC):
+            # round-2 headerless blob: nothing to verify against
+            return data or None
+        hdr = data[len(_S_MAGIC):len(_S_MAGIC) + _HDR.size]
+        payload = data[len(_S_MAGIC) + _HDR.size:]
+        if len(hdr) == _HDR.size:
+            length, crc = _HDR.unpack(hdr)
+            if len(payload) == length and zlib.crc32(payload) == crc:
+                return payload
+        self._quarantine(path, kind)
+        return None
+
+    def _quarantine(self, path: str, kind: str) -> None:
+        """A snapshot that fails its checksum must not crash the boot:
+        move it aside (operators can inspect it), count it, and let
+        replay fall back to the journal / an empty table."""
+        try:
+            os.replace(path, path + ".corrupt")
+        except OSError:  # rtpulint: ignore[RTPU006] — quarantine rename is best-effort; the caller already treats the snapshot as absent
+            pass
+        count_corruption(kind)
+        print(f"[storage] WARNING: corrupt {kind} snapshot quarantined "
+              f"to {path}.corrupt; replaying without it", flush=True)
+
+    def save_meta(self, blob: bytes) -> None:
+        self._write_snapshot("meta.pkl", blob)
+
+    def load_meta(self) -> Optional[bytes]:
+        return self._read_snapshot("meta.pkl", "meta")
+
+    # --------------------------------------------------------- journal
+    def _journal_handle(self):
+        if self._jf is None or self._jf.closed:
+            path = self._p("kv.journal")
+            legacy = False
+            try:
+                with open(path, "rb") as f:
+                    head = f.read(len(_J_MAGIC))
+                # a non-empty journal that does not open with the frame
+                # magic is a round-2 raw-pickle stream: keep appending
+                # its format (mixing frames into it would make the
+                # legacy parser drop everything after the first frame)
+                legacy = bool(head) and head != _J_MAGIC
+            except FileNotFoundError:
+                pass
+            # UNBUFFERED: every write reaches the OS immediately, so a
+            # failed append can rewind its partial frame with truncate()
+            # and there is never a buffered remainder that a later
+            # flush/close would splice into the file AFTER the rewind
+            self._jf = open(path, "ab", buffering=0)
+            self._jf_legacy = legacy
+        return self._jf
+
+    def _close_journal(self) -> None:
+        if self._jf is not None and not self._jf.closed:
+            try:
+                self._jf.flush()
+                if self._j_dirty and _fsync_policy() != "off":
+                    os.fsync(self._jf.fileno())
+            except OSError:  # rtpulint: ignore[RTPU006] — close-path flush is best-effort; replay truncates whatever did not land
+                pass
+            self._jf.close()
+        self._jf = None
+        self._j_dirty = False
 
     def append_kv(self, record) -> None:
-        # consecutive pickle.dump records: byte-compatible with the
-        # journals round-2 controllers wrote
-        with open(self._p("kv.journal"), "ab") as f:
-            pickle.dump(record, f)
+        f = self._journal_handle()
+        start = f.tell()
+        try:
+            if self._jf_legacy:
+                f.write(pickle.dumps(record))
+            else:
+                payload = pickle.dumps(record)
+                # unbuffered handle: the header is ON DISK before the
+                # kill site — os._exit never sees a Python buffer, so a
+                # kill here leaves the genuinely torn frame the framed
+                # replay truncates
+                f.write(_J_MAGIC + _HDR.pack(len(payload),
+                                             zlib.crc32(payload)))
+                # journal-append kill site: header on disk, payload not
+                faults.syncpoint("controller.persist")
+                f.write(payload)
+        except BaseException:
+            # the append FAILED in-process (kill_at action=raise, ENOSPC
+            # mid-payload): rewind the partial frame NOW — left in
+            # place, every later acked append would land after a
+            # dangling header and be silently truncated at next replay
+            try:
+                f.truncate(start)
+            except OSError:  # rtpulint: ignore[RTPU006] — a disk too broken to truncate is the replay-time torn-tail path; the failing put was never acked either way
+                pass
+            raise
+        if _fsync_policy() == "always":
+            os.fsync(f.fileno())
+        else:
+            self._j_dirty = True
+
+    def flush(self) -> None:
+        if (self._j_dirty and self._jf is not None
+                and not self._jf.closed and _fsync_policy() != "off"):
+            os.fsync(self._jf.fileno())
+            self._j_dirty = False
+
+    def _read_journal(self, path: str) -> List:
+        """Replay the journal, TRUNCATING the file in place at the first
+        bad frame: everything before it is intact and everything after
+        it is untrusted (a torn tail from a crash mid-append, or
+        corruption — either way later appends must start at a clean
+        boundary or the next replay reads garbage)."""
+        # replay may truncate: the append handle must not point past it
+        self._close_journal()
+        records: List = []
+        truncate_to: Optional[int] = None
+        with open(path, "rb") as f:
+            head = f.read(len(_J_MAGIC))
+            if head and head != _J_MAGIC:
+                return self._read_legacy_journal(path)
+            if not head:
+                return []
+            f.seek(0)
+            while True:
+                start = f.tell()
+                hdr = f.read(len(_J_MAGIC) + _HDR.size)
+                if not hdr:
+                    break  # clean EOF
+                if (len(hdr) < len(_J_MAGIC) + _HDR.size
+                        or not hdr.startswith(_J_MAGIC)):
+                    truncate_to = start
+                    break
+                length, crc = _HDR.unpack(hdr[len(_J_MAGIC):])
+                payload = f.read(length)
+                if len(payload) < length or zlib.crc32(payload) != crc:
+                    truncate_to = start
+                    break
+                try:
+                    records.append(pickle.loads(payload))
+                except Exception:  # rtpulint: ignore[RTPU006] — a CRC-valid frame whose pickle fails is corruption-at-write; truncate like any bad frame
+                    truncate_to = start
+                    break
+        if truncate_to is not None:
+            with open(path, "r+b") as f:
+                f.truncate(truncate_to)
+            count_corruption("journal_tail")
+        return records
+
+    def _read_legacy_journal(self, path: str) -> List:
+        """Round-2 journals: consecutive raw pickle.dump records. Same
+        contract — parse the intact prefix, truncate the torn tail."""
+        records: List = []
+        good_end = 0
+        torn = False
+        with open(path, "rb") as f:
+            while True:
+                try:
+                    records.append(pickle.load(f))
+                    good_end = f.tell()
+                except EOFError:
+                    break
+                except Exception:  # rtpulint: ignore[RTPU006] — unframed stream: ANY parse error marks the torn tail, there is nothing narrower to catch across pickle's error zoo
+                    torn = True
+                    break
+        if torn:
+            with open(path, "r+b") as f:
+                f.truncate(good_end)
+            count_corruption("journal_tail")
+        return records
 
     def load_kv(self) -> Tuple[Optional[bytes], List, bool]:
-        snap = None
-        try:
-            with open(self._p("kv.pkl"), "rb") as f:
-                snap = f.read()
-        except FileNotFoundError:
-            pass
+        snap = self._read_snapshot("kv.pkl", "kv_snapshot")
+        path = self._p("kv.journal")
+        had_journal = os.path.exists(path)
         records: List = []
-        had_journal = os.path.exists(self._p("kv.journal"))
         if had_journal:
-            with open(self._p("kv.journal"), "rb") as f:
-                while True:
-                    try:
-                        records.append(pickle.load(f))
-                    except EOFError:
-                        break
-                    except Exception:
-                        # torn tail: the writer died mid-append;
-                        # everything before it is intact
-                        break
+            records = self._read_journal(path)
         return snap, records, had_journal
 
     def compact_kv(self, snapshot: bytes) -> None:
-        tmp = self._p("kv.pkl.tmp")
-        with open(tmp, "wb") as f:
-            f.write(snapshot)
-        os.replace(tmp, self._p("kv.pkl"))
+        self._close_journal()
+        self._write_snapshot("kv.pkl", snapshot)
         try:
             os.unlink(self._p("kv.journal"))
         except FileNotFoundError:
             pass
+        if _fsync_policy() == "always":
+            self._fsync_dir()
+
+    def close(self) -> None:
+        self._close_journal()
 
 
 class TCPBackend(StoreBackend):
     """The FileBackend verbs forwarded to a store server over RPC. Meta
     saves and journal appends are one-way sends (coalesced per loop
-    pass); replay reads are synchronous calls.
+    pass); replay reads are synchronous calls. Frame checksumming and
+    torn-tail truncation run SERVER-side (the store server's own
+    FileBackend), so a store machine crash has the same recovery
+    contract as a local disk.
 
     Lost sends are NOT silent: a notify that fails (store connection
     down) is recorded on a backlog and the backend flips ``degraded``;
@@ -216,6 +464,13 @@ class TCPBackend(StoreBackend):
                          if e[0] == "st_save_meta"]
         self._dropped = 0
         self._maybe_recover()
+
+    def flush(self) -> None:
+        # the periodic durability point doubles as backlog retry: a
+        # degraded backend re-offers its recorded losses even when no
+        # new mutation arrives to trigger the replay
+        if self._backlog:
+            self._replay_backlog()
 
     def close(self) -> None:
         import threading

@@ -113,6 +113,32 @@ def expired(deadline: Optional[float],
         (time.time() if now is None else now) >= deadline)
 
 
+def send_budget(deadline: Optional[float],
+                now: Optional[float] = None) -> Optional[float]:
+    """Relative remaining budget stamped NEXT TO the absolute wall
+    deadline at an RPC send. An absolute deadline does not survive
+    cross-host clock skew (a replica whose clock runs 30s ahead sheds
+    every request "expired" on arrival; 30s behind, it executes dead
+    work for 30 extra seconds) — the receiver re-derives its own
+    absolute deadline from this relative budget against ITS clock."""
+    if deadline is None:
+        return None
+    return deadline - (time.time() if now is None else now)
+
+
+def derive_deadline(deadline: Optional[float],
+                    budget_s: Optional[float],
+                    now: Optional[float] = None) -> Optional[float]:
+    """Receiver-side deadline: prefer the RELATIVE budget re-anchored to
+    the local clock (skew-proof; extends the deadline by at most the
+    frame's transit time — bounded by the RPC latency, vs unbounded
+    clock skew). The bare absolute deadline is the compatibility
+    fallback for senders that did not stamp a budget."""
+    if budget_s is not None:
+        return (time.time() if now is None else now) + budget_s
+    return deadline
+
+
 class ServiceTimeEWMA:
     """Exponentially weighted service-time estimate (seconds). alpha from
     the serve_ewma_alpha knob; ~1/alpha-call horizon. None until the

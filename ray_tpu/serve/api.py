@@ -11,10 +11,36 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, Optional
 
+from ..runtime.procutil import log
 from .config import (CONTROLLER_NAME, DEFAULT_APP_NAME, DEFAULT_HTTP_PORT,
                      GRPC_PROXY_NAME, PROXY_NAME, HTTPOptions, gRPCOptions)
 from .deployment import Application, flatten_app
 from .handle import DeploymentHandle, _Router
+
+
+def _warn_admission_pool_sizing(specs) -> list:
+    """Config sanity at deploy time (PR 13 known gap): every queued
+    picker parks one thread in handle._SUBMIT_POOL, so with
+    max_queued_requests >= the pool size the bounded-queue cap is
+    UNREACHABLE — overflow .remote() calls wait in the executor's own
+    unbounded queue where no admission or deadline logic runs, which is
+    exactly the timeout storm the admission plane exists to prevent.
+    Returns the offending deployment names (unit-testable)."""
+    from .handle import _SUBMIT_POOL
+
+    pool = _SUBMIT_POOL._max_workers
+    offenders = []
+    for spec in specs:
+        cap = getattr(spec.config, "max_queued_requests", -1)
+        if cap is not None and cap >= pool:
+            offenders.append(spec.name)
+            log.warning(
+                "serve deployment %r: max_queued_requests=%d >= the "
+                "submit/call pool size (%d) — queued requests beyond "
+                "the pool park in an unbounded executor queue where no "
+                "admission or deadline logic runs; lower the cap below "
+                "the pool size", spec.name, cap, pool)
+    return offenders
 
 
 def _get_controller(create: bool = True):
@@ -116,6 +142,7 @@ def run(app: Application, *, name: str = DEFAULT_APP_NAME,
     if _start_http:
         start()
     specs = flatten_app(app, name)
+    _warn_admission_pool_sizing(specs)
     payload = []
     for spec in specs:
         cfg_blob = serialization.dumps_inline(spec.config)

@@ -597,8 +597,15 @@ class DeploymentHandle:
                                   deadline=deadline)
             claimed_at = time.time()
             try:
-                ref = replica.handle_request.remote(method_name, resolved,
-                                                    resolved_kw, deadline)
+                from . import admission as _adm
+
+                # the RELATIVE budget rides beside the absolute wall
+                # deadline: the replica re-derives its own absolute
+                # deadline against ITS clock (cross-host clock skew
+                # made the bare wall deadline shed early/late)
+                ref = replica.handle_request.remote(
+                    method_name, resolved, resolved_kw, deadline,
+                    _adm.send_budget(deadline, claimed_at))
             except BaseException:
                 # pick() incremented the in-flight slot; give it back or the
                 # replica looks saturated forever.

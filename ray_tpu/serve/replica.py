@@ -117,7 +117,17 @@ class ReplicaActor:
 
     async def handle_request(self, method_name: str, args: tuple,
                              kwargs: dict,
-                             deadline: Optional[float] = None) -> Any:
+                             deadline: Optional[float] = None,
+                             budget_s: Optional[float] = None) -> Any:
+        from . import admission
+
+        # re-derive the absolute deadline against THIS replica's clock
+        # from the relative budget stamped at send: cross-host clock
+        # skew on the bare wall deadline shed requests early (receiver
+        # clock ahead) or executed dead work late (behind). The
+        # re-derived value also seeds the contextvar, so downstream
+        # handle.remote() calls re-stamp a consistent local budget.
+        deadline = admission.derive_deadline(deadline, budget_s)
         self._admit(deadline)
         self._admitted_total += 1
         self._ongoing += 1

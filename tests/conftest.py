@@ -67,6 +67,11 @@ def pytest_configure(config):
         "propagation, bounded-queue load shedding to typed "
         "429s/ServiceOverloadedError, engine expiry pruning) tests + "
         "the 10x-overload drill in benchmarks/overload_drill.py")
+    config.addinivalue_line(
+        "markers", "persist: durable control plane (crash-consistent "
+        "persist-dir journal framing, torn-write fuzz matrix, "
+        "replay↔reattach reconciliation) tests + the kill -9 restart "
+        "drill in tests/test_chaos.py")
 
 
 @pytest.fixture

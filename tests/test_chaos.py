@@ -733,6 +733,250 @@ def test_drill_spill_storm_30pct_drop(cluster, cfg_guard):
                                  node_id=node_b)
 
 
+# ------------------------------------- persist-dir kill -9 restart drill
+def _spawn_standalone_controller(addr, sname, pdir, logf):
+    """``python -m ray_tpu.runtime.controller`` as a real subprocess —
+    the only way kill_at(controller.persist) can exit(43) the control
+    plane without taking the test (and its live actors) down with it."""
+    env = dict(os.environ,
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))),
+               # bounds the replay verdicts (actor reattach grace, PG
+               # re-registration grace) so recovery asserts stay tight
+               RTPU_node_death_timeout_s="5.0")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.runtime.controller",
+         "--session-name", sname, "--address", addr,
+         "--persist-dir", pdir],
+        stdout=logf, stderr=subprocess.STDOUT, env=env,
+        start_new_session=True)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"standalone controller died at boot: {proc.returncode}")
+        probe = RpcClient(addr)
+        try:
+            probe.call("ping", _timeout=2)
+            return proc
+        except Exception:  # noqa: BLE001 — still booting; retry until the deadline
+            time.sleep(0.1)
+        finally:
+            probe.close()
+    raise AssertionError("standalone controller never answered ping")
+
+
+def test_drill_persist_dir_kill9_restart(tmp_path, cfg_guard):
+    """THE persist-dir drill (ROADMAP item 3 / PR 10 future work): a
+    standalone controller journaling to --persist-dir is killed with
+    exit 43 at the ``controller.persist`` syncpoint — MID journal
+    append, header on disk, payload not — under live named-actor + KV +
+    PG traffic, then restarted over the same directory. Asserts: named
+    actors resolve without re-creation (same worker process, zero
+    restarts, exactly one ALIVE incarnation), the actor kept serving
+    with zero errors, KV survives bit-exact (and the torn record is
+    GONE — it was never acked), the PG re-reserves its original
+    bundles, client errors stay typed and inside the outage window, and
+    the recovery time exports as
+    rtpu_recovery_ms{scenario=controller_persist}."""
+    import threading
+    import uuid
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    sname = f"persist_drill_{uuid.uuid4().hex[:6]}"
+    addr = f"unix:{tmp_path}/ctl.sock"
+    pdir = str(tmp_path / "persist")
+    logf = open(tmp_path / "controller.log", "ab")
+    proc = _spawn_standalone_controller(addr, sname, pdir, logf)
+    session = None
+    stop = threading.Event()
+    try:
+        session = ray_tpu.init(num_cpus=2, controller_address=addr,
+                               session_name=sname)
+        node_b = session.add_node(num_cpus=1)
+        ctl = session.core.controller
+
+        @ray_tpu.remote
+        class Keeper:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+            def pid(self):
+                return os.getpid()
+
+        keeper = Keeper.options(name="survivor").remote()
+        pid0 = ray_tpu.get(keeper.pid.remote(), timeout=60)
+        assert ray_tpu.get(keeper.bump.remote(), timeout=60) == 1
+
+        # durable state: KV (incl. a multi-MB value) + a placed PG
+        kv_acked = {f"k{i}": os.urandom(64) for i in range(6)}
+        kv_acked["big"] = os.urandom(2 << 20)
+        for key, value in kv_acked.items():
+            assert ctl.call("kv_put", ns="drill", key=key, value=value,
+                            _timeout=30)
+        pg = ctl.call("create_placement_group", pg_id="drill-pg",
+                      bundles=[{"CPU": 0.5}, {"CPU": 0.5}],
+                      strategy="SPREAD", _timeout=30)
+        assert pg["state"] == "CREATED", pg
+        pg_placement = pg["placement"]
+
+        # live traffic across the kill: actor calls ride owner->worker
+        # sockets (must see ZERO errors — the control plane is not on
+        # that path); KV reads hit the controller (typed errors allowed
+        # only inside the outage window)
+        actor_errors, kv_errors, bumps = [], [], []
+
+        def actor_traffic():
+            while not stop.is_set():
+                try:
+                    bumps.append(ray_tpu.get(keeper.bump.remote(),
+                                             timeout=30))
+                except Exception as e:  # noqa: BLE001 — the assertion below
+                    actor_errors.append(e)
+                    return
+                time.sleep(0.02)
+
+        def kv_traffic():
+            client = RpcClient(addr)
+            while not stop.is_set():
+                try:
+                    client.call("kv_get", ns="drill", key="k0",
+                                _timeout=3, _retry=0)
+                except Exception as e:  # noqa: BLE001 — recorded + asserted typed below
+                    kv_errors.append((time.monotonic(), e))
+                time.sleep(0.05)
+            client.close()
+
+        threads = [threading.Thread(target=actor_traffic, daemon=True),
+                   threading.Thread(target=kv_traffic, daemon=True)]
+        for th in threads:
+            th.start()
+        time.sleep(0.5)
+
+        # ---- arm + trigger: the next journal append dies mid-frame
+        ctl.call("fault_inject", spec="pk:kill_at(controller.persist)",
+                 _timeout=10)
+        t_kill = time.monotonic()
+        with pytest.raises(Exception):
+            ctl.call("kv_put", ns="drill", key="sacrifice",
+                     value=b"never-acked", _timeout=5, _retry=0)
+        assert proc.wait(timeout=30) == faults.KILL_EXIT_CODE
+        # the kill really happened MID-append: the journal ends with a
+        # torn frame — the 12-byte header (magic+len+crc) of the
+        # sacrificed record, payload missing — which replay must truncate
+        journal = open(os.path.join(pdir, "kv.journal"), "rb").read()
+        assert journal[-12:-8] == b"RJ1\n", journal[-16:]
+
+        # ---- restart over the SAME persist dir
+        proc = _spawn_standalone_controller(addr, sname, pdir, logf)
+        rc = RpcClient(addr)
+        deadline = time.monotonic() + 40
+        recovered = False
+        while time.monotonic() < deadline:
+            try:
+                nodes = rc.call("list_nodes", _timeout=5, _retry=0)
+                info = rc.call("get_actor", name="survivor",
+                               namespace="", _timeout=5, _retry=0)
+                pg2 = rc.call("get_placement_group", pg_id="drill-pg",
+                              _timeout=5, _retry=0)
+            except Exception:  # noqa: BLE001 — controller still booting/re-forming
+                time.sleep(0.2)
+                continue
+            if (len(nodes) == 2 and all(n["alive"] for n in nodes.values())
+                    and info is not None and info["state"] == "ALIVE"
+                    and pg2 is not None and pg2["state"] == "CREATED"):
+                recovered = True
+                break
+            time.sleep(0.2)
+        assert recovered, "cluster never re-formed from the persist dir"
+        t_recover = time.monotonic()
+        recovery_ms = (t_recover - t_kill) * 1000.0
+        faults.record_recovery("controller_persist", recovery_ms)
+
+        # named actor resolved WITHOUT re-creation: same process, zero
+        # restarts, exactly one ALIVE incarnation under the name
+        info = rc.call("get_actor", name="survivor", namespace="",
+                       _timeout=10)
+        assert info["state"] == "ALIVE" and info["num_restarts"] == 0
+        h2 = ray_tpu.get_actor("survivor")
+        assert ray_tpu.get(h2.pid.remote(), timeout=30) == pid0
+        actors = rc.call("list_actors", _timeout=10)
+        alive = [a for a in actors
+                 if a.get("name") == "survivor" and a["state"] == "ALIVE"]
+        assert len(alive) == 1, actors
+
+        # KV bit-exact: every ACKED key intact, the torn append GONE
+        for key, value in kv_acked.items():
+            assert rc.call("kv_get", ns="drill", key=key,
+                           _timeout=30) == value, key
+        assert rc.call("kv_get", ns="drill", key="sacrifice",
+                       _timeout=10) is None
+
+        # the PG re-reserved its ORIGINAL bundles on the re-registered
+        # nodes (idempotent re-reserve, not a scatter to fresh nodes)
+        pg2 = rc.call("get_placement_group", pg_id="drill-pg",
+                      _timeout=10)
+        assert pg2["state"] == "CREATED"
+        assert pg2["placement"] == pg_placement
+
+        # new work schedules through the restarted control plane
+        @ray_tpu.remote
+        def probe():
+            return "alive"
+
+        assert ray_tpu.get(probe.remote(), timeout=60) == "alive"
+
+        # traffic verdicts: give the KV loop a beat of post-recovery
+        # green, then stop everything
+        time.sleep(1.5)
+        stop.set()
+        for th in threads:
+            th.join(timeout=30)
+        assert not actor_errors, \
+            f"actor traffic errored across the kill: {actor_errors!r}"
+        # strictly sequential counts = ONE incarnation served the whole
+        # drill (a restart would reset the counter; a second incarnation
+        # would interleave duplicates)
+        assert bumps and bumps == list(range(bumps[0],
+                                             bumps[0] + len(bumps)))
+        typed = (rpc_mod.RpcTimeoutError, rpc_mod.NodeUnreachableError,
+                 rpc_mod.ConnectionLost, rpc_mod.RpcError,
+                 TimeoutError, ConnectionError)
+        import asyncio as _asyncio
+
+        typed = typed + (_asyncio.TimeoutError,)
+        for ts, err in kv_errors:
+            assert isinstance(err, typed), \
+                f"untyped client error during the drill: {err!r}"
+            assert t_kill - 0.5 <= ts <= t_recover + 5.0, \
+                f"client error OUTSIDE the outage window: {err!r} at {ts}"
+
+        # the drill exports its recovery scenario
+        from ray_tpu.util import metrics as metrics_mod
+
+        snap = metrics_mod.snapshot()
+        assert any(k.startswith("rtpu_recovery_ms")
+                   and "controller_persist" in k for k in snap), snap
+        assert recovery_ms < 40000
+        rc.close()
+    finally:
+        stop.set()
+        if session is not None:
+            try:
+                ray_tpu.shutdown()
+            except Exception:  # noqa: BLE001 — teardown best-effort with an external controller
+                pass
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        logf.close()
+
+
 # --------------------------------------------- chan_push backpressure
 def test_chan_push_backpressure_is_typed_and_retried(tmp_path,
                                                      monkeypatch,

@@ -1,0 +1,707 @@
+"""Durable control plane: crash-consistent journal + replay↔reattach.
+
+Three tiers:
+
+- torn-write fuzz: a framed journal truncated at EVERY byte offset of
+  its final record must replay the intact prefix, discard the tail, and
+  accept+replay a subsequent append — through FileBackend directly and
+  through TCPBackend/store-server for parity;
+- corruption handling: checksum-failing snapshots are quarantined
+  (``*.corrupt`` + ``rtpu_persist_corruptions_total``) and boot falls
+  back to journal-only replay instead of dying in ``pickle.loads``;
+  round-2 (unframed) journals/snapshots still replay;
+- replay↔reattach reconciliation: a replayed RESTARTING actor converges
+  to exactly ONE ALIVE incarnation — reattach within the grace window
+  prevents any lease (no double-restart), silence past the window gets
+  the normal death/restart verdict, a late reattach against an in-flight
+  replacement lease is refused (ghost killed), and stale death reports
+  from superseded incarnations are ignored. Replayed PGs re-reserve
+  their ORIGINAL bundles on re-registered nodes (idempotent
+  nodelet-side) or return to PENDING.
+
+The kill -9 drill itself (standalone controller killed at the
+``controller.persist`` syncpoint mid-append under live traffic) lives in
+tests/test_chaos.py.
+"""
+
+import asyncio
+import os
+import pickle
+import time
+
+import pytest
+
+from ray_tpu.runtime.config import get_config
+from ray_tpu.runtime.controller import (ACTOR_ALIVE, ACTOR_DEAD,
+                                        ACTOR_RESTARTING, ActorInfo,
+                                        Controller)
+from ray_tpu.runtime.rpc import EventLoopThread, RpcServer
+from ray_tpu.runtime.storage import FileBackend, TCPBackend, serve_store
+from ray_tpu.util import metrics as metrics_mod
+
+pytestmark = pytest.mark.persist
+
+
+@pytest.fixture
+def cfg_guard():
+    cfg = get_config()
+    saved = {k: getattr(cfg, k)
+             for k in ("persist_fsync", "node_death_timeout_s",
+                       "heartbeat_interval_s")}
+    yield cfg
+    for k, v in saved.items():
+        setattr(cfg, k, v)
+
+
+def _corruptions(kind: str) -> float:
+    snap = metrics_mod.snapshot()
+    return sum(v for k, v in snap.items()
+               if k.startswith("rtpu_persist_corruptions_total")
+               and kind in k)
+
+
+# ------------------------------------------------------ torn-write fuzz
+def _fuzz_records():
+    """Mixed put/del records including a multi-MB value; the FINAL
+    record is small so the every-byte-offset matrix stays cheap."""
+    return [
+        ("put", "ns", "k0", b"small-value-0"),
+        ("put", "ns", "big", os.urandom(2 << 20)),  # 2 MiB
+        ("del", "ns", "k0", None),
+        ("put", "ns", "k1", b"v1" * 64),
+        ("put", "ns", "fin", b"F" * 32),  # the record the matrix tears
+    ]
+
+
+def _build_journal(tmp_path, recs):
+    """Append `recs` through the real writer; return (journal bytes,
+    offset where the final record starts)."""
+    scratch = tmp_path / "scratch"
+    be = FileBackend(str(scratch))
+    for r in recs[:-1]:
+        be.append_kv(r)
+    be.close()
+    base = os.path.getsize(scratch / "kv.journal")
+    be = FileBackend(str(scratch))
+    be.append_kv(recs[-1])
+    be.close()
+    blob = (scratch / "kv.journal").read_bytes()
+    return blob, base
+
+
+def test_torn_write_fuzz_every_offset_file_backend(tmp_path):
+    recs = _fuzz_records()
+    blob, base = _build_journal(tmp_path, recs)
+    work = tmp_path / "matrix"
+    os.makedirs(work, exist_ok=True)
+    jpath = work / "kv.journal"
+    extra = ("put", "ns", "extra", b"post-truncation-append")
+    for cut in range(base, len(blob) + 1):
+        jpath.write_bytes(blob[:cut])
+        be = FileBackend(str(work))
+        snap, records, had = be.load_kv()
+        expected = recs if cut == len(blob) else recs[:-1]
+        assert had and snap is None
+        assert records == expected, f"cut={cut}"
+        # the torn tail was physically truncated: a subsequent append
+        # lands at a clean frame boundary and round-trips
+        be.append_kv(extra)
+        be.close()
+        be2 = FileBackend(str(work))
+        _, records2, _ = be2.load_kv()
+        be2.close()
+        assert records2 == expected + [extra], f"cut={cut}"
+
+
+def test_torn_write_fuzz_every_offset_tcp_backend(tmp_path):
+    """The same matrix through the store server's RPC verbs: torn-tail
+    truncation runs server-side, behind ``st_load_kv``/``st_append_kv``,
+    with identical results."""
+    recs = _fuzz_records()
+    blob, base = _build_journal(tmp_path, recs)
+    store_dir = tmp_path / "store"
+    server = serve_store(str(store_dir), "tcp:127.0.0.1:0")
+    elt = EventLoopThread.get()
+    be = TCPBackend(server.address)
+    jpath = store_dir / "kv.journal"
+    try:
+        for cut in range(base, len(blob) + 1):
+            jpath.write_bytes(blob[:cut])
+            snap, records, had = be.load_kv()
+            expected = recs if cut == len(blob) else recs[:-1]
+            assert had and snap is None
+            assert records == expected, f"cut={cut}"
+            extra = ("put", "ns", "extra", b"x%d" % cut)
+            be.append_kv(extra)  # one-way: poll until it lands
+            deadline = time.monotonic() + 15
+            records2 = None
+            while time.monotonic() < deadline:
+                _, records2, _ = be.load_kv()
+                if len(records2) == len(expected) + 1:
+                    break
+                time.sleep(0.01)
+            assert records2 == expected + [extra], f"cut={cut}"
+    finally:
+        be.close()
+        elt.run(server.stop())
+
+
+def test_corrupt_middle_record_truncates_suffix_cleanly(tmp_path):
+    """Corruption in the MIDDLE of the journal: replay keeps the intact
+    prefix, truncates from the bad frame (the suffix is untrusted), and
+    later appends are readable — before framing, the garbage stayed in
+    place and made every subsequent append unreadable too."""
+    recs = _fuzz_records()
+    blob, _ = _build_journal(tmp_path, recs)
+    work = tmp_path / "mid"
+    os.makedirs(work, exist_ok=True)
+    # flip a byte inside record 2's payload (record 1 = 13-byte value,
+    # record 2 = the 2 MiB value: offset 1 MiB is safely inside it)
+    data = bytearray(blob)
+    data[1 << 20] ^= 0xFF
+    (work / "kv.journal").write_bytes(bytes(data))
+    before = _corruptions("journal_tail")
+    be = FileBackend(str(work))
+    _, records, _ = be.load_kv()
+    assert records == recs[:1]  # intact prefix only
+    assert _corruptions("journal_tail") == before + 1
+    be.append_kv(("put", "ns", "after", b"y"))
+    be.close()
+    _, records2, _ = FileBackend(str(work)).load_kv()
+    assert records2 == recs[:1] + [("put", "ns", "after", b"y")]
+
+
+# --------------------------------------------------- snapshot corruption
+def test_meta_snapshot_corruption_quarantined(tmp_path):
+    be = FileBackend(str(tmp_path / "meta"))
+    blob = pickle.dumps({"jobs": {"j1": {"state": "RUNNING"}}})
+    be.save_meta(blob)
+    assert be.load_meta() == blob
+    path = os.path.join(be.dir, "meta.pkl")
+    data = bytearray(open(path, "rb").read())
+    data[-1] ^= 0xFF  # corrupt the payload under the checksum
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+    before = _corruptions("meta")
+    assert be.load_meta() is None  # quarantined, not a pickle crash
+    assert os.path.exists(path + ".corrupt")
+    assert not os.path.exists(path)
+    assert _corruptions("meta") == before + 1
+    be.save_meta(blob)  # the tier recovers after quarantine
+    assert be.load_meta() == blob
+
+
+def test_kv_snapshot_corruption_falls_back_to_journal(tmp_path):
+    be = FileBackend(str(tmp_path / "kv"))
+    be.compact_kv(pickle.dumps({"ns": {"a": b"1"}}))
+    be.append_kv(("put", "ns", "b", b"2"))
+    be.close()
+    path = os.path.join(be.dir, "kv.pkl")
+    data = bytearray(open(path, "rb").read())
+    data[-1] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+    be2 = FileBackend(be.dir)
+    snap, records, had = be2.load_kv()
+    assert snap is None  # corrupt snapshot quarantined...
+    assert records == [("put", "ns", "b", b"2")]  # ...journal replays
+    assert os.path.exists(path + ".corrupt")
+
+
+def test_controller_boot_survives_unreadable_legacy_meta(tmp_path):
+    """A headerless (round-2) meta blob whose pickle fails must not
+    crash the boot: counted, logged, and the KV journal still replays."""
+    pdir = tmp_path / "boot"
+
+    async def phase1():
+        c = Controller("pb", f"unix:{tmp_path}/b1.sock",
+                       persist_dir=str(pdir))
+        await c.kv_put("ns", "alpha", b"1")
+        await c.register_job("job-1", {"entrypoint": "x"})
+
+    asyncio.run(phase1())
+    # overwrite meta with a headerless non-pickle blob (legacy format
+    # passthrough: no checksum to fail, pickle.loads is the tripwire)
+    (pdir / "meta.pkl").write_bytes(b"\x80\x05not really a pickle")
+    before = _corruptions("meta")
+
+    async def phase2():
+        c2 = Controller("pb", f"unix:{tmp_path}/b2.sock",
+                        persist_dir=str(pdir))
+        assert await c2.kv_get("ns", "alpha") == b"1"
+        assert await c2.list_jobs() == []  # meta lost, boot survived
+
+    asyncio.run(phase2())
+    assert _corruptions("meta") == before + 1
+
+
+def test_legacy_journal_replays_and_truncates(tmp_path):
+    """Round-2 journals (raw consecutive pickles) still replay, torn
+    tails included, and appends keep the legacy format until compaction."""
+    work = tmp_path / "legacy"
+    os.makedirs(work)
+    r1, r2 = ("put", "ns", "a", b"1"), ("put", "ns", "b", b"2" * 1000)
+    with open(work / "kv.journal", "wb") as f:
+        pickle.dump(r1, f)
+        pickle.dump(r2, f)
+        f.write(pickle.dumps(("put", "ns", "torn", b"x" * 500))[:-7])
+    be = FileBackend(str(work))
+    _, records, _ = be.load_kv()
+    assert records == [r1, r2]
+    r3 = ("put", "ns", "c", b"3")
+    be.append_kv(r3)
+    be.close()
+    _, records2, _ = FileBackend(str(work)).load_kv()
+    assert records2 == [r1, r2, r3]
+
+
+# -------------------------------------------------------- fsync policy
+def test_persist_fsync_policy_knob(tmp_path, monkeypatch, cfg_guard):
+    fsyncs = []
+    monkeypatch.setattr(os, "fsync", lambda fd: fsyncs.append(fd))
+    rec = ("put", "ns", "k", b"v")
+
+    cfg_guard.persist_fsync = "always"
+    be = FileBackend(str(tmp_path / "always"))
+    fsyncs.clear()
+    be.append_kv(rec)
+    assert len(fsyncs) >= 1  # every append is a durability point
+    fsyncs.clear()
+    be.save_meta(b"blob")
+    assert len(fsyncs) >= 2  # tmp-file fsync + directory fsync
+    be.close()
+
+    cfg_guard.persist_fsync = "batch"
+    be = FileBackend(str(tmp_path / "batch"))
+    fsyncs.clear()
+    be.append_kv(rec)
+    be.append_kv(rec)
+    assert fsyncs == []  # appends batch...
+    be.flush()
+    assert len(fsyncs) == 1  # ...into the periodic flush
+    be.flush()
+    assert len(fsyncs) == 1  # nothing dirty: no syscall
+    be.close()
+
+    cfg_guard.persist_fsync = "off"
+    be = FileBackend(str(tmp_path / "off"))
+    fsyncs.clear()
+    be.append_kv(rec)
+    be.flush()
+    be.save_meta(b"blob")
+    be.close()
+    assert fsyncs == []
+
+
+# ------------------------------------- replay↔reattach reconciliation
+def _fake_node(tmp_path, name, lease_calls=None, reserve_calls=None):
+    """A stand-in nodelet: answers the controller verbs the
+    reconciliation paths drive, recording what it was asked."""
+    async def lease_worker_for_actor(spec, actor_id):
+        if lease_calls is not None:
+            lease_calls.append(actor_id)
+        return True
+
+    async def reserve_bundle(pg_id, bundle_index, resources):
+        if reserve_calls is not None:
+            reserve_calls.append((pg_id, bundle_index))
+        return True
+
+    async def return_bundle(pg_id, bundle_index):
+        return True
+
+    async def shutdown():
+        return True
+
+    async def fault_forward(spec=None, clear=None):
+        return True
+
+    server = RpcServer(f"unix:{tmp_path}/{name}.sock", {
+        "lease_worker_for_actor": lease_worker_for_actor,
+        "reserve_bundle": reserve_bundle,
+        "return_bundle": return_bundle,
+        "shutdown": shutdown,
+        "fault_forward": fault_forward,
+    })
+    EventLoopThread.get().run(server.start())
+    return server
+
+
+def _seed_named_actor(tmp_path, pdir, max_restarts):
+    async def phase1():
+        c = Controller("recon", f"unix:{tmp_path}/seed.sock",
+                       persist_dir=pdir)
+        await c.register_actor(
+            "a1", {"name": "svc", "namespace": "", "resources": {},
+                   "max_restarts": max_restarts})
+        await asyncio.sleep(0)
+        c._store_backend.close()
+
+    asyncio.run(phase1())
+
+
+def test_replayed_actor_reattach_converges_single_incarnation(
+        tmp_path, cfg_guard):
+    """The tentpole invariant: a replayed RESTARTING actor whose live
+    worker re-announces converges to exactly ONE ALIVE incarnation —
+    zero leases issued (no double-restart), num_restarts untouched."""
+    cfg_guard.node_death_timeout_s = 1.0
+    pdir = str(tmp_path / "p1")
+    _seed_named_actor(tmp_path, pdir, max_restarts=3)
+    elt = EventLoopThread.get()
+    lease_calls = []
+    node = _fake_node(tmp_path, "n1", lease_calls=lease_calls)
+    c2 = Controller("recon", f"unix:{tmp_path}/c2.sock", persist_dir=pdir)
+    elt.run(c2.start())
+    try:
+        info = c2.actors["a1"]
+        assert info.state == ACTOR_RESTARTING and info.awaiting_reattach
+        elt.run(c2.register_node("n1", node.address, {"CPU": 4.0}, {}))
+        ok = elt.run(c2.reattach_actor(
+            "a1", {"name": "svc", "namespace": ""},
+            "unix:/tmp/w1.sock", "w1", "n1"))
+        assert ok
+        assert info.state == ACTOR_ALIVE and info.num_restarts == 0
+        # ride out the reconcile grace window, heartbeating so the
+        # health sweep does not declare the (fake) node dead meanwhile
+        for _ in range(8):
+            time.sleep(0.2)
+            elt.run(c2.heartbeat("n1", None))
+        assert info.state == ACTOR_ALIVE and info.num_restarts == 0
+        assert lease_calls == []  # no replacement worker was ever leased
+        assert sum(1 for a in c2.actors.values()
+                   if a.spec.get("name") == "svc"
+                   and a.state == ACTOR_ALIVE) == 1
+        # idempotent re-announce of the SAME worker refreshes...
+        assert elt.run(c2.reattach_actor("a1", {}, "unix:/tmp/w1.sock",
+                                         "w1", "n1"))
+        # ...a DIFFERENT worker claiming the live id is a ghost: refused
+        assert not elt.run(c2.reattach_actor("a1", {}, "unix:/tmp/w9.sock",
+                                             "w9", "n1"))
+    finally:
+        elt.run(c2.stop())
+        elt.run(node.stop())
+
+
+def test_replayed_actor_silent_node_gets_restart_verdict(
+        tmp_path, cfg_guard):
+    """No reattach within node_death_timeout_s: the normal death/restart
+    verdict — exactly one replacement lease, restart counted."""
+    cfg_guard.node_death_timeout_s = 0.6
+    pdir = str(tmp_path / "p2")
+    _seed_named_actor(tmp_path, pdir, max_restarts=3)
+    elt = EventLoopThread.get()
+    lease_calls = []
+    node = _fake_node(tmp_path, "n2", lease_calls=lease_calls)
+    c2 = Controller("recon", f"unix:{tmp_path}/c3.sock", persist_dir=pdir)
+    elt.run(c2.start())
+    try:
+        elt.run(c2.register_node("n2", node.address, {"CPU": 4.0}, {}))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not lease_calls:
+            time.sleep(0.05)
+        assert lease_calls == ["a1"]  # exactly one replacement lease
+        info = c2.actors["a1"]
+        assert info.num_restarts == 1
+        # a LATE reattach from the old incarnation now races the booting
+        # replacement: refused (the announcing nodelet kills the ghost)
+        assert info.lease_inflight
+        assert not elt.run(c2.reattach_actor(
+            "a1", {}, "unix:/tmp/wold.sock", "wold", "n2"))
+        # the replacement comes up: exactly one ALIVE incarnation
+        elt.run(c2.actor_ready("a1", "unix:/tmp/w2.sock", "w2", "n2"))
+        assert info.state == ACTOR_ALIVE and info.worker_id == "w2"
+        # and its stale death report (ghost killed) is ignored
+        assert not elt.run(c2.actor_died("a1", worker_id="wold"))
+        assert info.state == ACTOR_ALIVE
+    finally:
+        elt.run(c2.stop())
+        elt.run(node.stop())
+
+
+def test_replayed_actor_without_restart_budget_dies(tmp_path, cfg_guard):
+    """max_restarts=0 + silent node: the verdict is DEAD and the name is
+    released — same ruling a node-death sweep would give."""
+    cfg_guard.node_death_timeout_s = 0.5
+    pdir = str(tmp_path / "p3")
+    _seed_named_actor(tmp_path, pdir, max_restarts=0)
+    elt = EventLoopThread.get()
+    c2 = Controller("recon", f"unix:{tmp_path}/c4.sock", persist_dir=pdir)
+    elt.run(c2.start())
+    try:
+        deadline = time.monotonic() + 10
+        info = c2.actors["a1"]
+        while time.monotonic() < deadline and info.state != ACTOR_DEAD:
+            time.sleep(0.05)
+        assert info.state == ACTOR_DEAD
+        assert ("", "svc") not in c2.named_actors
+        # a ghost worker of a DEAD actor is told to die (refused)
+        assert not elt.run(c2.reattach_actor(
+            "a1", {}, "unix:/tmp/w.sock", "w", "n"))
+    finally:
+        elt.run(c2.stop())
+
+
+def test_stale_death_report_from_superseded_worker_ignored():
+    async def run():
+        c = Controller("stale", "unix:/tmp/rtpu-test-stale.sock")
+        info = ActorInfo("x", {"max_restarts": 5})
+        info.state = ACTOR_ALIVE
+        info.worker_id = "w2"
+        c.actors["x"] = info
+        assert not await c.actor_died("x", worker_id="w1")  # stale
+        assert info.state == ACTOR_ALIVE
+        assert await c.actor_died("x", worker_id="w2")  # live incarnation
+        assert info.state == ACTOR_RESTARTING
+        assert info.worker_id is None  # next incarnation may report
+
+    asyncio.run(run())
+
+
+def test_reserve_bundle_idempotent_rereserve():
+    """The nodelet half of PG replay: re-reserving a bundle the nodelet
+    still holds is a no-op (a controller replaying its PG table — or
+    retrying a lost reply — must not leak the resources twice)."""
+    from ray_tpu.runtime.nodelet import Nodelet
+
+    n = Nodelet.__new__(Nodelet)
+    n.available = {"CPU": 4.0}
+    n.bundles = {}
+    n._resource_version = 0
+
+    async def run():
+        assert await n.reserve_bundle("pg", 0, {"CPU": 2.0})
+        assert n.available["CPU"] == 2.0
+        assert await n.reserve_bundle("pg", 0, {"CPU": 2.0})  # replay
+        assert n.available["CPU"] == 2.0  # NOT debited twice
+        # same id, different shape: old pool released first
+        assert await n.reserve_bundle("pg", 0, {"CPU": 1.0})
+        assert n.available["CPU"] == 3.0
+        assert await n.return_bundle("pg", 0)
+        assert n.available["CPU"] == 4.0
+
+    asyncio.run(run())
+
+
+def test_replayed_pg_rereserves_original_placement(tmp_path, cfg_guard):
+    """A replayed PG re-reserves its ORIGINAL bundles once the original
+    nodes re-register — same placement, bundles re-acquired idempotently
+    — instead of scattering to fresh nodes while the old reservations
+    leak."""
+    cfg_guard.node_death_timeout_s = 5.0
+    elt = EventLoopThread.get()
+    reserve_calls = []
+    n1 = _fake_node(tmp_path, "pg-n1", reserve_calls=reserve_calls)
+    n2 = _fake_node(tmp_path, "pg-n2", reserve_calls=reserve_calls)
+    pdir = str(tmp_path / "pgp")
+
+    async def phase1():
+        c = Controller("pgr", f"unix:{tmp_path}/pg1.sock",
+                       persist_dir=pdir)
+        await c.register_node("n1", n1.address, {"CPU": 2.0}, {})
+        await c.register_node("n2", n2.address, {"CPU": 2.0}, {})
+        out = await c.create_placement_group(
+            "pg-1", [{"CPU": 1.0}, {"CPU": 1.0}], strategy="SPREAD")
+        assert out["state"] == "CREATED"
+        await c.stop()
+        return out["placement"]
+
+    original = elt.run(phase1())
+    reserve_calls.clear()
+
+    c2 = Controller("pgr", f"unix:{tmp_path}/pg2.sock", persist_dir=pdir)
+    elt.run(c2.start())
+    try:
+        pg = c2.placement_groups["pg-1"]
+        assert pg["state"] == "PENDING"
+        assert pg["_replayed_placement"] == original
+        elt.run(c2.register_node("n1", n1.address, {"CPU": 2.0}, {}))
+        elt.run(c2.register_node("n2", n2.address, {"CPU": 2.0}, {}))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and pg["state"] != "CREATED":
+            time.sleep(0.05)
+        assert pg["state"] == "CREATED"
+        assert pg["placement"] == original  # SAME bundles, not fresh ones
+        assert sorted(reserve_calls) == [("pg-1", 0), ("pg-1", 1)]
+    finally:
+        elt.run(c2.stop())
+        elt.run(n1.stop())
+        elt.run(n2.stop())
+
+
+def test_replayed_pg_stays_pending_when_nodes_never_return(
+        tmp_path, cfg_guard):
+    cfg_guard.node_death_timeout_s = 0.4
+    elt = EventLoopThread.get()
+    n1 = _fake_node(tmp_path, "gone-n1")
+    pdir = str(tmp_path / "pgq")
+
+    async def phase1():
+        c = Controller("pgq", f"unix:{tmp_path}/q1.sock",
+                       persist_dir=pdir)
+        await c.register_node("n1", n1.address, {"CPU": 2.0}, {})
+        out = await c.create_placement_group(
+            "pg-q", [{"CPU": 1.0}], strategy="PACK")
+        assert out["state"] == "CREATED"
+        await c.stop()
+
+    elt.run(phase1())
+    c2 = Controller("pgq", f"unix:{tmp_path}/q2.sock", persist_dir=pdir)
+    elt.run(c2.start())
+    try:
+        pg = c2.placement_groups["pg-q"]
+        time.sleep(1.5)  # well past the re-registration grace
+        assert pg["state"] == "PENDING"  # no nodes: PENDING, not lost
+        assert "_replayed_placement" not in pg  # old claim released
+    finally:
+        elt.run(c2.stop())
+        elt.run(n1.stop())
+
+
+# ------------------------------------------- review-hardening regressions
+def test_failed_append_rewinds_partial_frame(tmp_path):
+    """An append that fails IN-PROCESS (kill_at action=raise at the
+    controller.persist syncpoint, or an I/O error mid-payload) must
+    rewind its partial frame: left in place, every LATER acked append
+    would sit behind a dangling header and be silently truncated at the
+    next replay."""
+    from ray_tpu.runtime import faults
+
+    work = tmp_path / "rewind"
+    be = FileBackend(str(work))
+    be.append_kv(("put", "ns", "pre", b"before"))
+    plane = faults.get_plane()
+    plane.add_rules("jk:kill_at(controller.persist,action=raise)")
+    try:
+        with pytest.raises(faults.FaultInjectedError):
+            be.append_kv(("put", "ns", "doomed", b"x" * 100))
+    finally:
+        plane.clear("jk")
+    # acked appends AFTER the failure must survive the next replay
+    be.append_kv(("put", "ns", "post", b"after"))
+    be.close()
+    _, records, _ = FileBackend(str(work)).load_kv()
+    assert records == [("put", "ns", "pre", b"before"),
+                       ("put", "ns", "post", b"after")]
+
+
+def test_ghost_death_during_replacement_lease_ignored(tmp_path, cfg_guard):
+    """Review finding: after the restart verdict clears info.worker_id,
+    a superseded ghost's death report (arriving while the replacement
+    lease is in flight) must NOT pass the stale-report guard and
+    trigger a second restart."""
+    cfg_guard.node_death_timeout_s = 0.5
+    pdir = str(tmp_path / "ghost")
+    _seed_named_actor(tmp_path, pdir, max_restarts=5)
+    elt = EventLoopThread.get()
+    lease_calls = []
+    node = _fake_node(tmp_path, "gn", lease_calls=lease_calls)
+    c2 = Controller("recon", f"unix:{tmp_path}/gc.sock", persist_dir=pdir)
+    elt.run(c2.start())
+    try:
+        elt.run(c2.register_node("gn", node.address, {"CPU": 4.0}, {}))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not lease_calls:
+            time.sleep(0.05)
+        info = c2.actors["a1"]
+        assert lease_calls == ["a1"] and info.lease_inflight
+        # the ghost's late reattach is refused (recording it superseded)
+        assert not elt.run(c2.reattach_actor(
+            "a1", {}, "unix:/tmp/ghost.sock", "w_ghost", "gn"))
+        # ...and the ghost's death report — info.worker_id is None in
+        # this window — must neither restart again nor touch the lease
+        assert not elt.run(c2.actor_died("a1", worker_id="w_ghost"))
+        assert info.num_restarts == 1  # still the ONE verdict
+        assert lease_calls == ["a1"]  # no second lease spawned
+        elt.run(c2.actor_ready("a1", "unix:/tmp/w2.sock", "w2", "gn"))
+        assert info.state == ACTOR_ALIVE
+        # redelivered ghost report after actor_ready: still ignored
+        assert not elt.run(c2.actor_died("a1", worker_id="w_ghost"))
+        assert info.state == ACTOR_ALIVE
+    finally:
+        elt.run(c2.stop())
+        elt.run(node.stop())
+
+
+def test_replayed_pg_partial_rereserve_keeps_held_bundles(
+        tmp_path, cfg_guard):
+    """Review finding: when ONE node of a replayed placement fails its
+    re-reserve, the bundles other nodelets HELD through the outage
+    (live actors inside) must NOT be rolled back — the PG keeps
+    retrying its original placement and converges once the laggard
+    recovers."""
+    cfg_guard.node_death_timeout_s = 8.0
+    elt = EventLoopThread.get()
+    calls = {"reserve": [], "return": []}
+    flaky = {"fail": True}
+
+    async def reserve_ok(pg_id, bundle_index, resources):
+        calls["reserve"].append(("ok-node", bundle_index))
+        return True
+
+    async def reserve_flaky(pg_id, bundle_index, resources):
+        calls["reserve"].append(("flaky-node", bundle_index))
+        return not flaky["fail"]
+
+    async def return_bundle(pg_id, bundle_index):
+        calls["return"].append(bundle_index)
+        return True
+
+    async def shutdown():
+        return True
+
+    servers = []
+    for name, reserve in (("hold-n1", reserve_ok),
+                          ("hold-n2", reserve_flaky)):
+        srv = RpcServer(f"unix:{tmp_path}/{name}.sock", {
+            "reserve_bundle": reserve, "return_bundle": return_bundle,
+            "shutdown": shutdown})
+        elt.run(srv.start())
+        servers.append(srv)
+    n1, n2 = servers
+    pdir = str(tmp_path / "pgh")
+
+    async def phase1():
+        c = Controller("pgh", f"unix:{tmp_path}/h1.sock",
+                       persist_dir=pdir)
+        await c.register_node("n1", n1.address, {"CPU": 2.0}, {})
+        await c.register_node("n2", n2.address, {"CPU": 2.0}, {})
+        flaky["fail"] = False
+        out = await c.create_placement_group(
+            "pg-h", [{"CPU": 1.0}, {"CPU": 1.0}], strategy="SPREAD")
+        assert out["state"] == "CREATED"
+        await c.stop()
+        return out["placement"]
+
+    original = elt.run(phase1())
+    calls["reserve"].clear()
+    calls["return"].clear()
+    flaky["fail"] = True  # n2 cannot re-fit yet after the restart
+
+    c2 = Controller("pgh", f"unix:{tmp_path}/h2.sock", persist_dir=pdir)
+    elt.run(c2.start())
+    try:
+        pg = c2.placement_groups["pg-h"]
+        elt.run(c2.register_node("n1", n1.address, {"CPU": 2.0}, {}))
+        elt.run(c2.register_node("n2", n2.address, {"CPU": 2.0}, {}))
+        deadline = time.monotonic() + 6
+        while time.monotonic() < deadline and not any(
+                n == "flaky-node" for n, _ in calls["reserve"]):
+            time.sleep(0.05)
+        time.sleep(0.3)  # let at least one full partial round finish
+        # the held bundle on n1 was NOT returned despite n2 failing
+        assert calls["return"] == [], calls
+        assert pg["state"] == "PENDING"
+        # the laggard recovers: the PG converges on the ORIGINAL
+        # placement with zero bundles ever yanked
+        flaky["fail"] = False
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and pg["state"] != "CREATED":
+            time.sleep(0.05)
+        assert pg["state"] == "CREATED"
+        assert pg["placement"] == original
+        assert calls["return"] == []
+    finally:
+        elt.run(c2.stop())
+        for srv in servers:
+            elt.run(srv.stop())

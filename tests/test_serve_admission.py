@@ -337,9 +337,15 @@ def test_deadline_propagates_downstream(serve_cluster):
     try:
         out = handle.options(timeout_s=7).remote().result(timeout_s=30)
         assert out["outer"] is not None and out["inner"] is not None
-        assert abs(out["outer"] - out["inner"]) < 1e-6, (
-            "downstream hop must inherit the ingress deadline, not "
+        # the deadline crosses each hop as a RELATIVE budget re-anchored
+        # to the receiver's clock (cross-host skew fix), so the
+        # downstream absolute value may drift by the hop's transit time
+        # — but never by a fresh per-hop stamp (a reset would put the
+        # inner deadline a whole serve_request_timeout_s=60s out)
+        assert abs(out["outer"] - out["inner"]) < 0.5, (
+            "downstream hop must inherit the ingress budget, not "
             "stamp a fresh one")
+        assert 0 < out["inner"] - time.time() < 7.5
         assert 0 < out["outer"] - time.time() < 7.5
         # no explicit timeout: the serve_request_timeout_s default
         out = handle.remote().result(timeout_s=30)
@@ -471,3 +477,111 @@ def test_kill_at_admission_syncpoint(serve_cluster):
         assert handle.remote(3).result(timeout_s=30) == 3
     finally:
         serve.delete("killat")
+
+
+# ----------------------------------------- cross-host clock-skew deadlines
+def test_clock_skew_budget_helpers_unit():
+    """PR 13 known gap: deadlines now cross the handle->replica RPC as
+    (absolute wall deadline, RELATIVE remaining budget) and the receiver
+    re-derives its own absolute deadline against ITS clock — a ±30s
+    clock skew no longer sheds early or executes dead work late."""
+    now = 1_000_000.0
+    deadline = now + 60.0
+    budget = admission.send_budget(deadline, now)
+    assert budget == 60.0
+    # receiver clock 30s AHEAD of the sender: the bare absolute deadline
+    # looks only 30s away; the budget re-anchors the full 60s
+    ahead = now + 30.0
+    assert admission.derive_deadline(deadline, budget, ahead) == ahead + 60.0
+    # receiver 30s BEHIND: the bare absolute would grant 90s of dead work
+    behind = now - 30.0
+    assert admission.derive_deadline(deadline, budget, behind) == behind + 60.0
+    # compatibility: no budget stamped -> the absolute passes through
+    assert admission.derive_deadline(deadline, None, ahead) == deadline
+    assert admission.send_budget(None) is None
+    assert admission.derive_deadline(None, None) is None
+
+
+def _bare_replica():
+    """ReplicaActor without serve/cluster plumbing (the PR-13 __new__
+    pattern): only the admission/deadline fields handle_request touches."""
+    from types import SimpleNamespace
+
+    from ray_tpu.serve.replica import ReplicaActor, get_request_deadline
+
+    r = ReplicaActor.__new__(ReplicaActor)
+    r._app, r._deployment, r._replica_id = "app", "dep", "r1"
+    r._config = SimpleNamespace(max_queued_requests=-1,
+                                max_ongoing_requests=0)
+    r._ongoing = r._total = 0
+    r._admitted_total = r._shed_total = r._expired_total = 0
+    r._service_ewma = admission.ServiceTimeEWMA(alpha=0.5)
+
+    class Echo:
+        def seen_deadline(self):
+            return get_request_deadline()
+
+    r._user_callable = Echo()
+    return r
+
+
+def test_replica_clock_ahead_no_early_shed():
+    """Replica clock 30s AHEAD of the sender: the bare absolute deadline
+    looks already expired on arrival (the pre-fix early shed); the
+    stamped relative budget executes the request, and the re-derived
+    deadline seeds the contextvar in the replica's own clock domain."""
+    r = _bare_replica()
+    # sender stamped a 20s budget; under +30s receiver skew its absolute
+    # deadline reads as 10s in the RECEIVER's past (equivalent shift —
+    # no clock mocking needed)
+    skewed_abs = time.time() - 10.0
+    seen = asyncio.run(r.handle_request("seen_deadline", (), {},
+                                        skewed_abs, 20.0))
+    assert seen is not None and seen - time.time() > 15.0
+    # legacy wire (no budget): the same skew sheds "expired" on arrival
+    with pytest.raises(RequestExpiredError):
+        asyncio.run(r.handle_request("seen_deadline", (), {},
+                                     skewed_abs, None))
+
+
+def test_replica_clock_behind_no_late_execution():
+    """Replica clock 30s BEHIND: the bare absolute deadline would grant
+    ~30 extra seconds of dead work; the relative budget (already spent
+    at send) sheds it on time."""
+    r = _bare_replica()
+    skewed_abs = time.time() + 29.0  # sender's deadline HAS passed
+    with pytest.raises(RequestExpiredError):
+        asyncio.run(r.handle_request("seen_deadline", (), {},
+                                     skewed_abs, -1.0))
+    # sanity: without the skew-proof budget this executed as dead work
+    assert asyncio.run(r.handle_request("seen_deadline", (), {},
+                                        skewed_abs, None)) == skewed_abs
+
+
+# ------------------------------------------- submit-pool sizing sanity
+def test_submit_pool_sizing_warning(caplog):
+    """Config sanity at deploy time (PR 13 known gap): a deployment
+    whose max_queued_requests reaches the submit/call pool size makes
+    the bounded-queue cap unreachable — overflow parks in the executor's
+    unbounded queue where no admission/deadline logic runs. serve.run
+    must warn."""
+    import logging
+    from types import SimpleNamespace
+
+    from ray_tpu.serve import api as serve_api
+    from ray_tpu.serve.handle import _SUBMIT_POOL
+
+    pool = _SUBMIT_POOL._max_workers
+    bad = SimpleNamespace(
+        name="oversized",
+        config=SimpleNamespace(max_queued_requests=pool))
+    good = SimpleNamespace(
+        name="ok", config=SimpleNamespace(max_queued_requests=pool - 1))
+    uncapped = SimpleNamespace(
+        name="uncapped", config=SimpleNamespace(max_queued_requests=-1))
+    with caplog.at_level(logging.WARNING, logger="ray_tpu"):
+        offenders = serve_api._warn_admission_pool_sizing(
+            [bad, good, uncapped])
+    assert offenders == ["oversized"]
+    assert any("max_queued_requests" in rec.getMessage()
+               for rec in caplog.records)

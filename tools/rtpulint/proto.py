@@ -15,11 +15,13 @@ cross-checks them:
   ``UNBOUNDED_METHODS`` / ``NON_IDEMPOTENT_METHODS`` in runtime/rpc.py);
 - the fault-plane grammar: ``SYNCPOINTS`` vs planted
   ``faults.syncpoint(...)`` sites (both AST-parsed from the package, so
-  a new plane's syncpoint — e.g. PR 13's ``serve.admission`` — must land
-  in runtime/faults.py's tuple AND as a planted call in the same
-  commit, or RTPU104 flags the half that is missing), and every
-  fault-rule string (``RTPU_FAULTS`` specs in source, tests and
-  benchmarks) vs the methods and syncpoints that actually exist;
+  a new plane's syncpoint — e.g. PR 13's ``serve.admission``, PR 15's
+  ``controller.persist`` planted mid journal-append in
+  runtime/storage.py — must land in runtime/faults.py's tuple AND as a
+  planted call in the same commit, or RTPU104 flags the half that is
+  missing), and every fault-rule string (``RTPU_FAULTS`` specs in
+  source, tests and benchmarks) vs the methods and syncpoints that
+  actually exist;
 - ``RuntimeConfig`` fields vs ``get_config().X`` reads;
 - ``rtpu_*`` metric declarations (name/type/label-set consistency).
 
