@@ -373,6 +373,17 @@ def bench_engine_sched() -> dict:
     return _run_bench_json("engine_sched.py", 420)
 
 
+def bench_broadcast_spill() -> dict:
+    """Tiered object store (benchmarks/broadcast_spill.py): replica
+    broadcast tree vs sequential owner fan-out under a modeled
+    fixed-bandwidth uplink (broadcast_gb_s / broadcast_ab_speedup,
+    >=2x asserted in-bench), spill/restore throughput through the
+    shm->disk tier API (spill_restore_mb_s), and the memory-pressure
+    drill — a put storm that must stay under the high-watermark with
+    every spilled object reading back bit-exact (spill_storm_green)."""
+    return _run_bench_json("broadcast_spill.py", 300)
+
+
 def bench_train(on_tpu: bool) -> dict:
     import jax
     import jax.numpy as jnp
@@ -582,6 +593,23 @@ def main():
                     result["detail"][key] = sched[key]
         except Exception as e:  # noqa: BLE001
             result["detail"]["engine_sched"] = {"error": repr(e)[:200]}
+
+    # 8d. tiered object store: broadcast-tree A/B under the modeled
+    # uplink, spill/restore throughput, memory-pressure storm drill
+    # (broadcast_* / spill_* keys), same time guard
+    if time.perf_counter() - start < 480:
+        try:
+            tier = bench_broadcast_spill()
+            result["detail"]["broadcast_spill"] = tier
+            for key in ("broadcast_gb_s", "broadcast_ab_speedup",
+                        "spill_restore_mb_s", "spill_storm_green"):
+                if key in tier:
+                    result["detail"][key] = tier[key]
+            if "spill_storm_green" not in tier:
+                result["detail"]["spill_storm_green"] = False
+        except Exception as e:  # noqa: BLE001
+            result["detail"]["broadcast_spill"] = {"error": repr(e)[:200]}
+            result["detail"]["spill_storm_green"] = False
 
     # 9. static analysis: rtpulint per-file rules over the WHOLE package
     # (cheap, ~2s). lint_clean records when the tree regresses on a

@@ -120,6 +120,23 @@ class RuntimeConfig:
     object_store_memory: int = 256 << 20
     object_store_fraction: float = 0.3
     object_spill_dir: str = ""  # "" = <session>/spill
+    # --- tiered object store (runtime/tiering.py) ---
+    # High watermark on shm-pool usage (fraction of pool capacity) above
+    # which the owner's SpillManager spills cold shm-resident objects to
+    # the disk tier and evicts safe (zero-borrower, spilled-or-lineaged)
+    # copies until usage drops back under it. 0 disables pressure-driven
+    # spill entirely (the pool-full put fallback still spills).
+    object_store_spill_threshold: float = 0.8
+    # Optional third tier: an fsspec URI (e.g. "s3://bucket/prefix" or
+    # "file:///mnt/ckpt") objects spill through to when configured.
+    # "" disables the URI tier; the disk tier is then terminal.
+    object_spill_uri: str = ""
+    # Shape of the broadcast replica tree (core.broadcast): 0 = the
+    # binomial ladder (every landed replica adopts one staggered child
+    # per round — population doubles each round, lands in
+    # ceil(log2(n+1)) rounds, the uplink-bound optimum); k >= 1 = the
+    # concurrent k-ary tree (2 = binary, 1 = chain/pipeline).
+    broadcast_fanout: int = 0
 
     # --- bulk data plane (cross-host object pulls; transfer.py) ---
     # master switch: False forces every pull onto the om_read RPC path

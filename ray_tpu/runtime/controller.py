@@ -207,8 +207,7 @@ class Controller:
             # nodelet-side), so actors already running inside a PG keep
             # their reservations across a controller restart
             "placement_groups": {
-                pg_id: {k: v for k, v in pg.items()
-                        if not k.startswith("_replay")}
+                pg_id: self._persistable_pg(pg)
                 for pg_id, pg in self.placement_groups.items()},
             "named_actors": {
                 f"{ns}\x00{name}": actor_id
@@ -219,6 +218,19 @@ class Controller:
                 if info.spec.get("name") and info.state != ACTOR_DEAD},
         }
         self._store_backend.save_meta(pickle.dumps(state))
+
+    @staticmethod
+    def _persistable_pg(pg: dict) -> dict:
+        """One PG entry as persisted: volatile _replay* keys stripped —
+        but a PG killed MID-RECONCILE (placement=None, original bundles
+        stashed in _replayed_placement) persists the ORIGINAL placement,
+        so a second restart's replay re-stashes it and keeps trying to
+        re-reserve the same nodelet bundles instead of leaking them
+        until PG removal (PR-15 double-restart edge)."""
+        out = {k: v for k, v in pg.items() if not k.startswith("_replay")}
+        if not out.get("placement") and pg.get("_replayed_placement"):
+            out["placement"] = pg["_replayed_placement"]
+        return out
 
     def _journal_kv(self, op: str, ns: str, key: str,
                     value: Optional[bytes] = None) -> None:

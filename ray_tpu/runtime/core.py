@@ -256,6 +256,7 @@ class CoreWorker:
         self.host_id = _get_host_id()
         self._pulls: Dict[ObjectID, asyncio.Future] = {}
         self._pull_manager = None  # lazy (transfer.PullManager)
+        self._spill_manager = None  # lazy (tiering.SpillManager)
         self._om_bulk: Dict[str, Any] = {}  # lazily-started BulkServer
         # lazily-created ChannelServer (compiled-graph cross-host edges)
         self._chan_plane: Dict[str, Any] = {}
@@ -329,8 +330,14 @@ class CoreWorker:
         }
         from .object_store import om_handlers
         from .transfer import chan_handlers
+        from . import tiering
 
         handlers.update(om_handlers(lambda: self.store, self._om_bulk))
+        # broadcast-tree landing: this process can be told to
+        # materialize an object from upstream replicas (tiering.om_pull)
+        handlers.update(tiering.pull_handlers(
+            lambda: self.store, lambda: self.pull_manager,
+            lambda: self.nodelet_addr or self.address))
         handlers.update(chan_handlers(self.session_name, self.host_id,
                                       self._chan_plane,
                                       lambda: self.address))
@@ -688,6 +695,8 @@ class CoreWorker:
         self._events.pop(oid, None)
         self.lineage.pop(oid, None)
         self._replica_dirs.pop(oid, None)
+        if self._spill_manager is not None:
+            self._spill_manager.forget(oid)
         if value is not _MISSING and value is not _IN_SHM \
                 and not isinstance(value, _RemoteShm):
             # plain inline value: the bytes never touched the shm store
@@ -817,6 +826,9 @@ class CoreWorker:
         else:
             size = self.store.put_serialized(oid, sv)
             self.memory_store[oid] = _IN_SHM
+            # tiering: track the sealed bytes and relieve pool pressure
+            # (spill+evict) if this put crossed the high watermark
+            self.spill_manager.note_sealed(oid, size)
             # advisory host accounting, symmetric with the worker-return
             # and pull-replica seal notices; _delete_object sends the
             # matching object_deleted when the bytes leave the pool
@@ -1039,6 +1051,49 @@ class CoreWorker:
             self._pull_manager = PullManager(self.client_for)
         return self._pull_manager
 
+    @property
+    def spill_manager(self):
+        """Owner-side tiering (tiering.SpillManager): pressure-driven
+        spill under the configured high-watermark plus lineage- and
+        borrower-aware eviction of shm copies."""
+        if self._spill_manager is None:
+            from .tiering import SpillManager
+
+            self._spill_manager = SpillManager(self)
+        return self._spill_manager
+
+    def broadcast(self, ref, nodes=None, *, fanout: Optional[int] = None,
+                  timeout: float = 120.0) -> dict:
+        """Land a replica of `ref`'s object on the target nodes via a
+        replica tree over the bulk data plane (tiering.broadcast_async):
+        each node that finishes its pull immediately serves its subtree,
+        so the owner uplink is paid O(log n) times instead of O(n).
+        fanout=None uses `broadcast_fanout` (0 = the staggered binomial
+        ladder, k>=1 = the concurrent k-ary tree). `nodes` = node ids
+        (None = every other alive node). Returns
+        {bytes, nodes, ok, failed, depth, seconds, gb_s, per_node}."""
+        from . import tiering
+
+        oid = ref.id() if isinstance(ref, ObjectRef) else ObjectID(ref) \
+            if isinstance(ref, bytes) else ref
+        size = self.store.size_of(oid)
+        if size is None:
+            # inline (or never-sealed) value: broadcast moves pool bytes,
+            # so land it in the pool first — same force_pool promotion the
+            # KV handoff plane uses
+            value = self.memory_store.get(oid, _MISSING)
+            if value is _MISSING or value is _IN_SHM \
+                    or isinstance(value, _RemoteShm):
+                raise exceptions.ObjectLostError(
+                    oid.hex(), "broadcast source not materialized here")
+            size = self.store.put_serialized(
+                oid, serialization.serialize(value))
+            self.memory_store[oid] = _IN_SHM
+        return EventLoopThread.get().run(
+            tiering.broadcast_async(self, oid, size, nodes=nodes,
+                                    fanout=fanout,
+                                    per_node_timeout=timeout))
+
     async def _pull_remote(self, oid: ObjectID, rs: _RemoteShm):
         """Pull an object from another host into the local pool (ref:
         object_manager/pull_manager.cc — demand-driven, per-object dedup,
@@ -1088,6 +1143,7 @@ class CoreWorker:
                 writer.abort()
                 raise
             self.memory_store[oid] = _IN_SHM
+            self.spill_manager.note_sealed(oid, size)
             self.nodelet.notify_nowait("object_sealed", oid=oid.binary(),
                                        size=size)
             if rs.owner_addr and rs.owner_addr != self.address:

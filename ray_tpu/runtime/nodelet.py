@@ -347,6 +347,7 @@ class Nodelet:
         self._factory_path = os.path.join(
             session_dir, "sock", f"factory-{node_id[:8]}.sock")
         self._store = None  # lazy: object-manager reads only
+        self._pull_manager = None  # lazy: broadcast-tree om_pull landings
         self._log_owned: set = set()  # worker log prefixes this node tails
         from .object_store import host_id as _host_id
         from .topology import detect_host_tpu
@@ -366,9 +367,16 @@ class Nodelet:
         from .object_store import host_id as _host_id
         from .object_store import om_handlers
         from .transfer import chan_handlers
+        from . import tiering
 
         self._om_bulk = {}  # lazily-started bulk stream server
         handlers = om_handlers(lambda: self.store, self._om_bulk)
+        # broadcast-tree landing (tiering.om_pull): the nodelet can be
+        # told to materialize an object into the host pool from upstream
+        # replicas and then serve its subtree from the same om/bulk tier
+        handlers.update(tiering.pull_handlers(
+            lambda: self.store, self._get_pull_manager,
+            lambda: self.address))
         # compiled-graph channel tier: the nodelet advertises the same
         # chan_endpoint/chan_push surface as workers (rings are host
         # shm files, so the host agent can serve any local consumer)
@@ -2103,6 +2111,16 @@ class Nodelet:
             self._store = make_store_client(self.session_name)
         return self._store
 
+    def _get_pull_manager(self):
+        """Receiver side of broadcast-tree landings (tiering.om_pull):
+        the nodelet pulls straight into the host pool over the bulk
+        plane, reusing the pooled peer-nodelet RPC links."""
+        if self._pull_manager is None:
+            from .transfer import PullManager
+
+            self._pull_manager = PullManager(self._peer_client)
+        return self._pull_manager
+
     async def object_sealed(self, oid: bytes, size: int):
         self.object_bytes += size
         return True
@@ -2128,6 +2146,10 @@ class Nodelet:
             "spill_hops_hist": dict(self.spill_hops_hist),
             "cluster_view": {nid: v.version
                              for nid, v in self.cluster_view.items()},
+            # tier occupancy of this host's pool (shm used/capacity,
+            # disk-tier bytes/objects) — the tiering plane's per-node
+            # observability surface
+            "tiering": _tier_stats_safe(self._store),
             # active fault rules + per-rule seen/fired counters, so
             # drills can assert an injection actually happened
             "faults": faults.get_plane().snapshot(),
@@ -2145,6 +2167,20 @@ class Nodelet:
             for key, value in snap.items():
                 out[key] = out.get(key, 0.0) + value  # counters sum
         return out
+
+
+def _tier_stats_safe(store) -> dict:
+    """tiering.tier_stats over the LAZY store handle: a node that never
+    touched the object plane reports {} instead of instantiating a pool
+    just to measure it empty."""
+    if store is None:
+        return {}
+    try:
+        from .tiering import tier_stats
+
+        return tier_stats(store)
+    except Exception:  # rtpulint: ignore[RTPU006] — observability probe; a torn-down pool must not fail get_node_info
+        return {}
 
 
 def _serve_metrics_snapshot() -> Dict[str, float]:

@@ -18,15 +18,19 @@ from __future__ import annotations
 
 import collections
 import itertools
+import logging
 import mmap
 import os
 import pickle
+import shutil
 import struct
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from .ids import ObjectID
 from . import serialization
+
+logger = logging.getLogger(__name__)
 
 try:
     from .._native import OutOfMemory
@@ -59,6 +63,36 @@ def _shm_dir(session_name: str) -> str:
     return os.path.join(root, f"rtpu_{session_name}")
 
 
+# RAM-backed filesystem magics (statfs f_type): spilling there defeats
+# the disk tier — the "spilled" bytes still live in host memory.
+_TMPFS_MAGIC = 0x01021994
+_RAMFS_MAGIC = 0x858458F6
+_warned_spill_roots: set = set()
+
+
+def _fs_magic(path: str) -> Optional[int]:
+    """statfs(2) f_type of the nearest existing ancestor of ``path``
+    (the spill dir itself usually does not exist yet), or None when the
+    probe is unavailable (non-Linux, no libc)."""
+    probe = os.path.abspath(path)
+    while probe and not os.path.exists(probe):
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            break
+        probe = parent
+    try:
+        import ctypes
+
+        libc = ctypes.CDLL(None, use_errno=True)
+        buf = ctypes.create_string_buffer(256)
+        if libc.statfs(probe.encode(), buf) != 0:
+            return None
+        # struct statfs leads with __fsword_t f_type (a signed long)
+        return struct.unpack_from("l", buf.raw, 0)[0] & 0xFFFFFFFF
+    except Exception:  # rtpulint: ignore[RTPU006] — tmpfs probe is advisory; any failure just skips the warning
+        return None
+
+
 def _spill_dir(session_name: str) -> str:
     """Disk tier for objects that do not fit the shm pool (ref:
     local_object_manager.h:112 SpillObjects — here a transparent
@@ -72,6 +106,14 @@ def _spill_dir(session_name: str) -> str:
     cfg_dir = get_config().object_spill_dir
     root = (cfg_dir or os.environ.get("RTPU_SPILL_ROOT")
             or f"/tmp/ray_tpu/{session_name}/spill")
+    if root not in _warned_spill_roots:
+        _warned_spill_roots.add(root)
+        if _fs_magic(root) in (_TMPFS_MAGIC, _RAMFS_MAGIC):
+            logger.warning(
+                "object spill directory %r is on a RAM-backed filesystem "
+                "(tmpfs/ramfs): the disk tier will spill into memory, not "
+                "disk. Point object_spill_dir (RuntimeConfig) or the "
+                "RTPU_SPILL_ROOT env var at a real disk.", root)
     return os.path.join(root, f"rtpu_{session_name}")
 
 
@@ -187,14 +229,55 @@ class ObjectStoreClient:
     moral equivalent of plasma client Release; ref: plasma/client.cc).
     """
 
-    def __init__(self, session_name: str, root: Optional[str] = None):
+    def __init__(self, session_name: str, root: Optional[str] = None,
+                 uri_fallback: bool = False):
         self.session_name = session_name
+        # no explicit root = the shm primary tier; an explicit root is a
+        # spill (disk) tier client, which may in turn fall back to the
+        # fsspec URI tier (tiering.py) on a local miss
+        self._is_primary = root is None
+        self._uri_fallback = uri_fallback
         self._root = root or _shm_dir(session_name)
+        self._spill: Optional["ObjectStoreClient"] = None
         self._pinned: Dict[ObjectID, _Segment] = {}
         self._fds = _FdCache()  # object-manager read tier (read_range)
 
     def _path(self, oid: ObjectID) -> str:
         return os.path.join(self._root, oid.hex())
+
+    @property
+    def spill(self) -> "ObjectStoreClient":
+        """Disk tier under this primary (mirrors the native client's
+        spill property so both store flavors speak the same tier API)."""
+        if self._spill is None:
+            self._spill = ObjectStoreClient(
+                self.session_name, root=_spill_dir(self.session_name),
+                uri_fallback=True)
+        return self._spill
+
+    def _maybe_uri_restore(self, oid: ObjectID) -> None:
+        """Disk-tier miss: restore the object from the fsspec URI tier
+        into this tier's file (atomic), when a URI tier is configured."""
+        if not self._uri_fallback or os.path.exists(self._path(oid)):
+            return
+        from . import tiering
+
+        ut = tiering.get_uri_tier(self.session_name)
+        if ut is not None and ut.contains(oid):
+            ut.restore_into(oid, self._path(oid))
+
+    def push_uri(self, oid: ObjectID) -> bool:
+        """Upload this tier's copy to the fsspec URI tier; False when no
+        URI tier is configured or the object is absent locally."""
+        if not self._uri_fallback:
+            return False
+        from . import tiering
+
+        ut = tiering.get_uri_tier(self.session_name)
+        if ut is None or not os.path.exists(self._path(oid)):
+            return False
+        ut.upload(oid, self._path(oid))
+        return True
 
     # ---- write path ----
     def put_serialized(self, oid: ObjectID, sv: serialization.SerializedValue) -> int:
@@ -209,6 +292,12 @@ class ObjectStoreClient:
             header_tail += struct.pack(">QQ", cursor, len(raw))
             cursor = _aligned(cursor + len(raw))
         total = cursor
+        if self._is_primary and total > pool_capacity(self.session_name):
+            # larger than the whole shm pool could ever hold: land it on
+            # the disk tier directly (the native client's OutOfMemory
+            # fallback, priced up front — tmpfs has no allocator to say no
+            # until the write faults)
+            return self.spill.put_serialized(oid, sv)
         seg = _Segment.create(self._path(oid), max(total, 1))
         mv = memoryview(seg.mm)
         pos = 0
@@ -226,14 +315,29 @@ class ObjectStoreClient:
 
     # ---- read path ----
     def contains(self, oid: ObjectID) -> bool:
-        return os.path.exists(self._path(oid))
+        if os.path.exists(self._path(oid)):
+            return True
+        if self._is_primary:
+            return self.spill.contains(oid)
+        if self._uri_fallback:
+            from . import tiering
+
+            ut = tiering.get_uri_tier(self.session_name)
+            return ut is not None and ut.contains(oid)
+        return False
 
     def get(self, oid: ObjectID) -> Any:
         """Zero-copy deserialize. The segment stays pinned in this process
         until `release(oid)` (views may alias the mmap)."""
         seg = self._pinned.get(oid)
         if seg is None:
-            seg = _Segment.open(self._path(oid))
+            self._maybe_uri_restore(oid)
+            try:
+                seg = _Segment.open(self._path(oid))
+            except FileNotFoundError:
+                if not self._is_primary:
+                    raise
+                return self.spill.get(oid)
             self._pinned[oid] = seg
         mv = memoryview(seg.mm)
         (meta_len,) = _HDR.unpack_from(mv, 0)
@@ -261,6 +365,8 @@ class ObjectStoreClient:
             except BufferError:
                 # views still alive in this process; keep pinned
                 self._pinned[oid] = seg
+        elif self._spill is not None:
+            self._spill.release(oid)
 
     def delete(self, oid: ObjectID):
         self.release(oid)
@@ -269,17 +375,33 @@ class ObjectStoreClient:
             os.unlink(self._path(oid))
         except FileNotFoundError:
             pass
+        if self._is_primary:
+            self.spill.delete(oid)
 
     def size_of(self, oid: ObjectID) -> Optional[int]:
         try:
             return os.stat(self._path(oid)).st_size
         except FileNotFoundError:
+            if self._is_primary:
+                return self.spill.size_of(oid)
+            if self._uri_fallback:
+                from . import tiering
+
+                ut = tiering.get_uri_tier(self.session_name)
+                if ut is not None:
+                    return ut.size_of(oid)
             return None
 
     # ---- node-to-node transfer (object-manager tier; ref:
     # src/ray/object_manager/object_manager.h:119 chunked push/pull) ----
     def read_range(self, oid: ObjectID, offset: int, length: int) -> bytes:
-        f = self._fds.acquire(self._path(oid))  # FileNotFoundError if gone
+        self._maybe_uri_restore(oid)
+        try:
+            f = self._fds.acquire(self._path(oid))  # gone: FileNotFoundError
+        except FileNotFoundError:
+            if not self._is_primary:
+                raise
+            return self.spill.read_range(oid, offset, length)
         return os.pread(f.fileno(), length, offset)
 
     def acquire_range(self, oid: ObjectID):
@@ -289,16 +411,109 @@ class ObjectStoreClient:
         the cache entry) closes the cached fd, and an async sendfile
         mid-body must keep a valid descriptor — the dup'd fd serves the
         in-flight range to completion even if the file is unlinked."""
+        self._maybe_uri_restore(oid)
         try:
             f = self._fds.acquire(self._path(oid))
             dupf = os.fdopen(os.dup(f.fileno()), "rb")
         except FileNotFoundError:
+            if self._is_primary:
+                return self.spill.acquire_range(oid)
             return None
         size = os.fstat(dupf.fileno()).st_size
         return (dupf, 0, size, dupf.close)
 
     def create_for_ingest(self, oid: ObjectID, size: int) -> "_FileIngest":
+        if self._is_primary and size > pool_capacity(self.session_name):
+            return self.spill.create_for_ingest(oid, size)
         return _FileIngest(self._path(oid), size)
+
+    # ---- tier API (runtime/tiering.py drives these) ----
+    def tier_of(self, oid: ObjectID) -> Optional[str]:
+        """Which tier holds a LOCAL copy: "shm" | "disk" | "uri" | None.
+        Unlike contains(), reports the highest tier only (no fall-through
+        semantics) so the SpillManager can tell resident from spilled."""
+        if os.path.exists(self._path(oid)):
+            return "shm" if self._is_primary else "disk"
+        if self._is_primary:
+            return self.spill.tier_of(oid)
+        if self._uri_fallback:
+            from . import tiering
+
+            ut = tiering.get_uri_tier(self.session_name)
+            if ut is not None and ut.contains(oid):
+                return "uri"
+        return None
+
+    def spill_object(self, oid: ObjectID) -> Optional[int]:
+        """Ensure a disk-tier copy exists (shm copy stays — eviction is a
+        separate, refusable step). Returns the object size, or None when
+        the object is nowhere local."""
+        if not self._is_primary:
+            return None
+        src = self._path(oid)
+        try:
+            size = os.stat(src).st_size
+        except FileNotFoundError:
+            return self.spill.size_of(oid)  # already disk-only (or gone)
+        dst = self.spill._path(oid)
+        if not os.path.exists(dst):
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            tmp = f"{dst}.tmp.{os.getpid()}.{next(_tmp_ids)}"
+            shutil.copyfile(src, tmp)
+            os.rename(tmp, dst)
+        return size
+
+    def evict_shm(self, oid: ObjectID) -> bool:
+        """Drop the shm copy ONLY (disk/URI copies and lineage survive).
+        The caller (SpillManager.evict) is responsible for the safety
+        check — zero borrowers, restorable from a lower tier or lineage."""
+        if not self._is_primary:
+            return False
+        path = self._path(oid)
+        self._fds.drop(path)
+        try:
+            os.unlink(path)
+            return True
+        except FileNotFoundError:
+            return False
+
+    def restore(self, oid: ObjectID) -> Optional[int]:
+        """Promote disk (or URI) tier copy back into shm; keeps the lower
+        tier copy so a later eviction is free. Returns the size, or None
+        when no lower-tier copy exists."""
+        if not self._is_primary:
+            return None
+        dst = self._path(oid)
+        try:
+            return os.stat(dst).st_size  # already resident
+        except FileNotFoundError:
+            pass
+        self.spill._maybe_uri_restore(oid)
+        src = self.spill._path(oid)
+        try:
+            size = os.stat(src).st_size
+        except FileNotFoundError:
+            return None
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        tmp = f"{dst}.tmp.{os.getpid()}.{next(_tmp_ids)}"
+        shutil.copyfile(src, tmp)
+        os.rename(tmp, dst)
+        return size
+
+    def shm_usage(self) -> Tuple[int, int]:
+        """(used_bytes, capacity) of the primary tier."""
+        used = 0
+        try:
+            with os.scandir(self._root) as it:
+                for entry in it:
+                    try:
+                        if entry.is_file(follow_symlinks=False):
+                            used += entry.stat().st_size
+                    except OSError:
+                        pass
+        except FileNotFoundError:
+            pass
+        return used, pool_capacity(self.session_name)
 
 
 
@@ -427,7 +642,8 @@ class NativeObjectStoreClient:
         a working set larger than the pool degrades instead of failing."""
         if self._spill is None:
             self._spill = ObjectStoreClient(
-                self.session_name, root=_spill_dir(self.session_name))
+                self.session_name, root=_spill_dir(self.session_name),
+                uri_fallback=True)
         return self._spill
 
     # ---- write path ----
@@ -597,6 +813,88 @@ class NativeObjectStoreClient:
         except OutOfMemory:
             return self.spill.create_for_ingest(oid, size)
         return _PoolIngest(self._pool, key, mv)
+
+    # ---- tier API (runtime/tiering.py drives these) ----
+    def tier_of(self, oid: ObjectID) -> Optional[str]:
+        """Which tier holds a LOCAL copy: "shm" | "disk" | "uri" | None."""
+        if self._pool.contains(self._key(oid)):
+            return "shm"
+        return self.spill.tier_of(oid)
+
+    def spill_object(self, oid: ObjectID) -> Optional[int]:
+        """Copy the pool-resident object down to the disk tier (the pool
+        copy stays; eviction is the separate, refusable step). Returns
+        the object size, or None when the object is nowhere local."""
+        key = self._key(oid)
+        raw = self._pool.get_raw(key)  # bumps refcount: pins across copy
+        if raw is None:
+            return self.spill.size_of(oid)  # already disk-only (or gone)
+        try:
+            file_off, size = raw
+            dst = self.spill._path(oid)
+            if not os.path.exists(dst):
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                tmp = f"{dst}.tmp.{os.getpid()}.{next(_tmp_ids)}"
+                with open(tmp, "wb") as f:
+                    off = 0
+                    while off < size:
+                        n = min(8 << 20, size - off)
+                        f.write(os.pread(self._fd, n, file_off + off))
+                        off += n
+                os.rename(tmp, dst)
+        finally:
+            self._pool.release(key)
+        return size
+
+    def evict_shm(self, oid: ObjectID) -> bool:
+        """Drop the pool copy ONLY (disk/URI copies and lineage survive).
+        Safety (zero borrowers, restorable) is the caller's contract."""
+        key = self._key(oid)
+        if not self._pool.contains(key):
+            return False
+        try:
+            self._pool.delete(key)
+        except Exception:  # rtpulint: ignore[RTPU006] — a referenced entry goes pending-delete instead; treat as not evicted
+            return False
+        return not self._pool.contains(key)
+
+    def restore(self, oid: ObjectID) -> Optional[int]:
+        """Promote the disk (or URI) copy back into the pool; keeps the
+        lower-tier copy. Returns the size; None when no lower-tier copy
+        exists or the pool cannot fit it right now."""
+        key = self._key(oid)
+        raw = self._pool.get_raw(key)
+        if raw is not None:
+            self._pool.release(key)
+            return raw[1]  # already resident
+        self.spill._maybe_uri_restore(oid)
+        src = self.spill._path(oid)
+        try:
+            size = os.stat(src).st_size
+        except FileNotFoundError:
+            return None
+        try:
+            mv = self._pool.create(key, max(size, 1))
+        except FileExistsError:
+            return size  # concurrent restore won
+        except OutOfMemory:
+            return None  # pool still too hot; serve from disk meanwhile
+        with open(src, "rb") as f:
+            off = 0
+            while off < size:
+                chunk = f.read(min(8 << 20, size - off))
+                if not chunk:
+                    break
+                mv[off:off + len(chunk)] = chunk
+                off += len(chunk)
+        mv.release()
+        self._pool.seal(key)
+        return size
+
+    def shm_usage(self) -> Tuple[int, int]:
+        """(used_bytes, capacity) of the primary (pool) tier."""
+        st = self._pool.stats()
+        return int(st["used_bytes"]), int(st["capacity"])
 
 
 class _PoolIngest:

@@ -94,8 +94,12 @@ IDEMPOTENT_METHODS = frozenset({
 })
 
 # long-poll methods whose wait is the PRODUCT, not a failure: no default
-# deadline (explicit _timeout still applies)
-UNBOUNDED_METHODS = frozenset({"fetch_object", "c_get", "c_wait"})
+# deadline (explicit _timeout still applies). om_pull (broadcast-tree
+# landing) runs a whole multi-chunk transfer inside one call — its
+# duration is the object size over the fabric, and broadcast_async
+# always passes an explicit per-node _timeout.
+UNBOUNDED_METHODS = frozenset({"fetch_object", "c_get", "c_wait",
+                               "om_pull"})
 
 # Methods whose handlers have at-most-once side effects: NEVER retried
 # transparently — a retried-but-executed frame double-runs user code,

@@ -536,10 +536,29 @@ def backend_for(persist_dir: str) -> StoreBackend:
 
 def serve_store(directory: str, address: str):
     """Store server: FileBackend fronted by RPC handlers. Returns the
-    RpcServer (already started on the shared loop thread)."""
+    RpcServer (already started on the shared loop thread).
+
+    Runs its own periodic flush on the controller health-sweep cadence
+    (heartbeat_interval_s): under persist_fsync="batch" journal appends
+    defer their fsync to flush(), and a STANDALONE store server has no
+    controller health loop to drive it — without this, "batch" on the
+    TCP backend silently meant "off" (PR-15 known gap)."""
+    import asyncio
+
+    from .config import get_config
     from .rpc import EventLoopThread, RpcServer
 
     backend = FileBackend(directory)
+
+    async def _flush_loop():
+        while True:
+            await asyncio.sleep(
+                max(0.05, get_config().heartbeat_interval_s))
+            try:
+                await asyncio.get_event_loop().run_in_executor(
+                    None, backend.flush)
+            except Exception:  # rtpulint: ignore[RTPU006] — a failed batch fsync retries next beat; appends already hit the OS
+                pass
 
     async def st_save_meta(blob: bytes, seq: int = 0):
         backend.save_meta(blob)
@@ -568,6 +587,10 @@ def serve_store(directory: str, address: str):
         "st_compact_kv": st_compact_kv, "ping": ping,
     })
     EventLoopThread.get().run(server.start())
+    # exposed for tests/shutdown: the flush task is cancellable and the
+    # backend reachable without reparsing the handler closure
+    server._store_backend = backend
+    server._store_flush_task = EventLoopThread.get().spawn(_flush_loop())
     return server
 
 

@@ -1,0 +1,592 @@
+"""Tiered object store: spill, eviction, and replica broadcast trees.
+
+The object plane's storage model (ref: local_object_manager.h:112
+SpillObjects, object_manager.cc PushManager) as an explicit subsystem
+instead of the silent pool-full fallback object_store.py started with:
+
+- **Tier model** — shm (primary pool) → local disk (`_spill_dir`) →
+  optional fsspec URI (`object_spill_uri`). Per-object tier state is the
+  owner's to track (`SpillManager.tier view via store.tier_of`); a
+  spilled object stays readable through every store entry point
+  (get/read_range/acquire_range fall through tier by tier), so a pull of
+  a spilled object streams straight off the disk tier through the
+  BulkServer chunk path — no rehydrate-first.
+- **Pressure-driven spill + eviction** — when shm-pool usage crosses
+  `object_store_spill_threshold`, the owner's SpillManager copies cold
+  objects down a tier in the background and then evicts the shm copy of
+  objects that are SAFE to drop: zero borrower refs AND (a spilled copy
+  OR recorded lineage). `ObjectLostError` → lineage reconstruction
+  (core._recover) remains the backstop for anything evicted on lineage
+  alone.
+- **Broadcast trees** — `core.broadcast(ref, nodes)` drives the `om_pull`
+  RPC over a fanout tree: each target that lands a replica immediately
+  serves its subtree (its nodelet runs the om/bulk tier), turning O(n)
+  sequential owner fan-out into O(log n) depth. Landed replicas are
+  seeded into the owner's `_replica_dirs`, so later point pulls stripe
+  across them too (`_route_source`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .config import get_config
+from .ids import ObjectID
+from .object_store import host_id
+
+logger = logging.getLogger(__name__)
+
+TIER_SHM = "shm"
+TIER_DISK = "disk"
+TIER_URI = "uri"
+
+# ---------------------------------------------------------------- metrics
+_metrics = None
+
+
+def _get_metrics():
+    global _metrics
+    if _metrics is None:
+        from ..util.metrics import Counter, Gauge
+
+        _metrics = {
+            "spill_bytes": Counter(
+                "rtpu_spill_bytes_total",
+                "bytes copied from the shm tier down to the disk tier"),
+            "spill_objects": Counter(
+                "rtpu_spill_objects_total",
+                "objects spilled shm -> disk"),
+            "restore_bytes": Counter(
+                "rtpu_spill_restore_bytes_total",
+                "bytes promoted from a lower tier back into shm"),
+            "evictions": Counter(
+                "rtpu_spill_evictions_total",
+                "shm copies dropped under memory pressure"),
+            "refused": Counter(
+                "rtpu_spill_refused_total",
+                "evictions refused (borrowed or not restorable)"),
+            "serve_bytes": Counter(
+                "rtpu_spill_serve_bytes_total",
+                "bytes served to pullers straight off a spilled copy"),
+            "usage_ratio": Gauge(
+                "rtpu_spill_shm_usage_ratio",
+                "shm pool usage as a fraction of capacity"),
+            "bcast_bytes": Counter(
+                "rtpu_broadcast_bytes_total",
+                "object bytes landed on replicas by broadcast trees"),
+            "bcast_nodes": Counter(
+                "rtpu_broadcast_nodes_total",
+                "replicas landed by broadcast trees"),
+            "bcast_depth": Gauge(
+                "rtpu_broadcast_depth",
+                "tree depth of the most recent broadcast"),
+            "bcast_gb_s": Gauge(
+                "rtpu_broadcast_gb_s",
+                "aggregate throughput of the most recent broadcast"),
+        }
+    else:
+        # A metrics-registry wipe (e.g. `metrics._reset_for_tests`) would
+        # orphan this module-level cache: increments keep landing on the
+        # cached Counter objects while snapshot()/exposition read a
+        # registry that no longer knows them. Re-attach the cached series
+        # so the tiering counters stay visible across a wipe.
+        from ..util import metrics as _metrics_mod
+
+        with _metrics_mod._registry_lock:
+            for metric in _metrics.values():
+                _metrics_mod._registry.setdefault(metric.name, metric)
+    return _metrics
+
+
+# ---------------------------------------------------------------- URI tier
+class UriTier:
+    """Third tier behind the local disk: any fsspec filesystem
+    (s3://, gs://, file://, ...). Strictly optional — constructed only
+    when `object_spill_uri` is set AND fsspec imports."""
+
+    def __init__(self, uri: str, session_name: str):
+        import fsspec  # gated: absence disables the tier, never errors
+
+        self._fs, root = fsspec.core.url_to_fs(uri)
+        self._root = root.rstrip("/") + f"/rtpu_{session_name}"
+
+    def _key(self, oid: ObjectID) -> str:
+        return f"{self._root}/{oid.hex()}"
+
+    def contains(self, oid: ObjectID) -> bool:
+        try:
+            return bool(self._fs.exists(self._key(oid)))
+        except Exception:  # rtpulint: ignore[RTPU006] — an unreachable remote tier reads as a miss, not an error
+            return False
+
+    def size_of(self, oid: ObjectID) -> Optional[int]:
+        try:
+            return int(self._fs.size(self._key(oid)))
+        except Exception:  # rtpulint: ignore[RTPU006] — missing/unreachable key: same None as a local miss
+            return None
+
+    def upload(self, oid: ObjectID, path: str) -> None:
+        self._fs.makedirs(self._root, exist_ok=True)
+        self._fs.put_file(path, self._key(oid))
+
+    def restore_into(self, oid: ObjectID, path: str) -> None:
+        """Download into `path` atomically (tmp + rename) so concurrent
+        restorers and readers never observe a torn file."""
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.uri.{os.getpid()}"
+        self._fs.get_file(self._key(oid), tmp)
+        os.rename(tmp, path)
+
+    def delete(self, oid: ObjectID) -> None:
+        try:
+            self._fs.rm_file(self._key(oid))
+        except Exception:  # rtpulint: ignore[RTPU006] — double-delete of a remote key is a no-op
+            pass
+
+
+_uri_tiers: Dict[Tuple[str, str], Optional[UriTier]] = {}
+_uri_lock = threading.Lock()
+
+
+def get_uri_tier(session_name: str) -> Optional[UriTier]:
+    """The session's URI tier, or None when `object_spill_uri` is unset
+    or fsspec is unavailable. Cached per (session, uri) so a config
+    change takes effect live."""
+    uri = get_config().object_spill_uri
+    if not uri:
+        return None
+    key = (session_name, uri)
+    with _uri_lock:
+        if key not in _uri_tiers:
+            try:
+                _uri_tiers[key] = UriTier(uri, session_name)
+            except Exception as e:  # rtpulint: ignore[RTPU006] — no fsspec/bad URI: tier disabled, warn once
+                logger.warning("URI tier %r unavailable: %r", uri, e)
+                _uri_tiers[key] = None
+        return _uri_tiers[key]
+
+
+def _dir_bytes(path: str) -> Tuple[int, int]:
+    """(bytes, files) under a tier directory; 0s when it does not exist."""
+    total = count = 0
+    try:
+        with os.scandir(path) as it:
+            for entry in it:
+                try:
+                    if entry.is_file(follow_symlinks=False):
+                        total += entry.stat().st_size
+                        count += 1
+                except OSError:
+                    pass
+    except FileNotFoundError:
+        pass
+    return total, count
+
+
+def tier_stats(store) -> dict:
+    """Tier occupancy snapshot for get_node_info (nodelet reporting)."""
+    usage = getattr(store, "shm_usage", None)
+    if usage is None:
+        return {}
+    used, cap = usage()
+    out = {"shm_used_bytes": int(used), "shm_capacity": int(cap)}
+    spill = getattr(store, "spill", None)
+    if spill is not None:
+        disk_bytes, disk_objects = _dir_bytes(spill._root)
+        out["disk_bytes"] = disk_bytes
+        out["disk_objects"] = disk_objects
+    stats = getattr(store, "stats", None)
+    if callable(stats):
+        out["pool_evictions"] = int(stats().get("evictions", 0))
+    return out
+
+
+# ------------------------------------------------------------ spill manager
+class SpillManager:
+    """Owner-side pressure valve over the primary tier.
+
+    Event-driven, not polled: every seal (put / pull-ingest) calls
+    `note_sealed`, which kicks an async spill pass iff usage crossed the
+    high watermark. The pass walks the owner's LRU of shm-resident owned
+    objects oldest-first — preferring victims other replicas already
+    serve (the PR-6 locality directory makes those bytes cheap to shed) —
+    spills any victim with neither a lower-tier copy nor lineage, then
+    evicts the shm copy of everything SAFE: zero borrower refs and
+    restorable (spilled copy or recorded lineage)."""
+
+    def __init__(self, core):
+        self.core = core
+        self._lock = threading.Lock()
+        # shm-resident owned objects, oldest first (LRU on seal/restore)
+        self._lru: "collections.OrderedDict[ObjectID, int]" = \
+            collections.OrderedDict()
+        self._pass_inflight = False
+        self._counters = {"spilled": 0, "spilled_bytes": 0, "evicted": 0,
+                          "restored": 0, "refused": 0, "passes": 0}
+
+    # ---- bookkeeping (any thread) ----
+    def note_sealed(self, oid: ObjectID, size: int) -> None:
+        with self._lock:
+            self._lru[oid] = size
+            self._lru.move_to_end(oid)
+        self.maybe_spill()
+
+    def note_access(self, oid: ObjectID) -> None:
+        with self._lock:
+            if oid in self._lru:
+                self._lru.move_to_end(oid)
+
+    def forget(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._lru.pop(oid, None)
+
+    # ---- pressure ----
+    @property
+    def threshold(self) -> float:
+        return get_config().object_store_spill_threshold
+
+    def usage(self) -> float:
+        fn = getattr(self.core.store, "shm_usage", None)
+        if fn is None:
+            return 0.0
+        used, cap = fn()
+        ratio = (used / cap) if cap else 0.0
+        _get_metrics()["usage_ratio"].set(ratio)
+        return ratio
+
+    def maybe_spill(self) -> None:
+        """Kick one background spill pass when over the watermark.
+        Callable from any thread; collapses concurrent kicks into the
+        single in-flight pass."""
+        thr = self.threshold
+        if thr <= 0 or self.usage() <= thr:
+            return
+        with self._lock:
+            if self._pass_inflight:
+                return
+            self._pass_inflight = True
+        from .rpc import EventLoopThread
+
+        try:
+            EventLoopThread.get().spawn(self._spill_pass())
+        except Exception:  # rtpulint: ignore[RTPU006] — loop torn down (shutdown): pressure relief is moot
+            with self._lock:
+                self._pass_inflight = False
+
+    async def _spill_pass(self) -> None:
+        loop = asyncio.get_event_loop()
+        core = self.core
+        store = core.store
+        m = _get_metrics()
+        try:
+            self._counters["passes"] += 1
+            while True:
+                thr = self.threshold
+                if thr <= 0 or self.usage() <= thr:
+                    return
+                with self._lock:
+                    order = list(self._lru)
+                # locality-aware victim order: objects the broadcast/pull
+                # directory shows replicated elsewhere first, then LRU age
+                order.sort(key=lambda o: 0 if core._replica_dirs.get(o)
+                           else 1)
+                progressed = False
+                for oid in order:
+                    thr = self.threshold
+                    if thr <= 0 or self.usage() <= thr:
+                        return
+                    if core.borrows.get(oid):
+                        continue  # borrower-pinned: never evictable
+                    if store.tier_of(oid) != TIER_SHM:
+                        self.forget(oid)  # already left shm behind our back
+                        continue
+                    if not (store.spill.tier_of(oid) is not None
+                            or oid in core.lineage):
+                        size = await loop.run_in_executor(
+                            None, store.spill_object, oid)
+                        if size:
+                            progressed = True
+                            self._counters["spilled"] += 1
+                            self._counters["spilled_bytes"] += size
+                            m["spill_objects"].inc()
+                            m["spill_bytes"].inc(size)
+                            if get_config().object_spill_uri:
+                                await loop.run_in_executor(
+                                    None, store.spill.push_uri, oid)
+                    if self.evict(oid):
+                        progressed = True
+                if not progressed:
+                    return  # nothing left that is safe to shed
+        finally:
+            with self._lock:
+                self._pass_inflight = False
+
+    # ---- eviction ----
+    def evictable(self, oid: ObjectID) -> bool:
+        """Zero borrower refs AND restorable: a spilled (disk/URI) copy
+        exists, or lineage is recorded so core._recover can rebuild it."""
+        if self.core.borrows.get(oid):
+            return False
+        store = self.core.store
+        return (store.spill.tier_of(oid) is not None
+                or oid in self.core.lineage)
+
+    def evict(self, oid: ObjectID) -> bool:
+        """Drop the shm copy; refuses (False + metric) when unsafe."""
+        if not self.evictable(oid):
+            self._counters["refused"] += 1
+            _get_metrics()["refused"].inc()
+            return False
+        store = self.core.store
+        size = None
+        try:
+            size = store.size_of(oid)
+        except Exception:  # rtpulint: ignore[RTPU006] — size probe races the eviction it precedes; accounting is advisory
+            pass
+        if not store.evict_shm(oid):
+            return False
+        self.forget(oid)
+        self._counters["evicted"] += 1
+        _get_metrics()["evictions"].inc()
+        if size and self.core.nodelet is not None:
+            try:  # host accounting: the bytes left the pool
+                self.core.nodelet.notify_nowait(
+                    "object_deleted", oid=oid.binary(), size=size)
+            except Exception:  # rtpulint: ignore[RTPU006] — advisory accounting on a shutdown path
+                pass
+        return True
+
+    def restore(self, oid: ObjectID) -> Optional[int]:
+        """Promote a spilled copy back into shm (keeps the lower-tier
+        copy so the next eviction is free)."""
+        size = self.core.store.restore(oid)
+        if size:
+            self._counters["restored"] += 1
+            _get_metrics()["restore_bytes"].inc(size)
+            with self._lock:
+                self._lru[oid] = size
+                self._lru.move_to_end(oid)
+            if self.core.nodelet is not None:
+                try:
+                    self.core.nodelet.notify_nowait(
+                        "object_sealed", oid=oid.binary(), size=size)
+                except Exception:  # rtpulint: ignore[RTPU006] — advisory accounting on a shutdown path
+                    pass
+        return size
+
+    def stats(self) -> dict:
+        out = dict(self._counters)
+        out["tracked"] = len(self._lru)
+        out["usage"] = round(self.usage(), 4)
+        return out
+
+
+# ------------------------------------------------------------ broadcast
+def tree_parents(n: int, fanout: int = 2) -> List[Optional[int]]:
+    """Parent index for each of `n` broadcast targets; None = the owner.
+    A k-ary forest rooted at the owner: the first `fanout` targets pull
+    from the owner, target i >= fanout pulls from target i//fanout - 1.
+    fanout=1 degenerates to a chain (pipeline), fanout=2 is the binary
+    tree (depth ceil(log2(n+1)))."""
+    fanout = max(1, int(fanout))
+    return [None if i < fanout else i // fanout - 1 for i in range(n)]
+
+
+def binomial_parents(n: int) -> List[Optional[int]]:
+    """Parent index per target for the binomial broadcast ladder; None =
+    the owner. Target i is rank i+1 (the owner is rank 0); rank r pulls
+    from rank r - 2**floor(log2(r)) — in round k every already-landed
+    replica (owner included) adopts exactly ONE new child, so all n
+    targets land in ceil(log2(n+1)) rounds and no uplink ever serves
+    two children at once (broadcast_async staggers siblings for this
+    shape). Strictly better than the k-ary tree when landing time is
+    uplink-bound: the replica population doubles every round instead of
+    growing by the leaf layer."""
+    out: List[Optional[int]] = []
+    for i in range(n):
+        r = i + 1
+        p = r - (1 << (r.bit_length() - 1))
+        out.append(None if p == 0 else p - 1)
+    return out
+
+
+def _tree_depth(parents: List[Optional[int]]) -> int:
+    depth = [0] * len(parents)
+    out = 0
+    for i, p in enumerate(parents):
+        depth[i] = 1 if p is None else depth[p] + 1
+        out = max(out, depth[i])
+    return out
+
+
+def pull_handlers(get_store, get_pull_manager, serve_addr) -> dict:
+    """The receiver half of the broadcast tree: `om_pull` tells a node
+    "materialize this object from these sources" — once sealed, the
+    node's own om/bulk tier serves its subtree. Registered by every
+    process that runs the om tier (nodelets and owners)."""
+
+    async def om_pull(oid: bytes, size: int, sources: list):
+        obj_id = ObjectID(oid)
+        store = get_store()
+        t0 = time.perf_counter()
+        if not store.contains(obj_id):
+            try:
+                writer = store.create_for_ingest(obj_id, size)
+            except FileExistsError:
+                # concurrent ingest of the same object on this host
+                # (a point pull racing the broadcast): wait for its seal
+                deadline = time.monotonic() + 120.0
+                while not store.contains(obj_id):
+                    if time.monotonic() > deadline:
+                        raise
+                    await asyncio.sleep(0.02)
+            else:
+                try:
+                    await get_pull_manager().pull(
+                        obj_id, size, [tuple(s) for s in sources], writer)
+                    writer.seal()
+                except BaseException:
+                    writer.abort()
+                    raise
+        return {"ok": True, "host": host_id(), "addr": serve_addr(),
+                "bytes": size, "seconds": time.perf_counter() - t0}
+
+    return {"om_pull": om_pull}
+
+
+async def broadcast_async(core, oid: ObjectID, size: int, nodes=None,
+                          fanout: Optional[int] = None,
+                          per_node_timeout: float = 120.0) -> dict:
+    """Land a replica of a pool-resident object on every target node via
+    a fanout tree of `om_pull` calls. `nodes` is a list of node ids (None
+    = every alive node but this one) or explicit (host, rpc_addr) pairs
+    (unit tests drive the tree without a controller). Failed subtree
+    roots fail over to pulling from the owner directly, so one dead node
+    costs its own replica, not its subtree's.
+
+    fanout >= 1 builds the concurrent k-ary tree (`tree_parents`);
+    fanout <= 0 (the default config) builds the binomial ladder
+    (`binomial_parents`) with siblings STAGGERED — a parent starts
+    serving its next child only once the previous one lands, so every
+    transfer gets a full uplink and the replica population doubles per
+    round."""
+    cfg = get_config()
+    fanout = int(fanout if fanout is not None else cfg.broadcast_fanout)
+    targets: List[Tuple[str, str]] = []
+    if nodes and isinstance(nodes[0], (tuple, list)):
+        targets = [(str(h), str(a)) for h, a in nodes]
+    else:
+        infos = await core.controller.call_async("list_nodes")
+        wanted = set(nodes) if nodes is not None else None
+        for nid, info in (infos or {}).items():
+            if wanted is not None and nid not in wanted:
+                continue
+            if not info.get("alive", True):
+                continue
+            addr = info.get("address")
+            if not addr or addr == core.nodelet_addr:
+                continue  # the owner's own node already holds the object
+            targets.append((nid, addr))
+    owner_serve = core.nodelet_addr or core.address
+    result = {"bytes": size, "nodes": len(targets), "ok": 0, "failed": [],
+              "depth": 0, "seconds": 0.0, "gb_s": 0.0, "per_node": []}
+    if not targets:
+        return result
+    if fanout <= 0:
+        parents = binomial_parents(len(targets))
+    else:
+        parents = tree_parents(len(targets), fanout)
+    result["depth"] = _tree_depth(parents)
+    done = [asyncio.Event() for _ in targets]
+    replies: List[Optional[dict]] = [None] * len(targets)
+    landed: List[Tuple[str, str]] = []  # (host, serve_addr), land order
+    # binomial mode: stagger siblings — child i waits for the previous
+    # child of the SAME parent (owner included, keyed None) so a parent
+    # serves one child per round with its whole uplink
+    prev_sib: List[Optional[int]] = [None] * len(targets)
+    if fanout <= 0:
+        last_child: dict = {}
+        for i, p in enumerate(parents):
+            if p in last_child:
+                prev_sib[i] = last_child[p]
+            last_child[p] = i
+
+    async def land(i: int):
+        try:
+            p = parents[i]
+            if p is not None:
+                await done[p].wait()
+            if prev_sib[i] is not None:
+                await done[prev_sib[i]].wait()
+            # pull from the parent replica; the owner serves only tree
+            # ROOTS (and children whose parent failed) so its uplink is
+            # paid O(fanout) times, not O(n)
+            parent_reply = replies[p] if p is not None else None
+            if parent_reply and parent_reply.get("ok"):
+                sources = [(parent_reply.get("host", targets[p][0]),
+                            parent_reply.get("addr") or targets[p][1])]
+                # ...plus a couple of other ALREADY-LANDED replicas: the
+                # puller stripes chunks across sources by least-inflight,
+                # so replicas that finished early (and would otherwise
+                # sit idle while the tree trickles down) keep serving.
+                # Store-and-forward down a bare k-ary tree is bounded by
+                # each parent's uplink; the swarm sources recover most of
+                # that idle bandwidth without ever re-touching the owner.
+                # The staggered binomial ladder (fanout<=0) already keeps
+                # every uplink serving exactly one transfer — extra
+                # sources there would steal bandwidth from scheduled
+                # transfers, so the swarm is k-ary-only.
+                if fanout > 0:
+                    me = targets[i][1]
+                    for extra in landed:
+                        if len(sources) >= 3:
+                            break
+                        if extra[1] != me and extra not in sources:
+                            sources.append(extra)
+            else:
+                sources = [(core.host_id, owner_serve)]
+            try:
+                r = await core.client_for(targets[i][1]).call_async(
+                    "om_pull", oid=oid.binary(), size=size,
+                    sources=sources, _timeout=per_node_timeout)
+                replies[i] = r if isinstance(r, dict) else {"ok": bool(r)}
+                if replies[i].get("ok"):
+                    landed.append((replies[i].get("host", targets[i][0]),
+                                   replies[i].get("addr") or targets[i][1]))
+            except Exception as e:  # noqa: BLE001 — per-target verdicts, never a torn broadcast
+                replies[i] = {"ok": False, "error": repr(e)}
+        finally:
+            done[i].set()
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(land(i) for i in range(len(targets))))
+    dt = time.perf_counter() - t0
+    d = core._replica_dirs.setdefault(oid, {})
+    for i, r in enumerate(replies):
+        if r and r.get("ok"):
+            result["ok"] += 1
+            # seed the pull directory: later point pulls stripe across
+            # the landed replicas (and _h_replica_ready now has a dir
+            # to add late joiners to)
+            addr = r.get("addr") or targets[i][1]
+            d.setdefault(addr, [r.get("host", targets[i][0]), 0, 0.0])
+        else:
+            result["failed"].append(
+                {"node": targets[i][0],
+                 "error": (r or {}).get("error", "no reply")})
+        result["per_node"].append(r)
+    result["seconds"] = dt
+    landed = size * result["ok"]
+    result["gb_s"] = (landed / dt / 1e9) if dt > 0 else 0.0
+    m = _get_metrics()
+    m["bcast_bytes"].inc(landed)
+    m["bcast_nodes"].inc(result["ok"])
+    m["bcast_depth"].set(result["depth"])
+    m["bcast_gb_s"].set(result["gb_s"])
+    return result

@@ -208,6 +208,17 @@ class BulkServer:
 
     async def _serve_range(self, writer, oid: ObjectID, off: int, ln: int):
         store = self._get_store()
+        # tier probe BEFORE acquiring: a spilled object streams to the
+        # puller straight off its disk-tier file (acquire_range falls
+        # through tier by tier) — no rehydrate-first — and the tiering
+        # plane counts those bytes separately
+        spilled = False
+        tier_fn = getattr(store, "tier_of", None)
+        if tier_fn is not None:
+            try:
+                spilled = tier_fn(oid) in ("disk", "uri")
+            except Exception:  # rtpulint: ignore[RTPU006] — tier probe is metrics-only; serving proceeds either way
+                spilled = False
         try:
             rng = store.acquire_range(oid)
         except Exception:
@@ -231,6 +242,10 @@ class BulkServer:
                 await self._send_body(writer, f, base + off, ln)
             self.bytes_out += ln
             _get_metrics()["bytes_out"].inc(ln)
+            if spilled and ln:
+                from .tiering import _get_metrics as _tier_metrics
+
+                _tier_metrics()["serve_bytes"].inc(ln)
         finally:
             release()
 

@@ -68,6 +68,10 @@ def pytest_configure(config):
         "429s/ServiceOverloadedError, engine expiry pruning) tests + "
         "the 10x-overload drill in benchmarks/overload_drill.py")
     config.addinivalue_line(
+        "markers", "tiering: tiered object store (shm/disk/URI spill + "
+        "restore, pressure-driven lineage/borrower-aware eviction, "
+        "replica broadcast trees) tests")
+    config.addinivalue_line(
         "markers", "persist: durable control plane (crash-consistent "
         "persist-dir journal framing, torn-write fuzz matrix, "
         "replay↔reattach reconciliation) tests + the kill -9 restart "

@@ -185,9 +185,12 @@ def test_proto_gate_whole_program_clean():
     the package, with tests/ and benchmarks/ as auxiliary evidence, and
     a <10s perf guard on the whole pass (it parses ~180 modules once)."""
     pkg = os.path.join(REPO, "ray_tpu")
-    t0 = time.perf_counter()
+    # CPU time, not wall: the guard is about analyzer complexity (the
+    # pass is single-process and compute-bound), and wall time on the
+    # shared box swings with ambient load.
+    t0 = time.process_time()
     findings, n_files = run_proto([pkg], aux_paths=default_aux_paths(pkg))
-    elapsed = time.perf_counter() - t0
+    elapsed = time.process_time() - t0
     assert n_files > 150  # package + tests + benchmarks
     unsuppressed = [f for f in findings if not f.suppressed]
     assert not unsuppressed, "\n".join(

@@ -293,6 +293,39 @@ def test_persist_fsync_policy_knob(tmp_path, monkeypatch, cfg_guard):
     assert fsyncs == []
 
 
+def test_store_server_batch_flush_cadence(tmp_path, monkeypatch, cfg_guard):
+    """A STANDALONE store server drives backend.flush() on the
+    health-sweep cadence itself, so persist_fsync="batch" over the TCP
+    backend means "fsync every heartbeat" — not "never" (it had no
+    controller health loop to piggyback on)."""
+    fsyncs = []
+    monkeypatch.setattr(os, "fsync", lambda fd: fsyncs.append(fd))
+    cfg_guard.persist_fsync = "batch"
+    cfg_guard.heartbeat_interval_s = 0.05
+    server = serve_store(str(tmp_path / "cadence"), "tcp:127.0.0.1:0")
+    elt = EventLoopThread.get()
+    be = TCPBackend(server.address)
+    try:
+        be.append_kv(("put", "ns", "k", b"v"))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not fsyncs:
+            time.sleep(0.01)
+        assert len(fsyncs) >= 1  # the server's own loop flushed the append
+        n = len(fsyncs)
+        time.sleep(0.3)  # several beats with nothing dirty...
+        assert len(fsyncs) == n  # ...make zero fsync syscalls
+        be.append_kv(("put", "ns", "k2", b"v2"))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(fsyncs) == n:
+            time.sleep(0.01)
+        assert len(fsyncs) > n  # next beat flushed the new dirt
+    finally:
+        server._store_flush_task.cancel()
+        be.close()
+        elt.run(server.stop())
+        server._store_backend.close()
+
+
 # ------------------------------------- replay↔reattach reconciliation
 def _fake_node(tmp_path, name, lease_calls=None, reserve_calls=None):
     """A stand-in nodelet: answers the controller verbs the
@@ -525,6 +558,63 @@ def test_replayed_pg_rereserves_original_placement(tmp_path, cfg_guard):
         assert sorted(reserve_calls) == [("pg-1", 0), ("pg-1", 1)]
     finally:
         elt.run(c2.stop())
+        elt.run(n1.stop())
+        elt.run(n2.stop())
+
+
+def test_replayed_pg_survives_second_controller_crash(tmp_path, cfg_guard):
+    """Regression (double-restart edge): the replayed-placement claim is
+    itself persisted — a controller that checkpoints and dies AGAIN
+    before the replayed PG reconciles comes back still holding the
+    ORIGINAL placement, and re-reserves those exact bundles once the
+    nodes finally return (instead of persisting placement=None and
+    scattering to fresh nodes while the old reservations leak)."""
+    cfg_guard.node_death_timeout_s = 5.0
+    elt = EventLoopThread.get()
+    reserve_calls = []
+    n1 = _fake_node(tmp_path, "kk-n1", reserve_calls=reserve_calls)
+    n2 = _fake_node(tmp_path, "kk-n2", reserve_calls=reserve_calls)
+    pdir = str(tmp_path / "pgkk")
+
+    async def phase1():
+        c = Controller("pgkk", f"unix:{tmp_path}/kk1.sock",
+                       persist_dir=pdir)
+        await c.register_node("n1", n1.address, {"CPU": 2.0}, {})
+        await c.register_node("n2", n2.address, {"CPU": 2.0}, {})
+        out = await c.create_placement_group(
+            "pg-kk", [{"CPU": 1.0}, {"CPU": 1.0}], strategy="SPREAD")
+        assert out["state"] == "CREATED"
+        await c.stop()
+        return out["placement"]
+
+    original = elt.run(phase1())
+    reserve_calls.clear()
+    # crash #1 -> replay. The nodes never re-register in this
+    # incarnation; the controller checkpoints mid-reconcile and dies.
+    c2 = Controller("pgkk", f"unix:{tmp_path}/kk2.sock", persist_dir=pdir)
+    elt.run(c2.start())
+    pg = c2.placement_groups["pg-kk"]
+    assert pg["state"] == "PENDING"
+    assert pg["_replayed_placement"] == original
+    c2._persist()  # the dying controller's last checkpoint
+    elt.run(c2.stop())
+    # crash #2 -> the claim survived the second replay
+    c3 = Controller("pgkk", f"unix:{tmp_path}/kk3.sock", persist_dir=pdir)
+    elt.run(c3.start())
+    try:
+        pg = c3.placement_groups["pg-kk"]
+        assert pg["state"] == "PENDING"
+        assert pg["_replayed_placement"] == original
+        elt.run(c3.register_node("n1", n1.address, {"CPU": 2.0}, {}))
+        elt.run(c3.register_node("n2", n2.address, {"CPU": 2.0}, {}))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and pg["state"] != "CREATED":
+            time.sleep(0.05)
+        assert pg["state"] == "CREATED"
+        assert pg["placement"] == original
+        assert sorted(reserve_calls) == [("pg-kk", 0), ("pg-kk", 1)]
+    finally:
+        elt.run(c3.stop())
         elt.run(n1.stop())
         elt.run(n2.stop())
 

@@ -1,0 +1,496 @@
+"""Tiered object store: spill/restore parity, pressure-driven eviction,
+and replica broadcast trees.
+
+Unit tier drives the tier API on real store clients (native pool when the
+toolchain is present, pure file store otherwise) plus a minimal fake
+owner for the SpillManager's borrower/lineage safety rules. The
+broadcast tier wires N in-process "nodes" (store + RPC server + pull
+manager each) into a fanout tree without a cluster, mirroring
+test_transfer's replica harness. The spill-storm test runs the pressure
+valve against fault-injected slow remote reads (`delay(om_read)`).
+"""
+
+import os
+import time
+
+import pytest
+
+from ray_tpu.runtime import faults, object_store, tiering
+from ray_tpu.runtime.config import get_config
+from ray_tpu.runtime.ids import ObjectID
+from ray_tpu.runtime.object_store import ObjectStoreClient, make_store_client
+from ray_tpu.runtime.rpc import EventLoopThread, RpcClient, RpcServer
+from ray_tpu.runtime.serialization import serialize
+from ray_tpu.runtime.tiering import (SpillManager, binomial_parents,
+                                     tree_parents)
+from ray_tpu.runtime.transfer import BulkServer, PullManager
+from ray_tpu.util import metrics
+
+pytestmark = pytest.mark.tiering
+
+_session_ids = iter(range(10_000))
+
+
+@pytest.fixture
+def tier_env(tmp_path, monkeypatch):
+    """Unique session + tmp-rooted spill dir + small pool; cleans the
+    shm/spill dirs up afterwards."""
+    sess = f"tier{os.getpid()}_{next(_session_ids)}"
+    monkeypatch.setenv("RTPU_SPILL_ROOT", str(tmp_path / "spill"))
+    monkeypatch.setenv("RTPU_POOL_SIZE", str(64 << 20))
+    yield sess
+    object_store.cleanup_session(sess)
+
+
+@pytest.fixture
+def tier_cfg():
+    cfg = get_config()
+    saved = (cfg.object_store_spill_threshold, cfg.object_spill_uri,
+             cfg.broadcast_fanout, cfg.bulk_chunk_size,
+             cfg.bulk_transfer_enabled)
+    yield cfg
+    (cfg.object_store_spill_threshold, cfg.object_spill_uri,
+     cfg.broadcast_fanout, cfg.bulk_chunk_size,
+     cfg.bulk_transfer_enabled) = saved
+
+
+def _spill_counter(name: str) -> float:
+    # Touch the tiering metric cache first: it re-attaches the spill
+    # series to the registry if an earlier test wiped it
+    # (metrics._reset_for_tests), so before/after deltas stay coherent.
+    tiering._get_metrics()
+    return metrics.snapshot("rtpu_").get(name, 0.0)
+
+
+class _FakeCore:
+    """The slice of CoreWorker the SpillManager contracts against."""
+
+    def __init__(self, store):
+        self.store = store
+        self.borrows = {}
+        self.lineage = {}
+        self._replica_dirs = {}
+        self.nodelet = None
+
+
+# ------------------------------------------------------------- unit tier
+def test_tree_parents_shapes():
+    assert tree_parents(0) == []
+    # binary tree over 8 targets: 2 roots, t_i pulls from t_{i//2 - 1}
+    assert tree_parents(8, 2) == [None, None, 0, 0, 1, 1, 2, 2]
+    # chain (fanout=1): a pipeline
+    assert tree_parents(4, 1) == [None, 0, 1, 2]
+    # wide fanout >= n: everything pulls from the owner
+    assert tree_parents(3, 8) == [None, None, None]
+
+
+def test_binomial_parents_shapes():
+    """The binomial ladder: rank r pulls from rank r - msb(r); the owner
+    (rank 0) adopts targets 0, 1, 3, 7, ... — one per round — and the
+    population doubles every round."""
+    assert binomial_parents(0) == []
+    # 12 targets land in ceil(log2(13)) = 4 rounds
+    assert binomial_parents(12) == [
+        None, None, 0, None, 0, 1, 2, None, 0, 1, 2, 3]
+    # every parent's children arrive in increasing index order (the
+    # stagger chain in broadcast_async relies on this)
+    parents = binomial_parents(30)
+    for p in set(parents):
+        kids = [i for i, q in enumerate(parents) if q == p]
+        assert kids == sorted(kids)
+    # round count: targets reachable after k rounds = 2^k - 1
+    for n, rounds in [(1, 1), (3, 2), (7, 3), (8, 4), (15, 4), (16, 5)]:
+        ranks = [i + 1 for i in range(n)]
+        assert max(r.bit_length() for r in ranks) == rounds
+
+
+@pytest.mark.parametrize("nbytes", [
+    1 << 10, (3 << 10) + 7, 1 << 16, (1 << 20) + 13, 8 << 20, 64 << 20])
+def test_spill_restore_byte_parity_fuzz(tier_env, nbytes):
+    """put -> spill -> evict -> get (served off disk) -> restore -> get:
+    bit-exact at every step, across sizes spanning 1 KB - 64 MB
+    including unaligned ones."""
+    if nbytes == 64 << 20:
+        os.environ["RTPU_POOL_SIZE"] = str(128 << 20)  # restored by tier_env
+    store = make_store_client(tier_env)
+    oid = ObjectID.from_random()
+    payload = os.urandom(nbytes)
+    store.put_serialized(oid, serialize(payload))
+    assert store.tier_of(oid) == "shm"
+    size = store.spill_object(oid)
+    assert size and size >= nbytes
+    assert store.spill.tier_of(oid) == "disk"
+    assert store.evict_shm(oid)
+    assert store.tier_of(oid) == "disk"
+    assert store.get(oid) == payload  # transparent read off the disk tier
+    store.release(oid)
+    assert store.restore(oid) == size
+    assert store.tier_of(oid) == "shm"
+    assert store.get(oid) == payload
+    store.release(oid)
+    store.delete(oid)
+
+
+def test_put_larger_than_pool_roundtrips(tier_env, monkeypatch):
+    """An object LARGER than the whole shm pool lands on the disk tier at
+    put and reads back bit-exact (the acceptance round-trip)."""
+    monkeypatch.setenv("RTPU_POOL_SIZE", str(8 << 20))
+    store = make_store_client(tier_env)
+    oid = ObjectID.from_random()
+    payload = os.urandom(24 << 20)
+    store.put_serialized(oid, serialize(payload))
+    assert store.tier_of(oid) == "disk"  # never fit shm
+    assert store.contains(oid)
+    assert store.get(oid) == payload
+    store.release(oid)
+    store.delete(oid)
+    assert not store.contains(oid)
+
+
+def test_evict_under_borrow_refused(tier_env):
+    """A borrowed object is NEVER evictable — even with a spilled copy —
+    and the refusal is counted. Clearing the borrow makes it evictable."""
+    store = ObjectStoreClient(tier_env)
+    core = _FakeCore(store)
+    sm = SpillManager(core)
+    oid = ObjectID.from_random()
+    store.put_serialized(oid, serialize(os.urandom(1 << 20)))
+    sm.note_sealed(oid, 1 << 20)
+    store.spill_object(oid)  # restorable...
+    core.borrows[oid] = {"unix:/tmp/borrower.sock"}  # ...but borrowed
+    before = _spill_counter("rtpu_spill_refused_total")
+    assert not sm.evictable(oid)
+    assert not sm.evict(oid)
+    assert store.tier_of(oid) == "shm"  # still resident
+    assert _spill_counter("rtpu_spill_refused_total") == before + 1
+    core.borrows.pop(oid)
+    assert sm.evictable(oid)
+    assert sm.evict(oid)
+    assert store.tier_of(oid) == "disk"
+
+
+def test_evict_without_copy_or_lineage_refused(tier_env):
+    """Zero borrowers is not enough: an object with neither a spilled
+    copy nor lineage would be data loss — refused. Recording lineage
+    makes it evictable (reconstruction is the backstop)."""
+    store = ObjectStoreClient(tier_env)
+    core = _FakeCore(store)
+    sm = SpillManager(core)
+    oid = ObjectID.from_random()
+    store.put_serialized(oid, serialize(b"y" * 4096))
+    sm.note_sealed(oid, 4096)
+    assert not sm.evictable(oid)
+    assert not sm.evict(oid)
+    core.lineage[oid] = ("spec", [oid], [])
+    assert sm.evictable(oid)
+    assert sm.evict(oid)
+    assert store.tier_of(oid) is None  # gone everywhere; lineage rebuilds
+
+
+def test_pressure_pass_spills_then_evicts_to_watermark(tier_env, tier_cfg,
+                                                       monkeypatch):
+    """Filling the pool past the watermark kicks the background pass:
+    cold unborrowed objects spill + evict until usage is back under the
+    threshold; the borrowed object keeps its shm copy."""
+    monkeypatch.setenv("RTPU_POOL_SIZE", str(16 << 20))
+    tier_cfg.object_store_spill_threshold = 0.5
+    store = ObjectStoreClient(tier_env)
+    core = _FakeCore(store)
+    sm = SpillManager(core)
+    borrowed = None
+    for i in range(10):  # 10 x 1 MiB -> ~62% of the 16 MiB "pool"
+        oid = ObjectID.from_random()
+        store.put_serialized(oid, serialize(os.urandom(1 << 20)))
+        if i == 0:
+            borrowed = oid
+            core.borrows[oid] = {"unix:/tmp/b.sock"}
+        sm.note_sealed(oid, 1 << 20)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and sm.usage() > 0.5:
+        time.sleep(0.05)
+    assert sm.usage() <= 0.5
+    stats = sm.stats()
+    assert stats["spilled"] >= 1 and stats["evicted"] >= 1
+    assert store.tier_of(borrowed) == "shm"  # borrower-pinned: untouched
+
+
+def test_restore_mid_pull_streams_from_disk(tier_env, tier_cfg):
+    """A pull of a spilled object streams off the disk tier through the
+    BulkServer chunk path (no rehydrate-first); restoring the object to
+    shm mid-pull is safe and the result is bit-exact."""
+    tier_cfg.bulk_chunk_size = 256 << 10
+    store = ObjectStoreClient(tier_env)
+    oid = ObjectID.from_random()
+    payload = os.urandom(4 << 20)
+    store.put_serialized(oid, serialize(payload))
+    store.spill_object(oid)
+    assert store.evict_shm(oid)  # disk tier only: the stream serves it
+    elt = EventLoopThread.get()
+    server = elt.run(BulkServer(lambda: store, host="127.0.0.1").start())
+    dst = ObjectStoreClient("tierdst", root=str(os.path.join(
+        os.environ["RTPU_SPILL_ROOT"], "dst")))
+    pm = PullManager(lambda addr: None)  # endpoints pre-seeded: no RPC
+    pm._endpoints = {"src": server.address}
+    size = store.size_of(oid)
+    before = _spill_counter("rtpu_spill_serve_bytes_total")
+    writer = dst.create_for_ingest(oid, size)
+    fut = elt.spawn(pm.pull(oid, size, [("hS", "src")], writer))
+    # wait for the first chunk to be served off the DISK tier...
+    deadline = time.monotonic() + 10
+    while (time.monotonic() < deadline and not fut.done()
+           and _spill_counter("rtpu_spill_serve_bytes_total") <= before):
+        time.sleep(0.002)
+    served_early = _spill_counter("rtpu_spill_serve_bytes_total") > before
+    # ...then promote it back to shm while chunks are still in flight
+    assert store.restore(oid) == size
+    fut.result(timeout=60)
+    writer.seal()
+    assert dst.get(oid) == payload
+    dst.release(oid)
+    assert served_early or fut.done()  # fast pulls may beat the probe
+    assert _spill_counter("rtpu_spill_serve_bytes_total") > before
+    assert store.tier_of(oid) == "shm"
+    elt.run(server.stop())
+
+
+def test_uri_tier_third_hop(tier_env, tier_cfg, tmp_path):
+    """With object_spill_uri configured (file:// via fsspec), a spilled
+    object pushed to the URI tier survives losing BOTH local tiers and
+    restores transparently on read."""
+    pytest.importorskip("fsspec")
+    tier_cfg.object_spill_uri = f"file://{tmp_path}/uri"
+    store = ObjectStoreClient(tier_env)
+    oid = ObjectID.from_random()
+    payload = os.urandom(2 << 20)
+    store.put_serialized(oid, serialize(payload))
+    store.spill_object(oid)
+    assert store.spill.push_uri(oid)
+    # drop shm AND the disk copy: only the URI tier holds it now
+    assert store.evict_shm(oid)
+    os.unlink(store.spill._path(oid))
+    assert store.tier_of(oid) == "uri"
+    assert store.contains(oid)
+    assert store.get(oid) == payload  # uri -> disk restore, then serve
+    store.release(oid)
+    assert store.spill.tier_of(oid) == "disk"  # restored copy landed
+    ut = tiering.get_uri_tier(tier_env)
+    ut.delete(oid)
+    assert not ut.contains(oid)
+
+
+def test_tmpfs_spill_dir_warns(tmp_path, monkeypatch, caplog):
+    """Satellite: a spill root on tmpfs (RAM) logs a warning naming the
+    knobs; a real-disk root stays quiet."""
+    if object_store._fs_magic("/dev/shm") != object_store._TMPFS_MAGIC:
+        pytest.skip("/dev/shm is not tmpfs on this box")
+    monkeypatch.setenv("RTPU_SPILL_ROOT", "/dev/shm/rtpu_tmpfs_trap")
+    object_store._warned_spill_roots.clear()
+    with caplog.at_level("WARNING", logger="ray_tpu.runtime.object_store"):
+        object_store._spill_dir("warnsess")
+    assert "RTPU_SPILL_ROOT" in caplog.text and "tmpfs" in caplog.text
+    assert "object_spill_dir" in caplog.text
+    # warn-once: repeated resolution of the same root stays quiet
+    caplog.clear()
+    with caplog.at_level("WARNING", logger="ray_tpu.runtime.object_store"):
+        object_store._spill_dir("warnsess")
+    assert not caplog.records
+    # a real-disk root never warns
+    monkeypatch.setenv("RTPU_SPILL_ROOT", str(tmp_path / "realdisk"))
+    object_store._warned_spill_roots.clear()
+    caplog.clear()
+    with caplog.at_level("WARNING", logger="ray_tpu.runtime.object_store"):
+        object_store._spill_dir("warnsess")
+    tmp_magic = object_store._fs_magic(str(tmp_path))
+    if tmp_magic not in (object_store._TMPFS_MAGIC,
+                         object_store._RAMFS_MAGIC):
+        assert not caplog.records
+
+
+def test_spill_storm_under_delayed_remote_reads(tier_env, tier_cfg,
+                                                monkeypatch, tmp_path):
+    """Pressure storm with fault-injected slow om_read: a remote reader
+    keeps pulling (RPC path) while the pressure valve spills + evicts
+    underneath it. Zero untyped errors, and the pool ends under the
+    watermark — evicted objects serve transparently off the disk tier."""
+    monkeypatch.setenv("RTPU_POOL_SIZE", str(16 << 20))
+    tier_cfg.object_store_spill_threshold = 0.5
+    tier_cfg.bulk_transfer_enabled = False  # force om_read (the delayed op)
+    store = ObjectStoreClient(tier_env)
+    core = _FakeCore(store)
+    sm = SpillManager(core)
+    elt = EventLoopThread.get()
+    sock = f"unix:{tmp_path}/storm.sock"
+    server = RpcServer(sock, object_store.om_handlers(lambda: store))
+    elt.run(server.start())
+    plane = faults.get_plane()
+    plane.add_rules("storm:delay(om_read,ms=20)")
+    client = RpcClient(sock)
+    dst = ObjectStoreClient("stormdst", root=str(tmp_path / "dst"))
+    pm = PullManager(lambda addr: client)
+    errors = []
+    sealed = []
+    try:
+        for i in range(12):  # 12 x 1 MiB through a 16 MiB pool at 0.5
+            oid = ObjectID.from_random()
+            payload = os.urandom(1 << 20)
+            store.put_serialized(oid, serialize(payload))
+            sm.note_sealed(oid, 1 << 20)
+            sealed.append((oid, payload))
+            if i >= 2:  # concurrently read an OLDER (spill-candidate) one
+                roid, rpayload = sealed[i - 2]
+
+                async def read_back(roid=roid, rpayload=rpayload):
+                    try:
+                        size = store.size_of(roid)
+                        writer = dst.create_for_ingest(roid, size)
+                        await pm.pull(roid, size, [("hS", sock)], writer)
+                        writer.seal()
+                        if dst.get(roid) != rpayload:
+                            errors.append(f"parity {roid.hex()}")
+                        dst.release(roid)
+                    except Exception as e:  # noqa: BLE001 — the drill asserts zero errors of ANY kind
+                        errors.append(repr(e))
+
+                elt.spawn(read_back()).result(timeout=60)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and sm.usage() > 0.5:
+            time.sleep(0.05)
+    finally:
+        snap = plane.snapshot()
+        plane.clear("storm")
+        elt.run(server.stop())
+    assert errors == []
+    assert sm.usage() <= 0.5
+    assert sm.stats()["spilled"] >= 1
+    assert any(r.get("fired", 0) > 0 for r in snap)  # the delay really hit
+
+
+# --------------------------------------------------------- broadcast tier
+class _FakeOwner:
+    """The slice of CoreWorker broadcast_async contracts against, wired
+    to in-process RPC servers instead of a cluster."""
+
+    def __init__(self, store, serve_addr, host):
+        self.store = store
+        self.nodelet_addr = serve_addr
+        self.address = serve_addr
+        self.host_id = host
+        self.controller = None  # explicit targets: never consulted
+        self._replica_dirs = {}
+        self._clients = {}
+
+    def client_for(self, addr):
+        client = self._clients.get(addr)
+        if client is None:
+            client = RpcClient(addr)
+            self._clients[addr] = client
+        return client
+
+
+def _broadcast_rig(tmp_path, n, sess="bcast"):
+    """Owner + n target nodes, each a store + RPC server running the
+    om tier and the om_pull (broadcast landing) handler."""
+    elt = EventLoopThread.get()
+    clients = {}
+
+    def client_for(addr):
+        c = clients.get(addr)
+        if c is None:
+            c = RpcClient(addr)
+            clients[addr] = c
+        return c
+
+    stores, servers = [], []
+    for i in range(n + 1):  # 0 = owner
+        store = ObjectStoreClient(sess, root=str(tmp_path / f"node{i}"))
+        handlers = object_store.om_handlers(lambda s=store: s)
+        pm = PullManager(client_for)
+        handlers.update(tiering.pull_handlers(
+            lambda s=store: s, lambda pm=pm: pm,
+            lambda i=i: servers[i].address))
+        server = RpcServer(f"unix:{tmp_path}/bn{i}.sock", handlers)
+        elt.run(server.start())
+        stores.append(store)
+        servers.append(server)
+    owner = _FakeOwner(stores[0], servers[0].address, "h0")
+    owner.client_for = client_for
+    return owner, stores, servers
+
+
+def test_broadcast_binary_tree_lands_everywhere(tmp_path, tier_cfg):
+    """8-node broadcast over a binary tree: every node lands a bit-exact
+    replica, the tree depth is log2-ish, and the owner's replica
+    directory is seeded with every landed node."""
+    tier_cfg.bulk_chunk_size = 256 << 10
+    n = 8
+    owner, stores, servers = _broadcast_rig(tmp_path, n)
+    oid = ObjectID.from_random()
+    payload = os.urandom(4 << 20)
+    stores[0].put_serialized(oid, serialize(payload))
+    size = stores[0].size_of(oid)
+    targets = [(f"h{i}", servers[i].address) for i in range(1, n + 1)]
+    elt = EventLoopThread.get()
+    out = elt.run(tiering.broadcast_async(owner, oid, size, nodes=targets,
+                                          fanout=2))
+    assert out["ok"] == n and out["failed"] == []
+    assert out["depth"] == 3  # 8 targets, fanout 2: levels of 2, 4, 2
+    for i in range(1, n + 1):
+        assert stores[i].get(oid) == payload
+        stores[i].release(oid)
+    # the owner's pull directory now stripes across the landed replicas
+    assert len(owner._replica_dirs[oid]) == n
+    for s in servers:
+        elt.run(s.stop())
+
+
+def test_broadcast_binomial_ladder_lands_everywhere(tmp_path, tier_cfg):
+    """fanout=0 (the config default) broadcasts over the staggered
+    binomial ladder: every node lands bit-exact and the owner adopts
+    only ceil(log2(n+1)) direct children."""
+    tier_cfg.bulk_chunk_size = 256 << 10
+    n = 8
+    owner, stores, servers = _broadcast_rig(tmp_path, n)
+    oid = ObjectID.from_random()
+    payload = os.urandom(4 << 20)
+    stores[0].put_serialized(oid, serialize(payload))
+    size = stores[0].size_of(oid)
+    targets = [(f"h{i}", servers[i].address) for i in range(1, n + 1)]
+    elt = EventLoopThread.get()
+    out = elt.run(tiering.broadcast_async(owner, oid, size, nodes=targets,
+                                          fanout=0))
+    assert out["ok"] == n and out["failed"] == []
+    # owner's direct children: ranks 1, 2, 4, 8 -> 4 of the 8 targets
+    assert sum(1 for p in binomial_parents(n) if p is None) == 4
+    for i in range(1, n + 1):
+        assert stores[i].get(oid) == payload
+        stores[i].release(oid)
+    assert len(owner._replica_dirs[oid]) == n
+    for s in servers:
+        elt.run(s.stop())
+
+
+def test_broadcast_chain_and_dead_node_failover(tmp_path, tier_cfg):
+    """fanout=1 builds a chain; a dead node mid-chain reports failed
+    while its child falls back to pulling from the owner — one dead node
+    costs one replica, not the subtree."""
+    tier_cfg.bulk_chunk_size = 256 << 10
+    n = 4
+    owner, stores, servers = _broadcast_rig(tmp_path, n)
+    oid = ObjectID.from_random()
+    payload = os.urandom(1 << 20)
+    stores[0].put_serialized(oid, serialize(payload))
+    size = stores[0].size_of(oid)
+    elt = EventLoopThread.get()
+    elt.run(servers[2].stop())  # node 2 (chain middle) is dead
+    targets = [(f"h{i}", servers[i].address) for i in range(1, n + 1)]
+    out = elt.run(tiering.broadcast_async(owner, oid, size, nodes=targets,
+                                          fanout=1, per_node_timeout=5))
+    assert out["depth"] == n  # a chain
+    assert out["ok"] == n - 1
+    assert [f["node"] for f in out["failed"]] == ["h2"]
+    for i in (1, 3, 4):
+        assert stores[i].get(oid) == payload
+        stores[i].release(oid)
+    for i, s in enumerate(servers):
+        if i != 2:
+            elt.run(s.stop())
