@@ -206,10 +206,14 @@ def bench_serve(on_tpu: bool) -> dict:
 
 
 def bench_serve_tp() -> dict:
-    """Tensor-parallel serve datapoint: sharded vs single-chip decode
-    step latency + greedy parity on the virtual 8-device CPU mesh
-    (benchmarks/sharded_serve.py). Runs in a subprocess so its CPU
-    device config never touches this process's TPU backend."""
+    """Tensor-parallel + pipeline-parallel serve datapoint: sharded vs
+    single-chip decode step latency with real scaling efficiency
+    (tp_scaling_eff = speedup/tp), the 2-stage pipelined engine's
+    decode_tok_s_pp and steady-state pp_bubble_frac (loadavg-downgraded
+    bar at 0.35), and greedy parity for BOTH arms on the virtual
+    8-device CPU mesh (benchmarks/sharded_serve.py). Runs in a
+    subprocess so its CPU device config never touches this process's
+    TPU backend."""
     import os
     import subprocess
 
@@ -220,7 +224,7 @@ def bench_serve_tp() -> dict:
     out = subprocess.run(
         [sys.executable, os.path.join(here, "benchmarks",
                                       "sharded_serve.py"),
-         "--tp", "2", "--steps", "15"],
+         "--tp", "2", "--steps", "15", "--pp", "2"],
         capture_output=True, text=True, timeout=420, cwd=here, env=env)
     for line in reversed(out.stdout.strip().splitlines()):
         line = line.strip()
@@ -343,8 +347,11 @@ def bench_chaos_drill() -> dict:
     emits recovery_controller_ms / recovery_node_death_ms /
     recovery_controller_persist_ms / persist_drill_green /
     chaos_drills_green so every round carries recovery time next to
-    throughput."""
-    return _run_bench_json("chaos_drill.py", 300)
+    throughput. The pp stage-rank kill drill rides along
+    (recovery_pp_rank_ms / pp_drill_green): SIGKILL one rank of a
+    2-stage pipelined serve gang mid-decode, typed ActorDiedError,
+    replacement gang's first token timed."""
+    return _run_bench_json("chaos_drill.py", 480)
 
 
 def bench_overload_drill() -> dict:
@@ -493,7 +500,15 @@ def main():
     # same time guard
     if time.perf_counter() - start < 420:
         try:
-            result["detail"]["serve_tp"] = bench_serve_tp()
+            serve_tp = bench_serve_tp()
+            result["detail"]["serve_tp"] = serve_tp
+            # hoist the scaling + pipeline headlines next to the other
+            # plane keys (tp_scaling_eff = speedup/tp; pp_bubble_frac =
+            # steady-state starved-read fraction of the 2-stage gang)
+            for key in ("tp_scaling_eff", "pp_bubble_frac",
+                        "decode_tok_s_pp", "pp_green"):
+                if key in serve_tp:
+                    result["detail"][key] = serve_tp[key]
         except Exception as e:  # noqa: BLE001
             result["detail"]["serve_tp"] = {"error": repr(e)[:200]}
 
@@ -556,7 +571,9 @@ def main():
             for key in ("recovery_controller_ms",
                         "recovery_node_death_ms",
                         "recovery_controller_persist_ms",
-                        "persist_drill_green", "chaos_drills_green"):
+                        "recovery_pp_rank_ms",
+                        "persist_drill_green", "chaos_drills_green",
+                        "pp_drill_green"):
                 if key in drill:
                     result["detail"][key] = drill[key]
         except Exception as e:  # noqa: BLE001

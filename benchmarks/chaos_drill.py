@@ -14,8 +14,15 @@ Prints ONE JSON line:
       simulate the mid-append kill) until a replacement replays the
       persist dir, the named actor reattaches WITHOUT re-creation, and
       the acked KV reads back bit-exact (the torn record discarded);
-  persist_drill_green / chaos_drills_green — drills converged inside
-      their deadlines.
+  recovery_pp_rank_ms — wall time from SIGKILLing one pipeline stage
+      rank of a 2-stage pipelined serve engine mid-decode (the driver
+      must surface a typed ActorDiedError naming the dead rank, never
+      an untyped hang) until a REPLACEMENT stage gang emits its first
+      recovered token;
+  persist_drill_green / chaos_drills_green / pp_drill_green — drills
+      converged inside their deadlines (the pp drill carries its own
+      green key so a pipeline regression never masks the control-plane
+      drills' signal, and vice versa).
 
 The full scripted-disaster catalog lives in tests/test_chaos.py (the
 real kill -9 at the controller.persist syncpoint runs there, against a
@@ -199,6 +206,87 @@ def main():
             shutil.rmtree(pdir, ignore_errors=True)
 
         out["chaos_drills_green"] = True
+
+        # ---- drill 4: pipeline stage-rank SIGKILL → typed error →
+        # rebuilt stage gang serves traffic (ray_tpu/serve/llm/pp.py).
+        # Own try + green key: a serve-plane regression must not mask
+        # the control-plane drills above, and vice versa.
+        out["pp_drill_green"] = False
+        try:
+            import signal
+
+            import numpy as np
+
+            # virtual CPU devices for the engine and — via the env the
+            # fresh session's nodelet (and so its stage workers)
+            # inherits — the stage processes; config set directly too
+            # because a site hook may have pre-imported jax already
+            flag = "--xla_force_host_platform_device_count=8"
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = f"{flags} {flag}".strip()
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            try:
+                jax.config.update("jax_num_cpu_devices", 8)
+            except AttributeError:
+                pass
+
+            from ray_tpu import exceptions
+            from ray_tpu.serve.llm import (
+                EngineConfig,
+                PipelinedEngine,
+                SamplingParams,
+            )
+
+            # fresh session: drills 1-3 killed a node and swapped the
+            # controller; the stage gang deserves a clean cluster
+            ray_tpu.shutdown()
+            session = ray_tpu.init(num_cpus=4)
+            cfg.rpc_connect_timeout_s = 2.0  # fail fast vs the corpse
+            cfg.rpc_retry_max = 1
+            pcfg = dict(model="tiny", page_size=8, num_pages=64,
+                        max_model_len=128, max_batch=2,
+                        prefill_buckets=(16, 32, 64), dtype="float32",
+                        model_overrides={"vocab_size": 512},
+                        pp=2, pp_fetch_timeout_s=6.0)
+            prompt = list(np.random.default_rng(3).integers(0, 400, 12))
+            ppe = PipelinedEngine(EngineConfig(**pcfg))
+            ppe.add_request("pre", prompt, SamplingParams(max_tokens=32))
+            got = 0
+            for _ in range(100):
+                got += sum(len(d.new_token_ids) for d in ppe.step())
+                if got >= 3:
+                    break
+            assert got >= 3, "decode never reached steady state"
+            victim = ray_tpu.get(ppe._stage_handles[1].pid.remote(),
+                                 timeout=30)
+            t0 = time.monotonic()
+            os.kill(victim, signal.SIGKILL)
+            try:
+                for _ in range(50):
+                    ppe.step()
+                raise AssertionError(
+                    "stage death never surfaced as ActorDiedError")
+            except exceptions.ActorDiedError:
+                pass  # the typed verdict the drill demands
+            ppe.shutdown()
+            # gang replaced: kill → first recovered token, timed
+            ppe2 = PipelinedEngine(EngineConfig(**pcfg))
+            ppe2.add_request("post", prompt, SamplingParams(max_tokens=4))
+            first = None
+            for _ in range(200):
+                if any(d.new_token_ids for d in ppe2.step()):
+                    first = time.monotonic()
+                    break
+            assert first is not None, "rebuilt gang produced no tokens"
+            out["recovery_pp_rank_ms"] = round((first - t0) * 1000.0, 1)
+            ppe2.shutdown()
+            out["pp_drill_green"] = True
+        except Exception as e:  # noqa: BLE001 — the bench line reports it
+            out["pp_error"] = repr(e)[:200]
     except Exception as e:  # noqa: BLE001 — the bench line reports it
         out["error"] = repr(e)[:200]
     finally:

@@ -54,13 +54,23 @@ class DAGNode:
 
     def experimental_compile(self,
                              buffer_size_bytes: Optional[int] = None,
+                             max_inflight_executions: Optional[int] = None,
                              ) -> "Any":
         """Compile into per-actor channel loops (CompiledDAG). The
         per-edge ring buffer defaults to config.dag_buffer_size; one
-        slot must hold the largest frame crossing any edge."""
+        slot must hold the largest frame crossing any edge.
+        ``max_inflight_executions`` sets the per-edge ring depth (= how
+        many execute() results may be pending at once, default 4) — a
+        pipeline-parallel serving loop sizes it >= 2*(stages-1) so the
+        microbatch window that hides the fill/drain bubble fits in the
+        channels."""
         from .compiled_dag import CompiledDAG
 
-        return CompiledDAG(self, buffer_size_bytes=buffer_size_bytes)
+        kwargs = {}
+        if max_inflight_executions is not None:
+            kwargs["max_inflight_executions"] = max_inflight_executions
+        return CompiledDAG(self, buffer_size_bytes=buffer_size_bytes,
+                           **kwargs)
 
 
 class InputNode(DAGNode):

@@ -75,6 +75,20 @@ def run_dag_loop(instance: Any, ops: List[dict]) -> None:
 
 
 def _run_dag_loop(instance: Any, ops: List[dict]) -> None:
+    # starved-read accounting, published on the instance so a concurrent
+    # actor call can report it while the loop runs (actors keep serving
+    # normal .remote() calls): a read whose ring is EMPTY at the moment
+    # the loop arrives at it is an idle (bubble) tick — the stage would
+    # block waiting for upstream. reads/starved over a steady-state
+    # window is the pipeline-parallel serving bubble fraction
+    # (serve/llm/pp.py pp_stats).
+    stats = getattr(instance, "__rtpu_dag_stats__", None)
+    if not isinstance(stats, dict):
+        stats = {"reads": 0, "starved_reads": 0}
+        try:
+            instance.__rtpu_dag_stats__ = stats
+        except Exception:  # rtpulint: ignore[RTPU006] — instances with __slots__ just lose the (optional) bubble accounting
+            pass
     while True:
         local: Dict[int, Any] = {}
         written: set = set()  # channel names written this iteration
@@ -90,6 +104,11 @@ def _run_dag_loop(instance: Any, ops: List[dict]) -> None:
                     elif kind == "local":
                         args.append(local[spec])
                     else:
+                        probe = getattr(spec, "ready", None)
+                        if probe is not None:
+                            stats["reads"] += 1
+                            if not probe():
+                                stats["starved_reads"] += 1
                         value = spec.read()
                         consumed.add(spec.name)
                         if isinstance(value, _DagLoopError):

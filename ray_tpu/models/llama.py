@@ -374,6 +374,24 @@ class ScannedLayer(nn.Module):
         return (x, positions, segment_ids), new_cache
 
 
+def _scanned_layers(cfg: LlamaConfig, length: int):
+    """The scan-transformed layer stack shared by LlamaModel, LayerStack
+    and StageModel: ONE definition of the scan axes/metadata so every
+    consumer produces the identical "layers" param collection (leaves
+    stacked with a leading [length] axis under PARTITION_NAME "layers")."""
+    layer_cls = ScannedLayer
+    if cfg.remat:
+        layer_cls = nn.remat(ScannedLayer, prevent_cse=False,
+                             policy=_remat_policy(cfg.remat_policy))
+    return nn.scan(
+        layer_cls,
+        variable_axes={"params": 0, "losses": 0},
+        split_rngs={"params": True},
+        length=length,
+        metadata_params={nn.PARTITION_NAME: "layers"},
+    )
+
+
 class LayerStack(nn.Module):
     """A sub-stack of decoder layers — one pipeline stage's worth.
 
@@ -387,19 +405,55 @@ class LayerStack(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions):
-        layer_cls = ScannedLayer
-        if self.config.remat:
-            layer_cls = nn.remat(
-                ScannedLayer, prevent_cse=False,
-                policy=_remat_policy(self.config.remat_policy))
-        (x, _, _), _ = nn.scan(
-            layer_cls,
-            variable_axes={"params": 0, "losses": 0},
-            split_rngs={"params": True},
-            length=self.layers_per_stage,
-            metadata_params={nn.PARTITION_NAME: "layers"},
-        )(self.config, name="layers")((x, positions, None), None)
+        (x, _, _), _ = _scanned_layers(self.config, self.layers_per_stage)(
+            self.config, name="layers")((x, positions, None), None)
         return x
+
+
+class StageModel(nn.Module):
+    """One SERVING pipeline stage of LlamaModel: an [n_layers] slice of
+    the scanned "layers" collection, plus the embedding table on the
+    first stage and final_norm + lm_head on the last.
+
+    Every param keeps the name it has in the full LlamaModel tree
+    ("embed" / "layers" / "final_norm" / "lm_head"), so stage params are
+    literal slices of a full-model init (serve/llm/pp.py stage_params) —
+    which is what makes the pipelined engine bit-exact against the
+    single-process one: the per-layer math, the embed lookup and the head
+    projection are the same ops on the same values, only partitioned
+    across processes.
+
+    Call signature mirrors the serving path of LlamaModel.__call__:
+    `x` is int32 token ids on the first stage (embedded here) and the
+    previous stage's hidden states elsewhere; `kv_caches` is this stage's
+    [n_layers]-leading PagedCache slice; returns (hidden-or-logits,
+    new_caches).
+    """
+
+    config: LlamaConfig
+    n_layers: int
+    first: bool = False
+    last: bool = False
+
+    @nn.compact
+    def __call__(self, x, positions, kv_caches):
+        cfg = self.config
+        if self.first:
+            embed = self.param(
+                "embed", A(nn.initializers.normal(0.02), ("vocab", "embed")),
+                (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+            x = embed[x].astype(cfg.dtype)
+        (x, _, _), new_caches = _scanned_layers(cfg, self.n_layers)(
+            cfg, name="layers")((x, positions, None), kv_caches)
+        if self.last:
+            x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="final_norm")(x)
+            x = nn.DenseGeneral(
+                features=cfg.vocab_size, use_bias=False, axis=-1,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                kernel_init=A(nn.initializers.lecun_normal(),
+                              ("embed", "vocab")),
+                name="lm_head")(x)
+        return x, new_caches
 
 
 class LlamaModel(nn.Module):
@@ -429,18 +483,8 @@ class LlamaModel(nn.Module):
         x = embed[input_ids].astype(cfg.dtype)
 
         if cfg.scan_layers:
-            layer_cls = ScannedLayer
-            if cfg.remat:
-                layer_cls = nn.remat(
-                    ScannedLayer, prevent_cse=False,
-                    policy=_remat_policy(cfg.remat_policy))
-            (x, _, _), new_caches = nn.scan(
-                layer_cls,
-                variable_axes={"params": 0, "losses": 0},
-                split_rngs={"params": True},
-                length=cfg.num_layers,
-                metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, name="layers")((x, positions, segment_ids), kv_caches)
+            (x, _, _), new_caches = _scanned_layers(cfg, cfg.num_layers)(
+                cfg, name="layers")((x, positions, segment_ids), kv_caches)
         else:
             layer_cls = DecoderLayer
             if cfg.remat:

@@ -193,6 +193,14 @@ class Channel:
     def _get_counts(self):
         return struct.unpack_from("<QQ", self._mm, 0)
 
+    def ready(self) -> bool:
+        """Non-blocking probe: is an item waiting to be read? Used by
+        the compiled-DAG loop to classify each read as fed vs STARVED —
+        the event-based pipeline-bubble measure (a stage about to block
+        on an empty input ring is an idle tick; dag/loop_runner.py)."""
+        write_count, read_count = self._get_counts()
+        return read_count < write_count
+
     def _closed(self) -> bool:
         return self._mm[16] == 1
 

@@ -41,7 +41,10 @@ the controller's ``fault_inject`` admin RPC). Rules are ';'-separated::
   planted MID journal-append (frame header written, payload not) and
   just before a snapshot rename in runtime/storage.py, so restart
   drills die with a genuinely torn write on disk —
-  ``data.split_pull``).
+  ``data.split_pull``, ``serve.pp_tick`` — planted at the top of each
+  pipeline stage worker's per-microbatch tick (serve/llm/pp.py), so
+  chaos drills can kill one stage rank mid-decode with frames in
+  flight).
   ``action=exit`` (default) terminates the process with exit code 43;
   ``action=raise`` raises :class:`FaultInjectedError` in place (for
   in-process tests).
@@ -77,6 +80,7 @@ SYNCPOINTS = (
     "controller.health_sweep",
     "controller.persist",
     "data.split_pull",
+    "serve.pp_tick",
 )
 
 

@@ -259,7 +259,7 @@ def place_bundles(nodes: Sequence, bundles: List[Dict[str, float]],
         # TPU gang placement: one bundle per host, all on ICI-adjacent
         # hosts of ONE slice — the most compact contiguous host rectangle
         # (exceeds ref accelerators/tpu.py's pod-name-affinity emulation).
-        from .topology import slice_from_nodes
+        from .topology import ici_path, slice_from_nodes
 
         tpu_nodes = [n for n in alive
                      if (n.labels or {}).get("rtpu.slice")]
@@ -286,7 +286,11 @@ def place_bundles(nodes: Sequence, bundles: List[Dict[str, float]],
             gang = view.contiguous_hosts(len(bundles))
             if gang is None:
                 continue
-            gang = sorted(gang, key=lambda h: h.worker_index)
+            # bundle order == ICI snake order: consecutive bundles land
+            # on neighbouring hosts, so a pipeline-parallel gang's
+            # rank k -> k+1 activation channel is one ICI hop (a plain
+            # worker_index sort jumps the row width at every grid wrap)
+            gang = ici_path(gang)
             placement = [by_widx[sname][h.worker_index] for h in gang]
             ok = True
             for nid, bundle in zip(placement, bundles):

@@ -142,6 +142,37 @@ class TpuSlice:
         return None
 
 
+def ici_path(hosts: Sequence[TpuHost]) -> List[TpuHost]:
+    """Order a gang of hosts along a boustrophedon (snake) walk of their
+    bounding box: axis 0 ascending, each later axis alternating
+    direction with the cumulative parity of the earlier (transformed)
+    coordinates. Over an axis-aligned rectangle — what contiguous_hosts
+    returns — consecutive hosts in this order differ by exactly one
+    grid step, i.e. ONE ICI hop. Pipeline-parallel gang placement keys
+    bundle order on it so stage k and stage k+1 are ICI neighbours (a
+    worker_index sort walks row-major and jumps the row width at every
+    wrap); non-rectangular gangs (the worker_index-run fallback) still
+    get a deterministic order, just without the adjacency guarantee."""
+    hosts = list(hosts)
+    if len(hosts) < 2:
+        return hosts
+    dims = len(hosts[0].coords)
+    mins = tuple(min(h.coords[a] for h in hosts) for a in range(dims))
+    exts = tuple(max(h.coords[a] for h in hosts) - mins[a] + 1
+                 for a in range(dims))
+
+    def snake_key(host: TpuHost) -> Tuple[int, ...]:
+        key = []
+        parity = 0
+        for v, m, e in zip(host.coords, mins, exts):
+            kv = (v - m) if parity % 2 == 0 else e - 1 - (v - m)
+            key.append(kv)
+            parity += kv
+        return tuple(key)
+
+    return sorted(hosts, key=snake_key)
+
+
 def _rect_shapes(n: int, grid: Tuple[int, ...]) -> List[Tuple[int, ...]]:
     """Axis-aligned box shapes with exactly n cells that fit the grid,
     most compact (smallest perimeter) first."""

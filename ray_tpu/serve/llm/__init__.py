@@ -32,6 +32,7 @@ from .kv_transfer import (  # noqa: F401
     prefix_chain_hashes,
     seal_handoff,
 )
+from .pp import PipelinedEngine, make_engine  # noqa: F401
 from .server import (  # noqa: F401
     LLMConfig,
     LLMServer,
@@ -40,6 +41,7 @@ from .server import (  # noqa: F401
 )
 from .sharding import (  # noqa: F401
     ServeSharding,
+    pp_bundles,
     resolve_serve_mesh,
     tp_bundles,
 )
@@ -52,7 +54,8 @@ __all__ = [
     "Processor", "ProcessorConfig", "build_llm_processor",
     "HttpRequestProcessorConfig", "build_http_request_processor",
     "PrefillServer", "DecodeServer", "PDRouter", "build_pd_openai_app",
-    "ServeSharding", "resolve_serve_mesh", "tp_bundles",
+    "PipelinedEngine", "make_engine",
+    "ServeSharding", "resolve_serve_mesh", "tp_bundles", "pp_bundles",
     "seal_handoff", "fetch_handoff", "prefix_chain_hashes",
     "HandoffRegistry",
 ]

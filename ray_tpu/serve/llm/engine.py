@@ -196,6 +196,26 @@ class EngineConfig:
     # accepted, so one dispatch can emit many tokens on repetitive
     # output. Greedy-only and bit-exact by construction. 0 = off.
     spec_lookahead: int = 0
+    # pipeline parallelism (serve/llm/pp.py PipelinedEngine): >1 splits
+    # the layer stack into `pp` stage engines, each its own worker
+    # process on its own chip gang, chained rank->rank by compiled-DAG
+    # channels. The scheduler (this class) runs on rank 0 unchanged;
+    # only the three _compute_* seams and _fetch_tokens change. pp must
+    # divide num_layers. Composes with tp INSIDE each stage (each stage
+    # process shards its params/KV slice over its own tp-chip mesh).
+    pp: int = 1
+    # decode slot groups under pp — the microbatches that keep S stages
+    # busy (a slot's next input token is the previous tick's output, so
+    # consecutive ticks of ONE group can never overlap; groups of
+    # DIFFERENT slots can). 0 => max(2, 2*(pp-1)), the classic
+    # fill+drain bound. Ignored when pp == 1.
+    pp_microbatches: int = 0
+    # bound on one pipelined result fetch (harvest-side ref.get): a
+    # stage rank that dies mid-flight writes no sentinel, so the fetch
+    # times out — the engine then probes the gang and raises a TYPED
+    # ActorDiedError/GetTimeoutError instead of hanging. Ignored when
+    # pp == 1.
+    pp_fetch_timeout_s: float = 60.0
 
 
 _MAX_TOP_K = 64
@@ -233,13 +253,29 @@ class LLMEngine:
     `step()`."""
 
     def __init__(self, config: EngineConfig, params=None, mesh=None):
+        self.config = config
+        self._build_compute(params, mesh)
+        self.max_pages_per_seq = config.max_model_len // config.page_size
+
+        self.allocator = PageAllocator(
+            config.num_pages, config.page_size,
+            shard_degree=(self.sharding.tp if self.sharding else 1))
+        self._init_host_state()
+
+    def _build_compute(self, params, mesh) -> None:
+        """Device-state construction seam: model config, params, the
+        paged KV pool, the decode carry and the sharding context. The
+        pipelined engine (serve/llm/pp.py) overrides this to place each
+        layer slice in its own stage worker process; every host-side
+        scheduler structure built after it (allocator, queues, slots,
+        prefix cache) is backend-agnostic and shared verbatim."""
         import jax
         import jax.numpy as jnp
 
         from ...models.llama import LlamaModel, get_config
         from .sharding import resolve_serve_mesh
 
-        self.config = config
+        config = self.config
         dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
         self.model_cfg = get_config(
             config.model, scan_layers=True, remat=False, dtype=dtype,
@@ -301,11 +337,9 @@ class LLMEngine:
             # device-resident last-sampled-token per slot: the decode
             # chain's carry (design rule 2 in the module docstring)
             self.slot_ids = jnp.zeros((config.max_batch, 1), jnp.int32)
-        self.max_pages_per_seq = config.max_model_len // config.page_size
 
-        self.allocator = PageAllocator(
-            config.num_pages, config.page_size,
-            shard_degree=(self.sharding.tp if self.sharding else 1))
+    def _init_host_state(self) -> None:
+        config = self.config
         self._intake: List[Request] = []
         self._intake_lock = threading.Lock()
         self._aborted: set = set()
@@ -777,6 +811,54 @@ class LLMEngine:
         self._jit_cache[key] = fn
         return fn
 
+    # The three compute seams + the harvest fetch: everything the
+    # scheduler knows about the compute backend. The base engine runs
+    # in-process jits against self.kv_pages/self.slot_ids; the pipelined
+    # engine (pp.py) overrides these to push frames through the stage
+    # DAG and returns CompiledDAGRef handles instead of device arrays.
+
+    def _compute_prefill(self, sb, rb, cp, bt, total, ids, positions,
+                         gather, temp, topk, keys):
+        """One prefill dispatch; returns the sampled-tokens handle the
+        harvest will resolve via _fetch_tokens ([rb] int32)."""
+        import jax.numpy as jnp
+
+        fn = self._jit("prefill", (sb, rb, cp))
+        tokens, self.kv_pages = fn(
+            self.params, self.kv_pages, jnp.asarray(bt),
+            jnp.asarray(total), jnp.asarray(ids), jnp.asarray(positions),
+            jnp.asarray(gather), temp, topk, keys)
+        try:
+            tokens.copy_to_host_async()
+        except Exception:  # noqa: BLE001  # rtpulint: ignore[RTPU006] — optional D2H prefetch: CPU backends lack it; harvest blocks on the array either way
+            pass
+        return tokens
+
+    def _compute_decode(self, k_steps, mp, bt, total, caps, positions,
+                        override_mask, override_ids, temp, topk,
+                        keys_steps):
+        """One fused K-step decode dispatch over the full slot set;
+        returns the tokens handle ([K, S] int32 after _fetch_tokens)."""
+        import jax.numpy as jnp
+
+        fn = self._jit("decode", (k_steps, mp))
+        toks, self.slot_ids, self.kv_pages = fn(
+            self.params, self.kv_pages, self.slot_ids,
+            jnp.asarray(bt), jnp.asarray(total), jnp.asarray(caps),
+            jnp.asarray(positions), jnp.asarray(override_mask),
+            jnp.asarray(override_ids), temp, topk,
+            jnp.asarray(keys_steps))
+        try:
+            toks.copy_to_host_async()
+        except Exception:  # noqa: BLE001  # rtpulint: ignore[RTPU006] — optional D2H prefetch: CPU backends lack it; harvest blocks on the array either way
+            pass
+        return toks
+
+    def _fetch_tokens(self, handle) -> np.ndarray:
+        """Resolve a compute handle into host tokens (blocks until the
+        async D2H copy lands; microseconds once it has)."""
+        return np.asarray(handle)
+
     def _dispatch_prefills(self) -> None:
         """Legacy (prefill-priority) mode: admit as many waiting requests
         as slots/pages allow and launch one WHOLE-prompt prefill dispatch
@@ -888,8 +970,6 @@ class LLMEngine:
         chunk in token-budget mode. Rows whose start is > 0 attend to
         their earlier pages through the same ctx-merge path prefix-cache
         hits use; only rows whose FINAL chunk this is sample a token."""
-        import jax.numpy as jnp
-
         # rows always pad to the wave size: ONE compiled row count per
         # length bucket (per-size row buckets would multiply the compile
         # shapes, and an unwarmed shape hit mid-traffic is a
@@ -916,17 +996,10 @@ class LLMEngine:
                 req.dispatched_t = now
         cp = (self.max_pages_per_seq
               if any(req.n_prefilled for req, _ in group) else 0)
-        fn = self._jit("prefill", (sb, rb, cp))
         temp, topk, keys = self._sampling_arrays(
             [req for req, _ in group], rb)
-        tokens, self.kv_pages = fn(
-            self.params, self.kv_pages, jnp.asarray(bt),
-            jnp.asarray(total), jnp.asarray(ids), jnp.asarray(positions),
-            jnp.asarray(gather), temp, topk, keys)
-        try:
-            tokens.copy_to_host_async()
-        except Exception:  # noqa: BLE001  # rtpulint: ignore[RTPU006] — optional D2H prefetch: CPU backends lack it; harvest blocks on the array either way
-            pass
+        tokens = self._compute_prefill(sb, rb, cp, bt, total, ids,
+                                       positions, gather, temp, topk, keys)
         for req, n_new in group:
             req.n_prefilled += n_new
             if req.n_prefilled >= len(req.prompt_ids):
@@ -1043,21 +1116,13 @@ class LLMEngine:
                                "rows": recs})
         return True
 
-    def _dispatch_decode_chunk(self) -> bool:
-        """Launch one fused K-step decode dispatch over the full slot set,
-        reading last tokens from the device-resident carry. Returns False
-        when there is nothing safe to decode (no eligible slot, or a page
-        shortfall that needs the pipeline drained first)."""
-        import jax.numpy as jnp
-
+    def _decode_eligible(self) -> List[Request]:
+        """Slots safe to decode: RUNNING, prefill harvested
+        (decode_ready), and not already dispatched through their whole
+        token budget — chunks past max_tokens are 100% waste; chunks
+        past an unpredictable EOS/stop-token are the speculative waste
+        we accept."""
         cfg = self.config
-        page = cfg.page_size
-        k_steps = max(1, int(cfg.decode_steps_per_dispatch))
-        S = cfg.max_batch
-        # eligible: RUNNING, prefill harvested (decode_ready), and not
-        # already dispatched through its whole token budget — chunks past
-        # max_tokens are 100% waste; chunks past an unpredictable
-        # EOS/stop-token are the speculative waste we accept
         elig = []
         for req in self.running:
             if (req.slot < 0 or not req.decode_ready
@@ -1070,15 +1135,20 @@ class LLMEngine:
                     or len(req.prompt_ids) + req.planned_out >= cap):
                 continue
             elig.append(req)
-        if not elig:
-            return False
-        # page horizon: every eligible slot needs pages covering its
-        # planned writes through this chunk (clamped by its cap). Oldest
-        # first; on exhaustion with an empty pipeline, preempt the victim
-        # with the MOST reclaimable pages (sole-reference pages — prefix
-        # pages shared with other live requests free nothing), newest
-        # arrival breaking ties (vLLM's recompute-style preemption) —
-        # with work in flight, back off and let the harvest free pages.
+        return elig
+
+    def _reserve_decode_pages(self, elig: List[Request],
+                              k_steps: int) -> Optional[List[Request]]:
+        """Page horizon for one decode chunk: every eligible slot needs
+        pages covering its planned writes through this chunk (clamped by
+        its cap). Oldest first; on exhaustion with an empty pipeline,
+        preempt the victim with the MOST reclaimable pages
+        (sole-reference pages — prefix pages shared with other live
+        requests free nothing), newest arrival breaking ties (vLLM's
+        recompute-style preemption) — with work in flight, back off
+        (returns None) and let the harvest free pages."""
+        cfg = self.config
+        page = cfg.page_size
         for req in sorted(elig, key=lambda r: r.arrival_t):
             cap = _cap_total(req, cfg.max_model_len)
             # last position this chunk writes: the pending token sits at
@@ -1093,7 +1163,7 @@ class LLMEngine:
                         self.allocator.allocate(required - len(req.pages)))
                 except OutOfPages:
                     if self._inflight:
-                        return False
+                        return None
                     victims = [r for r in self.running
                                if r is not req and r.planned_out
                                == len(r.output_ids)]
@@ -1106,8 +1176,21 @@ class LLMEngine:
                         key=lambda r: (
                             self.allocator.reclaimable_pages(r.pages),
                             r.arrival_t)))
-        elig = [r for r in elig
+        return [r for r in elig
                 if r in self.running and r.state == RUNNING]
+
+    def _dispatch_decode_chunk(self) -> bool:
+        """Launch one fused K-step decode dispatch over the full slot set,
+        reading last tokens from the device-resident carry. Returns False
+        when there is nothing safe to decode (no eligible slot, or a page
+        shortfall that needs the pipeline drained first)."""
+        cfg = self.config
+        k_steps = max(1, int(cfg.decode_steps_per_dispatch))
+        S = cfg.max_batch
+        elig = self._decode_eligible()
+        if not elig:
+            return False
+        elig = self._reserve_decode_pages(elig, k_steps)
         if not elig:
             return False
 
@@ -1144,17 +1227,9 @@ class LLMEngine:
                 temp, topk = t_k, tk_k
         for req in elig:
             req.planned_out += k_steps
-        fn = self._jit("decode", (k_steps, mp))
-        toks, self.slot_ids, self.kv_pages = fn(
-            self.params, self.kv_pages, self.slot_ids,
-            jnp.asarray(bt), jnp.asarray(total), jnp.asarray(caps),
-            jnp.asarray(positions), jnp.asarray(override_mask),
-            jnp.asarray(override_ids), temp, topk,
-            jnp.asarray(keys_steps))
-        try:
-            toks.copy_to_host_async()
-        except Exception:  # noqa: BLE001  # rtpulint: ignore[RTPU006] — optional D2H prefetch: CPU backends lack it; harvest blocks on the array either way
-            pass
+        toks = self._compute_decode(k_steps, mp, bt, total, caps,
+                                    positions, override_mask,
+                                    override_ids, temp, topk, keys_steps)
         self._inflight.append({
             "kind": "decode", "toks": toks, "slots": chunk_slots,
             "k": k_steps,
@@ -1164,7 +1239,7 @@ class LLMEngine:
     # ---------------------------------------------------------- harvest
 
     def _harvest(self, rec: dict, deltas: List[OutputDelta]) -> None:
-        toks_np = np.asarray(rec["toks"])
+        toks_np = self._fetch_tokens(rec["toks"])
         if rec["kind"] == "prefill":
             for i, (rid, slot, end, final) in enumerate(rec["group"]):
                 req = self.requests.get(rid)

@@ -17,6 +17,7 @@ from typing import Any, Dict, List, Optional
 
 from .. import deployment
 from .engine import EngineConfig, LLMEngine, SamplingParams
+from .pp import make_engine
 from .tokenizer import get_tokenizer
 
 
@@ -215,7 +216,10 @@ class LLMServer(EngineDriverMixin):
         if engine_cfg.eos_token_id is None:
             engine_cfg.eos_token_id = getattr(
                 self.tokenizer, "eos_token_id", None)
-        self.engine = LLMEngine(engine_cfg)
+        # pp > 1: the replica becomes the rank-0 scheduler of a
+        # pipeline-parallel stage gang (serve/llm/pp.py); same engine
+        # surface, so the driver loop and streaming path are unchanged
+        self.engine = make_engine(engine_cfg)
         if llm_config.warmup:
             self.engine.warmup()
         self._ids = itertools.count()
@@ -376,11 +380,20 @@ class OpenAIIngress:
 
 def placement_options(llm_config: LLMConfig) -> Dict[str, Any]:
     """Deployment placement options for an engine-hosting replica: a
-    tp-sized SLICE_PACK bundle set when the config asks for a TPU gang
-    reservation, else nothing."""
+    SLICE_PACK bundle set when the config asks for a TPU gang
+    reservation — one tp-chip bundle for a single-process engine, one
+    PER STAGE for a pipelined one (bundle order follows the ICI snake
+    path, so stage k and k+1 land on neighbouring hosts) — else
+    nothing."""
     tp = getattr(llm_config.engine, "tp", 1)
-    if not llm_config.reserve_tpu_bundle or tp <= 1:
+    pp = getattr(llm_config.engine, "pp", 1)
+    if not llm_config.reserve_tpu_bundle or (tp <= 1 and pp <= 1):
         return {}
+    if pp > 1:
+        from .sharding import pp_bundles
+
+        return {"placement_bundles": pp_bundles(pp, tp),
+                "placement_strategy": "SLICE_PACK"}
     from .sharding import tp_bundles
 
     return {"placement_bundles": tp_bundles(tp),

@@ -84,11 +84,19 @@ class ServeSharding:
     def param_shardings(self, model, example_ids):
         """NamedShardings for the model's (unboxed) param tree, derived
         from the logical axis annotations via the shared rule table."""
+        return self.module_param_shardings(model, example_ids)
+
+    def module_param_shardings(self, module, *example_args):
+        """`param_shardings` for an arbitrary flax module signature —
+        the pipelined engine's StageModel takes (x, positions,
+        kv_caches), not just ids, but shards by the SAME logical axis
+        annotations (its params keep the full model's names), so one
+        rule-table lowering serves both."""
         import flax.linen as nn
         import jax
 
         abstract = jax.eval_shape(
-            lambda: model.init(jax.random.PRNGKey(0), example_ids))
+            lambda: module.init(jax.random.PRNGKey(0), *example_args))
         logical = nn.get_partition_spec(abstract)
         return nn.logical_to_mesh_sharding(
             logical, self.mesh, self._rules())["params"]
@@ -166,6 +174,28 @@ def tp_bundles(tp: int,
             f"exposes; the single-process engine cannot span hosts "
             f"(multi-host tensor parallelism is not supported yet)")
     return [{"TPU": float(tp)}]
+
+
+def pp_bundles(pp: int, tp: int = 1,
+               chips_per_host: int = CHIPS_PER_HOST) -> List[Dict[str, float]]:
+    """Placement-group bundles for a pipeline-parallel stage gang: one
+    tp-chip bundle PER STAGE. Each stage engine is its own worker
+    process with a single-host tp mesh, so per-stage tp keeps the
+    one-host bound tp_bundles enforces — but stages themselves may (and
+    at pp*tp > chips_per_host must) land on different hosts. SLICE_PACK
+    walks the gang along the ICI snake path (runtime/topology.py
+    ici_path via scheduling), so bundle order == stage order ==
+    neighbouring hosts: the rank k -> k+1 activation channel crosses
+    one ICI hop, not the slice diameter."""
+    if pp < 1:
+        raise ValueError(f"pp must be >= 1, got pp={pp}")
+    if tp > chips_per_host:
+        raise ValueError(
+            f"tp={tp} exceeds the {chips_per_host} chips one host "
+            f"exposes; a pipeline stage is a single-process tp engine, "
+            f"so scale further with pp (stages multiply chips, tp "
+            f"cannot widen past one host)")
+    return [{"TPU": float(tp)} for _ in range(pp)]
 
 
 def resolve_serve_mesh(mesh=None, tp: int = 1,

@@ -76,6 +76,12 @@ def pytest_configure(config):
         "persist-dir journal framing, torn-write fuzz matrix, "
         "replay↔reattach reconciliation) tests + the kill -9 restart "
         "drill in tests/test_chaos.py")
+    config.addinivalue_line(
+        "markers", "pp: pipeline-parallel serving (multi-process stage "
+        "engines over compiled-DAG channels: bit-exact greedy parity vs "
+        "the single-process engine, zero steady-state control RPCs, "
+        "bubble accounting, stage gang placement) tests + the stage-rank "
+        "kill drill in tests/test_chaos.py")
 
 
 @pytest.fixture
