@@ -391,6 +391,17 @@ def bench_broadcast_spill() -> dict:
     return _run_bench_json("broadcast_spill.py", 300)
 
 
+def bench_scale_envelope() -> dict:
+    """Scheduler scale envelope over the in-process 100-node harness
+    (benchmarks/scale_envelope.py): many_tasks_per_s /
+    many_actors_per_s / many_pgs_per_s against real
+    controller/gossip/spill paths with fake workers,
+    gossip_entries_per_beat (O(changed) bar), and the warm-standby
+    failover drill — recovery_controller_failover_ms < 1000 with every
+    actor reattached, never re-created (failover_drill_green)."""
+    return _run_bench_json("scale_envelope.py", 480)
+
+
 def bench_train(on_tpu: bool) -> dict:
     import jax
     import jax.numpy as jnp
@@ -627,6 +638,27 @@ def main():
         except Exception as e:  # noqa: BLE001
             result["detail"]["broadcast_spill"] = {"error": repr(e)[:200]}
             result["detail"]["spill_storm_green"] = False
+
+    # 8e. scheduler scale envelope: the 100-node in-process harness
+    # (many_tasks / many_actors / many_pgs throughput, O(changed)
+    # gossip fan-out) + the warm-standby controller failover drill
+    # (recovery_controller_failover_ms, zero actor re-creation), same
+    # time guard
+    if time.perf_counter() - start < 480:
+        try:
+            scale = bench_scale_envelope()
+            result["detail"]["scale_envelope"] = scale
+            for key in ("many_tasks_per_s", "many_actors_per_s",
+                        "many_pgs_per_s", "gossip_entries_per_beat",
+                        "recovery_controller_failover_ms",
+                        "failover_drill_green", "scale_envelope_green"):
+                if key in scale:
+                    result["detail"][key] = scale[key]
+            if "failover_drill_green" not in scale:
+                result["detail"]["failover_drill_green"] = False
+        except Exception as e:  # noqa: BLE001
+            result["detail"]["scale_envelope"] = {"error": repr(e)[:200]}
+            result["detail"]["failover_drill_green"] = False
 
     # 9. static analysis: rtpulint per-file rules over the WHOLE package
     # (cheap, ~2s). lint_clean records when the tree regresses on a

@@ -1,0 +1,345 @@
+"""Scale envelope: the 100-node in-process harness under control-plane
+load, plus the warm-standby failover drill at that scale.
+
+runtime/simcluster.py boots N nodelets (real Nodelet code: registration,
+heartbeats, gossip, spill, journal) whose workers are in-process fakes —
+so one box exercises the CONTROL plane at a node count it could never
+host for real. Against that harness this bench measures:
+
+  many_tasks_per_s       — plain-task completions/s: 30k zero-work tasks
+                           submitted from one owner, placed across the
+                           harness via owner-side backlog frames, the
+                           gossiped p2p spill window, and batched
+                           pick_nodes waves (100k with --full)
+  many_actors_per_s      — actor create->ready->first-call round trips/s
+  many_pgs_per_s         — placement groups reserved+removed/s (1-bundle
+                           groups over the harness's "sim" resource)
+  gossip_entries_per_beat — per-beat view fan-out measured over a quiet
+                           window: must be O(changed), not O(nodes)
+  recovery_controller_failover_ms — warm-standby promotion time
+                           (rtpu_recovery_ms{scenario=controller_failover}),
+                           lease-expiry triggered, with live actors
+  failover_drill_green   — every failover assertion held: sub-second
+                           activation, every actor exactly one ALIVE
+                           incarnation on its ORIGINAL worker (zero
+                           re-creations), handles keep working, zero
+                           untyped client errors
+
+Bars (the PR-20 acceptance set):
+  - recovery_controller_failover_ms < 1000 and zero actor re-creation —
+    NEVER load-downgraded;
+  - idle gossip fan-out stays O(changed): <= max(8, 0.2 * nodes)
+    entries/beat — never downgraded (it is a payload count, not a rate);
+  - throughput floors (many_tasks_per_s >= 300, many_actors_per_s >= 5,
+    many_pgs_per_s >= 5) downgrade to load_note on a measurably starved
+    box (loadavg > 1.5x cores) — the PR-11 deflake discipline.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_NODES = 100
+N_TASKS = 30_000
+N_TASKS_FULL = 100_000
+N_ACTORS = 200
+N_PGS = 100
+N_FAILOVER_ACTORS = 20
+GOSSIP_WINDOW_S = 3.0
+
+
+def _note(msg: str) -> None:
+    print(f"[scale_envelope] {msg}", file=sys.stderr, flush=True)
+
+
+def _suite_overloaded() -> bool:
+    try:
+        return os.getloadavg()[0] > 1.5 * (os.cpu_count() or 1)
+    except OSError:
+        return False
+
+
+def _bench_many_tasks(ray_tpu, session, n_tasks: int) -> dict:
+    @ray_tpu.remote(num_cpus=0, resources={"sim": 1})
+    def echo(x):
+        return x
+
+    t0 = time.perf_counter()
+    refs = [echo.remote(i) for i in range(n_tasks)]
+    staged_s = time.perf_counter() - t0
+    out = ray_tpu.get(refs, timeout=500)
+    dt = time.perf_counter() - t0
+    assert out[min(12345, n_tasks - 1)] == min(12345, n_tasks - 1)
+    head = dict(session.nodelet_inproc.sched_counters)
+    return {
+        "n": n_tasks,
+        "staged_s": round(staged_s, 2),
+        "wall_s": round(dt, 2),
+        "many_tasks_per_s": round(n_tasks / dt, 1),
+        "pick_node_rpcs": head.get("pick_node_rpcs", 0),
+        "spill_bounces": head.get("spill_bounces", 0),
+    }
+
+
+def _bench_many_actors(ray_tpu, n_actors: int) -> dict:
+    @ray_tpu.remote(num_cpus=0, resources={"sim": 1})
+    class Echo:
+        def ping(self, x):
+            return x
+
+    t0 = time.perf_counter()
+    actors = [Echo.remote() for _ in range(n_actors)]
+    refs = [a.ping.remote(i) for i, a in enumerate(actors)]
+    out = ray_tpu.get(refs, timeout=300)
+    dt = time.perf_counter() - t0
+    assert out == list(range(n_actors))
+    for a in actors:
+        ray_tpu.kill(a)
+    return {
+        "n": n_actors,
+        "wall_s": round(dt, 2),
+        "many_actors_per_s": round(n_actors / dt, 1),
+    }
+
+
+def _bench_many_pgs(ray_tpu, n_pgs: int) -> dict:
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    t0 = time.perf_counter()
+    pgs = [placement_group([{"sim": 1}], strategy="PACK")
+           for _ in range(n_pgs)]
+    for pg in pgs:
+        assert pg.ready(timeout=120), f"pg {pg.id} never reserved"
+    for pg in pgs:
+        remove_placement_group(pg)
+    dt = time.perf_counter() - t0
+    return {
+        "n": n_pgs,
+        "wall_s": round(dt, 2),
+        "many_pgs_per_s": round(n_pgs / dt, 1),
+    }
+
+
+def _measure_gossip(cluster, window_s: float) -> dict:
+    """Idle-window fan-out: with no membership/resource churn the
+    per-beat delta payload must be near-empty regardless of N."""
+    before = cluster.gossip_stats()
+    time.sleep(window_s)
+    after = cluster.gossip_stats()
+    beats = max(1, after["beats"] - before["beats"])
+    entries = after["entries"] - before["entries"]
+    return {
+        "window_s": window_s,
+        "beats": beats,
+        "entries": entries,
+        "gossip_entries_per_beat": round(entries / beats, 2),
+    }
+
+
+def _failover_drill(ray_tpu, session, cluster, n_actors: int) -> dict:
+    """Kill the primary controller in place with live actors on the
+    harness; the warm standby must take over on lease expiry in < 1s of
+    activation time, and every actor must come back as ITS OWN worker
+    (reattach, not re-create) with handles still working."""
+    from ray_tpu.runtime import faults
+    from ray_tpu.runtime import rpc as rtpu_rpc
+    from ray_tpu.runtime.config import get_config
+    from ray_tpu.runtime.controller import StandbyController
+    from ray_tpu.util import metrics as rtpu_metrics
+
+    out: dict = {"n_actors": n_actors, "failover_drill_green": False}
+    problems = []
+
+    @ray_tpu.remote(num_cpus=0, resources={"sim": 1})
+    class Survivor:
+        def ping(self, x):
+            return x
+
+    actors = [Survivor.options(name=f"fo-{i}").remote()
+              for i in range(n_actors)]
+    assert ray_tpu.get([a.ping.remote(i) for i, a in enumerate(actors)],
+                       timeout=120) == list(range(n_actors))
+
+    elt = rtpu_rpc.EventLoopThread.get()
+    ctrl = session.controller_inproc
+    pre = {row["actor_id"]: row for row in
+           session.core.controller.call("list_actors")
+           if row.get("state") == "ALIVE"}
+
+    standby_addr = f"unix:{session.session_dir}/sock/standby.sock"
+    standby = StandbyController(
+        session.session_name, session.controller_addr,
+        listen_address=standby_addr)
+    elt.run(standby.start())
+    # read follower state over its OWN admin surface, the way an
+    # operator's probe would
+    status = rtpu_rpc.RpcClient(standby_addr).call("standby_status")
+    out["standby_applied_seq"] = status["applied_seq"]
+    assert not status["promoted"]
+
+    # in-place primary death: cancel its health loop and close its
+    # server — the kill -9 analogue that leaves the address free
+    elt.loop.call_soon_threadsafe(ctrl._health_task.cancel)
+    elt.run(ctrl._server.stop())
+    t_kill = time.perf_counter()
+
+    deadline = time.perf_counter() + 8 * get_config().standby_lease_timeout_s
+    while standby.promoted is None and time.perf_counter() < deadline:
+        time.sleep(0.02)
+    detect_s = time.perf_counter() - t_kill
+    if standby.promoted is None:
+        problems.append("standby never promoted on lease expiry")
+        out["problems"] = problems
+        return out
+    out["failover_detect_s"] = round(detect_s, 2)
+
+    snap = rtpu_metrics.snapshot("rtpu_recovery_ms")
+    rec_ms = snap.get("rtpu_recovery_ms{scenario=controller_failover}")
+    out["recovery_controller_failover_ms"] = (
+        round(rec_ms, 2) if rec_ms is not None else None)
+    if rec_ms is None or rec_ms >= 1000.0:
+        problems.append(f"promotion activation {rec_ms} ms >= 1000 ms")
+
+    # nodelets heal via heartbeat {registered: False} -> re-register ->
+    # reattach_actor per live worker. Wait for the whole harness.
+    try:
+        cluster.wait_alive(timeout=60)
+    except TimeoutError:
+        problems.append("harness never fully re-registered on the "
+                        "promoted controller")
+    t_wait = time.perf_counter() + 60
+    post = {}
+    while time.perf_counter() < t_wait:
+        post = {row["actor_id"]: row for row in
+                session.core.controller.call("list_actors")
+                if row.get("state") == "ALIVE"}
+        if len([a for a in pre if a in post]) == len(pre):
+            break
+        time.sleep(0.1)
+    missing = [a for a in pre if a not in post]
+    if missing:
+        problems.append(f"{len(missing)} actors not ALIVE after failover")
+    # reattached, not re-created: same worker address, zero restarts
+    recreated = [a for a in pre if a in post
+                 and (post[a].get("address") != pre[a].get("address")
+                      or post[a].get("num_restarts", 0)
+                      != pre[a].get("num_restarts", 0))]
+    if recreated:
+        problems.append(f"{len(recreated)} actors were RE-CREATED "
+                        "(address/restart count changed) instead of "
+                        "reattached")
+    out["actors_reattached"] = len(pre) - len(missing) - len(recreated)
+
+    # exactly one live incarnation per actor: the ALIVE rows must map
+    # 1:1 onto the pre-failover set for our name prefix
+    dupes = [a for a, row in post.items()
+             if a not in pre and str(row.get("name", "")).startswith("fo-")]
+    if dupes:
+        problems.append(f"{len(dupes)} extra live incarnations")
+
+    errors = 0
+    for i, a in enumerate(actors):
+        try:
+            assert ray_tpu.get(a.ping.remote(i), timeout=60) == i
+        except Exception:  # noqa: BLE001 — counted, reported, asserted zero
+            errors += 1
+    if errors:
+        problems.append(f"{errors} post-failover calls failed")
+    out["post_failover_call_errors"] = errors
+
+    for a in actors:
+        ray_tpu.kill(a)
+    if problems:
+        out["problems"] = problems
+    out["failover_drill_green"] = not problems
+    return out
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--nodes", type=int, default=N_NODES)
+    parser.add_argument("--tasks", type=int, default=0,
+                        help="0 = 30k (100k with --full)")
+    parser.add_argument("--full", action="store_true",
+                        help="100k-task envelope instead of 30k")
+    args = parser.parse_args()
+    n_tasks = args.tasks or (N_TASKS_FULL if args.full else N_TASKS)
+
+    import ray_tpu
+    from ray_tpu.runtime.simcluster import SimCluster
+
+    out = {"nodes": args.nodes, "failover_drill_green": False}
+    os.environ.setdefault("RTPU_prestart_workers", "0")
+    session = ray_tpu.init(num_cpus=2)
+    try:
+        with SimCluster(n_nodes=args.nodes, max_workers=4) as cluster:
+            cluster.wait_alive(timeout=120)
+            _note(f"harness alive: {cluster.alive_nodes()} nodes")
+            tasks = _bench_many_tasks(ray_tpu, session, n_tasks)
+            _note(f"many_tasks: {tasks}")
+            actors = _bench_many_actors(ray_tpu, N_ACTORS)
+            _note(f"many_actors: {actors}")
+            pgs = _bench_many_pgs(ray_tpu, N_PGS)
+            _note(f"many_pgs: {pgs}")
+            gossip = _measure_gossip(cluster, GOSSIP_WINDOW_S)
+            _note(f"gossip: {gossip}")
+            drill = _failover_drill(ray_tpu, session, cluster,
+                                    N_FAILOVER_ACTORS)
+            _note(f"failover: {drill}")
+            out["detail"] = {"many_tasks": tasks, "many_actors": actors,
+                             "many_pgs": pgs, "gossip": gossip,
+                             "failover": drill}
+            for src, key in ((tasks, "many_tasks_per_s"),
+                             (actors, "many_actors_per_s"),
+                             (pgs, "many_pgs_per_s"),
+                             (gossip, "gossip_entries_per_beat"),
+                             (drill, "recovery_controller_failover_ms"),
+                             (drill, "failover_drill_green")):
+                out[key] = src.get(key)
+
+            problems = list(drill.get("problems", []))
+            # payload-shape bar: never load-downgraded
+            beat_cap = max(8.0, 0.2 * args.nodes)
+            if gossip["gossip_entries_per_beat"] > beat_cap:
+                problems.append(
+                    f"idle gossip fan-out {gossip['gossip_entries_per_beat']}"
+                    f" entries/beat > {beat_cap} (O(nodes), not O(changed))")
+            # throughput floors: load-guarded
+            soft = []
+            if tasks["many_tasks_per_s"] < 300:
+                soft.append(f"many_tasks {tasks['many_tasks_per_s']}/s"
+                            " < 300/s")
+            if actors["many_actors_per_s"] < 5:
+                soft.append(f"many_actors {actors['many_actors_per_s']}/s"
+                            " < 5/s")
+            if pgs["many_pgs_per_s"] < 5:
+                soft.append(f"many_pgs {pgs['many_pgs_per_s']}/s < 5/s")
+            if soft and _suite_overloaded():
+                out["load_note"] = (
+                    f"throughput floors missed under load (loadavg "
+                    f"{os.getloadavg()[0]:.1f} on {os.cpu_count()} "
+                    "cores): " + "; ".join(soft))
+                soft = []
+            problems.extend(soft)
+            if problems:
+                out["problems"] = problems
+            out["scale_envelope_green"] = not problems
+            out["failover_drill_green"] = drill["failover_drill_green"]
+    except Exception as e:  # noqa: BLE001 — the bench line reports it
+        out["error"] = repr(e)[:300]
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001 — drill teardown is best-effort
+            pass
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -66,6 +66,12 @@ class RuntimeConfig:
     # Drain delay in seconds. 0 drains on the next loop pass (lowest
     # latency); >0 trades per-call latency for larger coalesced bursts.
     submit_drain_interval_s: float = 0.0
+    # Backlog batching: frames (of submit_batch_max specs each) one
+    # io-loop wakeup may drain when the staged queue runs deep. 1
+    # restores one-frame-per-wakeup; under a 100k+ staged burst the
+    # re-arm hop per frame dominates, so deep backlogs drain several
+    # frames per wakeup while shallow ones keep the low-latency path.
+    submit_backlog_frames: int = 8
 
     # --- controller persistence (runtime/storage.py) ---
     # fsync policy for the persist-dir journal/snapshots: "always"
@@ -76,6 +82,22 @@ class RuntimeConfig:
     # loses nothing under any policy (OS-buffered writes survive process
     # death); the knob prices host/power failure.
     persist_fsync: str = "batch"
+    # Journal compaction policy: rewrite the kv/actor journal into a
+    # snapshot once either bound trips (records appended since the last
+    # compaction, or bytes appended). Bounds restart replay to one
+    # snapshot load + a bounded tail under sustained actor churn —
+    # every create/restart/death is one journal record. 0 disables that
+    # trigger; both 0 disables size-based compaction entirely.
+    journal_compact_records: int = 4096
+    journal_compact_bytes: int = 4 << 20
+    # Warm-standby controller (controller.StandbyController): the
+    # follower replays the primary's framed journal stream continuously
+    # and promotes itself when the primary has been silent (no stream
+    # record, no successful lease ping) for this long. Explicit
+    # standby_promote ignores the lease.
+    standby_lease_timeout_s: float = 2.0
+    # Cadence of the follower's lease pings against the primary.
+    standby_poll_interval_s: float = 0.25
 
     # --- health / liveness (ref: gcs_health_check_manager.cc cadence flags
     # ray_config_def.h:879-885) ---

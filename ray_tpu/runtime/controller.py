@@ -74,6 +74,20 @@ class NodeInfo:
         }
 
 
+_compaction_metric = None
+
+
+def _count_compaction() -> None:
+    global _compaction_metric
+    if _compaction_metric is None:
+        from ..util.metrics import Counter
+
+        _compaction_metric = Counter(
+            "rtpu_journal_compactions_total",
+            "journal-to-snapshot compactions performed")
+    _compaction_metric.inc()
+
+
 ACTOR_PENDING = "PENDING_CREATION"
 ACTOR_ALIVE = "ALIVE"
 ACTOR_RESTARTING = "RESTARTING"
@@ -175,6 +189,35 @@ class Controller:
         # revision they applied and heartbeat replies ship only newer
         # entries
         self._view_rev = 0
+        # recency index over nodes, most-recently-CHANGED last: a view
+        # delta walks it from the newest end and stops at the first
+        # entry at-or-below the asking nodelet's revision — O(changed),
+        # where the previous full-table scan made every heartbeat reply
+        # O(N) and the gossip plane O(N^2) per beat interval at scale
+        self._view_index: "collections.OrderedDict[str, NodeInfo]" = \
+            collections.OrderedDict()
+        # alive-node count maintained at the liveness transitions (the
+        # per-heartbeat sum() over all nodes was another O(N)-per-beat)
+        self._alive_count = 0
+        # recency index over heartbeats, most-recently-BEATEN last: the
+        # health sweep pops stale nodes off the old end and stops at the
+        # first fresh one — O(stale+1) per sweep instead of O(N)
+        self._beat_order: "collections.OrderedDict[str, None]" = \
+            collections.OrderedDict()
+        # gossip fan-out accounting (cluster_status): proves delta
+        # gossip ships O(changed) entries per beat, not O(nodes)
+        self._gossip_beats = 0
+        self._gossip_entries = 0
+        # journal position: one seq per streamed/journaled mutation.
+        # meta snapshots stamp the seq they cover so replay never
+        # re-applies actor records older than the snapshot
+        self._journal_seq = 0
+        self._journal_records_since = 0
+        self._journal_bytes_since = 0
+        self._compactions = 0
+        # warm-standby followers: connections subscribed via
+        # journal_subscribe; every mutation record is streamed to them
+        self._standby_conns: List[ServerConn] = []
         self._server = RpcServer(address, self._handlers(), on_disconnect=self._on_disconnect)
         self._health_task: Optional[asyncio.Task] = None
         self.start_time = time.time()
@@ -195,12 +238,10 @@ class Controller:
     #   every control RPC O(total state)); compacted into kv.pkl on
     #   restart replay
 
-    def _persist(self) -> None:
-        """Atomic snapshot of the small metadata tables (jobs, PG specs,
-        named actors). KV mutations go through _journal_kv instead."""
-        if self._store_backend is None:
-            return
-        state = {
+    def _state_dict(self) -> dict:
+        """The durable metadata tables as one snapshot dict — what
+        meta.pkl persists and what journal_subscribe hands a standby."""
+        return {
             "jobs": dict(self.jobs),
             # placement IS persisted: replay tries to re-reserve the
             # SAME bundles on re-registered nodes first (idempotent
@@ -216,8 +257,20 @@ class Controller:
                 info.actor_id: info.spec
                 for info in self.actors.values()
                 if info.spec.get("name") and info.state != ACTOR_DEAD},
+            # every actor journal record at or below this seq is already
+            # reflected here: replay skips those instead of re-applying
+            # a pre-snapshot create/death over newer snapshot state
+            "actor_seq": self._journal_seq,
         }
-        self._store_backend.save_meta(pickle.dumps(state))
+
+    def _persist(self) -> None:
+        """Atomic snapshot of the small metadata tables (jobs, PG specs,
+        named actors). KV and actor-churn mutations go through
+        _journal_kv/_journal_actor instead — appended, not rewritten."""
+        state = self._state_dict()
+        if self._store_backend is not None:
+            self._store_backend.save_meta(pickle.dumps(state))
+        self._stream_record(("meta", "", "", state, self._journal_seq))
 
     @staticmethod
     def _persistable_pg(pg: dict) -> dict:
@@ -235,9 +288,79 @@ class Controller:
     def _journal_kv(self, op: str, ns: str, key: str,
                     value: Optional[bytes] = None) -> None:
         """Append one KV mutation record — O(record), not O(store)."""
+        self._journal_seq += 1
+        if self._store_backend is not None:
+            self._store_backend.append_kv((op, ns, key, value))
+            self._account_journal(len(value) if value else 0)
+        self._stream_record((op, ns, key, value, self._journal_seq))
+
+    def _journal_actor(self, op: str, actor_id: str,
+                       spec: Optional[dict] = None) -> None:
+        """Append one actor-lifecycle record ("aput" upsert / "adel"
+        drop). Under churn every named-actor create/restart/death was a
+        FULL meta rewrite — O(named actors) per mutation; now it is one
+        O(record) append, and compaction folds the tail back into the
+        snapshot. The seq rides inside the pickled value so the journal
+        record stays the 4-tuple shape the tail-truncating reader
+        already frames."""
+        self._journal_seq += 1
+        blob = pickle.dumps((self._journal_seq, spec))
+        if self._store_backend is not None:
+            self._store_backend.append_kv((op, "", actor_id, blob))
+            self._account_journal(len(blob))
+        self._stream_record((op, "", actor_id, spec, self._journal_seq))
+
+    def _account_journal(self, nbytes: int) -> None:
+        """Track journal growth since the last compaction and compact
+        once either knob trips: replay cost stays one snapshot load
+        plus a bounded tail, however long the churn ran."""
+        self._journal_records_since += 1
+        # ~overhead of one framed pickled record around the payload
+        self._journal_bytes_since += nbytes + 64
+        cfg = get_config()
+        rec_cap = cfg.journal_compact_records
+        byte_cap = cfg.journal_compact_bytes
+        if (rec_cap and self._journal_records_since >= rec_cap) or \
+                (byte_cap and self._journal_bytes_since >= byte_cap):
+            self._compact_journal()
+
+    def _compact_journal(self) -> None:
+        """Fold the journal into fresh snapshots: meta first (its
+        actor_seq stamp covers every actor record in the journal), then
+        the kv snapshot (which truncates the journal). Crash-safe at
+        every point: the controller.persist syncpoints inside the
+        backend leave either the old or the new file of each snapshot,
+        and a journal that outlives a newer meta replays only the
+        records the meta does not already cover (the seq guard)."""
         if self._store_backend is None:
             return
-        self._store_backend.append_kv((op, ns, key, value))
+        self._persist()
+        self._store_backend.compact_kv(pickle.dumps(
+            {ns: dict(kvs) for ns, kvs in self.kv.items()}))
+        self._journal_records_since = 0
+        self._journal_bytes_since = 0
+        self._compactions += 1
+        _count_compaction()
+
+    def _stream_record(self, record: tuple) -> None:
+        """Fan one mutation record out to subscribed standbys. Notify
+        tasks are created in mutation order and each connection's write
+        lock is FIFO, so a single subscriber observes records in order;
+        the follower still seq-guards and resyncs on any gap."""
+        if not self._standby_conns:
+            return
+        for conn in [c for c in self._standby_conns if c.closed]:
+            self._standby_conns.remove(conn)
+        for conn in self._standby_conns:
+            spawn_logged(self._notify_standby(conn, record),
+                         name="controller.stream_journal")
+
+    @staticmethod
+    async def _notify_standby(conn: ServerConn, record: tuple) -> None:
+        try:
+            await conn.notify("journal_record", record=record)
+        except Exception as e:  # noqa: BLE001 — a dead follower resyncs on reconnect; the primary must not fail a mutation over it
+            log.debug("journal stream to standby failed: %r", e)
 
     def _replay_persisted(self) -> None:
         """Replay snapshot + journal into fresh tables (ref:
@@ -258,6 +381,61 @@ class Controller:
                 log.warning("persisted meta snapshot unreadable; "
                             "starting with empty meta tables")
                 state = {}
+        self._load_state(state)
+        snap_blob, records, had_journal = self._store_backend.load_kv()
+        if snap_blob:
+            try:
+                loaded = pickle.loads(snap_blob)
+            except Exception:  # rtpulint: ignore[RTPU006] — a corrupt legacy kv snapshot must not crash the boot; journal replay still runs
+                count_corruption("kv_snapshot")
+                log.warning("persisted kv snapshot unreadable; "
+                            "replaying journal only")
+                loaded = {}
+            for ns, kvs in loaded.items():
+                self.kv[ns].update(kvs)
+        meta_seq = int(state.get("actor_seq", 0) or 0)
+        self._journal_seq = meta_seq
+        for record in records:
+            try:
+                op, ns, key, value = record
+            except Exception:
+                break  # malformed record; prefix is intact
+            if op == "put":
+                self.kv[ns][key] = value
+            elif op in ("aput", "adel"):
+                # actor-churn records: the seq rides inside the pickled
+                # value; records the meta snapshot already covers are
+                # skipped (a meta rewrite can postdate journal appends)
+                try:
+                    seq, spec = pickle.loads(value)
+                except Exception:  # rtpulint: ignore[RTPU006] — one corrupt actor record is skipped, not a boot abort; the prefix already replayed
+                    count_corruption("actor_record")
+                    continue
+                if seq > self._journal_seq:
+                    self._journal_seq = seq
+                if seq <= meta_seq:
+                    continue
+                self._apply_actor_record(op, key, spec)
+            else:
+                self.kv[ns].pop(key, None)
+        if had_journal:
+            # compact even when only a torn tail was found: appends
+            # after uncleared garbage would be unreadable next replay.
+            # Meta first: the journal may hold actor records the last
+            # meta predates, and the kv compaction below drops them —
+            # without the fresh (actor_seq-stamped) meta a SECOND
+            # restart would lose that churn tail.
+            self._persist()
+            self._store_backend.compact_kv(pickle.dumps(
+                {ns: dict(kvs) for ns, kvs in self.kv.items()}))
+        # actor/PG rescheduling kicks off in start() (needs the loop)
+
+    def _load_state(self, state: dict) -> None:
+        """Apply one durable-state snapshot dict (from meta.pkl replay
+        or a primary's journal_subscribe reply) onto fresh tables —
+        PGs come back PENDING with their original placement stashed for
+        same-bundle re-reservation, named actors come back RESTARTING
+        awaiting reattach."""
         self.jobs.update(state.get("jobs", {}))
         for pg_id, pg in state.get("placement_groups", {}).items():
             # bundles must be re-reserved on live nodes; stash the old
@@ -281,32 +459,28 @@ class Controller:
             # double-created every replayed actor whose process survived
             info.awaiting_reattach = True
             self.actors[actor_id] = info
-        snap_blob, records, had_journal = self._store_backend.load_kv()
-        if snap_blob:
-            try:
-                loaded = pickle.loads(snap_blob)
-            except Exception:  # rtpulint: ignore[RTPU006] — a corrupt legacy kv snapshot must not crash the boot; journal replay still runs
-                count_corruption("kv_snapshot")
-                log.warning("persisted kv snapshot unreadable; "
-                            "replaying journal only")
-                loaded = {}
-            for ns, kvs in loaded.items():
-                self.kv[ns].update(kvs)
-        for record in records:
-            try:
-                op, ns, key, value = record
-            except Exception:
-                break  # malformed record; prefix is intact
-            if op == "put":
-                self.kv[ns][key] = value
-            else:
-                self.kv[ns].pop(key, None)
-        if had_journal:
-            # compact even when only a torn tail was found: appends
-            # after uncleared garbage would be unreadable next replay
-            self._store_backend.compact_kv(pickle.dumps(
-                {ns: dict(kvs) for ns, kvs in self.kv.items()}))
-        # actor/PG rescheduling kicks off in start() (needs the loop)
+
+    def _apply_actor_record(self, op: str, actor_id: str,
+                            spec: Optional[dict]) -> None:
+        """Overlay one replayed actor-churn record on the tables built
+        so far (same replay semantics as _load_state's actor_specs)."""
+        if op == "aput":
+            info = ActorInfo(actor_id, spec or {})
+            info.state = ACTOR_RESTARTING
+            info.awaiting_reattach = True
+            self.actors[actor_id] = info
+            name = info.spec.get("name")
+            if name:
+                ns = info.spec.get("namespace", "")
+                self.named_actors[(ns, name)] = actor_id
+        else:
+            info = self.actors.pop(actor_id, None)
+            spec = info.spec if info is not None else (spec or {})
+            name = spec.get("name")
+            if name:
+                ns = spec.get("namespace", "")
+                if self.named_actors.get((ns, name)) == actor_id:
+                    self.named_actors.pop((ns, name), None)
 
     def _handlers(self):
         return {
@@ -328,6 +502,7 @@ class Controller:
             "kill_actor": self.kill_actor,
             # scheduling
             "pick_node": self.pick_node,
+            "pick_nodes": self.pick_nodes,
             # placement groups
             "create_placement_group": self.create_placement_group,
             "remove_placement_group": self.remove_placement_group,
@@ -354,6 +529,8 @@ class Controller:
             "fault_inject": self.fault_inject,
             "reattach_actor": self.reattach_actor,
             "ping": self.ping,
+            # warm standby
+            "journal_subscribe": self.journal_subscribe,
         }
 
     async def start(self):
@@ -383,24 +560,56 @@ class Controller:
                 pass
         if self._health_task:
             self._health_task.cancel()
-        for node in self.nodes.values():
-            if node.client is not None:
-                try:
-                    await node.client.notify_async("shutdown")
-                except Exception:  # rtpulint: ignore[RTPU006] — a nodelet that is already gone needs no shutdown notice
-                    pass
+        # best-effort shutdown notices, fanned out concurrently under
+        # ONE bound: each already-dead node otherwise costs a full
+        # rpc_connect_timeout_s redial loop, serially — stopping a
+        # controller over a torn-down 100-node harness took minutes
+        notifies = [self._notify_shutdown(node.client)
+                    for node in self.nodes.values()
+                    if node.client is not None]
+        if notifies:
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*notifies, return_exceptions=True),
+                    timeout=2.0)
+            except asyncio.TimeoutError:
+                pass
         await self._server.stop()
+
+    @staticmethod
+    async def _notify_shutdown(client) -> None:
+        try:
+            await client.notify_async("shutdown")
+        except Exception:  # rtpulint: ignore[RTPU006] — a nodelet that is already gone needs no shutdown notice
+            pass
 
     # ------------------------------------------------------------------ nodes
     def _bump_view(self, node: NodeInfo) -> None:
         self._view_rev += 1
         node.entry_rev = self._view_rev
+        # recency index: most-recently-changed last. Reassign (not just
+        # move) so a re-registered node's fresh NodeInfo replaces the
+        # stale object under the same id.
+        self._view_index[node.node_id] = node
+        self._view_index.move_to_end(node.node_id)
 
     def _view_delta(self, known_rev: int, exclude: str = None) -> List[dict]:
         """Gossip entries that changed since the asking nodelet's last
-        applied revision (its own entry is omitted — it IS the source)."""
-        return [n.view_wire() for n in self.nodes.values()
-                if n.entry_rev > known_rev and n.node_id != exclude]
+        applied revision (its own entry is omitted — it IS the source).
+
+        Walks the recency index from the newest end and stops at the
+        first entry at-or-below known_rev — O(changed entries), where
+        the previous full-table comprehension cost O(N) per heartbeat
+        even when nothing changed (at 100+ peers beating twice a
+        second, that scan WAS the control-plane load)."""
+        out: List[dict] = []
+        for node in reversed(self._view_index.values()):
+            if node.entry_rev <= known_rev:
+                break  # everything older is already applied
+            if node.node_id != exclude:
+                out.append(node.view_wire())
+        out.reverse()  # oldest-first, matching the previous wire order
+        return out
 
     async def register_node(self, node_id: str, address: str,
                             resources: Dict[str, float],
@@ -427,11 +636,15 @@ class Controller:
             faults.record_recovery(
                 "node_reregister",
                 (time.monotonic() - old.died_at) * 1000.0)
+        if old is None or not old.alive:
+            self._alive_count += 1
         self.nodes[node_id] = info
+        self._beat_order[node_id] = None
+        self._beat_order.move_to_end(node_id)
         self._bump_view(info)
         await self._publish("node", {"event": "node_added", "node": info.snapshot()})
         return {"session_name": self.session_name,
-                "n_nodes": sum(1 for n in self.nodes.values() if n.alive),
+                "n_nodes": self._alive_count,
                 # seed the new nodelet's cluster view at registration so
                 # p2p spill works before the first gossip beat
                 "view": self._view_delta(0, exclude=node_id),
@@ -446,6 +659,11 @@ class Controller:
         if node is None:
             return {"registered": False}
         node.last_heartbeat = time.monotonic()
+        # heartbeats arrive with monotonically increasing timestamps, so
+        # append-to-end keeps the recency index sorted by last beat and
+        # the health sweep only ever inspects the stale front
+        self._beat_order[node_id] = None
+        self._beat_order.move_to_end(node_id)
         want_full = False
         changed = False
         if available_resources is not None:
@@ -476,6 +694,7 @@ class Controller:
             # heartbeats resumed across a partition/outage: heal the
             # liveness verdict and export the measured outage
             node.alive = True
+            self._alive_count += 1
             changed = True
             if node.died_at:
                 faults.record_recovery(
@@ -483,9 +702,7 @@ class Controller:
                 node.died_at = 0.0
         if changed:
             self._bump_view(node)
-        reply = {"registered": True,
-                 "n_nodes": sum(1 for n in self.nodes.values()
-                                if n.alive)}
+        reply = {"registered": True, "n_nodes": self._alive_count}
         if want_full:
             reply["want_full"] = True
         if known_view_rev is not None:
@@ -493,9 +710,11 @@ class Controller:
             # per-node deltas since the nodelet's last applied revision
             # (ref: ray_syncer.h:83 — spill decisions then run nodelet-
             # side with zero pick_node round trips in steady state)
-            reply["view"] = self._view_delta(known_view_rev,
-                                             exclude=node_id)
+            view = self._view_delta(known_view_rev, exclude=node_id)
+            reply["view"] = view
             reply["view_rev"] = self._view_rev
+            self._gossip_beats += 1
+            self._gossip_entries += len(view)
         return reply
 
     async def list_nodes(self):
@@ -509,8 +728,11 @@ class Controller:
         # sweep noticing the death, the scheduler must not place new work
         # on the draining node (ref: node drain protocol in
         # gcs_node_manager.cc HandleDrainNode).
+        if node.alive:
+            self._alive_count -= 1
         node.alive = False
         node.died_at = time.monotonic()
+        self._beat_order.pop(node_id, None)
         self._bump_view(node)  # death propagates through the gossip too
         if node.client is not None:
             await node.client.notify_async("shutdown")
@@ -539,15 +761,28 @@ class Controller:
                 except Exception as e:  # noqa: BLE001 — a failed fsync degrades durability, not liveness
                     log.debug("persist flush failed: %r", e)
             now = time.monotonic()
-            for node in self.nodes.values():
-                if node.alive and now - node.last_heartbeat > cfg.node_death_timeout_s:
-                    node.alive = False
-                    node.died_at = now
-                    self._bump_view(node)
-                    await self._publish(
-                        "node", {"event": "node_dead", "node": node.snapshot()}
-                    )
-                    await self._handle_node_death(node)
+            # pop stale nodes off the OLD end of the beat-recency index
+            # and stop at the first fresh one: O(stale+1) per sweep.
+            # The previous whole-table scan ran every interval — at N
+            # nodes that is O(N) per second forever, and with the O(N)
+            # heartbeat replies it made the control loop quadratic.
+            while self._beat_order:
+                node_id = next(iter(self._beat_order))
+                node = self.nodes.get(node_id)
+                if node is None or not node.alive:
+                    self._beat_order.popitem(last=False)
+                    continue
+                if now - node.last_heartbeat <= cfg.node_death_timeout_s:
+                    break  # everything behind it beat even more recently
+                self._beat_order.popitem(last=False)
+                node.alive = False
+                self._alive_count -= 1
+                node.died_at = now
+                self._bump_view(node)
+                await self._publish(
+                    "node", {"event": "node_dead", "node": node.snapshot()}
+                )
+                await self._handle_node_death(node)
 
     async def _handle_node_death(self, node: NodeInfo):
         # Fail/restart actors that lived there (ref: gcs_actor_manager.cc
@@ -595,7 +830,10 @@ class Controller:
         self.actors[actor_id] = info
         if name:
             self.named_actors[(namespace, name)] = actor_id
-            self._persist()
+            # one O(record) journal append, NOT a meta rewrite: under
+            # actor churn the per-create full-snapshot _persist() made
+            # every named registration O(named actors)
+            self._journal_actor("aput", actor_id, spec)
         spawn_logged(self._schedule_actor(info),
                      name="controller.schedule_actor")
         return {"status": "registered", "actor_id": actor_id}
@@ -637,6 +875,19 @@ class Controller:
                 bundle_index=spec.get("bundle_index", -1),
             )
             if node is not None:
+                # advisory debit (same contract as pick_nodes): a burst
+                # of concurrent creations must not all read the same
+                # table snapshot and pick the same best-pack node — at
+                # 200 parallel creates that funneled 100+ leases onto
+                # one node, which accepted them all (feasible_ever) and
+                # wedged the overflow behind its exhausted resources
+                # forever. The next resource report overwrites the
+                # debit, so a failed lease only under-packs briefly.
+                for res, amount in resources.items():
+                    if amount > 0:
+                        avail = node.available_resources.get(res, 0.0)
+                        node.available_resources[res] = max(
+                            0.0, avail - amount)
                 # from here a replacement worker may be booting: a late
                 # reattach from an older incarnation must be refused
                 # (reattach_actor checks this flag), or two ALIVE
@@ -718,7 +969,7 @@ class Controller:
             if name:
                 ns = info.spec.get("namespace", "")
                 self.named_actors[(ns, name)] = actor_id
-                self._persist()
+                self._journal_actor("aput", actor_id, info.spec)
         info.awaiting_reattach = False
         info.state = ACTOR_ALIVE
         info.address = address
@@ -759,6 +1010,11 @@ class Controller:
             info.worker_id = None  # any incarnation may report the next death
             info.lease_inflight = False
             info.awaiting_reattach = False
+            if info.spec.get("name"):
+                # restart is churn too: re-journal the spec so a
+                # standby/replay sees the same named set (idempotent
+                # upsert on replay)
+                self._journal_actor("aput", actor_id, info.spec)
             await self._publish(f"actor:{actor_id}", info.snapshot())
             spawn_logged(self._schedule_actor(info),
                          name="controller.schedule_actor")
@@ -770,7 +1026,7 @@ class Controller:
             name = info.spec.get("name")
             if name:
                 self.named_actors.pop((info.spec.get("namespace", ""), name), None)
-                self._persist()
+                self._journal_actor("adel", actor_id, info.spec)
             self._wake_actor_waiters(actor_id)
             await self._publish(f"actor:{actor_id}", info.snapshot())
         return True
@@ -895,6 +1151,63 @@ class Controller:
                 {"resources": dict(resources), "ts": time.time()})
             return None
         return {"node_id": node.node_id, "address": node.address}
+
+    async def pick_nodes(self, resources: Dict[str, float], count: int = 1,
+                         strategy: str = "HYBRID",
+                         exclude: List[str] = None):
+        """Place a whole WAVE of identical plain tasks in one RPC.
+
+        A deep backlog of tasks this node can never run used to cost
+        one pick_node round trip per task — at 100k queued tasks that
+        is a 100k-RPC storm through the controller (the many_tasks
+        scale wall the 100-node harness hit first). One call now
+        returns a capacity-bounded placement plan: per feasible node,
+        at most ``floor(available / request)`` assignments, filled in
+        the HYBRID pack order. The plan debits the live table in place
+        so back-to-back waves inside one heartbeat window don't
+        double-book a node; the next resource report from each node
+        overwrites the debit with truth.
+
+        Only plain HYBRID/SPREAD specs take this path (the nodelet
+        keeps per-spec pick_node for affinity/PG placement, which
+        needs per-task validation). Returns ``[{node_id, address, n},
+        ...]``; the n's sum to at most ``count`` — the shortfall is
+        unschedulable demand, recorded for the autoscaler once per
+        wave instead of once per task."""
+        count = max(1, int(count))
+        req = dict(resources or {})
+        plan: List[dict] = []
+        remaining = count
+        candidates = [n for n in self.nodes.values()
+                      if n.alive and (not exclude
+                                      or n.node_id not in exclude)]
+        # same pack order as the single pick: busiest feasible first
+        candidates.sort(
+            key=lambda n: scheduling._utilization_after(n, req))
+        for node in candidates:
+            if remaining <= 0:
+                break
+            cap = remaining
+            for key, amount in req.items():
+                if amount <= 0:
+                    continue
+                avail = node.available_resources.get(key, 0.0)
+                cap = min(cap, int(avail // amount))
+            if cap <= 0:
+                continue
+            for key, amount in req.items():
+                if amount > 0:
+                    node.available_resources[key] = \
+                        node.available_resources.get(key, 0.0) \
+                        - cap * amount
+            plan.append({"node_id": node.node_id,
+                         "address": node.address, "n": cap})
+            remaining -= cap
+        if remaining > 0:
+            self.unschedulable.append(
+                {"resources": dict(req), "ts": time.time(),
+                 "count": remaining})
+        return plan
 
     # ------------------------------------------------------------------ placement groups
     async def create_placement_group(self, pg_id: str, bundles: List[Dict[str, float]],
@@ -1200,10 +1513,40 @@ class Controller:
                  "strategy": pg.get("strategy", "PACK")}
                 for pg_id, pg in self.placement_groups.items()
                 if pg.get("state") == "PENDING"],
+            # gossip fan-out accounting: entries/beats ≈ per-beat view
+            # payload — scale tests assert it stays O(changed), not
+            # O(nodes)
+            "gossip": {"beats": self._gossip_beats,
+                       "entries": self._gossip_entries,
+                       "view_rev": self._view_rev},
+            "journal": {"seq": self._journal_seq,
+                        "records_since_compaction":
+                            self._journal_records_since,
+                        "bytes_since_compaction":
+                            self._journal_bytes_since,
+                        "compactions": self._compactions,
+                        "standbys": len(self._standby_conns)},
         }
 
     async def ping(self):
         return "pong"
+
+    # ------------------------------------------------------------ warm standby
+    async def journal_subscribe(self, known_seq: int = 0,
+                                _conn: ServerConn = None):
+        """A warm-standby follower bootstraps here: one full snapshot of
+        the durable tables (same shape as meta.pkl plus the kv store)
+        stamped with the current journal seq, and the calling connection
+        joins the journal stream — every later mutation arrives as a
+        framed journal_record notify. Idempotent: re-subscribing (the
+        follower's gap recovery) re-registers the same connection and
+        hands back a fresh snapshot."""
+        if _conn is not None and _conn not in self._standby_conns:
+            self._standby_conns.append(_conn)
+        return {"session_name": self.session_name,
+                "state": self._state_dict(),
+                "kv": {ns: dict(kvs) for ns, kvs in self.kv.items()},
+                "seq": self._journal_seq}
 
     # ------------------------------------------------------------ fault plane
     async def fault_inject(self, spec: str = None, clear=None,
@@ -1254,6 +1597,224 @@ class Controller:
         return out
 
 
+class StandbyController:
+    """Warm-standby follower (ref: the reference's external-Redis GCS
+    fault tolerance, SURVEY §5 — but journal streaming instead of a
+    shared store): subscribes to the primary's journal stream via
+    ``journal_subscribe``, replays every mutation record continuously
+    into replica tables, and takes over — binds the primary's address
+    and starts serving as THE controller — on lease expiry (primary
+    silent past ``standby_lease_timeout_s``) or an explicit
+    ``standby_promote``. Because the follower is already caught up,
+    promotion is activation, not replay: milliseconds, not a cold
+    restart. Nodelets notice the fresh controller via their next
+    heartbeat's ``registered: False`` and re-register + reattach live
+    actors — the PR-15 reconciliation contract, so zero actors are
+    re-created across the failover."""
+
+    def __init__(self, session_name: str, primary_address: str,
+                 listen_address: Optional[str] = None,
+                 persist_dir: Optional[str] = None):
+        self.session_name = session_name
+        self.primary_address = primary_address
+        self.listen_address = listen_address
+        self.persist_dir = persist_dir
+        self.client = RpcClient(primary_address, notify_handlers={
+            "journal_record": self._on_record})
+        self._server = None
+        if listen_address:
+            self._server = RpcServer(listen_address, {
+                "standby_status": self.standby_status,
+                "standby_promote": self.standby_promote,
+                "ping": self._ping,
+            })
+        # replica tables: the meta-state dict + the kv store, exactly
+        # what journal_subscribe snapshots and the stream mutates
+        self._state: dict = {}
+        self._kv: Dict[str, Dict[str, bytes]] = collections.defaultdict(dict)
+        self.applied_seq = 0
+        self._records_applied = 0
+        self._last_signal = time.monotonic()
+        self._needs_sync = True
+        self._lease_task: Optional[asyncio.Task] = None
+        self.promoted: Optional[Controller] = None
+        self._promoting = False
+        faults.add_identity("standby")
+
+    # ----------------------------------------------------------- lifecycle
+    async def start(self):
+        if self._server is not None:
+            await self._server.start()
+        await self._sync()
+        self._lease_task = asyncio.ensure_future(self._lease_loop())
+
+    async def stop(self, stop_promoted: bool = True):
+        if self._lease_task is not None:
+            self._lease_task.cancel()
+        self.client.close()
+        if self._server is not None:
+            await self._server.stop()
+        if stop_promoted and self.promoted is not None:
+            await self.promoted.stop()
+
+    # ------------------------------------------------------------- replica
+    async def _sync(self):
+        """(Re)bootstrap: one full snapshot + (re)join the stream."""
+        snap = await self.client.call_async("journal_subscribe",
+                                            known_seq=self.applied_seq)
+        self._state = snap.get("state") or {}
+        self._kv = collections.defaultdict(dict)
+        for ns, kvs in (snap.get("kv") or {}).items():
+            self._kv[ns].update(kvs)
+        self.applied_seq = int(snap.get("seq", 0) or 0)
+        self._needs_sync = False
+        self._last_signal = time.monotonic()
+
+    def _on_record(self, record: tuple) -> None:
+        """One streamed mutation record. Applied in order; a gap (lost
+        notify, follower restart mid-stream) flags a full resync rather
+        than guessing — the journal stream is an optimization over
+        re-snapshotting, never a correctness dependency."""
+        self._last_signal = time.monotonic()
+        try:
+            op, ns, key, value, seq = record
+        except Exception:  # noqa: BLE001 — an unframeable record forces a resync, not a crash
+            self._needs_sync = True
+            return
+        if op == "meta":
+            if seq >= self.applied_seq:
+                self._state = value or {}
+                self.applied_seq = seq
+            return
+        if seq <= self.applied_seq:
+            return  # duplicate (already covered by a snapshot)
+        if seq != self.applied_seq + 1:
+            self._needs_sync = True  # gap: resync from a fresh snapshot
+            return
+        self.applied_seq = seq
+        self._records_applied += 1
+        if op == "put":
+            self._kv[ns][key] = value
+        elif op == "del":
+            self._kv[ns].pop(key, None)
+        elif op in ("aput", "adel"):
+            specs = self._state.setdefault("actor_specs", {})
+            named = self._state.setdefault("named_actors", {})
+            if op == "aput":
+                specs[key] = value
+                name = (value or {}).get("name")
+                if name:
+                    nskey = f"{(value or {}).get('namespace', '')}\x00{name}"
+                    named[nskey] = key
+            else:
+                spec = specs.pop(key, None) or value or {}
+                name = spec.get("name")
+                if name:
+                    nskey = f"{spec.get('namespace', '')}\x00{name}"
+                    if named.get(nskey) == key:
+                        named.pop(nskey, None)
+
+    async def _lease_loop(self):
+        """Follower heartbeat: ping the primary, resync on flagged gaps,
+        and promote once the primary has been silent (no stream record,
+        no ping reply) past the lease timeout."""
+        cfg = get_config()
+        while self.promoted is None:
+            await asyncio.sleep(cfg.standby_poll_interval_s)
+            if self._needs_sync:
+                try:
+                    await self._sync()
+                except Exception as e:  # noqa: BLE001 — a primary mid-outage fails the resync; the lease clock keeps running toward promotion
+                    log.debug("standby resync failed: %r", e)
+            else:
+                try:
+                    # wait_for bounds the WHOLE attempt: against a dead
+                    # primary the per-call _timeout never starts —
+                    # _ensure_connected redials for the full
+                    # rpc_connect_timeout_s (10s) first, which pinned
+                    # takeover detection near 10s however small the
+                    # lease knobs were
+                    await asyncio.wait_for(
+                        self.client.call_async(
+                            "ping",
+                            _timeout=cfg.standby_poll_interval_s * 4,
+                            _retry=0),
+                        timeout=cfg.standby_poll_interval_s * 4)
+                    self._last_signal = time.monotonic()
+                except Exception:  # rtpulint: ignore[RTPU006] — a failed lease ping IS the signal: silence accumulates toward the takeover verdict
+                    pass
+            if time.monotonic() - self._last_signal \
+                    > cfg.standby_lease_timeout_s:
+                try:
+                    await self.promote(reason="lease expired")
+                except Exception as e:  # noqa: BLE001 — e.g. the primary still holds the address; keep following and retry next expiry
+                    log.warning("standby promotion failed: %r", e)
+                    self._last_signal = time.monotonic()
+
+    # ----------------------------------------------------------- promotion
+    async def promote(self, reason: str = "explicit"):
+        """Take over as THE controller: activate the replica tables in a
+        fresh Controller bound to the primary's address. The replica is
+        already caught up, so this is bind + table activation — no
+        journal replay on the takeover path."""
+        if self.promoted is not None:
+            return {"promoted": True, "ms": 0.0, "already": True}
+        if self._promoting:
+            raise RuntimeError("promotion already in flight")
+        self._promoting = True
+        t0 = time.monotonic()
+        try:
+            faults.syncpoint("controller.failover")
+            self.client.close()  # leave the stream; the primary is done
+            ctrl = Controller(self.session_name, self.primary_address,
+                              persist_dir=None)
+            ctrl._load_state(self._state)
+            for ns, kvs in self._kv.items():
+                ctrl.kv[ns].update(kvs)
+            ctrl._journal_seq = max(
+                self.applied_seq,
+                int(self._state.get("actor_seq", 0) or 0))
+            if self.persist_dir:
+                # adopt a durability target of our own: fold the replica
+                # into fresh snapshots so a later restart replays from
+                # here (safe over the primary's old dir — the replica
+                # supersedes its journal)
+                from .storage import backend_for
+
+                ctrl._store_backend = backend_for(self.persist_dir)
+                ctrl._compact_journal()
+            await ctrl.start()
+            ms = (time.monotonic() - t0) * 1000.0
+            # metric BEFORE the promoted flag: `promoted` is the
+            # externally-polled completion signal, and on a one-core
+            # box a waiter that sees it can snapshot rtpu_recovery_ms
+            # before this thread gets scheduled again
+            faults.record_recovery("controller_failover", ms)
+            self.promoted = ctrl
+            log.warning("standby promoted to controller (%s) in %.1fms",
+                        reason, ms)
+            return {"promoted": True, "ms": ms, "reason": reason,
+                    "applied_seq": self.applied_seq}
+        finally:
+            self._promoting = False
+
+    # ------------------------------------------------------------ handlers
+    async def standby_status(self):
+        return {"session_name": self.session_name,
+                "primary_address": self.primary_address,
+                "applied_seq": self.applied_seq,
+                "records_applied": self._records_applied,
+                "lag_s": time.monotonic() - self._last_signal,
+                "promoted": self.promoted is not None,
+                "named_actors": len(self._state.get("named_actors", {}))}
+
+    async def standby_promote(self):
+        return await self.promote(reason="standby_promote rpc")
+
+    async def _ping(self):
+        return "pong"
+
+
 def main():
     import argparse
 
@@ -1265,12 +1826,25 @@ def main():
                              "or tcp:HOST:PORT of a store server "
                              "(python -m ray_tpu.runtime.storage) for "
                              "head failover to another machine")
+    parser.add_argument("--standby-of", default=None, metavar="ADDR",
+                        help="run as a warm standby of the primary "
+                             "controller at ADDR: replay its journal "
+                             "stream continuously and take over ADDR on "
+                             "lease expiry. --address becomes this "
+                             "standby's own status/promote endpoint")
     args = parser.parse_args()
 
     async def run():
-        controller = Controller(args.session_name, args.address,
-                                persist_dir=args.persist_dir)
-        await controller.start()
+        if args.standby_of:
+            standby = StandbyController(
+                args.session_name, args.standby_of,
+                listen_address=args.address,
+                persist_dir=args.persist_dir)
+            await standby.start()
+        else:
+            controller = Controller(args.session_name, args.address,
+                                    persist_dir=args.persist_dir)
+            await controller.start()
         await asyncio.Event().wait()
 
     asyncio.run(run())

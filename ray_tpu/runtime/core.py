@@ -313,6 +313,7 @@ class CoreWorker:
         self._stage_lock = threading.Lock()
         self._submit_batch_enabled = cfg.submit_batch_enabled
         self._submit_batch_max = max(1, cfg.submit_batch_max)
+        self._submit_backlog_frames = max(1, cfg.submit_backlog_frames)
         self._submit_drain_interval = cfg.submit_drain_interval_s
         self._loop = None  # io loop, cached at start()
 
@@ -1486,37 +1487,51 @@ class CoreWorker:
         submit_task_batch frame, and starts actor sends in staging order
         (per-connection FIFO — and therefore actor `seq` order and
         cancel-after-submit — is preserved because registration and send
-        scheduling happen in queue order within one loop pass)."""
+        scheduling happen in queue order within one loop pass).
+
+        Backlog batching: one wakeup drains up to submit_backlog_frames
+        frames of submit_batch_max specs each while the queue runs deep.
+        Past ~100k staged tasks the re-arm hop per frame (call_soon +
+        disarm/arm handshake) dominated the drain; frames stay capped so
+        one pass still cannot hold the loop unboundedly."""
         # disarm BEFORE popping: a producer appending after the pop loop
         # finishes observes the flag down and re-arms
         with self._stage_lock:
             self._stage_armed = False
         staged = self._staged
         task_specs = []
-        n = 0
-        while n < self._submit_batch_max:
-            try:
-                kind, task_id, spec, return_ids, arg_refs, actor_id = \
-                    staged.popleft()
-            except IndexError:
-                break
-            n += 1
-            self._register_pending(task_id, spec, return_ids, arg_refs)
-            if kind == "task":
-                task_specs.append(spec)
-            else:
-                if task_specs:
-                    # flush so global staging order also holds across
-                    # the task/actor interleave
-                    spawn_logged(
-                        self._submit_batch_to_nodelet(task_specs),
-                        name="core.submit_batch")
-                    task_specs = []
-                spawn_logged(self._send_actor_task(actor_id, spec),
-                             name="core.actor_send")
-        if task_specs:
-            spawn_logged(self._submit_batch_to_nodelet(task_specs),
-                         name="core.submit_batch")
+        cap = self._submit_batch_max
+        for frame in range(self._submit_backlog_frames):
+            n = 0
+            while n < cap:
+                try:
+                    kind, task_id, spec, return_ids, arg_refs, actor_id \
+                        = staged.popleft()
+                except IndexError:
+                    break
+                n += 1
+                self._register_pending(task_id, spec, return_ids,
+                                       arg_refs)
+                if kind == "task":
+                    task_specs.append(spec)
+                else:
+                    if task_specs:
+                        # flush so global staging order also holds
+                        # across the task/actor interleave
+                        spawn_logged(
+                            self._submit_batch_to_nodelet(task_specs),
+                            name="core.submit_batch")
+                        task_specs = []
+                    spawn_logged(self._send_actor_task(actor_id, spec),
+                                 name="core.actor_send")
+            if task_specs:
+                # ship one frame per inner pass: frame size (and thus
+                # the largest single RPC payload) stays submit_batch_max
+                spawn_logged(self._submit_batch_to_nodelet(task_specs),
+                             name="core.submit_batch")
+                task_specs = []
+            if n < cap:
+                break  # queue ran dry inside this frame
         if staged:
             # past the per-pass cap: keep the loop responsive, drain the
             # rest on the next pass. _drain_staged only ever runs ON the

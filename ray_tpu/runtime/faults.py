@@ -44,7 +44,10 @@ the controller's ``fault_inject`` admin RPC). Rules are ';'-separated::
   ``data.split_pull``, ``serve.pp_tick`` — planted at the top of each
   pipeline stage worker's per-microbatch tick (serve/llm/pp.py), so
   chaos drills can kill one stage rank mid-decode with frames in
-  flight).
+  flight — ``controller.failover`` — planted at the top of a standby
+  controller's promotion (controller.StandbyController.promote), after
+  the takeover decision but before the replayed state is activated, so
+  failover drills can kill/raise exactly in the handover window).
   ``action=exit`` (default) terminates the process with exit code 43;
   ``action=raise`` raises :class:`FaultInjectedError` in place (for
   in-process tests).
@@ -79,6 +82,7 @@ SYNCPOINTS = (
     "serve.admission",
     "controller.health_sweep",
     "controller.persist",
+    "controller.failover",
     "data.split_pull",
     "serve.pp_tick",
 )
@@ -322,18 +326,17 @@ def _count_injection(rule_name: str) -> None:
 
 def record_recovery(scenario: str, ms: float) -> None:
     """Export a measured recovery time as rtpu_recovery_ms{scenario=} —
-    the drill suite and the runtime's own heal paths both feed it."""
-    global _recovery_metric
-    if _recovery_metric is None:
-        from ..util.metrics import Gauge
+    the drill suite and the runtime's own heal paths both feed it.
 
-        _recovery_metric = Gauge("rtpu_recovery_ms",
-                                 "observed recovery time per scenario",
-                                 ("scenario",))
-    _recovery_metric.set(ms, tags={"scenario": scenario})
+    Constructed per call, NOT cached: re-registering a live name shares
+    its storage (one series), and after a registry wipe (test fixtures
+    use ``metrics._reset_for_tests``) the fresh instance re-registers —
+    a cached handle would keep feeding an orphaned Gauge that
+    ``snapshot()`` can no longer see."""
+    from ..util.metrics import Gauge
 
-
-_recovery_metric = None
+    Gauge("rtpu_recovery_ms", "observed recovery time per scenario",
+          ("scenario",)).set(ms, tags={"scenario": scenario})
 
 
 class FaultPlane:

@@ -331,6 +331,12 @@ class Nodelet:
         # single loop pass ships as ONE submit_task_batch frame
         self._spill_staged: Dict[str, tuple] = {}
         self._spill_drain_armed = False
+        # controller-spill wave coalescing: plain specs that need
+        # controller placement stage here and a single drainer places
+        # them submit_batch_max at a time via pick_nodes (one RPC per
+        # wave, not per task)
+        self._ctrl_spill_staged: collections.deque = collections.deque()
+        self._ctrl_spill_armed = False
         self._dispatch_seq = 0  # stamps pushes so workers dedupe dups
         # spill-path observability (benchmarks/scale.py + tests assert
         # the zero-pick_node steady state on these)
@@ -1440,7 +1446,15 @@ class Nodelet:
                         or not self._feasible_ever(spec):
                     # controller-authoritative placement: PG specs,
                     # affinity, work this node can never run, or p2p
-                    # disabled / view still empty
+                    # disabled / view still empty. Plain specs coalesce
+                    # into pick_nodes WAVES — a deep backlog of
+                    # infeasible work used to cost one pick_node RPC
+                    # per task (the 100k-task storm the 100-node
+                    # harness surfaced); affinity/PG/locality keep the
+                    # per-spec path, which validates per task
+                    if self._ctrl_spill_batchable(spec, strategy):
+                        self._stage_ctrl_spill(spec)
+                        return True
                     if await self._controller_spill(
                             spec, strategy, affinity_elsewhere, hops):
                         return True
@@ -1659,8 +1673,116 @@ class Nodelet:
             spawn_logged(self._send_spills(addr, node_id, specs),
                          name="nodelet.send_spills")
 
+    @staticmethod
+    def _ctrl_spill_batchable(spec: dict, strategy: str) -> bool:
+        """Wave-placement eligibility: plain HYBRID specs only —
+        affinity needs per-task target validation, PG specs resolve
+        against reserved bundles, and locality-weighted picks score
+        per-task argument residency."""
+        return ((not strategy or strategy == "HYBRID")
+                and not spec.get("placement_group_id")
+                and not spec.get("arg_locs"))
+
+    def _stage_ctrl_spill(self, spec: dict) -> None:
+        self._ctrl_spill_staged.append(spec)
+        if not self._ctrl_spill_armed:
+            self._ctrl_spill_armed = True
+            spawn_logged(self._drain_ctrl_spills(),
+                         name="nodelet.ctrl_spill_drain")
+
+    async def _drain_ctrl_spills(self) -> None:
+        """Single long-running drainer over the controller-spill
+        backlog: one pick_nodes RPC places up to submit_batch_max specs
+        per wave. A wave that places nothing (no cluster capacity right
+        now) backs off instead of spinning — capacity re-appears via
+        the next resource reports, and the staged specs ARE the
+        autoscaler's demand signal meanwhile (pick_nodes records the
+        shortfall)."""
+        cfg = get_config()
+        backoff = 0.0
+        try:
+            while self._ctrl_spill_staged and not self._stopping:
+                if backoff:
+                    await asyncio.sleep(backoff)
+                frame: List[dict] = []
+                cap = max(1, cfg.submit_batch_max)
+                while self._ctrl_spill_staged and len(frame) < cap:
+                    frame.append(self._ctrl_spill_staged.popleft())
+                groups: Dict[tuple, List[dict]] = {}
+                for spec in frame:
+                    sig = tuple(sorted(
+                        (spec.get("resources") or {}).items()))
+                    groups.setdefault(sig, []).append(spec)
+                placed_any = False
+                for sig, specs in groups.items():
+                    if await self._place_ctrl_wave(dict(sig), specs):
+                        placed_any = True
+                # cap inside one heartbeat window: capacity reappears
+                # with the next resource reports, and a longer sleep
+                # here just stretches every placement round
+                backoff = 0.0 if placed_any \
+                    else min(max(backoff * 2, 0.05),
+                             cfg.view_gossip_interval_s / 2)
+        finally:
+            self._ctrl_spill_armed = False
+            if self._ctrl_spill_staged and not self._stopping:
+                # re-arm for arrivals that raced the teardown
+                self._ctrl_spill_armed = True
+                spawn_logged(self._drain_ctrl_spills(),
+                             name="nodelet.ctrl_spill_drain")
+
+    async def _place_ctrl_wave(self, req: Dict[str, float],
+                               specs: List[dict]) -> bool:
+        """One placement wave: ask the controller for a capacity plan,
+        ship per-target submit_task_batch frames, push the shortfall
+        back onto the staged backlog. Returns True if anything
+        placed."""
+        self.sched_counters["pick_node_rpcs"] += 1
+        try:
+            plan = await self.controller.call_async(
+                "pick_nodes", resources=req, count=len(specs),
+                strategy="HYBRID", _timeout=_spill_timeout(), _retry=0)
+        except Exception:
+            # controller hiccup: park the wave in the local queue (the
+            # per-spec path's fallback) — local capacity can still run
+            # the work and the queue's retry paths re-drive placement;
+            # only a REACHABLE controller with no capacity keeps specs
+            # staged as demand signal
+            for spec in specs:
+                self.queue.append(spec)
+            self._dispatch()
+            return False
+        i = 0
+        sends = []
+        for entry in plan or ():
+            chunk = specs[i:i + int(entry.get("n", 0))]
+            if not chunk:
+                break
+            i += len(chunk)
+            if entry["node_id"] == self.node_id:
+                # busy-but-feasible work the plan kept local
+                for spec in chunk:
+                    self.queue.append(spec)
+                self._dispatch()
+                continue
+            for spec in chunk:
+                spec["_spilled"] = True
+                spec["_spill_hops"] = spec.get("_spill_hops", 0) + 1
+                spec["_spill_from"] = self.address
+                spec["_placement_seq"] = \
+                    spec.get("_placement_seq", 0) + 1
+                spec.pop("_hop_counted", None)
+            sends.append(self._send_spills(
+                entry["address"], entry["node_id"], chunk,
+                counter="controller_spills"))
+        self._ctrl_spill_staged.extend(specs[i:])
+        if sends:
+            await asyncio.gather(*sends)
+        return i > 0
+
     async def _send_spills(self, addr: str, node_id: str,
-                           specs: List[dict]) -> None:
+                           specs: List[dict],
+                           counter: str = "p2p_spills") -> None:
         client = self._peer_client(addr)
         try:
             if len(specs) == 1:
@@ -1692,7 +1814,7 @@ class Nodelet:
                     spec.pop("_hop_counted", None)
                 self._spawn_resubmit(spec, _prepped=True)
             return
-        self.sched_counters["p2p_spills"] += len(specs)
+        self.sched_counters[counter] += len(specs)
         for spec in specs:
             self._owner_client(spec["owner_addr"]).notify_nowait(
                 "task_spilled", task_id=spec["task_id"], node_id=node_id,

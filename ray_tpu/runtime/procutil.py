@@ -35,6 +35,14 @@ _tracked_lock = threading.Lock()
 # STOPPED loop (EventLoopThread.reset in tests) can never finish, so
 # _prune_dead_loops drops them once the set grows.
 _tracked: set = set()
+# prune high-water mark. A fixed threshold melts down at scale: a
+# saturated many-node harness legitimately holds tens of thousands of
+# PENDING tasks on a running loop, so "prune when len > 256" made every
+# spawn rescan the whole set — O(live) per spawn, quadratic per burst
+# (the 100-node simcluster drill spent ~60% of loop samples here). The
+# mark doubles past the live population after each prune, so scans are
+# amortized O(1) per spawn while stopped-loop strays still get dropped.
+_prune_mark: int = 256
 _exception_counts: Dict[str, int] = {}
 _exc_counter = None  # lazy util.metrics Counter
 
@@ -61,10 +69,12 @@ def spawn_logged(coro, *, name: str) -> "asyncio.Task":
         task.set_name(f"rtpu:{name}")
     except AttributeError:
         pass
+    global _prune_mark
     with _tracked_lock:
         _tracked.add(task)
-        if len(_tracked) > 256:
+        if len(_tracked) > _prune_mark:
             _prune_dead_loops()
+            _prune_mark = max(256, 2 * len(_tracked))
     task.add_done_callback(_on_task_done)
     return task
 
@@ -89,8 +99,11 @@ def _task_name(task) -> str:
 
 
 def _on_task_done(task) -> None:
+    global _prune_mark
     with _tracked_lock:
         _tracked.discard(task)
+        if _prune_mark > 256 and len(_tracked) < _prune_mark // 4:
+            _prune_mark //= 2  # decay after a burst drains
     if task.cancelled():
         return
     exc = task.exception()

@@ -86,11 +86,17 @@ IDEMPOTENT_METHODS = frozenset({
     "list_placement_groups", "get_placement_group",
     "list_task_events", "list_tasks", "get_task", "list_trace_spans",
     "om_meta", "om_endpoint", "om_read", "chan_endpoint", "view_update",
-    "pick_node", "subscribe",
+    # pick_nodes' optimistic table debits are advisory and overwritten
+    # by the next resource report — a duplicated wave plan only
+    # under-packs, never double-runs anything
+    "pick_node", "pick_nodes", "subscribe",
     # storage reads (controller persistence tier): re-reading re-reads
     "st_load_meta", "st_load_kv",
     # client-proxy liveness touch: a duplicated beat is a no-op
     "c_heartbeat",
+    # warm standby: re-subscribing re-registers the same connection and
+    # re-snapshots; status is a read
+    "journal_subscribe", "standby_status",
 })
 
 # long-poll methods whose wait is the PRODUCT, not a failure: no default
@@ -131,6 +137,10 @@ NON_IDEMPOTENT_METHODS = frozenset({
     "chan_push",
     # controller persistence writes (append/compact ordering matters)
     "st_save_meta", "st_append_kv", "st_compact_kv",
+    # warm standby: the streamed journal is seq-guarded by the follower
+    # (a duplicate record is skipped, a gap forces resync — never a
+    # transport retry); promotion binds an address at most once
+    "journal_record", "standby_promote",
     # client proxy: submissions and refcounts mirror the owner API
     "c_export", "c_submit", "c_create_actor", "c_actor_call",
     "c_release_actor", "c_put", "c_cancel", "c_free", "c_kill_actor",

@@ -1,0 +1,467 @@
+"""In-process many-node harness for scheduler scale testing.
+
+The scale envelope the paper targets (100+ nodes, 100k+ queued tasks)
+cannot be exercised with real worker processes on a CI box — forking
+100 nodelets x N workers swamps the host long before the *scheduler*
+becomes the bottleneck. This module keeps every control-plane path
+REAL and fakes only the data plane:
+
+- ``SimNodelet`` is a real :class:`~.nodelet.Nodelet` — registration,
+  heartbeat/gossip, dispatch queues, spill, leases, reaping and
+  re-registration all run the production code — except that workers
+  are in-process :class:`SimWorker` objects instead of forked
+  interpreters (no factory subprocess, no log/memory monitors).
+- ``SimWorker`` registers through the real ``worker_register`` RPC
+  with ``pid=0`` (never signaled by ``_kill_worker``, never probed by
+  the reap loop's death check) and serves the real worker surface
+  (``execute_task``/``create_actor``/``actor_call``/...) over the real
+  RPC push channel, completing tasks instantly (or after an optional
+  simulated service time) with the exact result frames
+  ``runtime/worker.py`` produces.
+- ``SimCluster`` stands up N of these against a live session's
+  controller. Sim nodes advertise a synthetic ``{"sim": slots}``
+  resource, so driver tasks requesting ``resources={"sim": 1}`` are
+  locally infeasible on the head node and travel the real owner
+  staging -> backlog batching -> p2p spill / controller spill ->
+  remote dispatch -> result push pipeline.
+
+Everything a scale bug lives in — the controller's O(changed) gossip
+deltas, the health sweep, journal compaction under actor churn, the
+owner's staged-submission drain, per-peer spill coalescing — runs
+unmodified. Only ``fn(*args)`` itself is simulated.
+
+One process still means one GIL: throughput numbers from this harness
+measure *control-plane* cost (specs scheduled per second), which is
+exactly what the ``many_tasks``/``many_actors``/``many_pgs`` bench
+keys want.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import os
+import time
+from typing import Dict, List, Optional
+
+from . import serialization
+from .core import get_core
+from .ids import NodeID, WorkerID
+from .nodelet import Nodelet, WorkerState
+from .procutil import log, spawn_logged
+from .rpc import RpcClient, RpcServer
+
+SIM_RESOURCE = "sim"  # synthetic resource only sim nodes advertise
+
+
+class SimWorker:
+    """A fake worker sharing the nodelet's process and event loop.
+
+    Speaks the real worker wire protocol — registers via
+    ``worker_register`` (so the nodelet's idle pools, dispatch dedupe
+    stamps and actor leases all exercise their production paths) and
+    answers ``execute_task``/``actor_call`` with the result frames
+    ``runtime/worker.py`` would send — but never deserializes the
+    function: tasks complete with their first inline positional
+    argument echoed back (or ``None``), after ``task_time_s`` of
+    simulated service time.
+    """
+
+    def __init__(self, nodelet: "SimNodelet", worker_id: str,
+                 env_key: str = "", task_time_s: float = 0.0):
+        self.nodelet = nodelet
+        self.worker_id = worker_id
+        self.env_key = env_key
+        self.task_time_s = task_time_s
+        self.actor_id: Optional[str] = None
+        self.tasks_run = 0
+        self.calls_run = 0
+        self._closed = False
+        # short unix path: AF_UNIX caps sun_path at ~107 chars and
+        # session dirs can be long, so key the socket by worker prefix
+        self.address = (f"unix:{nodelet.session_dir}/sock/"
+                        f"sw-{worker_id[:12]}.sock")
+        handlers = {
+            "execute_task": self.h_execute_task,
+            "create_actor": self.h_create_actor,
+            "actor_call": self.h_actor_call,
+            "kill_self": self.h_kill_self,
+            "drain_exit": self.h_drain_exit,
+            "fault_inject": self.h_fault_inject,
+            "shutdown": self.h_kill_self,
+            "ping": lambda: "pong",
+        }
+        self._server = RpcServer(self.address, handlers)
+        # dial the nodelet with the same handlers as notify handlers:
+        # the nodelet pushes dispatches back over the connection this
+        # client registers with (worker_register's _conn), so pushes
+        # land here without a socket round trip
+        self.client = RpcClient(self.nodelet.address,
+                                notify_handlers=dict(handlers))
+        self._owner_clients: Dict[str, RpcClient] = {}
+        # same dedupe window as worker.py: the nodelet's push can
+        # double-deliver on a drain-then-fallback race
+        self._done: set = set()
+        self._done_order: collections.deque = collections.deque()
+
+    async def start(self):
+        if self.nodelet._stopping:
+            return
+        await self._server.start()
+        self.address = self._server.address
+        await self.client.call_async(
+            "worker_register", worker_id=self.worker_id,
+            address=self.address, pid=0, env_key=self.env_key)
+
+    async def stop(self):
+        if self._closed:
+            return
+        self._closed = True
+        for c in self._owner_clients.values():
+            c.close()
+        self._owner_clients.clear()
+        self.client.close()
+        await self._server.stop()
+
+    # ------------------------------------------------------------ helpers
+    def _dup(self, spec: dict) -> bool:
+        key = (spec["task_id"], spec.get("_dispatch_seq"))
+        if key in self._done:
+            return True
+        self._done.add(key)
+        self._done_order.append(key)
+        while len(self._done_order) > 256:
+            self._done.discard(self._done_order.popleft())
+        return False
+
+    def _owner(self, addr: str) -> RpcClient:
+        client = self._owner_clients.get(addr)
+        if client is None:
+            client = self._owner_clients[addr] = RpcClient(addr)
+        return client
+
+    @staticmethod
+    def _echo_value(spec: dict):
+        """First inline positional arg, echoed — lets tests assert the
+        result actually traveled the owner path, without loading user
+        functions into the harness process."""
+        try:
+            blob = spec.get("args_inline")
+            if blob is None:
+                return None
+            args, _kwargs = serialization.loads_inline(blob)
+            return args[0] if len(args) == 1 else None
+        except Exception:  # noqa: BLE001 — opaque args simulate as None
+            return None
+
+    def _ok_result(self, spec: dict) -> dict:
+        n = spec.get("num_returns", 1)
+        n = n if isinstance(n, int) else 1
+        blob = serialization.dumps_inline(self._echo_value(spec))
+        return {"task_id": spec["task_id"], "status": "ok",
+                "results": [("inline", blob)] * max(n, 1)}
+
+    # ------------------------------------------------------------ handlers
+    def h_execute_task(self, spec: dict):
+        if self._dup(spec):
+            return True
+        if self.task_time_s > 0:
+            spawn_logged(self._finish_task_later(spec),
+                         name="simworker.task")
+        else:
+            self._finish_task(spec)
+        return True
+
+    async def _finish_task_later(self, spec: dict):
+        await asyncio.sleep(self.task_time_s)
+        self._finish_task(spec)
+
+    def _finish_task(self, spec: dict):
+        if self._closed:
+            return
+        self.tasks_run += 1
+        # one frame per finished plain task, same as worker.py
+        # _deliver_result: result + worker-free ride task_done together
+        self.client.notify_nowait(
+            "task_done", worker_id=self.worker_id,
+            task_id=spec["task_id"], owner_addr=spec["owner_addr"],
+            result=self._ok_result(spec))
+
+    def h_create_actor(self, spec: dict):
+        if self.actor_id is not None or self._dup(spec):
+            return True
+        self.actor_id = spec["actor_id"]
+        spawn_logged(self._announce_ready(), name="simworker.actor_ready")
+        return True
+
+    async def _announce_ready(self):
+        try:
+            await self.client.call_async(
+                "actor_ready", actor_id=self.actor_id,
+                address=self.address, worker_id=self.worker_id,
+                node_id=self.nodelet.node_id)
+        except Exception as e:  # noqa: BLE001 — mirrors worker.py: an unreported ready leaves the actor PENDING for the drill to observe
+            log.debug("sim actor_ready undeliverable: %r", e)
+
+    def h_actor_call(self, spec: dict):
+        if self._dup(spec):
+            return True
+        self.calls_run += 1
+        if self.task_time_s > 0:
+            spawn_logged(self._finish_call_later(spec),
+                         name="simworker.actor_call")
+        else:
+            self._finish_call(spec)
+        return True
+
+    async def _finish_call_later(self, spec: dict):
+        await asyncio.sleep(self.task_time_s)
+        self._finish_call(spec)
+
+    def _finish_call(self, spec: dict):
+        if self._closed:
+            return
+        # actor results go straight to the owner (never via the
+        # nodelet), matching worker.py _deliver_result
+        self._owner(spec["owner_addr"]).notify_nowait(
+            "task_result", **self._ok_result(spec))
+
+    def h_kill_self(self):
+        spawn_logged(self._exit(intended=False), name="simworker.kill")
+        return True
+
+    def h_drain_exit(self):
+        spawn_logged(self._exit(intended=True), name="simworker.drain")
+        return True
+
+    def h_fault_inject(self, spec: str = None, clear=None):
+        # sim workers share the nodelet process's fault plane; the rules
+        # are already applied there — re-applying would double them
+        return {}
+
+    async def _exit(self, intended: bool):
+        if self._closed:
+            return
+        if self.actor_id is not None:
+            try:
+                await self.client.call_async(
+                    "actor_exited", worker_id=self.worker_id,
+                    actor_id=self.actor_id,
+                    reason="sim worker exit", intended=intended)
+            except Exception as e:  # noqa: BLE001 — unreported exits surface via the controller liveness sweep
+                log.debug("sim actor_exited undeliverable: %r", e)
+        await self.stop()
+
+
+class SimNodelet(Nodelet):
+    """A real nodelet whose workers are in-process :class:`SimWorker`s.
+
+    Control plane (register/heartbeat/gossip/dispatch/spill/lease/
+    reattach) is inherited untouched; the overrides below remove every
+    subprocess and host-monitoring dependency so hundreds of instances
+    share one event loop.
+    """
+
+    def __init__(self, *, sim_task_time_s: float = 0.0, **kwargs):
+        super().__init__(**kwargs)
+        self._sim_task_time_s = sim_task_time_s
+        self.sim_workers: Dict[str, SimWorker] = {}
+
+    # no prefork factory subprocess
+    def _start_factory(self):
+        self._factory_proc = None
+
+    # host monitors are process-global; 100 copies would stack-poll psutil
+    async def _memory_monitor_loop(self):
+        return
+
+    async def _log_monitor_loop(self):
+        return
+
+    def _start_worker(self, force: bool = False, runtime_env: dict = None,
+                      env_key: str = "", warm: bool = True):
+        # same cap + placeholder bookkeeping as the base class, then an
+        # in-process boot instead of an executor-side fork
+        n_task_workers = self.starting + sum(
+            1 for w in self.workers.values() if not w.is_actor)
+        if not force and n_task_workers >= self.max_workers:
+            return
+        self.starting += 1
+        self.starting_by_key[env_key] = \
+            self.starting_by_key.get(env_key, 0) + 1
+        worker_id = WorkerID.from_random().hex()
+        ws = WorkerState(worker_id, "", -1, None, env_key=env_key)
+        ws.current_task = {"placeholder": True}
+        self.workers[worker_id] = ws
+        sw = SimWorker(self, worker_id, env_key=env_key,
+                       task_time_s=self._sim_task_time_s)
+        self.sim_workers[worker_id] = sw
+        spawn_logged(self._boot_sim_worker(sw, worker_id),
+                     name="simnodelet.worker_boot")
+
+    async def _boot_sim_worker(self, sw: SimWorker, worker_id: str):
+        try:
+            await sw.start()
+        except Exception:
+            # mirror _spawn_worker_proc's failure path: unwind the
+            # placeholder so the stall check can start a replacement
+            self.sim_workers.pop(worker_id, None)
+            ws = self.workers.pop(worker_id, None)
+            if ws is not None:
+                self._dec_starting(ws.env_key)
+            raise
+
+    def _kill_worker(self, ws: WorkerState):
+        sw = self.sim_workers.pop(ws.worker_id, None)
+        super()._kill_worker(ws)  # pid=0: bookkeeping only, no signals
+        if sw is not None:
+            spawn_logged(sw.stop(), name="simnodelet.worker_stop")
+
+    async def fault_forward(self, spec: str = None, clear=None):
+        # sim workers share this process's fault plane — the controller
+        # fan-out already applied the rules here once; forwarding would
+        # apply them again per worker
+        return 0
+
+    async def _forward_fault_inject(self, ws, spec, clear):
+        return None  # worker_register's injected-rule push, same reason
+
+
+class SimCluster:
+    """N sim nodelets attached to a live session's controller.
+
+    Usage (inside a running ``ray_tpu.init()`` session)::
+
+        cluster = SimCluster(n_nodes=100)
+        cluster.start()
+        ... drive tasks with resources={"sim": 1} ...
+        cluster.stop()
+
+    Sim nodes advertise ``{"CPU": cpus_per_node, "sim": sim_slots}``
+    plus a ``{"rtpu.sim": "1"}`` label. The driver's head node never
+    advertises ``sim``, so a ``resources={"sim": 1}`` task is locally
+    infeasible and must travel the real spill plane to a sim node.
+
+    Submit sim tasks with ``num_cpus=0`` (a task's implicit CPU:1
+    otherwise becomes the binding constraint in the spill picker's
+    optimistic debits: each wave then places only ``cpus_per_node``
+    tasks per peer no matter how many ``sim`` slots are free —
+    ``cpus_per_node`` defaults to ``sim_slots`` as a belt against
+    exactly that).
+    """
+
+    def __init__(self, n_nodes: int = 100, *, cpus_per_node: float = 64.0,
+                 sim_slots: float = 64.0, max_workers: int = 2,
+                 task_time_s: float = 0.0,
+                 session_name: Optional[str] = None,
+                 session_dir: Optional[str] = None,
+                 controller_addr: Optional[str] = None,
+                 labels: Optional[Dict[str, str]] = None):
+        if session_name is None or controller_addr is None:
+            core = get_core()
+            if core is None:
+                raise RuntimeError(
+                    "SimCluster needs a running session (ray_tpu.init()) "
+                    "or explicit session_name/session_dir/controller_addr")
+            session_name = session_name or core.session_name
+            session_dir = session_dir or core.session_dir
+            controller_addr = controller_addr or core.controller_addr
+        self.n_nodes = n_nodes
+        self.session_name = session_name
+        self.session_dir = session_dir
+        self.controller_addr = controller_addr
+        self.resources = {"CPU": cpus_per_node, SIM_RESOURCE: sim_slots}
+        self.max_workers = max_workers
+        self.task_time_s = task_time_s
+        self.labels = dict(labels or {}, **{"rtpu.sim": "1"})
+        self.nodelets: List[SimNodelet] = []
+        self._admin: Optional[RpcClient] = None
+
+    # ------------------------------------------------------------ lifecycle
+    def _loop(self):
+        from .rpc import EventLoopThread
+
+        return EventLoopThread.get()
+
+    def start(self, register_timeout_s: float = 60.0):
+        os.makedirs(os.path.join(self.session_dir, "sock"), exist_ok=True)
+        for i in range(self.n_nodes):
+            node_id = f"sim{i:04d}{NodeID.from_random().hex()[:24]}"
+            addr = f"unix:{self.session_dir}/sock/simn-{i:04d}.sock"
+            self.nodelets.append(SimNodelet(
+                session_name=self.session_name,
+                session_dir=self.session_dir,
+                node_id=node_id, address=addr,
+                controller_addr=self.controller_addr,
+                resources=dict(self.resources),
+                labels=dict(self.labels),
+                max_workers=self.max_workers,
+                sim_task_time_s=self.task_time_s))
+
+        async def boot():
+            # bounded waves: each start() registers with the controller,
+            # and an unbounded gather of hundreds just piles timeouts
+            for base in range(0, len(self.nodelets), 16):
+                await asyncio.gather(
+                    *(n.start() for n in self.nodelets[base:base + 16]))
+
+        self._loop().run(boot(), timeout=register_timeout_s)
+        return self
+
+    def stop(self):
+        async def teardown():
+            for base in range(0, len(self.nodelets), 16):
+                await asyncio.gather(
+                    *(n.stop() for n in self.nodelets[base:base + 16]),
+                    return_exceptions=True)
+
+        if self.nodelets:
+            self._loop().run(teardown(), timeout=120)
+        self.nodelets = []
+        if self._admin is not None:
+            self._admin.close()
+            self._admin = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ------------------------------------------------------------ admin
+    @property
+    def admin(self) -> RpcClient:
+        """A control client pinned to the controller address — survives
+        a standby takeover of the same address."""
+        if self._admin is None:
+            self._admin = RpcClient(self.controller_addr)
+        return self._admin
+
+    def status(self) -> dict:
+        return self.admin.call("cluster_status")
+
+    def alive_nodes(self) -> int:
+        nodes = self.status().get("nodes", {})
+        return sum(1 for n in nodes.values() if n.get("alive"))
+
+    def wait_alive(self, n: Optional[int] = None, timeout: float = 60.0):
+        """Block until the controller sees >= n alive nodes."""
+        want = self.n_nodes if n is None else n
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            alive = self.alive_nodes()
+            if alive >= want:
+                return alive
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"only {self.alive_nodes()} of {want} sim nodes alive "
+            f"after {timeout}s")
+
+    def tasks_run(self) -> int:
+        return sum(sw.tasks_run for n in self.nodelets
+                   for sw in n.sim_workers.values())
+
+    def gossip_stats(self) -> dict:
+        """Controller-side gossip counters (beats, entries shipped) —
+        the O(changed) assertion reads entries/beat from here."""
+        return dict(self.status().get("gossip", {}))

@@ -77,6 +77,12 @@ def pytest_configure(config):
         "replay↔reattach reconciliation) tests + the kill -9 restart "
         "drill in tests/test_chaos.py")
     config.addinivalue_line(
+        "markers", "simscale: scheduler scale envelope over the "
+        "in-process many-node harness (runtime/simcluster.py: real "
+        "nodelets, fake workers — task-burst drain, O(changed) gossip "
+        "fan-out, warm-standby failover reattach); the 100-node/100k "
+        "envelope itself is slow-marked + benchmarks/scale_envelope.py")
+    config.addinivalue_line(
         "markers", "pp: pipeline-parallel serving (multi-process stage "
         "engines over compiled-DAG channels: bit-exact greedy parity vs "
         "the single-process engine, zero steady-state control RPCs, "

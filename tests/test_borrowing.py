@@ -92,6 +92,7 @@ def test_owner_deletes_after_borrowers_drain(session):
     assert not core.store.contains(oid)
 
 
+@pytest.mark.slow
 def test_lineage_reconstruction_after_node_death(tmp_path):
     """Kill the node holding a task result before it is ever read; get()
     re-executes the producing task (ref: object_recovery_manager.h:43)."""

@@ -585,6 +585,7 @@ def _on(node_id):
     return NodeAffinitySchedulingStrategy(node_id=node_id)
 
 
+@pytest.mark.slow
 def test_drill_node_death_mid_dag_step(two_host, cfg_guard):
     """Kill the remote stage's worker process mid compiled-DAG steady
     state: the in-flight step must surface a typed, DEADLINE-bounded
@@ -624,6 +625,7 @@ def test_drill_node_death_mid_dag_step(two_host, cfg_guard):
     assert ray_tpu.get(alive.remote(), timeout=60) == 1
 
 
+@pytest.mark.slow
 def test_drill_ring_allreduce_rank_death(two_host, cfg_guard):
     """Kill one rank's worker mid ring-allreduce: the surviving rank and
     the driver converge on a typed error within the deadline instead of
@@ -655,6 +657,7 @@ def test_drill_ring_allreduce_rank_death(two_host, cfg_guard):
         rdag.teardown()
 
 
+@pytest.mark.slow
 def test_drill_source_death_mid_pull_converges(two_host, cfg_guard):
     """Prefill/source-node death mid cross-host pull (the KV-handoff
     failure mode): the puller's replicas all die, the typed loss
@@ -685,6 +688,7 @@ def test_drill_source_death_mid_pull_converges(two_host, cfg_guard):
     assert value.shape == (6 << 20,) and int(value[0]) == 7
 
 
+@pytest.mark.slow
 def test_drill_spill_storm_30pct_drop(cluster, cfg_guard):
     """30%-drop storm on the spill link: every frame the peer drops
     times out at the sender and re-enters placement — all tasks
@@ -1032,6 +1036,7 @@ def test_chan_push_backpressure_is_typed_and_retried(tmp_path,
 
 # --------------------------------------- drill: pp stage-rank death
 @pytest.mark.pp
+@pytest.mark.slow
 def test_drill_pp_stage_rank_death_mid_decode(fresh_cluster, cfg_guard):
     """SIGKILL one pipeline stage rank mid-decode: the driver must
     surface a typed ActorDiedError naming the dead rank (never an

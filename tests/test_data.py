@@ -715,6 +715,7 @@ def test_map_groups(shared_cluster):
     assert got == {0: (10, 27), 1: (10, 27), 2: (10, 27)}
 
 
+@pytest.mark.slow
 def test_to_tf(shared_cluster):
     """ref: dataset.py to_tf — tf.data pipeline over dataset batches."""
     tf = pytest.importorskip("tensorflow")

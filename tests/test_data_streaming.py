@@ -41,6 +41,7 @@ def _slow_map(delay):
 
 
 # ------------------------------------------------------------ the pipeline
+@pytest.mark.slow
 def test_ttfb_streams_far_ahead_of_full_drain():
     """>=100-block pipeline with a non-trivial map: the first batch must
     arrive >=5x earlier than full materialization (the streamed pump

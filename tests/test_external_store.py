@@ -85,6 +85,7 @@ def test_controller_failover_through_store_server(store_server, tmp_path):
         loop.run(c2.stop())
 
 
+@pytest.mark.slow
 def test_tcp_backend_degraded_detect_and_replay(tmp_path):
     """A store-server outage mid-run must not silently drop journal
     records: the backend flips `degraded`, buffers the lost sends, and
@@ -163,6 +164,7 @@ def test_file_backend_round_trip(tmp_path):
         EventLoopThread.get().run(c2.stop())
 
 
+@pytest.mark.slow
 def test_store_server_failover_mid_run(tmp_path):
     """Kill the store server MID-RUN, bring a replacement up from the
     same journal directory, and verify (a) the controller's backend

@@ -217,6 +217,7 @@ def test_handoff_registry_ttl_and_cap():
 
 # ---------------------------------------------------- bulk-plane handoff
 
+@pytest.mark.slow
 def test_handoff_seal_fetch_inject_parity(shared_cluster):
     """Prefill → seal (descriptor, no dense KV in the message) → fetch →
     inject → decode reproduces the colocated greedy output token for
@@ -518,6 +519,7 @@ def test_llm_cache_aware_routing_two_replicas(shared_cluster):
         serve.delete("kvroute")
 
 
+@pytest.mark.slow
 def test_pd_router_parity_breakdown_and_health(shared_cluster):
     """Disagg e2e over serve: PDRouter generation with the bulk-plane
     handoff is token-identical to the colocated engine (greedy); the

@@ -150,6 +150,7 @@ def test_weight_broadcast_ladder_one_uplink_per_round():
 
 # --------------------------------------------------------- cluster tier
 
+@pytest.mark.slow
 def test_pp_bit_exact_greedy_s2_and_broadcast_wiring(shared_cluster):
     """S=2, tp=1: token-identical greedy output vs the single-process
     engine, with the checkpoint landed via the PR-16 replica broadcast
@@ -191,6 +192,7 @@ def test_pp_bit_exact_greedy_s2_and_broadcast_wiring(shared_cluster):
         pp.shutdown()
 
 
+@pytest.mark.slow
 def test_pp_bit_exact_greedy_s2_tp2(shared_cluster):
     """S=2 stages, tp=2 INSIDE each stage (composed single-host TP):
     still token-identical vs the unsharded single-process engine."""
@@ -213,6 +215,7 @@ def test_pp_bit_exact_greedy_s2_tp2(shared_cluster):
         pp.shutdown()
 
 
+@pytest.mark.slow
 def test_pp_preemption_token_identical(shared_cluster):
     """OutOfPages mid-decode under pp: preempt -> re-prefill ->
     continue, still token-identical to the uncontended single-engine

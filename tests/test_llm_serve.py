@@ -70,6 +70,7 @@ def test_allocator_prefix_sharing_and_eviction():
 
 # --------------------------------------------------------------- engine
 
+@pytest.mark.slow
 def test_single_request_matches_dense_greedy():
     """Greedy engine output must equal token-by-token dense forward."""
     import jax
@@ -93,6 +94,7 @@ def test_single_request_matches_dense_greedy():
     assert got == want, (got, want)
 
 
+@pytest.mark.slow
 def test_continuous_batching_matches_solo_runs():
     """Concurrent greedy requests must produce the same tokens as each
     request run alone (batching must not change results)."""
@@ -165,6 +167,7 @@ def test_byte_tokenizer_roundtrip():
 
 # ---------------------------------------------------------- serve stack
 
+@pytest.mark.slow
 def test_openai_app_over_serve(shared_cluster):
     from ray_tpu import serve
     from ray_tpu.serve.llm import LLMConfig, build_openai_app
@@ -200,6 +203,7 @@ def test_openai_app_over_serve(shared_cluster):
         serve.delete("llm")
 
 
+@pytest.mark.slow
 def test_batch_llm_processor_pipeline(shared_cluster):
     """Batch inference Processor over ray_tpu.data (ref:
     llm/_internal/batch/processor/vllm_engine_proc.py + stages/)."""
@@ -262,6 +266,7 @@ def test_pd_handoff_matches_single_engine():
     assert out == ref_out
 
 
+@pytest.mark.slow
 def test_pd_disaggregated_app_over_serve(shared_cluster):
     from ray_tpu import serve
     from ray_tpu.serve.llm import LLMConfig, build_pd_openai_app
@@ -291,6 +296,7 @@ def test_pd_disaggregated_app_over_serve(shared_cluster):
         serve.delete("pdllm")
 
 
+@pytest.mark.slow
 def test_pd_concurrent_requests_one_replica(shared_cluster):
     """Concurrent requests through one Prefill + one Decode replica: the
     shared driver loop serializes engine stepping; every request must
@@ -338,6 +344,7 @@ def test_pd_prefill_respects_stop_on_first_token():
 
 # ----------------------------------------------------- tensor parallel
 
+@pytest.mark.slow
 def test_tp_sharded_engine_matches_single_device():
     """Greedy decode on a tp=2 engine (virtual 8-device mesh) must be
     token-identical to the single-device engine — batched, with fused
@@ -402,6 +409,7 @@ def test_tp_non_divisible_kv_heads_raises():
         resolve_serve_mesh(bad)
 
 
+@pytest.mark.slow
 def test_tp_pd_handoff_matches_single_engine():
     """Disaggregated prefill→decode across two tp=2 engines reproduces
     the single-device greedy output (the handoff blob is gathered from /
@@ -445,6 +453,7 @@ def test_tp_bundles_and_page_budget():
 
 # ------------------------------------- scheduler v2 (token budget/spec)
 
+@pytest.mark.slow
 def test_chunked_prefill_matches_unchunked():
     """prefill_chunk_tokens splits long prompts into per-step chunks
     (later chunks attend to earlier pages via the ctx-merge path);
@@ -466,6 +475,7 @@ def test_chunked_prefill_matches_unchunked():
     assert out == ref_out
 
 
+@pytest.mark.slow
 def test_chunked_prefill_interleave_bounds_itl():
     """While a max-bucket prompt prefills, a running slot's inter-token
     gap stays bounded with chunking on: the long prompt advances one
@@ -524,6 +534,7 @@ def test_chunked_prefill_interleave_bounds_itl():
     assert gap_on < gap_off, (gap_on, gap_off)
 
 
+@pytest.mark.slow
 def test_preemption_token_identical_after_readmission():
     """OutOfPages mid-decode -> preempt (recompute-style) -> re-admission
     must reproduce the uncontended greedy output token for token, and the
@@ -592,6 +603,7 @@ def test_prefix_aware_coadmission_skips_blocked_head():
     assert first_seen.index("sharer") < first_seen.index("stranger")
 
 
+@pytest.mark.slow
 def test_spec_decode_oracle_and_adversarial_drafts():
     """Speculative verification is bit-exact by construction: perfect
     drafts accept wholesale (many tokens per dispatch), hostile drafts
@@ -771,6 +783,7 @@ def test_multi_step_decode_matches_single_step():
     assert outs[1] == outs[4], (outs[1], outs[4])
 
 
+@pytest.mark.slow
 def test_multi_step_decode_batched_prefill_concurrent():
     """Concurrent requests through batched prefill + fused decode match
     the sequential single-step reference."""

@@ -62,6 +62,7 @@ def test_causality():
 
 
 @pytest.mark.parametrize("scan_layers", [True, False])
+@pytest.mark.slow
 def test_decode_with_cache_matches_full_forward(scan_layers):
     """Prefill + cached decode must reproduce the full-sequence logits."""
     import flax.linen as nn
@@ -100,6 +101,7 @@ def test_decode_with_cache_matches_full_forward(scan_layers):
                                    err_msg=f"position {t}")
 
 
+@pytest.mark.slow
 def test_sharded_training_loss_decreases(cpu_mesh_devices):
     cfg = get_config("debug-sharded")
     model = LlamaModel(cfg)
@@ -126,6 +128,7 @@ def test_sharded_training_loss_decreases(cpu_mesh_devices):
     "PR 6) — same GSPMD reduction-order parity family as the "
     "test_ring_attention train-step parity failure. Not strict: a future "
     "jax bump may restore parity.")
+@pytest.mark.slow
 def test_sharded_matches_single_device(cpu_mesh_devices):
     """The same seed on a sharded mesh and a single device must produce the
     same loss trajectory (GSPMD is numerics-preserving up to reduction
@@ -155,12 +158,14 @@ def test_sharded_matches_single_device(cpu_mesh_devices):
                                rtol=2e-2)
 
 
+@pytest.mark.slow
 def test_graft_entry_dryrun():
     import __graft_entry__ as graft
 
     graft.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_graft_entry_dryrun_odd_devices():
     import __graft_entry__ as graft
 

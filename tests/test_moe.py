@@ -88,6 +88,7 @@ def test_moe_capacity_drops_are_finite(tiny_moe):
     assert np.isfinite(np.asarray(logits, np.float32)).all()
 
 
+@pytest.mark.slow
 def test_moe_ep_sharded_training_matches_single_device(cpu_mesh_devices):
     from ray_tpu.parallel.mesh import MeshConfig, create_mesh
     from ray_tpu.parallel.train_lib import (ShardedTrainer,
@@ -119,6 +120,7 @@ def test_moe_ep_sharded_training_matches_single_device(cpu_mesh_devices):
                                rtol=2e-2)
 
 
+@pytest.mark.slow
 def test_moe_paged_decode_in_engine(shared_cluster):
     """The serving engine generates with an MoE model (paged KV + sparse
     FFN compose)."""

@@ -159,6 +159,7 @@ def test_tcp_cluster_bringup():
                         pass
 
 
+@pytest.mark.slow
 def test_broadcast_spreads_across_replicas(tmp_path):
     """Fan-out of one large object to several simulated hosts rides the
     replica directory: the owner routes later pullers at completed

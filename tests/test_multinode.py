@@ -58,6 +58,7 @@ def test_spillback_across_nodes(cluster):
     assert len(nodes) == 2, f"expected both nodes busy, saw {nodes}"
 
 
+@pytest.mark.slow
 def test_node_death_mid_task_retries_elsewhere(cluster):
     session, add = cluster
     node_b = add(num_cpus=2)
@@ -134,6 +135,7 @@ def test_collective_group_across_nodes(cluster):
     assert float(ra[0]) == 3.0 and float(rb[0]) == 3.0
 
 
+@pytest.mark.slow
 def test_node_partition_detected_and_recovered(cluster):
     """A frozen node (network-partition analog: SIGSTOP stops its
     heartbeats) is declared dead by the health sweep; the cluster keeps

@@ -795,3 +795,155 @@ def test_replayed_pg_partial_rereserve_keeps_held_bundles(
         elt.run(c2.stop())
         for srv in servers:
             elt.run(srv.stop())
+
+
+# -------------------------------------------------- journal compaction
+#
+# PR-20: under actor churn the journal used to grow without bound —
+# every named create/restart/death appended a record and nothing ever
+# folded the tail back into the snapshots, so replay cost was
+# O(lifetime churn). Compaction (journal_compact_records /
+# journal_compact_bytes) bounds both the on-disk tail and replay work,
+# and must stay crash-safe at every point inside _compact_journal.
+
+def _durable_state(ctrl) -> dict:
+    """The logical durable state a replayed controller must agree on:
+    live named-actor bindings, live actor specs, and the KV store."""
+    return {
+        "named": dict(ctrl.named_actors),
+        "live": {a.actor_id: a.spec.get("name")
+                 for a in ctrl.actors.values()
+                 if a.state != ACTOR_DEAD},
+        "kv": {ns: dict(kvs) for ns, kvs in ctrl.kv.items() if kvs},
+    }
+
+
+def _churn_spec(i: int) -> dict:
+    return {"name": f"churn-{i}", "namespace": "", "resources": {},
+            "max_restarts": 0, "class_name": "Churn"}
+
+
+def test_journal_compaction_bounds_churn(tmp_path, monkeypatch, cfg_guard):
+    """>=1000 named-actor churn cycles with a lowered record cap: the
+    journal tail, the replayed record count, and replay time all stay
+    bounded by the knob — not by how long the churn ran — and a fresh
+    controller over the same dir replays to the identical durable
+    state."""
+    cfg_guard.persist_fsync = "off"
+    monkeypatch.setattr(cfg_guard, "journal_compact_records", 200)
+    monkeypatch.setattr(cfg_guard, "journal_compact_bytes", 1 << 20)
+    pdir = str(tmp_path / "churn")
+
+    async def churn():
+        c = Controller("jc", f"unix:{tmp_path}/jc.sock", persist_dir=pdir)
+        for i in range(1000):
+            await c.register_actor(f"a{i}", _churn_spec(i))
+            await c.actor_died(f"a{i}", reason="churn",
+                               worker_failed=True)
+            await c.kv_put("bench", f"k{i % 16}", b"v%d" % i)
+        for i in range(5):  # survivors prove live state crosses compaction
+            await c.register_actor(f"keep{i}", _churn_spec(1000 + i))
+        await asyncio.sleep(0)  # let death-path schedule tasks settle
+        state = _durable_state(c)
+        comps, seq = c._compactions, c._journal_seq
+        c._store_backend.close()
+        return state, comps, seq
+
+    state, comps, seq = asyncio.run(churn())
+    # 3000+ journaled mutations against a 200-record cap: compaction
+    # must have run many times, and the surviving tail is one cap's
+    # worth of records, not the lifetime's
+    assert seq >= 3000
+    assert comps >= seq // 200 - 1, (comps, seq)
+    assert os.path.getsize(os.path.join(pdir, "kv.journal")) < 256 << 10
+    be = FileBackend(pdir)
+    _, records, _ = be.load_kv()
+    be.close()
+    assert len(records) <= 200 + 8, len(records)
+
+    t0 = time.monotonic()
+    c2 = Controller("jc2", f"unix:{tmp_path}/jc2.sock", persist_dir=pdir)
+    replay_s = time.monotonic() - t0
+    assert replay_s < 2.0, replay_s
+    assert _durable_state(c2) == state
+    # the 1000 dead churn actors were folded away, not replayed
+    assert len(c2.actors) < 64
+    assert c2.named_actors == {("", f"churn-{1000 + i}"): f"keep{i}"
+                               for i in range(5)}
+    c2._store_backend.close()
+
+
+def test_compaction_crash_images_replay_identical(tmp_path, monkeypatch,
+                                                  cfg_guard):
+    """kill -9 at every stage of _compact_journal recovers the same
+    state: images captured before compaction, between the meta rewrite
+    and the kv snapshot (the mid-compact window), and after — plus a
+    torn tail on a post-compaction append — all replay to the identical
+    durable state."""
+    import shutil
+
+    cfg_guard.persist_fsync = "off"
+    # caps high: compaction happens only when the test forces it
+    monkeypatch.setattr(cfg_guard, "journal_compact_records", 10 ** 9)
+    monkeypatch.setattr(cfg_guard, "journal_compact_bytes", 10 ** 12)
+    pdir = tmp_path / "crash"
+
+    def image(tag: str) -> str:
+        dst = tmp_path / f"img_{tag}"
+        shutil.copytree(pdir, dst)
+        return str(dst)
+
+    async def build():
+        c = Controller("cc", f"unix:{tmp_path}/cc.sock",
+                       persist_dir=str(pdir))
+        for i in range(60):
+            await c.register_actor(f"a{i}", _churn_spec(i))
+            if i % 3:
+                await c.actor_died(f"a{i}", reason="churn",
+                                   worker_failed=True)
+            await c.kv_put("ns", f"k{i % 7}", b"x%d" % i)
+        pre = image("pre")            # crash before compaction started
+        c._persist()                  # first half of _compact_journal
+        mid = image("mid")            # crash between meta and kv snapshot
+        c._compact_journal()
+        state = _durable_state(c)
+        post = image("post")          # crash after a clean compaction
+        # one append AFTER compaction, for the torn-tail matrix below
+        await c.register_actor("tail", _churn_spec(999))
+        state_tail = _durable_state(c)
+        c._store_backend.close()
+        return pre, mid, post, state, state_tail
+
+    pre, mid, post, state, state_tail = asyncio.run(build())
+
+    def replay(d: str) -> dict:
+        c = Controller("rr", f"unix:{tmp_path}/rr.sock", persist_dir=d)
+        got = _durable_state(c)
+        c._store_backend.close()
+        return got
+
+    for tag, img in (("pre", pre), ("mid", mid), ("post", post)):
+        assert replay(img) == state, tag
+        # replay itself compacts; a SECOND restart over the same dir
+        # must land on the same state again (no one-shot recovery)
+        assert replay(img) == state, f"{tag} second restart"
+
+    # torn-tail matrix over the post-compaction append: the journal
+    # holds exactly that one record, so truncate it at every byte —
+    # any torn prefix replays to the pre-append state, the full record
+    # to the appended one (same contract the FileBackend fuzz proves,
+    # here end-to-end through controller replay)
+    blob = (pdir / "kv.journal").read_bytes()
+    for cut in range(0, len(blob) + 1, max(1, len(blob) // 64)):
+        torn = tmp_path / "img_torn"
+        if torn.exists():
+            shutil.rmtree(torn)
+        shutil.copytree(pdir, torn)
+        (torn / "kv.journal").write_bytes(blob[:cut])
+        expect = state_tail if cut == len(blob) else state
+        assert replay(str(torn)) == expect, cut
+    # and the exact full-length cut
+    torn = tmp_path / "img_torn"
+    shutil.rmtree(torn)
+    shutil.copytree(pdir, torn)
+    assert replay(str(torn)) == state_tail

@@ -67,6 +67,7 @@ def test_gpipe_grads_match_sequential(pp_mesh):
         rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_pipelined_llama_matches_plain_and_trains(cpu_mesh_devices):
     from ray_tpu.models.llama import LlamaModel, get_config
     from ray_tpu.parallel.pp_train import PipelinedTrainer

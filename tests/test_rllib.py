@@ -37,6 +37,7 @@ def test_replay_buffer_wraps():
     assert set(np.unique(sample["x"])) <= set(range(4, 14))
 
 
+@pytest.mark.slow
 def test_ppo_learns_cartpole():
     config = (PPOConfig()
               .environment("CartPole-v1")
@@ -90,6 +91,7 @@ def test_dqn_improves_cartpole(tmp_path):
     algo.stop()
 
 
+@pytest.mark.slow
 def test_remote_env_runners(shared_cluster):
     config = (PPOConfig()
               .environment("CartPole-v1")
@@ -104,6 +106,7 @@ def test_remote_env_runners(shared_cluster):
     algo.stop()
 
 
+@pytest.mark.slow
 def test_multi_learner_dqn_data_parallel(shared_cluster):
     """DQN across 2 learner actors: gradients allreduced, target nets sync,
     params stay identical on both ranks."""
@@ -131,6 +134,7 @@ def test_multi_learner_dqn_data_parallel(shared_cluster):
     algo.stop()
 
 
+@pytest.mark.slow
 def test_ppo_with_tune(shared_cluster, tmp_path):
     from ray_tpu import tune
     from ray_tpu.rllib.algorithms.algorithm import as_trainable
@@ -228,6 +232,7 @@ def test_sac_pendulum_trains():
     algo.stop()
 
 
+@pytest.mark.slow
 def test_impala_learns_cartpole():
     from ray_tpu.rllib import IMPALAConfig
 
@@ -248,6 +253,7 @@ def test_impala_learns_cartpole():
     algo.stop()
 
 
+@pytest.mark.slow
 def test_appo_runs_async_with_remote_runners(shared_cluster):
     from ray_tpu.rllib import APPOConfig
 
@@ -411,6 +417,7 @@ class _ParityEnv:
                 {"__all__": False}, {})
 
 
+@pytest.mark.slow
 def test_multi_agent_ppo_learns_per_policy():
     from ray_tpu.rllib import MultiAgentPPOConfig
     from ray_tpu.rllib.core.rl_module import RLModuleSpec
@@ -436,6 +443,7 @@ def test_multi_agent_ppo_learns_per_policy():
     algo.stop()
 
 
+@pytest.mark.slow
 def test_multi_agent_shared_policy_and_remote_runners(shared_cluster):
     """One shared policy for all agents (mapping collapses agent ids) and
     remote runner actors."""
@@ -499,6 +507,7 @@ def test_multi_agent_shared_policy_and_remote_runners(shared_cluster):
     algo.stop()
 
 
+@pytest.mark.slow
 def test_cql_offline_conservative():
     """CQL trains from a fixed dataset and its penalty keeps Q-values on
     out-of-distribution actions below dataset actions (ref:
@@ -596,6 +605,7 @@ def test_dreamerv3_components():
     # approximately; exactness holds for the expectation above
 
 
+@pytest.mark.slow
 def test_dreamerv3_learns_on_cartpole(shared_cluster):
     """World model + imagination actor-critic improves CartPole returns
     (ref: rllib/algorithms/dreamerv3/dreamerv3.py). Small budget: the
@@ -624,6 +634,7 @@ def test_dreamerv3_learns_on_cartpole(shared_cluster):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_dreamerv3_cnn_learns_on_image_env(shared_cluster):
     """The world model's CNN encoder/decoder path (ref: rllib/algorithms/
     dreamerv3/tf/models/world_model.py CNN path) learns on a small image

@@ -177,6 +177,7 @@ def test_autoscaling_scales_up(serve_cluster):
     serve.delete("slow")
 
 
+@pytest.mark.slow
 def test_replica_failure_recovers(serve_cluster):
     @serve.deployment(num_replicas=1, health_check_period_s=0.3)
     class Fragile:

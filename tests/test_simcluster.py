@@ -1,0 +1,270 @@
+"""Scheduler scale envelope over the in-process many-node harness.
+
+runtime/simcluster.py boots N REAL nodelets (registration, heartbeats,
+gossip deltas, owner-side backlog batching, p2p/controller spill,
+leases) whose workers are in-process fakes — so these tests exercise
+control-plane scale paths a CI box could never host with real forks:
+
+- a task burst from one owner drains across the whole harness through
+  the real staging -> backlog frames -> spill -> dispatch pipeline;
+- idle gossip fan-out stays O(changed) per beat, not O(nodes);
+- the warm-standby controller takes over in-place primary death on
+  lease expiry in < 1s of activation, with every live actor REATTACHED
+  (same worker, zero restarts) rather than re-created.
+
+The tier-1 cases run a trimmed harness; the 100-node / 100k-task
+envelope (the PR-20 acceptance floor, also driven by
+benchmarks/scale_envelope.py) is marked ``slow``.
+"""
+
+import time
+
+import pytest
+
+from ray_tpu.runtime.config import get_config
+
+pytestmark = pytest.mark.simscale
+
+
+@pytest.fixture
+def sim_session(monkeypatch):
+    """A private session sized for harness tests: tiny head node, no
+    prestarted workers (sim tasks never run on the head)."""
+    monkeypatch.setenv("RTPU_prestart_workers", "0")
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    session = ray_tpu.init(num_cpus=2)
+    yield ray_tpu, session
+    try:
+        ray_tpu.shutdown()
+    except Exception:  # noqa: BLE001 — failover tests leave the primary dead; teardown is best-effort
+        pass
+
+
+def test_task_burst_drains_across_harness(sim_session):
+    """A 3000-task burst against 24 sim nodes completes through the
+    real owner staging/backlog/spill paths, lands spread across the
+    harness (not funneled through one node), and the owner reaches the
+    controller through batched pick_nodes waves, not per-task RPCs."""
+    ray_tpu, session = sim_session
+    from ray_tpu.runtime.simcluster import SimCluster
+
+    n_tasks = 3000
+    with SimCluster(n_nodes=24, max_workers=4) as cluster:
+        cluster.wait_alive(timeout=60)
+
+        @ray_tpu.remote(num_cpus=0, resources={"sim": 1})
+        def echo(x):
+            return x
+
+        refs = [echo.remote(i) for i in range(n_tasks)]
+        out = ray_tpu.get(refs, timeout=240)
+        assert out == list(range(n_tasks))
+        assert cluster.tasks_run() == n_tasks
+        busy = sum(1 for n in cluster.nodelets
+                   if any(sw.tasks_run for sw in n.sim_workers.values()))
+        assert busy >= 4, f"burst funneled onto {busy} node(s)"
+        head = dict(session.nodelet_inproc.sched_counters)
+        # batched placement: one pick_nodes wave covers hundreds of
+        # queued specs; per-task RPC volume would be ~n_tasks
+        assert head.get("pick_node_rpcs", 0) < n_tasks / 10, head
+
+
+def test_idle_gossip_fanout_is_o_changed(sim_session):
+    """With no membership/resource churn the per-beat view delta must
+    be near-empty regardless of node count — the O(changed) recency
+    index, not the old O(nodes) full-table scan per heartbeat."""
+    _, _ = sim_session
+    from ray_tpu.runtime.simcluster import SimCluster
+
+    n_nodes = 24
+    with SimCluster(n_nodes=n_nodes) as cluster:
+        cluster.wait_alive(timeout=60)
+        time.sleep(1.0)  # let registration-churn deltas drain
+        before = cluster.gossip_stats()
+        time.sleep(2.5)
+        after = cluster.gossip_stats()
+        beats = after["beats"] - before["beats"]
+        entries = after["entries"] - before["entries"]
+        assert beats > 0
+        per_beat = entries / beats
+        assert per_beat <= max(8.0, 0.2 * n_nodes), (
+            f"{per_beat:.1f} entries/beat at {n_nodes} nodes — "
+            "gossip fan-out is O(nodes), not O(changed)")
+
+
+def test_warm_standby_failover_reattaches_actors(sim_session):
+    """In-place primary death with live actors on the harness: the
+    standby promotes on lease expiry, activation takes < 1s
+    (rtpu_recovery_ms{scenario=controller_failover}), and every actor
+    comes back as ITS OWN worker — same address, zero restarts, zero
+    extra incarnations — with handles still working."""
+    ray_tpu, session = sim_session
+    from ray_tpu.runtime import rpc as rtpu_rpc
+    from ray_tpu.runtime.controller import StandbyController
+    from ray_tpu.runtime.simcluster import SimCluster
+    from ray_tpu.util import metrics as rtpu_metrics
+
+    cfg = get_config()
+    saved = {k: getattr(cfg, k) for k in
+             ("standby_lease_timeout_s", "standby_poll_interval_s")}
+    cfg.standby_lease_timeout_s = 0.8
+    cfg.standby_poll_interval_s = 0.1
+    n_actors = 6
+    standby = None
+    try:
+        with SimCluster(n_nodes=8, max_workers=4) as cluster:
+            cluster.wait_alive(timeout=60)
+
+            @ray_tpu.remote(num_cpus=0, resources={"sim": 1})
+            class Survivor:
+                def ping(self, x):
+                    return x
+
+            actors = [Survivor.options(name=f"fo-{i}").remote()
+                      for i in range(n_actors)]
+            assert ray_tpu.get(
+                [a.ping.remote(i) for i, a in enumerate(actors)],
+                timeout=60) == list(range(n_actors))
+            pre = {row["actor_id"]: row for row in
+                   session.core.controller.call("list_actors")
+                   if row.get("state") == "ALIVE"}
+            assert len(pre) >= n_actors
+
+            elt = rtpu_rpc.EventLoopThread.get()
+            ctrl = session.controller_inproc
+            standby = StandbyController(
+                session.session_name, session.controller_addr)
+            elt.run(standby.start())
+
+            # in-place primary death: cancel the health loop, close the
+            # server — the kill -9 analogue that frees the address
+            elt.loop.call_soon_threadsafe(ctrl._health_task.cancel)
+            elt.run(ctrl._server.stop())
+            deadline = time.monotonic() + 8 * cfg.standby_lease_timeout_s
+            while standby.promoted is None and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert standby.promoted is not None, \
+                "standby never promoted on lease expiry"
+
+            snap = rtpu_metrics.snapshot("rtpu_recovery_ms")
+            rec_ms = snap.get(
+                "rtpu_recovery_ms{scenario=controller_failover}")
+            assert rec_ms is not None and rec_ms < 1000.0, rec_ms
+
+            cluster.wait_alive(timeout=60)
+            post = {}
+            t_wait = time.monotonic() + 60
+            while time.monotonic() < t_wait:
+                post = {row["actor_id"]: row for row in
+                        session.core.controller.call("list_actors")
+                        if row.get("state") == "ALIVE"}
+                if all(a in post for a in pre):
+                    break
+                time.sleep(0.1)
+            missing = [a for a in pre if a not in post]
+            assert not missing, f"{len(missing)} actors lost in failover"
+            # reattached, not re-created
+            recreated = [
+                a for a in pre
+                if post[a].get("address") != pre[a].get("address")
+                or post[a].get("num_restarts", 0)
+                != pre[a].get("num_restarts", 0)]
+            assert not recreated, f"{len(recreated)} actors re-created"
+            # exactly one live incarnation per actor
+            dupes = [a for a, row in post.items() if a not in pre
+                     and str(row.get("name", "")).startswith("fo-")]
+            assert not dupes, f"{len(dupes)} extra live incarnations"
+            assert ray_tpu.get(
+                [a.ping.remote(i) for i, a in enumerate(actors)],
+                timeout=60) == list(range(n_actors))
+            for a in actors:
+                ray_tpu.kill(a)
+    finally:
+        for k, v in saved.items():
+            setattr(cfg, k, v)
+        if standby is not None:
+            import ray_tpu as _rt
+
+            try:
+                _rt.shutdown()
+            except Exception:  # noqa: BLE001 — the dead primary makes teardown best-effort
+                pass
+            rtpu_rpc.EventLoopThread.get().run(standby.stop())
+
+
+def test_explicit_standby_promote_rpc(sim_session):
+    """`standby_promote` takes over WITHOUT waiting out the lease — the
+    operator's forced-failover path. The follower's `standby_status`
+    surface reports its stream position before and after."""
+    ray_tpu, session = sim_session
+    from ray_tpu.runtime import rpc as rtpu_rpc
+    from ray_tpu.runtime.controller import StandbyController
+    from ray_tpu.runtime.simcluster import SimCluster
+
+    standby = None
+    elt = rtpu_rpc.EventLoopThread.get()
+    try:
+        with SimCluster(n_nodes=4) as cluster:
+            cluster.wait_alive(timeout=60)
+            standby_addr = \
+                f"unix:{session.session_dir}/sock/standby-x.sock"
+            standby = StandbyController(
+                session.session_name, session.controller_addr,
+                listen_address=standby_addr)
+            elt.run(standby.start())
+            probe = rtpu_rpc.RpcClient(standby_addr)
+            status = probe.call("standby_status")
+            assert not status["promoted"]
+            assert status["primary_address"] == session.controller_addr
+
+            ctrl = session.controller_inproc
+            elt.loop.call_soon_threadsafe(ctrl._health_task.cancel)
+            elt.run(ctrl._server.stop())
+            out = probe.call("standby_promote", _timeout=30)
+            assert out["promoted"]
+            status = probe.call("standby_status")
+            assert status["promoted"]
+            probe.close()
+            # the promoted controller serves THE controller address
+            assert cluster.wait_alive(timeout=60) == 4
+    finally:
+        if standby is not None:
+            import ray_tpu as _rt
+
+            try:
+                _rt.shutdown()
+            except Exception:  # noqa: BLE001 — the dead primary makes teardown best-effort
+                pass
+            elt.run(standby.stop())
+
+
+@pytest.mark.slow
+def test_scale_envelope_100_nodes_100k_tasks(sim_session):
+    """The PR-20 acceptance floor: 100 nodelets, 100k queued tasks from
+    one owner, all completing through the real control-plane paths with
+    bounded controller traffic and no spill ping-pong."""
+    ray_tpu, session = sim_session
+    from ray_tpu.runtime.simcluster import SimCluster
+
+    n_tasks = 100_000
+    with SimCluster(n_nodes=100, max_workers=4) as cluster:
+        cluster.wait_alive(timeout=120)
+
+        @ray_tpu.remote(num_cpus=0, resources={"sim": 1})
+        def echo(x):
+            return x
+
+        refs = [echo.remote(i) for i in range(n_tasks)]
+        out = ray_tpu.get(refs, timeout=500)
+        assert out[12345] == 12345
+        ran = cluster.tasks_run()
+        # every task ran on the harness; a small duplicate-dispatch
+        # tail (spill re-sends racing completion, deduped at the
+        # owner) is expected under saturation but must stay bounded
+        assert n_tasks <= ran <= n_tasks * 1.05, ran
+        head = dict(session.nodelet_inproc.sched_counters)
+        assert head.get("pick_node_rpcs", 0) < 2000, head
+        assert head.get("spill_bounces", 0) < n_tasks / 100, head

@@ -208,6 +208,7 @@ def test_cancel_never_overtakes_submit(shared_cluster):
 
 
 # ----------------------------------------------------------------- chaos
+@pytest.mark.slow
 def test_chaos_drops_apply_to_batched_submissions(shared_cluster):
     """testing_rpc_failure rules keyed on submit_task drop individual
     specs on the coalesced path too (in-process _call_local route): with

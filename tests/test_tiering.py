@@ -469,6 +469,7 @@ def test_broadcast_binomial_ladder_lands_everywhere(tmp_path, tier_cfg):
         elt.run(s.stop())
 
 
+@pytest.mark.slow
 def test_broadcast_chain_and_dead_node_failover(tmp_path, tier_cfg):
     """fanout=1 builds a chain; a dead node mid-chain reports failed
     while its child falls back to pulling from the owner — one dead node

@@ -244,6 +244,7 @@ def test_dataset_sharding_consistent_across_workers(shared_cluster, tmp_path):
     assert len(ids0) > 0
 
 
+@pytest.mark.slow
 def test_torch_trainer_ddp_gloo(fresh_cluster, tmp_path):
     """TorchTrainer parity: 2 workers, gloo process group, DDP-wrapped
     model converges on a toy regression (ref: the reference's flagship
@@ -279,6 +280,7 @@ def test_torch_trainer_ddp_gloo(fresh_cluster, tmp_path):
     assert result.metrics["loss"] < 0.1, result.metrics
 
 
+@pytest.mark.slow
 def test_transformers_integration_reports(fresh_cluster, tmp_path):
     """HF Trainer logs flow through RayTrainReportCallback into train
     reports (ref: train/huggingface/transformers/_transformers_utils.py

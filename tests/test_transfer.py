@@ -300,6 +300,7 @@ def _on_node(node_id):
     return NodeAffinitySchedulingStrategy(node_id=node_id)
 
 
+@pytest.mark.slow
 def test_cross_host_pull_rides_bulk_stream(two_host_session):
     """Tier-1 localhost stream test: a result produced on the simulated
     host B reaches the driver over the bulk stream (not om_read), and
@@ -326,6 +327,7 @@ def test_cross_host_pull_rides_bulk_stream(two_host_session):
     assert stats["rpc_bytes_in"] == 0, stats
 
 
+@pytest.mark.slow
 def test_cross_host_pull_rpc_fallback_end_to_end(two_host_session):
     """Same flow with the stream disabled on the puller: the pull rides
     om_read and the value is still exact."""

@@ -338,6 +338,7 @@ def _run_restore_cycle(tmp_path, scheduler_src):
     return grid
 
 
+@pytest.mark.slow
 def test_tuner_restore_after_driver_kill_asha(shared_cluster, tmp_path):
     grid = _run_restore_cycle(
         tmp_path,
@@ -348,6 +349,7 @@ def test_tuner_restore_after_driver_kill_asha(shared_cluster, tmp_path):
     assert best.metrics["score"] == 36  # x=3 * 12 iterations
 
 
+@pytest.mark.slow
 def test_tuner_restore_after_driver_kill_pbt(shared_cluster, tmp_path):
     grid = _run_restore_cycle(
         tmp_path,
